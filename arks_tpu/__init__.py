@@ -1,0 +1,20 @@
+"""arks-tpu: a TPU-native LLM inference orchestration framework.
+
+A ground-up re-creation of the capabilities of scitix/arks (a Kubernetes
+operator + gateway data plane for LLM inference, reference at
+/root/reference) with TPU/JAX as the first-class runtime:
+
+- ``arks_tpu.models`` / ``arks_tpu.ops`` — JAX transformer forward passes
+  (Qwen2/Llama families), RoPE/RMSNorm/attention ops, Pallas kernels.
+- ``arks_tpu.engine`` — continuous-batching serving engine (the part the
+  reference delegates to vLLM/SGLang runtime containers).
+- ``arks_tpu.parallel`` — device mesh, tensor-parallel sharding over ICI,
+  multi-host distributed bootstrap (replaces Ray/NCCL rendezvous).
+- ``arks_tpu.server`` — OpenAI-compatible HTTP serving surface on :8080.
+- ``arks_tpu.control`` — resource schemas + reconcilers mirroring the
+  reference's CRDs/controllers (api/v1, internal/controller).
+- ``arks_tpu.gateway`` — auth / rate-limit / quota / metrics data plane
+  mirroring the reference's Envoy ext_proc plugin (pkg/gateway).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from arks_tpu.models.config import ModelConfig, get_config, register_config
+
+__all__ = ["ModelConfig", "get_config", "register_config"]

@@ -1,0 +1,157 @@
+"""Model architecture configs for the arks-tpu serving engine.
+
+The reference framework (scitix/arks) never touches model architecture — it
+passes a HuggingFace model directory to vLLM/SGLang containers
+(/root/reference/internal/controller/arksapplication_controller.go:941-1014).
+Here the engine is ours, so architecture configs are first-class.  Presets
+cover the model families named in BASELINE.json (Qwen2.5 at 0.5B/1.5B/7B/72B,
+Llama-3-8B) plus a ``tiny`` config for CPU-mesh tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False  # Qwen2-family uses bias on q/k/v projections.
+    max_position_embeddings: int = 32768
+    dtype: str = "bfloat16"
+    eos_token_ids: tuple[int, ...] = ()
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        e, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        attn = e * self.q_dim + 2 * e * self.kv_dim + self.q_dim * e
+        if self.qkv_bias:
+            attn += self.q_dim + 2 * self.kv_dim
+        mlp = 3 * e * f
+        norms = 2 * e
+        blocks = self.num_layers * (attn + mlp + norms)
+        head = 0 if self.tie_word_embeddings else e * v
+        return v * e + blocks + e + head
+
+    @staticmethod
+    def from_hf_config(path_or_dict: str | dict[str, Any], name: str = "") -> "ModelConfig":
+        """Build a config from a HuggingFace ``config.json`` (Qwen2/Llama style)."""
+        if isinstance(path_or_dict, str):
+            p = path_or_dict
+            if os.path.isdir(p):
+                p = os.path.join(p, "config.json")
+            with open(p) as f:
+                d = json.load(f)
+        else:
+            d = dict(path_or_dict)
+        arch = (d.get("architectures") or [""])[0].lower()
+        qkv_bias = "qwen2" in arch or d.get("model_type", "") == "qwen2"
+        heads = d["num_attention_heads"]
+        eos = d.get("eos_token_id") or ()
+        if isinstance(eos, int):
+            eos = (eos,)
+        return ModelConfig(
+            name=name or d.get("model_type", "hf-model"),
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d["intermediate_size"],
+            num_layers=d["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=d.get("num_key_value_heads", heads),
+            head_dim=d.get("head_dim", d["hidden_size"] // heads),
+            rope_theta=float(d.get("rope_theta", 10000.0)),
+            rms_norm_eps=float(d.get("rms_norm_eps", 1e-6)),
+            tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
+            qkv_bias=qkv_bias,
+            max_position_embeddings=int(d.get("max_position_embeddings", 32768)),
+            eos_token_ids=tuple(eos),
+        )
+
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def register_config(cfg: ModelConfig) -> ModelConfig:
+    _REGISTRY[cfg.name.lower()] = cfg
+    return cfg
+
+
+def get_config(name: str) -> ModelConfig:
+    key = name.lower()
+    if key in _REGISTRY:
+        return _REGISTRY[key]
+    raise KeyError(f"unknown model config {name!r}; known: {sorted(_REGISTRY)}")
+
+
+# Tiny config for CPU-mesh tests: dims divisible by 8 so every mesh shape works.
+register_config(ModelConfig(
+    name="tiny", vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=8, num_kv_heads=8, head_dim=8,
+    qkv_bias=True, eos_token_ids=(0,),
+))
+register_config(ModelConfig(
+    name="tiny-gqa", vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+    qkv_bias=True, eos_token_ids=(0,),
+))
+
+# Qwen2.5 family (HF: Qwen/Qwen2.5-*-Instruct).
+register_config(ModelConfig(
+    name="qwen2.5-0.5b", vocab_size=151936, hidden_size=896,
+    intermediate_size=4864, num_layers=24, num_heads=14, num_kv_heads=2,
+    head_dim=64, rope_theta=1000000.0, tie_word_embeddings=True,
+    qkv_bias=True, eos_token_ids=(151645, 151643),
+))
+register_config(ModelConfig(
+    name="qwen2.5-1.5b", vocab_size=151936, hidden_size=1536,
+    intermediate_size=8960, num_layers=28, num_heads=12, num_kv_heads=2,
+    head_dim=128, rope_theta=1000000.0, tie_word_embeddings=True,
+    qkv_bias=True, eos_token_ids=(151645, 151643),
+))
+register_config(ModelConfig(
+    name="qwen2.5-7b", vocab_size=152064, hidden_size=3584,
+    intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+    head_dim=128, rope_theta=1000000.0, qkv_bias=True,
+    eos_token_ids=(151645, 151643),
+))
+register_config(ModelConfig(
+    name="qwen2.5-72b", vocab_size=152064, hidden_size=8192,
+    intermediate_size=29568, num_layers=80, num_heads=64, num_kv_heads=8,
+    head_dim=128, rope_theta=1000000.0, qkv_bias=True,
+    eos_token_ids=(151645, 151643),
+))
+
+# Llama-3 family.
+register_config(ModelConfig(
+    name="llama3-8b", vocab_size=128256, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=500000.0, rms_norm_eps=1e-5,
+    eos_token_ids=(128001, 128009),
+))
+register_config(ModelConfig(
+    name="llama3-70b", vocab_size=128256, hidden_size=8192,
+    intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+    head_dim=128, rope_theta=500000.0, rms_norm_eps=1e-5,
+    eos_token_ids=(128001, 128009),
+))

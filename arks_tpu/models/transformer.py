@@ -1,0 +1,333 @@
+"""Functional decoder-only transformer (Qwen2 / Llama families) for serving.
+
+Design notes (TPU-first, not a port — the reference has no model code at all;
+it shells out to vLLM/SGLang containers):
+
+- Layers are **stacked**: every per-layer weight carries a leading [L] dim and
+  the forward pass is one ``lax.scan`` over layers.  One trace + one compile
+  regardless of depth, and uniform sharding per leaf.
+- Serving follows the slot model (JetStream-style): a decode batch of B slots,
+  each slot owning a [S] stretch of KV cache.  ``prefill`` runs a prompt
+  through the model producing its KV; ``insert`` drops that KV into a free
+  slot; ``decode_step`` advances every slot by one token.
+- Tensor parallelism is Megatron-pattern via weight PartitionSpecs over the
+  ``model`` mesh axis (column-parallel qkv/gate/up, row-parallel o/down); XLA
+  inserts the psums over ICI.  Batch parallelism rides the ``data`` axis.
+- KV heads shard over ``model`` when divisible; otherwise KV projections and
+  cache are replicated (cheap: GQA KV dims are small) — this keeps e.g.
+  Qwen2.5-7B (4 KV heads) correct on an 8-way TP mesh.
+
+Reference parity anchor: this module + arks_tpu.engine replace the runtime
+containers listed in /root/reference/api/v1/arksapplication_types.go:46-49.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from arks_tpu.models.config import ModelConfig
+from arks_tpu.ops.attention import decode_attention, prefill_attention
+from arks_tpu.ops.norms import rms_norm
+from arks_tpu.ops.rope import apply_rope
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Decode KV cache: [num_layers, num_slots, max_len, num_kv_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + sharding specs
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype | None = None) -> Params:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    l, e, f, v = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    qd, kvd = cfg.q_dim, cfg.kv_dim
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers: Params = {
+        "attn_norm": jnp.ones((l, e), dtype),
+        "wq": w(next(keys), (l, e, qd)),
+        "wk": w(next(keys), (l, e, kvd)),
+        "wv": w(next(keys), (l, e, kvd)),
+        "wo": w(next(keys), (l, qd, e)),
+        "mlp_norm": jnp.ones((l, e), dtype),
+        "w_gate": w(next(keys), (l, e, f)),
+        "w_up": w(next(keys), (l, e, f)),
+        "w_down": w(next(keys), (l, f, e)),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((l, qd), dtype)
+        layers["bk"] = jnp.zeros((l, kvd), dtype)
+        layers["bv"] = jnp.zeros((l, kvd), dtype)
+    params: Params = {
+        "embed": w(next(keys), (v, e)),
+        "layers": layers,
+        "final_norm": jnp.ones((e,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (e, v))
+    return params
+
+
+def shard_kv_heads(cfg: ModelConfig, tp: int) -> bool:
+    return tp > 1 and cfg.num_kv_heads % tp == 0
+
+
+def param_pspecs(cfg: ModelConfig, tp: int = 1) -> Params:
+    """PartitionSpec pytree matching ``init_params`` (leading [L] dim on layers)."""
+    kv = P(None, None, AXIS_MODEL) if shard_kv_heads(cfg, tp) else P(None, None, None)
+    kvb = P(None, AXIS_MODEL) if shard_kv_heads(cfg, tp) else P(None, None)
+    layers: Params = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, AXIS_MODEL),
+        "wk": kv,
+        "wv": kv,
+        "wo": P(None, AXIS_MODEL, None),
+        "mlp_norm": P(None, None),
+        "w_gate": P(None, None, AXIS_MODEL),
+        "w_up": P(None, None, AXIS_MODEL),
+        "w_down": P(None, AXIS_MODEL, None),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = P(None, AXIS_MODEL)
+        layers["bk"] = kvb
+        layers["bv"] = kvb
+    specs: Params = {
+        "embed": P(AXIS_MODEL, None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, AXIS_MODEL)
+    return specs
+
+
+def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
+               dtype: jnp.dtype | None = None) -> KVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1) -> KVCache:
+    batch = AXIS_DATA if dp > 1 else None
+    heads = AXIS_MODEL if shard_kv_heads(cfg, tp) else None
+    spec = P(None, batch, None, heads, None)
+    return KVCache(k=spec, v=spec)
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    tp = mesh.shape.get(AXIS_MODEL, 1)
+    specs = param_pspecs(cfg, tp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
+    tp = mesh.shape.get(AXIS_MODEL, 1)
+    dp = mesh.shape.get(AXIS_DATA, 1)
+    specs = cache_pspecs(cfg, tp, dp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), cache, specs)
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+
+def _constrain(x: jnp.ndarray, mesh: Mesh | None, *spec) -> jnp.ndarray:
+    if mesh is None or mesh.size == 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def _qkv(h: jnp.ndarray, lp: Params, cfg: ModelConfig):
+    q = jnp.einsum("...e,eq->...q", h, lp["wq"])
+    k = jnp.einsum("...e,ek->...k", h, lp["wk"])
+    v = jnp.einsum("...e,ek->...k", h, lp["wv"])
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    return q, k, v
+
+
+def _mlp(h: jnp.ndarray, lp: Params, cfg: ModelConfig, mesh: Mesh | None,
+         batch_axis: str | None) -> jnp.ndarray:
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    gate = jnp.einsum("...e,ef->...f", x, lp["w_gate"])
+    up = jnp.einsum("...e,ef->...f", x, lp["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
+    act = _constrain(act, mesh, *([batch_axis] + [None] * (act.ndim - 2) + [AXIS_MODEL]))
+    return jnp.einsum("...f,fe->...e", act, lp["w_down"])
+
+
+def _unembed(h_last: jnp.ndarray, params: Params, cfg: ModelConfig,
+             mesh: Mesh | None, batch_axis: str | None) -> jnp.ndarray:
+    h_last = rms_norm(h_last, params["final_norm"], cfg.rms_norm_eps)
+    table = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", h_last, table).astype(jnp.float32)
+    return _constrain(logits, mesh, batch_axis, None)
+
+
+def prefill_layer(
+    h: jnp.ndarray,       # [B, T, E]
+    lp: Params,
+    cfg: ModelConfig,
+    positions: jnp.ndarray,  # [B, T]
+    mesh: Mesh | None = None,
+    batch_axis: str | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer block over a full sequence. Returns (h, k, v) — the
+    single layer body shared by serving prefill and the training forward
+    (train discards k/v; XLA dead-code-eliminates them there)."""
+    b, t = h.shape[:2]
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(x, lp, cfg)
+    q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    attn = prefill_attention(q, k, v).reshape(b, t, cfg.q_dim)
+    attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
+    h = h + jnp.einsum("...q,qe->...e", attn, lp["wo"])
+    h = h + _mlp(h, lp, cfg, mesh, batch_axis)
+    return h, k, v
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,   # [B, T] int32, padded to bucket length T
+    lengths: jnp.ndarray,  # [B] int32 true lengths (<= T)
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run full prompts. Returns (last-token logits [B, V] float32,
+    k [L, B, T, Hkv, D], v [L, B, T, Hkv, D]) for cache insertion."""
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    h = jnp.take(params["embed"], tokens, axis=0)
+    h = _constrain(h, mesh, None, None, None)
+
+    def body(h, lp):
+        h, k, v = prefill_layer(h, lp, cfg, positions, mesh)
+        return h, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(body, h, params["layers"])
+    h_last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = _unembed(h_last, params, cfg, mesh, None)
+    return logits, ks, vs
+
+
+def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
+           slot: jnp.ndarray) -> KVCache:
+    """Insert prefill KV ([L, 1, T, Hkv, D]) into decode cache at ``slot``.
+
+    T must be <= cache max_len; entries beyond the true length are masked by
+    the per-slot length at decode time and overwritten as decoding proceeds.
+    """
+    start = (0, slot.astype(jnp.int32), 0, 0, 0)
+    return KVCache(
+        k=jax.lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
+        v=jax.lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start),
+    )
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: KVCache,
+    tokens: jnp.ndarray,   # [B] int32 — current token per slot
+    lengths: jnp.ndarray,  # [B] int32 — tokens already in cache per slot
+    mesh: Mesh | None = None,
+    batch_axis: str | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Advance every slot one token. The current token's KV is written at
+    position ``lengths`` (so the new valid length is lengths+1). Returns
+    (logits [B, V] float32, updated cache).
+
+    PRECONDITION: lengths[b] < cache.max_len for every active slot.  At
+    lengths == max_len the KV scatter is silently dropped (JAX out-of-bounds
+    scatter semantics) and logits would be computed against stale cache — the
+    engine must retire or evict a slot before it fills (see
+    arks_tpu.engine.scheduler)."""
+    b = tokens.shape[0]
+    h = jnp.take(params["embed"], tokens, axis=0)  # [B, E]
+    h = _constrain(h, mesh, batch_axis, None)
+    write_idx = lengths.astype(jnp.int32)
+
+    def body(carry, xs):
+        h = carry
+        lp, kc, vc = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(x, lp, cfg)
+        q = q.reshape(b, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, write_idx, cfg.rope_theta)
+        k = apply_rope(k, write_idx, cfg.rope_theta)
+        kc = kc.at[jnp.arange(b), write_idx].set(k.astype(kc.dtype))
+        vc = vc.at[jnp.arange(b), write_idx].set(v.astype(vc.dtype))
+        attn = decode_attention(q, kc, vc, write_idx + 1).reshape(b, cfg.q_dim)
+        attn = _constrain(attn, mesh, batch_axis, AXIS_MODEL)
+        h = h + jnp.einsum("bq,qe->be", attn, lp["wo"])
+        h = h + _mlp(h, lp, cfg, mesh, batch_axis)
+        return h, (kc, vc)
+
+    h, (ks, vs) = jax.lax.scan(
+        body, h, (params["layers"], cache.k, cache.v))
+    logits = _unembed(h, params, cfg, mesh, batch_axis)
+    return logits, KVCache(k=ks, v=vs)
+
+
+# ---------------------------------------------------------------------------
+# Jit wrappers
+# ---------------------------------------------------------------------------
+
+
+def make_prefill_fn(cfg: ModelConfig, mesh: Mesh | None = None):
+    fn = functools.partial(prefill, cfg=cfg, mesh=mesh)
+    return jax.jit(lambda params, tokens, lengths: fn(params, tokens=tokens, lengths=lengths))
+
+
+def make_decode_fn(cfg: ModelConfig, mesh: Mesh | None = None,
+                   batch_axis: str | None = None):
+    fn = functools.partial(decode_step, cfg=cfg, mesh=mesh, batch_axis=batch_axis)
+    return jax.jit(
+        lambda params, cache, tokens, lengths: fn(params, cache=cache, tokens=tokens, lengths=lengths),
+        donate_argnums=(1,),
+    )
+
+
+def make_insert_fn(cfg: ModelConfig, mesh: Mesh | None = None):
+    del cfg, mesh
+    return jax.jit(insert, donate_argnums=(0,))

@@ -1,0 +1,4 @@
+from arks_tpu.ops.norms import rms_norm
+from arks_tpu.ops.rope import apply_rope
+
+__all__ = ["rms_norm", "apply_rope"]

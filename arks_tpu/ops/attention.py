@@ -1,0 +1,85 @@
+"""Attention ops (XLA path) for prefill and decode.
+
+The reference delegates attention entirely to vLLM/SGLang CUDA kernels inside
+runtime containers (/root/reference/internal/controller/
+arksapplication_controller.go:941-1014 only builds their command lines).
+Here attention is ours.  This module is the pure-XLA formulation — large
+batched einsums that tile onto the MXU, masks as fused elementwise selects.
+A Pallas ragged/paged kernel (arks_tpu.ops.pallas_attention) can override the
+decode path; this is the portable fallback and the CPU-test reference.
+
+Conventions:
+- GQA everywhere: q heads H = G * Hkv.  q is reshaped to [.., Hkv, G, ..] so
+  the kv head dim lines up for a single einsum (no repeat_kv materialization).
+- Inputs stay in their storage dtype (bf16 on TPU); matmuls accumulate in
+  float32 via ``preferred_element_type`` — never materialize f32 casts of the
+  KV cache (that would multiply decode HBM traffic by 2x).
+- Softmax in float32 with max subtraction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _softmax(scores: jnp.ndarray, axis: int) -> jnp.ndarray:
+    scores = scores - jnp.max(scores, axis=axis, keepdims=True)
+    unnorm = jnp.exp(scores)
+    return unnorm / (jnp.sum(unnorm, axis=axis, keepdims=True) + 1e-9)
+
+
+def prefill_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,  # [B, T, Hkv, D]
+) -> jnp.ndarray:
+    """Causal self-attention over a full (padded) prompt. Returns [B, T, H, D].
+
+    Padded positions are handled by the caller: their outputs are garbage but
+    never read (only the last valid token's logits are used), and their K/V
+    entries are masked at decode time by the cache length.
+    """
+    b, t, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, t, hkv, g, d)
+    scale = 1.0 / (d ** 0.5)
+    # [B, Hkv, G, Tq, Tk], f32 accumulation on the MXU.
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    causal = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]  # [Tq, Tk]
+    scores = jnp.where(causal[None, None, None], scores, _NEG_INF)
+    probs = _softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,        # [B, H, D] — one new token per slot
+    k_cache: jnp.ndarray,  # [B, S, Hkv, D]
+    v_cache: jnp.ndarray,  # [B, S, Hkv, D]
+    lengths: jnp.ndarray,  # [B] int32 — number of valid cache entries per slot
+) -> jnp.ndarray:
+    """Masked attention of one query token per slot against the slot KV cache.
+
+    Cache index s is valid iff s < lengths[b] (the caller writes the current
+    token's K/V into the cache *before* calling, so lengths includes it).
+    Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    s = k_cache.shape[1]
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, hkv, g, d)
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(s)[None] < lengths[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+    probs = _softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, h, d).astype(q.dtype)

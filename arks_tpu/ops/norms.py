@@ -1,0 +1,14 @@
+"""Normalization ops. Computed in float32, cast back to the input dtype —
+the standard TPU recipe so bf16 activations don't lose the variance sum."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * (1.0 / jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(dtype)

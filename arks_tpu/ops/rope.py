@@ -1,0 +1,33 @@
+"""Rotary position embeddings (NeoX/Llama interleaving: rotate_half).
+
+Position-indexed on the fly (no precomputed table) so the same code path
+serves prefill ([B, T] positions) and decode ([B] positions) — XLA fuses the
+sin/cos into the surrounding elementwise work, which beats gathering from an
+HBM-resident table for decode-sized batches.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponent)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: [..., H, D] with leading dims matching ``positions`` (e.g. x [B, T, H, D]
+    with positions [B, T], or x [B, H, D] with positions [B]).
+    """
+    d = x.shape[-1]
+    freqs = rope_freqs(d, theta)  # [D/2]
+    angles = positions.astype(jnp.float32)[..., None, None] * freqs  # [..., 1, D/2]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate([x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,3 @@
+from arks_tpu.parallel.mesh import MeshPlan, make_mesh
+
+__all__ = ["MeshPlan", "make_mesh"]

@@ -1,0 +1,62 @@
+"""Device-mesh construction for serving and training.
+
+The reference expresses parallelism as container flags
+(``--tensor-parallel-size`` / ``--tp``, /root/reference/internal/controller/
+arksapplication_controller.go:949-995) executed by NCCL inside runtime
+containers.  Here the flag becomes a real mesh dimension: a
+``jax.sharding.Mesh`` with axes (data, model), with the model axis laid out
+over ICI-adjacent devices so TP collectives never leave the slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Resolved parallelism plan for a serving replica group."""
+
+    tensor_parallel: int
+    data_parallel: int
+
+    @property
+    def num_devices(self) -> int:
+        return self.tensor_parallel * self.data_parallel
+
+
+def resolve_plan(num_devices: int, tensor_parallel: int | None = None,
+                 data_parallel: int | None = None) -> MeshPlan:
+    if tensor_parallel is None and data_parallel is None:
+        tensor_parallel, data_parallel = num_devices, 1
+    elif tensor_parallel is None:
+        assert num_devices % data_parallel == 0, (num_devices, data_parallel)
+        tensor_parallel = num_devices // data_parallel
+    elif data_parallel is None:
+        assert num_devices % tensor_parallel == 0, (num_devices, tensor_parallel)
+        data_parallel = num_devices // tensor_parallel
+    plan = MeshPlan(tensor_parallel=tensor_parallel, data_parallel=data_parallel)
+    if plan.num_devices != num_devices:
+        raise ValueError(f"plan {plan} does not cover {num_devices} devices")
+    return plan
+
+
+def make_mesh(tensor_parallel: int | None = None, data_parallel: int | None = None,
+              devices=None) -> Mesh:
+    """Mesh with axes (data, model).
+
+    The model (TP) axis is innermost — on TPU, ``jax.devices()`` order follows
+    physical topology, so innermost-axis neighbors are ICI-adjacent and TP
+    psums ride the fastest links (scaling-book recipe).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    plan = resolve_plan(len(devices), tensor_parallel, data_parallel)
+    grid = np.asarray(devices).reshape(plan.data_parallel, plan.tensor_parallel)
+    return Mesh(grid, (AXIS_DATA, AXIS_MODEL))

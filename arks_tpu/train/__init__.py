@@ -1,0 +1,3 @@
+from arks_tpu.train.sft import TrainState, make_train_step, train_init
+
+__all__ = ["TrainState", "make_train_step", "train_init"]

@@ -1,0 +1,90 @@
+"""Decode-throughput benchmark on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the north-star target of 2,000 tok/s/chip (BASELINE.md — the
+reference publishes no numbers of its own).
+
+Measures the fused multi-step decode loop (K decode steps + greedy sampling
+inside one jitted scan) — one dispatch per K tokens, host transfer limited to
+sampled ids.  This is the same shape the serving engine runs, and the only
+honest way to time on a tunneled PJRT platform where per-dispatch latency
+dominates and block_until_ready can return early.
+
+Env knobs: ARKS_BENCH_MODEL (default qwen2.5-1.5b), ARKS_BENCH_BATCH,
+ARKS_BENCH_CACHE_LEN, ARKS_BENCH_STEPS, ARKS_BENCH_TRIALS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TOK_S_CHIP = 2000.0
+
+
+def main() -> None:
+    from arks_tpu.models import get_config
+    from arks_tpu.models import transformer as tf
+
+    model = os.environ.get("ARKS_BENCH_MODEL", "qwen2.5-1.5b")
+    batch = int(os.environ.get("ARKS_BENCH_BATCH", "64"))
+    cache_len = int(os.environ.get("ARKS_BENCH_CACHE_LEN", "1024"))
+    steps = int(os.environ.get("ARKS_BENCH_STEPS", "32"))
+    trials = int(os.environ.get("ARKS_BENCH_TRIALS", "3"))
+
+    cfg = get_config(model)
+    n_chips = len(jax.devices())
+    mesh = None
+    if n_chips > 1:
+        from arks_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(tensor_parallel=n_chips)
+
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    if mesh is not None:
+        params = tf.shard_params(params, cfg, mesh)
+    cache = tf.init_cache(cfg, num_slots=batch, max_len=cache_len)
+
+    def multi_step(params, cache, tokens, lengths):
+        def body(carry, _):
+            cache, tokens, lengths = carry
+            logits, cache = tf.decode_step(params, cfg, cache, tokens, lengths, mesh)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt, lengths + 1), nxt
+        (cache, tokens, lengths), out = jax.lax.scan(
+            body, (cache, tokens, lengths), None, length=steps)
+        return cache, tokens, lengths, out
+
+    fn = jax.jit(multi_step, donate_argnums=(1,))
+    tokens = jnp.zeros((batch,), jnp.int32)
+    # Mid-cache lengths: each decode step attends ~cache_len/2 of KV,
+    # a representative steady-state working set.
+    lengths = jnp.full((batch,), cache_len // 2, jnp.int32)
+
+    # Warmup / compile.
+    cache, tokens, lengths, out = fn(params, cache, tokens, lengths)
+    np.asarray(out[-1])
+
+    best = float("inf")
+    for _ in range(trials):
+        lengths = jnp.full((batch,), cache_len // 2, jnp.int32)
+        t0 = time.perf_counter()
+        cache, tokens, lengths, out = fn(params, cache, tokens, lengths)
+        np.asarray(out[-1])  # host fetch of sampled ids = completion barrier
+        best = min(best, time.perf_counter() - t0)
+
+    tok_s_chip = batch * steps / best / max(n_chips, 1)
+    print(json.dumps({
+        "metric": f"decode_throughput_{model}_b{batch}",
+        "value": round(tok_s_chip, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

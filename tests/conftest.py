@@ -1,0 +1,18 @@
+"""Test env: force an 8-device virtual CPU mesh.
+
+Mirrors the driver's multi-chip dry-run environment; all sharding tests run
+against this mesh, never real TPU hardware.  Note: this image's sitecustomize
+imports jax at interpreter startup (JAX_PLATFORMS=axon), so plain env vars are
+too late here — switch the platform via jax.config before any backend is used.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
