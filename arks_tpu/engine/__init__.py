@@ -1,0 +1,4 @@
+from arks_tpu.engine.types import Request, RequestOutput, SamplingParams
+from arks_tpu.engine.engine import EngineConfig, InferenceEngine
+
+__all__ = ["Request", "RequestOutput", "SamplingParams", "EngineConfig", "InferenceEngine"]

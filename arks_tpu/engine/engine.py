@@ -1,0 +1,409 @@
+"""Continuous-batching inference engine.
+
+This is the component the reference outsources entirely to vLLM/SGLang
+containers (it only writes their command lines —
+/root/reference/internal/controller/arksapplication_controller.go:941-1014).
+Here it is TPU-native:
+
+- **Slot model**: a fixed decode batch of ``num_slots`` sequences, each
+  owning a stretch of the slotted KV cache.  Prompts are prefilled one at a
+  time into bucketed-length compiled programs, then inserted into a free
+  slot; decode advances all slots together.
+- **Fused dispatch**: ``steps_per_dispatch`` decode steps + on-device
+  sampling run inside ONE jitted ``lax.scan`` per dispatch, and only the
+  sampled ids [K, B] come back to the host.  On a tunneled PJRT platform
+  per-dispatch overhead is ~10ms, so this is the difference between 70 and
+  3000+ tok/s.
+- **Host-authoritative scheduling**: lengths/last-token mirrors live on the
+  host; device state is params + cache + sampler keys.  The scheduler
+  decides admission, stopping, and slot reuse between dispatches.
+
+All jax work happens on the engine thread; the server talks to it via
+thread-safe queues (Request.outputs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arks_tpu.engine import sampler as sampler_mod
+from arks_tpu.engine.tokenizer import Tokenizer
+from arks_tpu.engine.types import Request, RequestOutput
+from arks_tpu.models.config import ModelConfig
+from arks_tpu.models import transformer as tf
+from arks_tpu.utils import metrics as prom
+
+log = logging.getLogger("arks_tpu.engine")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny"
+    num_slots: int = 8
+    max_cache_len: int = 1024
+    prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+    steps_per_dispatch: int = 4
+    # Parallelism: when a mesh isn't passed to InferenceEngine explicitly,
+    # one is built from these over all visible devices (tp defaults to
+    # devices/dp). Both 1 (or 1 visible device) → no mesh, single-chip path.
+    tensor_parallel: int | None = None
+    data_parallel: int = 1
+    dtype: str | None = None   # default: model config dtype
+    seed: int = 0
+
+    def resolve_buckets(self) -> list[int]:
+        """Prefill buckets clamped to the cache; never empty."""
+        buckets = sorted(b for b in self.prefill_buckets if b <= self.max_cache_len)
+        if not buckets or buckets[-1] < self.max_cache_len:
+            # Always allow full-cache-length prompts.
+            buckets.append(self.max_cache_len)
+        return buckets
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    num_prompt: int
+    generated: list[int] = dataclasses.field(default_factory=list)
+    num_emitted: int = 0  # tokens already streamed to the request queue
+    first_token_time: float | None = None
+
+
+class EngineMetrics:
+    """Normalized runtime metric names (what the reference's runtime
+    ServiceMonitor relabels vLLM/SGLang names into —
+    /root/reference/config/prometheus/monitor-runtime.yaml:13-44)."""
+
+    def __init__(self, registry: prom.Registry | None = None):
+        self.registry = registry or prom.Registry()
+        r = self.registry
+        self.num_requests_running = r.gauge(
+            "num_requests_running", "Requests currently decoding")
+        self.num_requests_waiting = r.gauge(
+            "num_requests_waiting", "Requests queued for admission")
+        self.prompt_tokens_total = r.counter(
+            "prompt_tokens_total", "Prefilled prompt tokens")
+        self.generation_tokens_total = r.counter(
+            "generation_tokens_total", "Generated tokens")
+        self.time_to_first_token_seconds = r.histogram(
+            "time_to_first_token_seconds", "TTFT",
+            buckets=[0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8])
+        self.time_per_output_token_seconds = r.histogram(
+            "time_per_output_token_seconds", "TPOT",
+            buckets=[0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64])
+        self.e2e_request_latency_seconds = r.histogram(
+            "e2e_request_latency_seconds", "End-to-end request latency",
+            buckets=[0.1, 0.25, 0.5, 1, 2.5, 5, 10, 20, 40, 80, 160])
+        self.request_success_total = r.counter(
+            "request_success_total", "Finished requests by reason")
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        tokenizer: Tokenizer,
+        params: tf.Params | None = None,
+        mesh=None,
+        registry: prom.Registry | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.ecfg = engine_cfg
+        self.tokenizer = tokenizer
+        if mesh is None and (engine_cfg.tensor_parallel or 1) * engine_cfg.data_parallel > 1:
+            from arks_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(tensor_parallel=engine_cfg.tensor_parallel,
+                             data_parallel=engine_cfg.data_parallel)
+        self.mesh = mesh
+        self.metrics = EngineMetrics(registry)
+        self._buckets = engine_cfg.resolve_buckets()
+        dtype = jnp.dtype(engine_cfg.dtype or cfg.dtype)
+
+        if params is None:
+            params = tf.init_params(cfg, jax.random.PRNGKey(engine_cfg.seed), dtype)
+        if mesh is not None:
+            params = tf.shard_params(params, cfg, mesh)
+        self.params = params
+
+        self._cache = tf.init_cache(cfg, engine_cfg.num_slots, engine_cfg.max_cache_len, dtype)
+        if mesh is not None:
+            self._cache = tf.shard_cache(self._cache, cfg, mesh)
+        self._sampling = sampler_mod.init_sampling_state(
+            engine_cfg.num_slots, engine_cfg.seed)
+
+        # Host-authoritative mirrors.
+        self._lengths = np.zeros((engine_cfg.num_slots,), np.int32)
+        self._last_token = np.zeros((engine_cfg.num_slots,), np.int32)
+        self._slots: dict[int, _Slot] = {}
+        self._free: list[int] = list(range(engine_cfg.num_slots))
+
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._aborted: set[str] = set()
+        self._abort_lock = threading.Lock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._request_seed = engine_cfg.seed
+
+        self._build_programs()
+
+    # ------------------------------------------------------------------
+    # Compiled programs
+    # ------------------------------------------------------------------
+
+    def _build_programs(self) -> None:
+        cfg, mesh = self.cfg, self.mesh
+        batch_axis = tf.AXIS_DATA if (mesh is not None and mesh.shape.get(tf.AXIS_DATA, 1) > 1) else None
+        K = self.ecfg.steps_per_dispatch
+
+        def prefill_and_sample(params, tokens, length, temperature, top_p, top_k, key):
+            logits, ks, vs = tf.prefill(params, cfg, tokens, length, mesh)
+            state = sampler_mod.SamplingState(
+                temperature=temperature[None], top_p=top_p[None],
+                top_k=top_k[None], key=key[None])
+            ids, _ = sampler_mod.sample(logits, state)
+            return ids[0], ks, vs
+
+        self._prefill_fn = jax.jit(prefill_and_sample)
+        self._insert_fn = jax.jit(tf.insert, donate_argnums=(0,))
+
+        def decode_loop(params, cache, tokens, lengths, sstate):
+            def body(carry, _):
+                cache, tokens, lengths, sstate = carry
+                logits, cache = tf.decode_step(
+                    params, cfg, cache, tokens, lengths, mesh, batch_axis)
+                nxt, sstate = sampler_mod.sample(logits, sstate)
+                return (cache, nxt, lengths + 1, sstate), nxt
+
+            (cache, tokens, lengths, sstate), toks = jax.lax.scan(
+                body, (cache, tokens, lengths, sstate), None, length=K)
+            return cache, sstate, toks  # toks [K, B]
+
+        self._decode_fn = jax.jit(decode_loop, donate_argnums=(1, 4))
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+
+    def add_request(self, request: Request) -> None:
+        self.metrics.num_requests_waiting.inc(1)
+        self._queue.put(request)
+
+    def abort(self, request_id: str) -> None:
+        """Free the request's slot at the next scheduler boundary (client
+        disconnect, stop-string hit in the server, etc.)."""
+        with self._abort_lock:
+            self._aborted.add(request_id)
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, name="engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    @property
+    def num_running(self) -> int:
+        return len(self._slots)
+
+    # ------------------------------------------------------------------
+    # Scheduler loop
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while self._running:
+            try:
+                progressed = self.step()
+            except Exception:
+                # A scheduler bug must not wedge every connected client:
+                # fail the in-flight requests and keep serving.
+                log.exception("engine step failed; aborting in-flight requests")
+                for slot in list(self._slots):
+                    self._finish(slot, "abort")
+                progressed = True
+            if not progressed:
+                time.sleep(0.001)
+
+    def step(self, block_s: float = 0.05) -> bool:
+        """One scheduler iteration: admit pending requests, then one decode
+        dispatch. Returns True if any work was done."""
+        admitted = self._admit()
+        if not self._slots:
+            # Idle: wait briefly for a request, then try admission again.
+            if not admitted:
+                try:
+                    req = self._queue.get(timeout=block_s)
+                except queue.Empty:
+                    return False
+                self._admit_one(req)
+            return True
+        self._decode_dispatch()
+        return True
+
+    def _admit(self) -> bool:
+        admitted = False
+        while self._free:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._admit_one(req)
+            admitted = True
+        return admitted
+
+    def _admit_one(self, req: Request) -> None:
+        self.metrics.num_requests_waiting.inc(-1)
+        with self._abort_lock:
+            if req.request_id in self._aborted:
+                self._aborted.discard(req.request_id)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort"))
+                return
+        # Cap the prompt so at least one decode dispatch fits in the cache.
+        max_prompt = min(self._buckets[-1],
+                         self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1)
+        ids = req.prompt_ids
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]  # keep the most recent context
+        bucket = next(b for b in self._buckets if b >= len(ids))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(ids)] = ids
+
+        p = req.params
+        self._request_seed += 1
+        seed = p.seed if p.seed is not None else self._request_seed
+        key = jax.random.PRNGKey(seed)
+        first_id, ks, vs = self._prefill_fn(
+            self.params, jnp.asarray(padded), jnp.asarray([len(ids)], jnp.int32),
+            jnp.float32(p.temperature), jnp.float32(p.top_p),
+            jnp.int32(p.top_k), key)
+
+        slot = self._free.pop()
+        self._cache = self._insert_fn(self._cache, ks, vs, jnp.asarray(slot))
+        self._sampling = sampler_mod.set_slot(
+            self._sampling, slot, p.temperature, p.top_p, p.top_k,
+            jax.random.fold_in(key, 1))
+
+        first = int(first_id)
+        now = time.monotonic()
+        st = _Slot(request=req, num_prompt=len(ids))
+        st.generated.append(first)
+        st.first_token_time = now
+        self._slots[slot] = st
+        self._lengths[slot] = len(ids)
+        self._last_token[slot] = first
+
+        self.metrics.prompt_tokens_total.inc(len(ids))
+        self.metrics.num_requests_running.set(len(self._slots))
+        ttft = now - req.arrival_time
+        self.metrics.time_to_first_token_seconds.observe(ttft)
+
+        if self._check_finished(slot):
+            return
+        st.num_emitted = 1
+        req.outputs.put(RequestOutput(
+            request_id=req.request_id, token_ids=[first],
+            num_prompt_tokens=len(ids), ttft_s=ttft))
+
+    def _decode_dispatch(self) -> None:
+        K = self.ecfg.steps_per_dispatch
+        with self._abort_lock:
+            aborted, self._aborted = self._aborted, set()
+        for slot in list(self._slots):
+            if self._slots[slot].request.request_id in aborted:
+                self._finish(slot, "abort")
+        # Retire any slot that would overflow its cache this dispatch.
+        for slot in list(self._slots):
+            if int(self._lengths[slot]) + 1 + K > self.ecfg.max_cache_len:
+                self._finish(slot, "length")
+        if not self._slots:
+            return
+
+        t0 = time.monotonic()
+        self._cache, self._sampling, toks = self._decode_fn(
+            self.params, self._cache, jnp.asarray(self._last_token),
+            jnp.asarray(self._lengths), self._sampling)
+        toks = np.asarray(toks)  # [K, B] — host sync point
+        dt = time.monotonic() - t0
+
+        for slot in list(self._slots):
+            st = self._slots[slot]
+            finished = False
+            new_tokens = 0
+            for k in range(K):
+                tok = int(toks[k, slot])
+                st.generated.append(tok)
+                new_tokens += 1
+                if self._is_stop(st, tok) or len(st.generated) >= st.request.params.max_tokens:
+                    finished = True
+                    break
+            self._lengths[slot] += K  # all K KVs were written on device
+            self._last_token[slot] = int(toks[K - 1, slot])
+            self.metrics.generation_tokens_total.inc(new_tokens)
+            self.metrics.time_per_output_token_seconds.observe(dt / K)
+            if finished:
+                self._finish(slot, self._finish_reason(st))
+            else:
+                delta = st.generated[st.num_emitted:]
+                st.num_emitted = len(st.generated)
+                st.request.outputs.put(RequestOutput(
+                    request_id=st.request.request_id, token_ids=delta,
+                    num_prompt_tokens=st.num_prompt))
+
+    # ------------------------------------------------------------------
+    # Stop handling
+    # ------------------------------------------------------------------
+
+    def _is_stop(self, st: _Slot, tok: int) -> bool:
+        p = st.request.params
+        if p.ignore_eos:
+            return tok in p.stop_token_ids
+        return tok in self.cfg.eos_token_ids or tok in self.tokenizer.eos_token_ids \
+            or tok in p.stop_token_ids
+
+    def _finish_reason(self, st: _Slot) -> str:
+        if len(st.generated) >= st.request.params.max_tokens:
+            return "length"
+        return "stop"
+
+    def _check_finished(self, slot: int) -> bool:
+        st = self._slots[slot]
+        tok = st.generated[-1]
+        if self._is_stop(st, tok) or len(st.generated) >= st.request.params.max_tokens:
+            self._finish(slot, self._finish_reason(st))
+            return True
+        return False
+
+    def _finish(self, slot: int, reason: str) -> None:
+        st = self._slots.pop(slot)
+        self._free.append(slot)
+        gen = st.generated
+        # The stop token itself is not part of the output text.
+        if reason == "stop" and gen and self._is_stop(st, gen[-1]):
+            final_ids = gen[:-1]
+        else:
+            final_ids = gen[: st.request.params.max_tokens]
+        delta = final_ids[st.num_emitted:]
+        st.request.outputs.put(RequestOutput(
+            request_id=st.request.request_id,
+            token_ids=delta,
+            finished=True, finish_reason=reason,
+            num_prompt_tokens=st.num_prompt,
+            num_generated_tokens=len(final_ids)))
+        now = time.monotonic()
+        self.metrics.e2e_request_latency_seconds.observe(now - st.request.arrival_time)
+        self.metrics.request_success_total.inc(reason=reason)
+        self.metrics.num_requests_running.set(len(self._slots))

@@ -1,0 +1,84 @@
+"""On-device token sampling for the fused decode loop.
+
+Sampling lives inside the jitted multi-step loop so only sampled ids ever
+cross the host boundary (per-dispatch host traffic on a tunneled PJRT
+platform is the latency budget — see bench.py).
+
+Per-slot params come in as arrays so one compiled program serves any mix of
+greedy/temperature/top-k/top-p requests.  Top-k/top-p work on a static
+``top_k_max``-wide slice of the vocab (lax.top_k), the standard TPU trick to
+avoid sorting the full vocab each step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+TOP_K_MAX = 64
+
+
+class SamplingState(NamedTuple):
+    """Per-slot sampling params, stacked into arrays (all [B])."""
+
+    temperature: jnp.ndarray  # f32; <=0 means greedy
+    top_p: jnp.ndarray        # f32 in (0, 1]
+    top_k: jnp.ndarray        # i32; 0 = disabled (use TOP_K_MAX window)
+    key: jnp.ndarray          # uint32 [B, 2] per-slot PRNG keys
+
+
+def init_sampling_state(batch: int, seed: int = 0) -> SamplingState:
+    keys = jax.random.split(jax.random.PRNGKey(seed), batch)
+    return SamplingState(
+        temperature=jnp.zeros((batch,), jnp.float32),
+        top_p=jnp.ones((batch,), jnp.float32),
+        top_k=jnp.zeros((batch,), jnp.int32),
+        key=jnp.asarray(keys),
+    )
+
+
+def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
+             top_p: float, top_k: int, key: jnp.ndarray) -> SamplingState:
+    return SamplingState(
+        temperature=state.temperature.at[slot].set(temperature),
+        top_p=state.top_p.at[slot].set(top_p),
+        top_k=state.top_k.at[slot].set(top_k),
+        key=state.key.at[slot].set(key),
+    )
+
+
+def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, SamplingState]:
+    """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
+
+    Greedy where temperature <= 0; otherwise temperature + top-k + top-p over
+    the TOP_K_MAX highest-logit candidates.
+    """
+    b, v = logits.shape
+    window = min(TOP_K_MAX, v)
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    top_logits, top_idx = jax.lax.top_k(logits, window)  # [B, K], descending
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = top_logits / temp
+
+    # top-k mask within the window (0 = keep whole window).
+    k = jnp.where(state.top_k <= 0, window, jnp.minimum(state.top_k, window))
+    rank = jnp.arange(window)[None, :]
+    scaled = jnp.where(rank < k[:, None], scaled, -jnp.inf)
+
+    # top-p (nucleus) over the kept candidates: keep the smallest prefix with
+    # cumulative prob >= top_p; candidates are already sorted descending.
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < state.top_p[:, None]  # first candidate always kept
+    scaled = jnp.where(keep, scaled, -jnp.inf)
+
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)
+    step_keys, carry_keys = new_keys[:, 0], new_keys[:, 1]
+    choice = jax.vmap(lambda key, s: jax.random.categorical(key, s))(step_keys, scaled)
+    sampled_ids = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    ids = jnp.where(state.temperature <= 0.0, greedy_ids, sampled_ids)
+    return ids, state._replace(key=carry_keys)

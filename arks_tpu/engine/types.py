@@ -1,0 +1,40 @@
+"""Request/response dataclasses for the serving engine."""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 256
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    stop_token_ids: tuple[int, ...] = ()
+    ignore_eos: bool = False
+    seed: int | None = None
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_ids: list[int]
+    params: SamplingParams
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    # Per-request output stream: the engine puts RequestOutput items here;
+    # the server consumes them (None-terminated via ``finished``).
+    outputs: "queue.Queue[RequestOutput]" = dataclasses.field(default_factory=queue.Queue)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    token_ids: list[int]          # newly generated token ids in this chunk
+    finished: bool = False
+    finish_reason: str | None = None   # "stop" | "length" | "abort"
+    num_prompt_tokens: int = 0
+    num_generated_tokens: int = 0      # cumulative, set when finished
+    ttft_s: float | None = None        # set on the first chunk

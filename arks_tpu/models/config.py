@@ -68,8 +68,10 @@ class ModelConfig:
         arch = (d.get("architectures") or [""])[0].lower()
         qkv_bias = "qwen2" in arch or d.get("model_type", "") == "qwen2"
         heads = d["num_attention_heads"]
-        eos = d.get("eos_token_id") or ()
-        if isinstance(eos, int):
+        eos = d.get("eos_token_id")
+        if eos is None:
+            eos = ()
+        elif isinstance(eos, int):
             eos = (eos,)
         return ModelConfig(
             name=name or d.get("model_type", "hf-model"),

@@ -1,0 +1,165 @@
+"""Weight loading: HF safetensors -> arks params; Orbax sharded checkpoints.
+
+Parity anchor: the reference's ArksModel controller downloads a raw HF
+snapshot into a PVC (/root/reference/internal/controller/
+arksmodel_controller.go:218-354, scripts/download.py).  The TPU-native twist
+(BASELINE.json north star) is a conversion step that writes **Orbax** sharded
+checkpoints so every host in a multi-host slice reads only its own shards;
+``arks_tpu.control.model`` drives that conversion after download.
+
+Layout conventions: all projection matrices are stored [in, out] (JAX
+convention; HF/torch stores [out, in]) and per-layer weights are stacked with
+a leading [L] dim for the scan-based forward pass.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arks_tpu.models.config import ModelConfig
+from arks_tpu.models import transformer as tf
+
+log = logging.getLogger("arks_tpu.weights")
+
+ORBAX_SUBDIR = "arks_orbax"
+
+
+# ---------------------------------------------------------------------------
+# HF safetensors -> params
+# ---------------------------------------------------------------------------
+
+def _hf_tensors(path: str) -> dict[str, np.ndarray]:
+    """Load all tensors from the safetensors shards in ``path``."""
+    from safetensors import safe_open
+
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    out: dict[str, np.ndarray] = {}
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                out[key] = f.get_tensor(key)
+    return out
+
+
+def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None) -> tf.Params:
+    """Convert a HuggingFace Qwen2/Llama checkpoint directory to arks params."""
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    t = _hf_tensors(path)
+    l = cfg.num_layers
+
+    def get(name: str, transpose: bool = False) -> np.ndarray:
+        x = t[name]
+        return x.T if transpose else x
+
+    def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([get(fmt.format(i), transpose) for i in range(l)]), dtype)
+
+    layers: tf.Params = {
+        "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+        "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
+        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
+        "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
+        "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
+        layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
+        layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
+    params: tf.Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight", True), dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Orbax sharded checkpoints
+# ---------------------------------------------------------------------------
+
+def orbax_path(model_path: str) -> str:
+    return os.path.join(model_path, ORBAX_SUBDIR)
+
+
+def save_orbax(params: tf.Params, model_path: str) -> str:
+    import orbax.checkpoint as ocp
+
+    path = orbax_path(model_path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_orbax(cfg: ModelConfig, model_path: str, mesh=None,
+               dtype: Any = None) -> tf.Params:
+    """Load an Orbax checkpoint, sharded directly to the mesh when given —
+    each host reads only the shards it owns (multi-host friendly)."""
+    import orbax.checkpoint as ocp
+
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    path = os.path.abspath(orbax_path(model_path))
+    template = jax.eval_shape(
+        lambda: tf.init_params(cfg, jax.random.PRNGKey(0), dtype))
+    if mesh is not None:
+        tp = mesh.shape.get(tf.AXIS_MODEL, 1)
+        specs = tf.param_pspecs(cfg, tp)
+        template = jax.tree.map(
+            lambda s, spec: jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=jax.sharding.NamedSharding(mesh, spec)),
+            template, specs)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, template)
+
+
+def convert_hf_to_orbax(cfg: ModelConfig, model_path: str,
+                        dtype: Any = None) -> str:
+    """One-shot conversion after model download (the ArksModel 'Loading'
+    phase extension). Idempotent: skips when the Orbax dir already exists."""
+    path = orbax_path(model_path)
+    if os.path.isdir(path) and os.listdir(path):
+        return path
+    params = params_from_hf(cfg, model_path, dtype)
+    return save_orbax(params, model_path)
+
+
+# ---------------------------------------------------------------------------
+# Entry point used by the serving pod
+# ---------------------------------------------------------------------------
+
+def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
+                dtype: Any = None) -> tf.Params:
+    """Best available weights: Orbax (sharded) > safetensors > random init."""
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    if model_path:
+        if os.path.isdir(orbax_path(model_path)):
+            log.info("loading Orbax checkpoint from %s", orbax_path(model_path))
+            return load_orbax(cfg, model_path, mesh, dtype)
+        if os.path.isdir(model_path) and any(
+                f.endswith(".safetensors") for f in os.listdir(model_path)):
+            log.info("loading HF safetensors from %s", model_path)
+            params = params_from_hf(cfg, model_path, dtype)
+            if mesh is not None:
+                params = tf.shard_params(params, cfg, mesh)
+            return params
+        log.warning("no weights found under %s; using random init", model_path)
+    params = tf.init_params(cfg, jax.random.PRNGKey(0), dtype)
+    if mesh is not None:
+        params = tf.shard_params(params, cfg, mesh)
+    return params

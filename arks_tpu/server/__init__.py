@@ -1,0 +1,3 @@
+from arks_tpu.server.openai_server import OpenAIServer
+
+__all__ = ["OpenAIServer"]

@@ -1,0 +1,103 @@
+"""Serving-pod entrypoint: ``python -m arks_tpu.server [flags]``.
+
+This is the TPU-native runtime command the workload controller generates —
+the analogue of the vLLM/SGLang command lines the reference operator writes
+(/root/reference/internal/controller/arksapplication_controller.go:941-1014).
+
+Multi-host rendezvous contract (the LWS env-var contract translated to JAX
+distributed init — reference controller :560-569):
+  ARKS_COORDINATOR_ADDRESS  leader pod address ("host:port")
+  ARKS_PROCESS_ID           worker index (0 = leader)
+  ARKS_NUM_PROCESSES        gang size
+When set, jax.distributed.initialize() is called before anything touches the
+backend; collectives then run over ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+log = logging.getLogger("arks_tpu.server")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("arks_tpu.server")
+    p.add_argument("--model", required=True, help="model config name (arks_tpu.models) "
+                   "or path to a model dir with config.json")
+    p.add_argument("--model-path", default=None, help="weights/tokenizer dir (optional; "
+                   "random init without it)")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--tensor-parallel-size", "--tp", type=int, default=None, dest="tp")
+    p.add_argument("--data-parallel-size", "--dp", type=int, default=1, dest="dp")
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--max-model-len", type=int, default=1024)
+    p.add_argument("--steps-per-dispatch", type=int, default=4)
+    p.add_argument("--dtype", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    coord = os.environ.get("ARKS_COORDINATOR_ADDRESS")
+    if coord:
+        pid = int(os.environ.get("ARKS_PROCESS_ID", "0"))
+        nproc = int(os.environ.get("ARKS_NUM_PROCESSES", "1"))
+        log.info("multi-host init: coordinator=%s process=%d/%d", coord, pid, nproc)
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+
+    from arks_tpu.engine.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import load_tokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.models.config import ModelConfig
+    from arks_tpu.server.openai_server import OpenAIServer
+
+    if os.path.isdir(args.model):
+        cfg = ModelConfig.from_hf_config(args.model, name=os.path.basename(args.model))
+        model_path = args.model_path or args.model
+    else:
+        cfg = get_config(args.model)
+        model_path = args.model_path
+
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1:
+        from arks_tpu.parallel.mesh import make_mesh
+        tp = args.tp or (n_dev // args.dp)
+        mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp)
+
+    params = None
+    if model_path:
+        from arks_tpu.models.weights import load_params
+        params = load_params(cfg, model_path, mesh=mesh, dtype=args.dtype)
+
+    ecfg = EngineConfig(
+        model=cfg.name, num_slots=args.num_slots, max_cache_len=args.max_model_len,
+        prefill_buckets=tuple(b for b in (32, 64, 128, 256, 512, 1024, 2048, 4096)
+                              if b <= args.max_model_len),
+        steps_per_dispatch=args.steps_per_dispatch,
+        tensor_parallel=args.tp, data_parallel=args.dp,
+        dtype=args.dtype, seed=args.seed,
+    )
+    tokenizer = load_tokenizer(model_path if model_path and os.path.isdir(model_path) else None)
+    engine = InferenceEngine(cfg, ecfg, tokenizer, params=params, mesh=mesh)
+    engine.start()
+
+    served = args.served_model_name or cfg.name
+    server = OpenAIServer(engine, served, host=args.host, port=args.port)
+    log.info("serving %s on %s:%d (devices=%d)", served, args.host, args.port, n_dev)
+    server.start(background=False)
+
+
+if __name__ == "__main__":
+    main()

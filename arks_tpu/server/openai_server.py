@@ -1,0 +1,336 @@
+"""OpenAI-compatible HTTP serving surface.
+
+Same wire contract the reference's gateway counts on from vLLM/SGLang
+runtime pods (port 8080 — /root/reference/internal/controller/
+arksapplication_controller.go:631-634; usage extraction —
+/root/reference/pkg/gateway/handle_response.go:113-182):
+
+- POST /v1/chat/completions, /v1/completions (stream + non-stream; SSE
+  frames ``data: {...}`` terminated by ``data: [DONE]``; when
+  ``stream_options.include_usage`` is set, the final data frame carries the
+  usage object and an empty choices list).
+- GET /v1/models, /metrics (Prometheus, normalized runtime names),
+  /healthz, /readiness.
+
+Stdlib-only (ThreadingHTTPServer): requests are I/O-bound handoffs to the
+engine thread; all device work stays on the engine thread.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from arks_tpu.engine.engine import InferenceEngine
+from arks_tpu.engine.tokenizer import IncrementalDetokenizer
+from arks_tpu.engine.types import Request, SamplingParams
+
+
+def _find_stop(text: str, stop_strings: list[str]) -> int | None:
+    """Earliest index at which any stop string begins, else None."""
+    best = None
+    for s in stop_strings:
+        i = text.find(s)
+        if i >= 0 and (best is None or i < best):
+            best = i
+    return best
+
+
+def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str]]:
+    """Build engine sampling params; returns (params, stop_strings).
+
+    ``stop_token_ids`` go to the engine directly.  ``stop`` strings that
+    encode to a single token also become stop ids; multi-token stop strings
+    are matched against streamed text by the server (which then aborts the
+    engine request)."""
+    stop = body.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    stop_ids = [int(t) for t in (body.get("stop_token_ids") or [])]
+    stop_strings: list[str] = []
+    for s in stop:
+        ids = tokenizer.encode(s)
+        if len(ids) == 1:
+            stop_ids.append(ids[0])
+        else:
+            stop_strings.append(s)
+    params = SamplingParams(
+        max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 256),
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        seed=body.get("seed"),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        stop_token_ids=tuple(stop_ids),
+    )
+    return params, stop_strings
+
+
+class OpenAIServer:
+    def __init__(self, engine: InferenceEngine, served_model_name: str,
+                 host: str = "0.0.0.0", port: int = 8080) -> None:
+        self.engine = engine
+        self.served_model_name = served_model_name
+        self.host, self.port = host, port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._ready = threading.Event()
+
+    # ------------------------------------------------------------------
+
+    def start(self, background: bool = True) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, code: int, message: str) -> None:
+                self._json(code, {"error": {"message": message, "code": code}})
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    self._json(200, server._models_payload())
+                elif self.path == "/metrics":
+                    text = server.engine.metrics.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                elif self.path in ("/healthz", "/health"):
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/readiness":
+                    if server._ready.is_set():
+                        self._json(200, {"status": "ready"})
+                    else:
+                        self._error(503, "not ready")
+                else:
+                    self._error(404, f"no route {self.path}")
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._error(400, "invalid JSON body")
+                try:
+                    if self.path == "/v1/chat/completions":
+                        server._handle_completion(self, body, chat=True)
+                    elif self.path == "/v1/completions":
+                        server._handle_completion(self, body, chat=False)
+                    else:
+                        self._error(404, f"no route {self.path}")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # engine/request failure → 500
+                    try:
+                        self._error(500, f"internal error: {e}")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        self._ready.set()
+        if background:
+            threading.Thread(target=self._httpd.serve_forever,
+                             name="http", daemon=True).start()
+        else:
+            self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    # ------------------------------------------------------------------
+
+    def _models_payload(self) -> dict:
+        return {"object": "list", "data": [{
+            "id": self.served_model_name, "object": "model",
+            "created": int(time.time()), "owned_by": "arks-tpu",
+        }]}
+
+    def _prompt_ids(self, body: dict, chat: bool) -> list[int]:
+        tok = self.engine.tokenizer
+        if chat:
+            messages = body.get("messages") or []
+            if not isinstance(messages, list) or not messages:
+                raise ValueError("messages must be a non-empty list")
+            return tok.apply_chat_template(messages)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = "".join(prompt) if all(isinstance(p, str) for p in prompt) else prompt
+        if isinstance(prompt, list):  # token-id prompt
+            return [int(t) for t in prompt]
+        return tok.encode(str(prompt))
+
+    def _handle_completion(self, h, body: dict, chat: bool) -> None:
+        model = body.get("model") or self.served_model_name
+        if model != self.served_model_name:
+            return h._error(404, f"model {model!r} not found")
+        try:
+            prompt_ids = self._prompt_ids(body, chat)
+        except ValueError as e:
+            return h._error(400, str(e))
+
+        params, stop_strings = _sampling_from_body(body, self.engine.tokenizer)
+        req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
+                      prompt_ids=prompt_ids, params=params)
+        self.engine.add_request(req)
+
+        if body.get("stream", False):
+            include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
+            self._stream_response(h, req, chat, model, include_usage, stop_strings)
+        else:
+            self._full_response(h, req, chat, model, stop_strings)
+
+    # ------------------------------------------------------------------
+
+    def _full_response(self, h, req: Request, chat: bool, model: str,
+                       stop_strings: list[str]) -> None:
+        detok = IncrementalDetokenizer(self.engine.tokenizer)
+        text = ""
+        fin = None
+        stopped_on_string = False
+        while True:
+            out = req.outputs.get()
+            text += detok.push(out.token_ids)
+            if not out.finished and stop_strings:
+                cut = _find_stop(text, stop_strings)
+                if cut is not None:
+                    text = text[:cut]
+                    stopped_on_string = True
+                    self.engine.abort(req.request_id)
+                    # Drain until the engine acknowledges the abort.
+                    while not out.finished:
+                        out = req.outputs.get()
+                    fin = out
+                    break
+            if out.finished:
+                fin = out
+                break
+        if not stopped_on_string:
+            text += detok.flush()
+        finish_reason = "stop" if stopped_on_string else fin.finish_reason
+        usage = {
+            "prompt_tokens": fin.num_prompt_tokens,
+            "completion_tokens": fin.num_generated_tokens,
+            "total_tokens": fin.num_prompt_tokens + fin.num_generated_tokens,
+        }
+        rid = req.request_id
+        if chat:
+            payload = {
+                "id": rid, "object": "chat.completion", "created": int(time.time()),
+                "model": model,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant", "content": text},
+                             "finish_reason": finish_reason}],
+                "usage": usage,
+            }
+        else:
+            payload = {
+                "id": rid, "object": "text_completion", "created": int(time.time()),
+                "model": model,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish_reason}],
+                "usage": usage,
+            }
+        h._json(200, payload)
+
+    def _stream_response(self, h, req: Request, chat: bool, model: str,
+                         include_usage: bool, stop_strings: list[str]) -> None:
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def send_frame(obj) -> None:
+            data = b"data: " + (obj if isinstance(obj, bytes) else json.dumps(obj).encode()) + b"\n\n"
+            h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            h.wfile.flush()
+
+        rid = req.request_id
+        created = int(time.time())
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def chunk(delta_text: str | None, finish: str | None = None, role: str | None = None,
+                  usage: dict | None = None, empty_choices: bool = False) -> dict:
+            if empty_choices:
+                choices = []
+            elif chat:
+                delta: dict = {}
+                if role:
+                    delta["role"] = role
+                if delta_text:
+                    delta["content"] = delta_text
+                choices = [{"index": 0, "delta": delta, "finish_reason": finish}]
+            else:
+                choices = [{"index": 0, "text": delta_text or "", "finish_reason": finish}]
+            payload = {"id": rid, "object": obj, "created": created,
+                       "model": model, "choices": choices}
+            if usage is not None:
+                payload["usage"] = usage
+            return payload
+
+        detok = IncrementalDetokenizer(self.engine.tokenizer)
+        fin = None
+        # Text already emitted to the client; used for stop-string matching
+        # across chunk boundaries (a stop string can straddle two deltas).
+        pending = ""
+        hold = max((len(s) for s in stop_strings), default=1) - 1
+        try:
+            if chat:
+                send_frame(chunk(None, role="assistant"))
+            while True:
+                out = req.outputs.get()
+                pending += detok.push(out.token_ids)
+                if stop_strings:
+                    cut = _find_stop(pending, stop_strings)
+                    if cut is not None:
+                        if pending[:cut]:
+                            send_frame(chunk(pending[:cut]))
+                        self.engine.abort(req.request_id)
+                        while not out.finished:
+                            out = req.outputs.get()
+                        fin = out
+                        send_frame(chunk(None, finish="stop"))
+                        break
+                if out.finished:
+                    pending += detok.flush()
+                    if pending:
+                        send_frame(chunk(pending))
+                    send_frame(chunk(None, finish=out.finish_reason))
+                    fin = out
+                    break
+                # Hold back enough tail to catch a straddling stop string.
+                safe = len(pending) - hold
+                if safe > 0:
+                    send_frame(chunk(pending[:safe]))
+                    pending = pending[safe:]
+            if include_usage and fin is not None:
+                usage = {
+                    "prompt_tokens": fin.num_prompt_tokens,
+                    "completion_tokens": fin.num_generated_tokens,
+                    "total_tokens": fin.num_prompt_tokens + fin.num_generated_tokens,
+                }
+                send_frame(chunk(None, usage=usage, empty_choices=True))
+            send_frame(b"[DONE]")
+            h.wfile.write(b"0\r\n\r\n")
+            h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            # Client went away: release the slot instead of decoding to
+            # max_tokens for nobody.
+            self.engine.abort(req.request_id)

@@ -1,0 +1,135 @@
+"""Engine-level tests: continuous batching, stop handling, streaming."""
+
+import queue
+
+import pytest
+
+from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.models import get_config
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    yield eng
+
+
+def _collect(req: Request, timeout=60):
+    ids, finished = [], None
+    while True:
+        out = req.outputs.get(timeout=timeout)
+        ids.extend(out.token_ids)
+        if out.finished:
+            finished = out
+            break
+    return ids, finished
+
+
+def _drive(engine, n_steps=200):
+    for _ in range(n_steps):
+        engine.step(block_s=0.01)
+        if engine.num_running == 0 and engine._queue.empty():
+            break
+
+
+def test_single_request_greedy(engine):
+    req = Request("r1", [5, 6, 7], SamplingParams(max_tokens=8, temperature=0.0,
+                                                  ignore_eos=True))
+    engine.add_request(req)
+    _drive(engine)
+    ids, fin = _collect(req)
+    assert len(ids) == 8
+    assert fin.finish_reason == "length"
+    assert fin.num_prompt_tokens == 3
+
+    # Determinism: same request again gives the same tokens.
+    req2 = Request("r2", [5, 6, 7], SamplingParams(max_tokens=8, temperature=0.0,
+                                                   ignore_eos=True))
+    engine.add_request(req2)
+    _drive(engine)
+    ids2, _ = _collect(req2)
+    assert ids2 == ids
+
+
+def test_more_requests_than_slots(engine):
+    reqs = [Request(f"m{i}", [10 + i, 20], SamplingParams(max_tokens=5, temperature=0.0,
+                                                          ignore_eos=True))
+            for i in range(5)]
+    for r in reqs:
+        engine.add_request(r)
+    _drive(engine, 400)
+    for r in reqs:
+        ids, fin = _collect(r)
+        assert fin.finished and len(ids) == 5
+
+
+def test_stop_token(engine):
+    # Force a stop token that greedy decoding actually produces: run once to
+    # learn the first generated token, then use it as the stop token.
+    probe = Request("p", [9, 9], SamplingParams(max_tokens=3, temperature=0.0,
+                                                ignore_eos=True))
+    engine.add_request(probe)
+    _drive(engine)
+    probe_ids, _ = _collect(probe)
+
+    stop = probe_ids[1]
+    req = Request("s", [9, 9], SamplingParams(max_tokens=10, temperature=0.0,
+                                              stop_token_ids=(stop,), ignore_eos=True))
+    engine.add_request(req)
+    _drive(engine)
+    ids, fin = _collect(req)
+    assert fin.finish_reason == "stop"
+    assert stop not in ids
+    assert ids == probe_ids[:1]
+
+
+def test_sampled_request_valid(engine):
+    req = Request("t", [1, 2, 3], SamplingParams(max_tokens=6, temperature=0.8,
+                                                 top_p=0.9, top_k=40, seed=42,
+                                                 ignore_eos=True))
+    engine.add_request(req)
+    _drive(engine)
+    ids, fin = _collect(req)
+    assert len(ids) == 6
+    assert all(0 <= t < get_config("tiny").vocab_size for t in ids)
+
+    # Same seed → same sample path.
+    req2 = Request("t2", [1, 2, 3], SamplingParams(max_tokens=6, temperature=0.8,
+                                                   top_p=0.9, top_k=40, seed=42,
+                                                   ignore_eos=True))
+    engine.add_request(req2)
+    _drive(engine)
+    ids2, _ = _collect(req2)
+    assert ids2 == ids
+
+
+def test_long_prompt_truncated(engine):
+    # 57 tokens fits the implicit max_cache_len bucket (64) minus headroom.
+    req = Request("lp", list(range(3, 60)), SamplingParams(max_tokens=3, temperature=0.0,
+                                                           ignore_eos=True))
+    engine.add_request(req)
+    _drive(engine)
+    ids, fin = _collect(req)
+    assert fin.finished and len(ids) == 3
+    assert fin.num_prompt_tokens == 57
+
+    # 100 tokens exceeds the cache: truncated to max_cache_len - K - 1, and
+    # generation still proceeds.
+    req2 = Request("lp2", list(range(3, 103)), SamplingParams(max_tokens=3, temperature=0.0,
+                                                              ignore_eos=True))
+    engine.add_request(req2)
+    _drive(engine)
+    ids2, fin2 = _collect(req2)
+    assert fin2.finished and len(ids2) >= 1
+    assert fin2.num_prompt_tokens == 64 - 4 - 1
+
+
+def test_metrics_populated(engine):
+    text = engine.metrics.registry.render()
+    assert "prompt_tokens_total" in text
+    assert "generation_tokens_total" in text
+    assert "time_to_first_token_seconds_bucket" in text

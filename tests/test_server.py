@@ -1,0 +1,186 @@
+"""HTTP surface tests: OpenAI wire contract incl. SSE streaming + usage."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from arks_tpu.engine import EngineConfig, InferenceEngine
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.models import get_config
+from arks_tpu.server import OpenAIServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    engine = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    engine.start()
+    srv = OpenAIServer(engine, served_model_name="tiny-serve", host="127.0.0.1", port=0)
+    srv.start(background=True)
+    yield srv
+    srv.stop()
+    engine.stop()
+
+
+def _post(server, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(), headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_models_list(server):
+    with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/v1/models") as r:
+        data = json.load(r)
+    assert data["object"] == "list"
+    assert data["data"][0]["id"] == "tiny-serve"
+
+
+def test_completion_non_stream(server):
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 6,
+        "temperature": 0, "ignore_eos": True,
+    }) as r:
+        data = json.load(r)
+    assert data["object"] == "text_completion"
+    assert data["choices"][0]["finish_reason"] == "length"
+    u = data["usage"]
+    assert u["prompt_tokens"] == 2 and u["completion_tokens"] == 6
+    assert u["total_tokens"] == 8
+
+
+def test_chat_completion_non_stream(server):
+    with _post(server, "/v1/chat/completions", {
+        "model": "tiny-serve",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+    }) as r:
+        data = json.load(r)
+    assert data["object"] == "chat.completion"
+    assert data["choices"][0]["message"]["role"] == "assistant"
+    assert data["usage"]["completion_tokens"] == 4
+
+
+def test_chat_stream_with_usage(server):
+    frames = []
+    with _post(server, "/v1/chat/completions", {
+        "model": "tiny-serve",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 5, "temperature": 0, "ignore_eos": True,
+        "stream": True, "stream_options": {"include_usage": True},
+    }) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[len("data: "):])
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    finishes = [c["choices"][0]["finish_reason"] for c in chunks if c["choices"]]
+    assert "length" in finishes
+    usage_frames = [c for c in chunks if c.get("usage") is not None]
+    assert len(usage_frames) == 1 and usage_frames[0]["choices"] == []
+    assert usage_frames[0]["usage"]["completion_tokens"] == 5
+
+
+def test_wrong_model_404(server):
+    try:
+        _post(server, "/v1/completions", {"model": "nope", "prompt": "x"})
+        assert False, "expected HTTPError"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert "not found" in json.load(e)["error"]["message"]
+
+
+def test_bad_json_400(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/v1/completions",
+        data=b"{not json", headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_metrics_endpoint(server):
+    with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics") as r:
+        text = r.read().decode()
+    assert "num_requests_running" in text
+    assert "generation_tokens_total" in text
+
+
+
+
+def test_stop_string_multi_token(server):
+    # Learn greedy output first, then use a 2-char substring of it as stop.
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "zq", "max_tokens": 8,
+        "temperature": 0, "ignore_eos": True,
+    }) as r:
+        full = json.load(r)["choices"][0]["text"]
+    assert len(full) >= 3
+    stop = full[1:3]
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "zq", "max_tokens": 8,
+        "temperature": 0, "ignore_eos": True, "stop": [stop],
+    }) as r:
+        data = json.load(r)
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert stop not in data["choices"][0]["text"]
+    assert data["choices"][0]["text"] == full[: full.find(stop)]
+
+
+def test_engine_abort_frees_slot():
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.types import Request, SamplingParams
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    ecfg = EngineConfig(model="tiny", num_slots=1, max_cache_len=64,
+                        prefill_buckets=(8,), steps_per_dispatch=2)
+    eng = InferenceEngine(get_config("tiny"), ecfg, ByteTokenizer())
+    req = Request("abort-me", [3, 4], SamplingParams(max_tokens=10_000, temperature=0.0,
+                                                     ignore_eos=True))
+    eng.add_request(req)
+    eng.step(block_s=0.01)  # admit + first dispatch
+    assert eng.num_running == 1
+    eng.abort("abort-me")
+    eng.step(block_s=0.01)  # abort consumed at the dispatch boundary
+    assert eng.num_running == 0
+    fin = None
+    while True:
+        out = req.outputs.get(timeout=30)
+        if out.finished:
+            fin = out
+            break
+    assert fin.finish_reason == "abort"
+
+
+def test_small_max_model_len_no_crash():
+    # Regression: max_cache_len below the smallest bucket must still admit.
+    from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    ecfg = EngineConfig(model="tiny", num_slots=1, max_cache_len=20,
+                        prefill_buckets=(32, 64), steps_per_dispatch=2)
+    eng = InferenceEngine(get_config("tiny"), ecfg, ByteTokenizer())
+    req = Request("tiny-cache", [1, 2, 3], SamplingParams(max_tokens=4, temperature=0.0,
+                                                          ignore_eos=True))
+    eng.add_request(req)
+    for _ in range(50):
+        eng.step(block_s=0.01)
+        if eng.num_running == 0 and eng._queue.empty():
+            break
+    outs = []
+    while True:
+        out = req.outputs.get(timeout=30)
+        outs.append(out)
+        if out.finished:
+            break
+    assert outs[-1].finished
