@@ -1,0 +1,7 @@
+from arks_tpu.control.resources import (
+    Application, DisaggregatedApplication, Endpoint, Model, Quota, Token,
+)
+from arks_tpu.control.store import Store
+
+__all__ = ["Application", "DisaggregatedApplication", "Endpoint", "Model",
+           "Quota", "Token", "Store"]

@@ -1,0 +1,92 @@
+"""Operator entrypoint: ``python -m arks_tpu.control [flags]``.
+
+The single-binary analogue of the reference's two deployments (operator
+cmd/main.go + gateway cmd/gateway/main.go): starts the controller set over a
+store, optionally the QoS gateway, and applies manifests — so
+
+    python -m arks_tpu.control --manifests examples/quickstart/quickstart.yaml
+
+is the ``kubectl apply -f examples/quickstart`` of the local/single-node
+deployment mode.  Manifests are YAML documents with the same kind/metadata/
+spec shape as the reference CRs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import time
+
+log = logging.getLogger("arks_tpu.operator")
+
+
+def apply_manifests(store, path: str) -> list:
+    import yaml
+
+    from arks_tpu.control.resources import KIND_BY_NAME
+    from arks_tpu.control.store import Conflict
+
+    applied = []
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if not doc:
+                continue
+            kind = doc.get("kind")
+            cls = KIND_BY_NAME.get(kind)
+            if cls is None:
+                raise ValueError(f"unknown kind {kind!r} in {path}")
+            obj = cls.from_dict(doc)
+            try:
+                store.create(obj)
+            except Conflict:
+                cur = store.get(cls, obj.name, obj.namespace)
+                cur.spec = obj.spec
+                store.update(cur)
+            applied.append(obj)
+            log.info("applied %s %s/%s", kind, obj.namespace, obj.name)
+    return applied
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("arks_tpu.control")
+    p.add_argument("--models-root", default="/tmp/arks-tpu/models")
+    p.add_argument("--manifests", action="append", default=[])
+    p.add_argument("--gateway-port", type=int, default=8081)
+    p.add_argument("--no-gateway", action="store_true")
+    p.add_argument("--local-platform", default=None,
+                   help="force jax platform for spawned engines (cpu for demos)")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from arks_tpu.control.manager import build_manager
+    from arks_tpu.gateway.server import Gateway
+
+    mgr = build_manager(models_root=args.models_root,
+                        local_platform=args.local_platform)
+    mgr.start()
+    gateway = None
+    if not args.no_gateway:
+        gateway = Gateway(mgr.store, port=args.gateway_port)
+        gateway.start(background=True)
+        log.info("gateway on :%d", gateway.port)
+    for path in args.manifests:
+        apply_manifests(mgr.store, path)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        log.info("shutting down")
+        if gateway:
+            gateway.stop()
+        mgr.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Operator assembly: store + the controller set (cmd/main.go analogue)."""
+
+from __future__ import annotations
+
+from arks_tpu.control.application_controller import ApplicationController
+from arks_tpu.control.endpoint_controller import EndpointController
+from arks_tpu.control.gangset_controller import GangSetController
+from arks_tpu.control.model_controller import ModelController, default_fetcher
+from arks_tpu.control.reconciler import Manager
+from arks_tpu.control.store import Store
+from arks_tpu.control.workloads import GangDriver, LocalProcessDriver
+
+
+def build_manager(
+    models_root: str,
+    driver: GangDriver | None = None,
+    store: Store | None = None,
+    fetcher=default_fetcher,
+    local_platform: str | None = None,
+) -> Manager:
+    """Wire the controller set over one store.
+
+    Token/Quota have no controllers — by design, matching the reference where
+    both reconcilers are unregistered no-ops (cmd/main.go:264-277); the
+    gateway consumes those resources read-only.
+    """
+    mgr = Manager(store)
+    driver = driver or LocalProcessDriver()
+    mgr.add(ModelController(mgr.store, models_root, fetcher=fetcher))
+    mgr.add(GangSetController(mgr.store, driver))
+    mgr.add(ApplicationController(mgr.store, local_platform=local_platform))
+    mgr.add(EndpointController(mgr.store))
+    return mgr

@@ -1,0 +1,258 @@
+"""Gang workloads: spec generation + drivers.
+
+The reference delegates gang semantics to external operators (LWS v0.7.0 /
+RBGS — SURVEY.md §1) and only generates their specs.  Here GangSet is a
+first-class resource with pluggable drivers:
+
+- FakeGangDriver — test double; readiness is script-controlled (the "fake
+  gang-status driver" the reference lacks, SURVEY.md §4).
+- LocalProcessDriver — real subprocesses on this host (single-node demo and
+  e2e tests): spawns the leader command per replica group, readiness-probes
+  its HTTP port, restarts the whole group on exit (the LWS
+  RecreateGroupOnPodRestart semantic, arksapplication_controller.go:581-584).
+
+Env contract injected into every member (the LWS env contract translated —
+reference :560-569):
+  ARKS_GANG_LEADER_ADDRESS, ARKS_GANG_SIZE, ARKS_GANG_WORKER_INDEX
+and for the jax runtime the serving entrypoint's rendezvous vars
+(ARKS_COORDINATOR_ADDRESS / ARKS_NUM_PROCESSES / ARKS_PROCESS_ID).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Protocol
+
+from arks_tpu.control.resources import GangSet
+
+log = logging.getLogger("arks_tpu.workloads")
+
+
+class GangDriver(Protocol):
+    def ensure(self, gs: GangSet) -> None: ...
+    def status(self, gs: GangSet) -> dict: ...
+    def teardown(self, gs: GangSet) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# Fake driver (tests)
+# ---------------------------------------------------------------------------
+
+
+class FakeGangDriver:
+    """Marks groups Running after ``ready_after`` ensure() calls (0 =
+    immediately); tests can fail groups explicitly."""
+
+    def __init__(self, ready_after: int = 0):
+        self.ready_after = ready_after
+        self._ensures: dict[tuple, int] = {}
+        self._failed: set[tuple] = set()
+        self.torn_down: list[tuple] = []
+
+    def fail_group(self, gs_key: tuple, index: int) -> None:
+        self._failed.add((gs_key, index))
+
+    def recover_group(self, gs_key: tuple, index: int) -> None:
+        self._failed.discard((gs_key, index))
+
+    def ensure(self, gs: GangSet) -> None:
+        self._ensures[gs.key] = self._ensures.get(gs.key, 0) + 1
+
+    def status(self, gs: GangSet) -> dict:
+        replicas = gs.spec.get("replicas", 1)
+        seen = self._ensures.get(gs.key, 0)
+        groups = []
+        for i in range(replicas):
+            if (gs.key, i) in self._failed:
+                phase = "Failed"
+            elif seen > self.ready_after:
+                phase = "Running"
+            else:
+                phase = "Pending"
+            groups.append({"index": i, "phase": phase,
+                           "leaderAddr": f"fake-{gs.name}-{i}:8080"})
+        ready = sum(1 for g in groups if g["phase"] == "Running")
+        return {"replicas": replicas, "readyReplicas": ready, "groups": groups}
+
+    def teardown(self, gs: GangSet) -> None:
+        self.torn_down.append(gs.key)
+        self._ensures.pop(gs.key, None)
+
+
+# ---------------------------------------------------------------------------
+# Local process driver (single-node demo / e2e)
+# ---------------------------------------------------------------------------
+
+
+class _Group:
+    def __init__(self, proc: subprocess.Popen, port: int):
+        self.proc = proc
+        self.port = port
+        self.started = time.monotonic()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalProcessDriver:
+    """Runs each replica group's leader as a local subprocess.
+
+    size > 1 gangs still launch only the leader here (one host); multi-host
+    members come from the k8s deployment path (arks_tpu.control.k8s_export).
+    """
+
+    def __init__(self, log_dir: str = "/tmp/arks-tpu-logs"):
+        import atexit
+
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._groups: dict[tuple, dict[int, _Group]] = {}
+        self._lock = threading.Lock()
+        # Unlike k8s pods (which rightly outlive their operator), local
+        # subprocesses must die with this process or they leak.
+        atexit.register(self.teardown_all)
+
+    def teardown_all(self) -> None:
+        with self._lock:
+            groups = [g for d in self._groups.values() for g in d.values()]
+            self._groups.clear()
+        for g in groups:
+            self._stop_group(g)
+
+    def ensure(self, gs: GangSet) -> None:
+        with self._lock:
+            groups = self._groups.setdefault(gs.key, {})
+            replicas = gs.spec.get("replicas", 1)
+            # Reap dead groups → restart whole group (RecreateGroupOnPodRestart).
+            for idx, g in list(groups.items()):
+                if g.proc.poll() is not None:
+                    log.warning("gang %s group %d exited rc=%s; restarting",
+                                gs.name, idx, g.proc.returncode)
+                    del groups[idx]
+            for idx in range(replicas):
+                if idx in groups:
+                    continue
+                groups[idx] = self._launch(gs, idx)
+            # Scale down.
+            for idx in [i for i in groups if i >= replicas]:
+                self._stop_group(groups.pop(idx))
+
+    def _launch(self, gs: GangSet, index: int) -> _Group:
+        port = _free_port()
+        cmd = list(gs.spec["leader"]["command"])
+        cmd = [c.replace("$(PORT)", str(port)) for c in cmd]
+        env = dict(os.environ)
+        env.update(gs.spec["leader"].get("env", {}))
+        env.update({
+            "ARKS_GANG_LEADER_ADDRESS": f"127.0.0.1:{port}",
+            "ARKS_GANG_SIZE": str(gs.spec.get("size", 1)),
+            "ARKS_GANG_WORKER_INDEX": "0",
+        })
+        logf = open(os.path.join(
+            self.log_dir, f"{gs.namespace}-{gs.name}-{index}.log"), "ab")
+        log.info("gang %s/%s group %d: %s (port %d)",
+                 gs.namespace, gs.name, index, shlex.join(cmd), port)
+        proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+        return _Group(proc, port)
+
+    def status(self, gs: GangSet) -> dict:
+        with self._lock:
+            groups = dict(self._groups.get(gs.key, {}))
+        replicas = gs.spec.get("replicas", 1)
+        out = []
+        for i in range(replicas):
+            g = groups.get(i)
+            if g is None or g.proc.poll() is not None:
+                out.append({"index": i, "phase": "Pending", "leaderAddr": ""})
+                continue
+            phase = "Running" if self._probe(g.port) else "Starting"
+            out.append({"index": i, "phase": phase,
+                        "leaderAddr": f"127.0.0.1:{g.port}"})
+        ready = sum(1 for g in out if g["phase"] == "Running")
+        return {"replicas": replicas, "readyReplicas": ready, "groups": out}
+
+    def _probe(self, port: int) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readiness", timeout=2) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def _stop_group(self, g: _Group) -> None:
+        if g.proc.poll() is None:
+            g.proc.terminate()
+            try:
+                g.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                g.proc.kill()
+
+    def teardown(self, gs: GangSet) -> None:
+        with self._lock:
+            groups = self._groups.pop(gs.key, {})
+        for g in groups.values():
+            self._stop_group(g)
+
+
+# ---------------------------------------------------------------------------
+# Runtime command generation (the generateLeaderCommand analogue,
+# reference arksapplication_controller.go:941-1014)
+# ---------------------------------------------------------------------------
+
+
+def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
+                      tensor_parallel: int, size: int, common_args: list[str],
+                      model_path: str | None = None,
+                      platform: str | None = None) -> list[str]:
+    cmd = [sys.executable, "-m", "arks_tpu.server",
+           "--model", model_arg,
+           "--served-model-name", served_model_name,
+           "--port", port_token,
+           "--tensor-parallel-size", str(tensor_parallel)]
+    if model_path:
+        cmd += ["--model-path", model_path]
+    if platform:
+        cmd += ["--platform", platform]
+    cmd += list(common_args)
+    return cmd
+
+
+def gpu_runtime_command(runtime: str, model_path: str, served_model_name: str,
+                        tensor_parallel: int, size: int,
+                        common_args: list[str]) -> list[str]:
+    """Command lines for the GPU runtimes the reference launches, kept for
+    mixed-fleet parity (semantics per arksapplication_controller.go:941-1014;
+    these run in their own container images, never on this host)."""
+    if runtime == "vllm":
+        return (["python3", "-m", "vllm.entrypoints.openai.api_server",
+                 "--host", "0.0.0.0", "--port", "8080",
+                 "--model", model_path,
+                 "--served-model-name", served_model_name,
+                 "--tensor-parallel-size", str(tensor_parallel)]
+                + list(common_args))
+    if runtime == "sglang":
+        return (["python3", "-m", "sglang.launch_server",
+                 "--host", "0.0.0.0", "--port", "8080",
+                 "--model-path", model_path,
+                 "--served-model-name", served_model_name,
+                 "--tp", str(tensor_parallel),
+                 "--dist-init-addr", "$(ARKS_GANG_LEADER_ADDRESS)",
+                 "--nnodes", str(size),
+                 "--node-rank", "$(ARKS_GANG_WORKER_INDEX)",
+                 "--enable-metrics"]
+                + list(common_args))
+    if runtime == "dynamo":
+        return (["dynamo", "run", "in=http", f"out=dyn://{served_model_name}",
+                 "--model-path", model_path] + list(common_args))
+    raise ValueError(f"unknown runtime {runtime!r}")

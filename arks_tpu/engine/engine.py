@@ -227,14 +227,28 @@ class InferenceEngine:
             try:
                 progressed = self.step()
             except Exception:
-                # A scheduler bug must not wedge every connected client:
-                # fail the in-flight requests and keep serving.
+                # A scheduler/device fault must not wedge every connected
+                # client: fail the in-flight requests, REBUILD the device
+                # state (the dispatch donated cache+sampler buffers, so they
+                # may already be invalidated), and keep serving.
                 log.exception("engine step failed; aborting in-flight requests")
                 for slot in list(self._slots):
                     self._finish(slot, "abort")
+                self._reset_device_state()
                 progressed = True
             if not progressed:
                 time.sleep(0.001)
+
+    def _reset_device_state(self) -> None:
+        dtype = jnp.dtype(self.ecfg.dtype or self.cfg.dtype)
+        self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
+                                    self.ecfg.max_cache_len, dtype)
+        if self.mesh is not None:
+            self._cache = tf.shard_cache(self._cache, self.cfg, self.mesh)
+        self._sampling = sampler_mod.init_sampling_state(
+            self.ecfg.num_slots, self.ecfg.seed)
+        self._lengths[:] = 0
+        self._last_token[:] = 0
 
     def step(self, block_s: float = 0.05) -> bool:
         """One scheduler iteration: admit pending requests, then one decode
@@ -321,10 +335,18 @@ class InferenceEngine:
     def _decode_dispatch(self) -> None:
         K = self.ecfg.steps_per_dispatch
         with self._abort_lock:
-            aborted, self._aborted = self._aborted, set()
+            aborted = set(self._aborted)
+        consumed = set()
         for slot in list(self._slots):
-            if self._slots[slot].request.request_id in aborted:
+            rid = self._slots[slot].request.request_id
+            if rid in aborted:
                 self._finish(slot, "abort")
+                consumed.add(rid)
+        if consumed:
+            # Aborts for requests still waiting in the admission queue stay
+            # in the set until _admit_one consumes them.
+            with self._abort_lock:
+                self._aborted -= consumed
         # Retire any slot that would overflow its cache this dispatch.
         for slot in list(self._slots):
             if int(self._lengths[slot]) + 1 + K > self.ecfg.max_cache_len:

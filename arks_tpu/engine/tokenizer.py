@@ -130,6 +130,9 @@ class _HFStreamDecoder:
 
     def __init__(self, tok) -> None:
         self._tok = tok
+        # transformers recomputes all_special_tokens per access; cache it —
+        # this runs once per streamed chunk on the hot path.
+        self._special = set(tok.all_special_tokens)
         self._tokens: list[str] = []
         self._prefix = 0  # token index: everything before is emitted
         self._emitted_in_window = 0  # chars of window text already emitted
@@ -141,8 +144,7 @@ class _HFStreamDecoder:
         if not ids:
             return ""
         new = self._tok.convert_ids_to_tokens(list(ids))
-        special = set(self._tok.all_special_tokens)
-        self._tokens.extend(t for t in new if t not in special)
+        self._tokens.extend(t for t in new if t not in self._special)
         text = self._window_text()
         safe_end = len(text) - 1 if text.endswith("�") else len(text)
         out = text[self._emitted_in_window:safe_end]
@@ -162,9 +164,15 @@ class _HFStreamDecoder:
 
 
 def load_tokenizer(path: str | None) -> Tokenizer:
-    if path is None:
-        return ByteTokenizer()
-    return HFTokenizer(path)
+    if path is not None:
+        import os
+
+        has_assets = any(
+            os.path.exists(os.path.join(path, f))
+            for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model"))
+        if has_assets:
+            return HFTokenizer(path)
+    return ByteTokenizer()
 
 
 class IncrementalDetokenizer:

@@ -1,0 +1,3 @@
+from arks_tpu.gateway.server import Gateway
+
+__all__ = ["Gateway"]

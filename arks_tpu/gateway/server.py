@@ -1,0 +1,423 @@
+"""Gateway data plane: auth, QoS, rate limiting, quota, weighted routing.
+
+The reference implements this as an Envoy ext_proc plugin
+(/root/reference/pkg/gateway/); here the gateway IS the proxy (one less
+moving part, same wire behaviors):
+
+request path (handle_request.go:33-249):
+  Bearer token -> 401 if absent; parse {model, stream,
+  stream_options.include_usage}; resolve QoS by (token, model); validate the
+  model against the namespace's endpoints; streaming REQUIRES
+  include_usage=true (or usage can't be metered); pre-check rate limits and
+  quota (429); count the request (rpm/rpd); forward with injected
+  {model, namespace, username} headers.
+
+response path (handle_response.go:80-268):
+  non-streaming -> parse {usage} from the JSON body; streaming -> relay SSE
+  frames while scanning for the final usage frame; then TPM/TPD DoLimit +
+  quota IncrUsage({prompt,response,total}) + metrics.
+
+routing (arksendpoint_controller.go:283-369 + dist/gateway.yaml:230-248):
+  weighted choice over Endpoint.status.routes; passive ejection of backends
+  after 3 consecutive 5xx/connect errors for 30s.
+
+defaults (types.go:24-64): rpm=100 when unset; tpm=rpm*1000 when unset.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from arks_tpu.control.store import Store
+from arks_tpu.gateway.metrics import GatewayMetrics
+from arks_tpu.gateway.qos import QosProvider, TokenQos
+from arks_tpu.gateway.quota import QuotaService, QuotaStatusSyncer
+from arks_tpu.gateway.ratelimiter import (
+    RateLimiter, REQUEST_RULES, TOKEN_RULES,
+)
+from arks_tpu.control.resources import (
+    QUOTA_PROMPT, QUOTA_RESPONSE, QUOTA_TOTAL, RL_RPM, RL_TPM,
+)
+
+log = logging.getLogger("arks_tpu.gateway")
+
+DEFAULT_RPM = 100            # types.go:24-64
+DEFAULT_TPM_MULTIPLIER = 1000
+
+EJECT_AFTER_CONSECUTIVE_5XX = 3   # dist/gateway.yaml:230-248
+EJECT_SECONDS = 30.0
+
+HDR_MODEL = "x-arks-model"
+HDR_NAMESPACE = "x-arks-namespace"
+HDR_USER = "x-arks-username"
+
+
+class _ApiError(Exception):
+    def __init__(self, code: int, message: str, stage: str = ""):
+        super().__init__(message)
+        self.code, self.message, self.stage = code, message, stage
+
+
+class _Ejector:
+    """Passive outlier detection per backend address."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._bad: dict[str, int] = {}
+        self._ejected_until: dict[str, float] = {}
+
+    def ok(self, addr: str) -> None:
+        with self._lock:
+            self._bad.pop(addr, None)
+
+    def fail(self, addr: str) -> None:
+        with self._lock:
+            n = self._bad.get(addr, 0) + 1
+            self._bad[addr] = n
+            if n >= EJECT_AFTER_CONSECUTIVE_5XX:
+                self._ejected_until[addr] = time.monotonic() + EJECT_SECONDS
+                self._bad[addr] = 0
+
+    def available(self, addrs: list[str]) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            live = [a for a in addrs if self._ejected_until.get(a, 0) <= now]
+        # Max 100% ejection protection: if everything is ejected, try all.
+        return live or addrs
+
+
+class Gateway:
+    def __init__(self, store: Store, host: str = "0.0.0.0", port: int = 8081,
+                 rate_limiter: RateLimiter | None = None,
+                 quota: QuotaService | None = None,
+                 quota_sync_s: float = 2.0):
+        self.store = store
+        self.host, self.port = host, port
+        self.qos = QosProvider(store)
+        self.limiter = rate_limiter or RateLimiter()
+        self.quota = quota or QuotaService()
+        self.syncer = QuotaStatusSyncer(store, self.quota, sync_s=quota_sync_s)
+        self.metrics = GatewayMetrics()
+        self.ejector = _Ejector()
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------------
+
+    def start(self, background: bool = True) -> None:
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, code: int, message: str) -> None:
+                # error body parity (util.go:40-77)
+                self._json(code, {"error": {"message": message, "code": code}})
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    try:
+                        secret = gw._bearer(self.headers)
+                        models = gw.qos.get_models_by_token(secret)
+                        self._json(200, {"object": "list", "data": [
+                            {"id": m, "object": "model", "owned_by": "arks-tpu"}
+                            for m in models]})
+                    except _ApiError as e:
+                        self._error(e.code, e.message)
+                elif self.path == "/metrics":
+                    text = gw.metrics.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                elif self.path in ("/healthz", "/readiness"):
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._error(404, f"no route {self.path}")
+
+            def do_POST(self):
+                if self.path not in ("/v1/chat/completions", "/v1/completions"):
+                    return self._error(404, f"no route {self.path}")
+                gw._handle_inference(self)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        self.syncer.start()
+        if background:
+            threading.Thread(target=self._httpd.serve_forever, name="gateway",
+                             daemon=True).start()
+        else:
+            self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.syncer.stop()
+        self.qos.stop()
+        if self._httpd:
+            self._httpd.shutdown()
+
+    # ------------------------------------------------------------------
+    # Admission
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _bearer(headers) -> str:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer ") or not auth[7:].strip():
+            raise _ApiError(401, "missing or malformed Authorization header",
+                            "auth")
+        return auth[7:].strip()
+
+    def _effective_limits(self, qos: TokenQos) -> dict[str, int]:
+        limits = dict(qos.rate_limits)
+        if RL_RPM not in limits:
+            limits[RL_RPM] = DEFAULT_RPM
+        if RL_TPM not in limits:
+            limits[RL_TPM] = limits[RL_RPM] * DEFAULT_TPM_MULTIPLIER
+        return limits
+
+    def _admit(self, handler) -> tuple[TokenQos, dict, dict[str, int]]:
+        secret = self._bearer(handler.headers)
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            body = json.loads(handler.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            raise _ApiError(400, "invalid JSON body", "parse")
+        model = body.get("model", "")
+        if not model:
+            raise _ApiError(400, "missing model field", "parse")
+
+        qos = self.qos.get_qos_by_token(secret, model)
+        if qos is None:
+            if not self.qos.token_known(secret):
+                raise _ApiError(401, "invalid token", "auth")
+            raise _ApiError(403, f"token has no access to model {model!r}", "auth")
+        if model not in self.qos.get_model_list(qos.namespace):
+            raise _ApiError(404, f"model {model!r} not found", "route")
+
+        # Streaming requires include_usage so usage can be metered
+        # (handle_request.go:160-171).
+        if body.get("stream", False):
+            if not (body.get("stream_options") or {}).get("include_usage"):
+                raise _ApiError(
+                    400, "streaming requests require "
+                    "stream_options.include_usage=true", "parse")
+
+        limits = self._effective_limits(qos)
+        for res in self.limiter.check_limit(
+                qos.namespace, qos.username, model, limits,
+                requested={r: 1 for r in REQUEST_RULES}):
+            if res.over:
+                self.metrics.rate_limit_hits_total.inc(
+                    rule=res.rule, namespace=qos.namespace, user=qos.username)
+                raise _ApiError(429, f"rate limit exceeded: {res.rule} "
+                                f"({res.current}/{res.limit})", "ratelimit")
+        if qos.quota_name:
+            q_limits = self.qos.get_quota_limits(qos.namespace, qos.quota_name)
+            over, typ = self.quota.check(qos.namespace, qos.quota_name, q_limits)
+            if over:
+                raise _ApiError(429, f"quota exceeded: {typ}", "quota")
+            for typ, limit in q_limits.items():
+                self.metrics.quota_limit.set(
+                    limit, namespace=qos.namespace, quota=qos.quota_name, type=typ)
+
+        # Count the admitted request (rpm/rpd).
+        self.limiter.do_limit(qos.namespace, qos.username, model,
+                              {r: 1 for r in REQUEST_RULES})
+        return qos, body, limits
+
+    # ------------------------------------------------------------------
+    # Routing + proxy
+    # ------------------------------------------------------------------
+
+    def _pick_backends(self, namespace: str, model: str) -> list[str]:
+        ep = self.qos.get_endpoint(namespace, model)
+        if ep is None:
+            raise _ApiError(404, f"model {model!r} not found", "route")
+        routes = ep.status.get("routes", [])
+        weighted: list[tuple[str, int]] = []
+        for r in routes:
+            for addr in r.get("backend", {}).get("addresses", []):
+                weighted.append((addr, max(r.get("weight", 1), 1)))
+        if not weighted:
+            raise _ApiError(503, f"no ready backends for model {model!r}", "route")
+        addrs = self.ejector.available([a for a, _ in weighted])
+        pool = [(a, w) for a, w in weighted if a in addrs]
+        ordered: list[str] = []
+        while pool:
+            total = sum(w for _, w in pool)
+            x = random.uniform(0, total)
+            acc = 0.0
+            for i, (a, w) in enumerate(pool):
+                acc += w
+                if x <= acc:
+                    ordered.append(a)
+                    pool.pop(i)
+                    break
+        return ordered
+
+    def _handle_inference(self, handler) -> None:
+        t0 = time.monotonic()
+        qos = None
+        status = 500
+        try:
+            qos, body, limits = self._admit(handler)
+            status = self._proxy(handler, qos, body, limits)
+        except _ApiError as e:
+            status = e.code
+            self.metrics.errors_total.inc(stage=e.stage or "other")
+            try:
+                handler._error(e.code, e.message)
+            except Exception:
+                pass
+        except Exception as e:
+            log.exception("gateway failure")
+            self.metrics.errors_total.inc(stage="internal")
+            try:
+                handler._error(500, f"gateway error: {e}")
+            except Exception:
+                pass
+        finally:
+            labels = dict(status=str(status))
+            if qos is not None:
+                labels.update(namespace=qos.namespace, user=qos.username,
+                              model=qos.endpoint)
+            self.metrics.requests_total.inc(**labels)
+            self.metrics.request_duration.observe(time.monotonic() - t0)
+
+    def _proxy(self, handler, qos: TokenQos, body: dict,
+               limits: dict[str, int]) -> int:
+        payload = json.dumps(body).encode()
+        stream = bool(body.get("stream", False))
+        last_err: Exception | None = None
+        for addr in self._pick_backends(qos.namespace, qos.endpoint):
+            host, _, port = addr.partition(":")
+            conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
+            try:
+                conn.request("POST", handler.path, body=payload, headers={
+                    "Content-Type": "application/json",
+                    # Routing headers parity (handle_request.go:208-231).
+                    HDR_MODEL: qos.endpoint,
+                    HDR_NAMESPACE: qos.namespace,
+                    HDR_USER: qos.username,
+                })
+                resp = conn.getresponse()
+            except OSError as e:
+                self.ejector.fail(addr)
+                last_err = e
+                conn.close()
+                continue
+            try:
+                if resp.status >= 500:
+                    self.ejector.fail(addr)
+                else:
+                    self.ejector.ok(addr)
+                if stream and resp.status == 200:
+                    usage = self._relay_stream(handler, resp)
+                else:
+                    usage = self._relay_full(handler, resp)
+                if resp.status < 500 and usage:
+                    self._account_usage(qos, usage, limits)
+                return resp.status
+            finally:
+                conn.close()
+        raise _ApiError(503, f"all backends unreachable: {last_err}", "route")
+
+    def _relay_full(self, handler, resp) -> dict | None:
+        data = resp.read()
+        handler.send_response(resp.status)
+        handler.send_header("Content-Type",
+                            resp.headers.get("Content-Type", "application/json"))
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+        if resp.status != 200:
+            return None
+        try:
+            return json.loads(data).get("usage")
+        except (ValueError, json.JSONDecodeError):
+            return None
+
+    def _relay_stream(self, handler, resp) -> dict | None:
+        """Relay SSE to the client, scanning frames for the usage object
+        (handle_response.go:113-133). Robust to chunk fragmentation: frames
+        are reassembled on blank-line boundaries."""
+        handler.send_response(resp.status)
+        handler.send_header("Content-Type",
+                            resp.headers.get("Content-Type", "text/event-stream"))
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        usage = None
+        buf = b""
+        t_proc = 0.0
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            handler.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            handler.wfile.flush()
+            tp = time.monotonic()
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                for line in frame.splitlines():
+                    if not line.startswith(b"data: "):
+                        continue
+                    data = line[6:].strip()
+                    if data == b"[DONE]":
+                        continue
+                    try:
+                        obj = json.loads(data)
+                    except (ValueError, json.JSONDecodeError):
+                        continue
+                    if obj.get("usage"):
+                        usage = obj["usage"]
+            t_proc += time.monotonic() - tp
+        handler.wfile.write(b"0\r\n\r\n")
+        handler.wfile.flush()
+        self.metrics.response_process_duration.observe(t_proc * 1000)
+        return usage
+
+    # ------------------------------------------------------------------
+    # Usage accounting (handle_response.go:184-223)
+    # ------------------------------------------------------------------
+
+    def _account_usage(self, qos: TokenQos, usage: dict,
+                       limits: dict[str, int]) -> None:
+        prompt = int(usage.get("prompt_tokens", 0))
+        completion = int(usage.get("completion_tokens", 0))
+        total = int(usage.get("total_tokens", prompt + completion))
+        self.limiter.do_limit(qos.namespace, qos.username, qos.endpoint,
+                              {r: total for r in TOKEN_RULES})
+        self.metrics.rate_limit_tokens.inc(
+            total, namespace=qos.namespace, user=qos.username)
+        if qos.quota_name:
+            self.quota.incr_usage(qos.namespace, qos.quota_name, {
+                QUOTA_PROMPT: prompt, QUOTA_RESPONSE: completion,
+                QUOTA_TOTAL: total})
+            for typ, used in self.quota.get_usage(
+                    qos.namespace, qos.quota_name).items():
+                self.metrics.quota_usage.set(
+                    used, namespace=qos.namespace, quota=qos.quota_name, type=typ)
+        for typ, amount in (("prompt", prompt), ("response", completion),
+                            ("total", total)):
+            self.metrics.token_usage.inc(
+                amount, type=typ, namespace=qos.namespace, user=qos.username)
+        self.metrics.token_distribution.observe(total)

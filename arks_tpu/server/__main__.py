@@ -70,11 +70,15 @@ def main() -> None:
         model_path = args.model_path
 
     n_dev = len(jax.devices())
+    tp = args.tp or (n_dev // args.dp)
+    want = tp * args.dp
     mesh = None
-    if n_dev > 1:
+    if want > 1:
         from arks_tpu.parallel.mesh import make_mesh
-        tp = args.tp or (n_dev // args.dp)
-        mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp)
+        # Use exactly the devices the plan asks for; a host may expose more
+        # (e.g. a forced multi-device CPU platform) than the spec wants.
+        mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp,
+                         devices=jax.devices()[:want])
 
     params = None
     if model_path:

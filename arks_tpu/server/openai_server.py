@@ -162,68 +162,106 @@ class OpenAIServer:
             "created": int(time.time()), "owned_by": "arks-tpu",
         }]}
 
-    def _prompt_ids(self, body: dict, chat: bool) -> list[int]:
+    def _prompt_ids_batch(self, body: dict, chat: bool) -> list[list[int]]:
+        """One id-list per prompt. Chat is always a single prompt; completions
+        accept a string, a token-id list, or a list of strings (OpenAI batch
+        form -> one choice per prompt)."""
         tok = self.engine.tokenizer
         if chat:
             messages = body.get("messages") or []
             if not isinstance(messages, list) or not messages:
                 raise ValueError("messages must be a non-empty list")
-            return tok.apply_chat_template(messages)
+            return [tok.apply_chat_template(messages)]
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
-            prompt = "".join(prompt) if all(isinstance(p, str) for p in prompt) else prompt
-        if isinstance(prompt, list):  # token-id prompt
-            return [int(t) for t in prompt]
-        return tok.encode(str(prompt))
+            if all(isinstance(p, int) for p in prompt) and prompt:
+                batch = [[int(t) for t in prompt]]
+            elif all(isinstance(p, str) for p in prompt) and prompt:
+                batch = [tok.encode(p) for p in prompt]
+            else:
+                raise ValueError("prompt list must be all strings or all token ids")
+        else:
+            batch = [tok.encode(str(prompt))]
+        for ids in batch:
+            if not ids:
+                raise ValueError("prompt must not be empty")
+        return batch
 
     def _handle_completion(self, h, body: dict, chat: bool) -> None:
         model = body.get("model") or self.served_model_name
         if model != self.served_model_name:
             return h._error(404, f"model {model!r} not found")
         try:
-            prompt_ids = self._prompt_ids(body, chat)
+            batch = self._prompt_ids_batch(body, chat)
         except ValueError as e:
             return h._error(400, str(e))
 
         params, stop_strings = _sampling_from_body(body, self.engine.tokenizer)
-        req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
-                      prompt_ids=prompt_ids, params=params)
-        self.engine.add_request(req)
+        stream = bool(body.get("stream", False))
+        if stream and len(batch) > 1:
+            return h._error(400, "streaming is not supported for batched prompts")
 
-        if body.get("stream", False):
+        reqs = []
+        for prompt_ids in batch:
+            req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
+                          prompt_ids=prompt_ids, params=params)
+            self.engine.add_request(req)
+            reqs.append(req)
+
+        if stream:
             include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
-            self._stream_response(h, req, chat, model, include_usage, stop_strings)
+            self._stream_response(h, reqs[0], chat, model, include_usage, stop_strings)
+        elif len(reqs) == 1:
+            self._full_response(h, reqs[0], chat, model, stop_strings)
         else:
-            self._full_response(h, req, chat, model, stop_strings)
+            self._batch_response(h, reqs, model, stop_strings)
 
     # ------------------------------------------------------------------
 
-    def _full_response(self, h, req: Request, chat: bool, model: str,
-                       stop_strings: list[str]) -> None:
+    def _collect_text(self, req: Request, stop_strings: list[str]):
+        """Drain a request to completion, applying stop-string truncation to
+        every chunk — including the final one and flushed tail text.
+        Returns (text, finish_reason, final RequestOutput)."""
         detok = IncrementalDetokenizer(self.engine.tokenizer)
         text = ""
-        fin = None
-        stopped_on_string = False
         while True:
             out = req.outputs.get()
             text += detok.push(out.token_ids)
-            if not out.finished and stop_strings:
+            if out.finished:
+                text += detok.flush()
+            if stop_strings:
                 cut = _find_stop(text, stop_strings)
                 if cut is not None:
                     text = text[:cut]
-                    stopped_on_string = True
-                    self.engine.abort(req.request_id)
-                    # Drain until the engine acknowledges the abort.
-                    while not out.finished:
-                        out = req.outputs.get()
-                    fin = out
-                    break
+                    if not out.finished:
+                        self.engine.abort(req.request_id)
+                        while not out.finished:
+                            out = req.outputs.get()
+                    return text, "stop", out
             if out.finished:
-                fin = out
-                break
-        if not stopped_on_string:
-            text += detok.flush()
-        finish_reason = "stop" if stopped_on_string else fin.finish_reason
+                return text, out.finish_reason, out
+
+    def _batch_response(self, h, reqs: list[Request], model: str,
+                        stop_strings: list[str]) -> None:
+        """OpenAI batched-prompt completions: one choice per prompt."""
+        choices, usage = [], {"prompt_tokens": 0, "completion_tokens": 0,
+                              "total_tokens": 0}
+        for i, req in enumerate(reqs):
+            text, finish_reason, fin = self._collect_text(req, stop_strings)
+            choices.append({"index": i, "text": text,
+                            "finish_reason": finish_reason})
+            usage["prompt_tokens"] += fin.num_prompt_tokens
+            usage["completion_tokens"] += fin.num_generated_tokens
+        usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
+        h._json(200, {
+            "id": reqs[0].request_id, "object": "text_completion",
+            "created": int(time.time()), "model": model,
+            "choices": choices, "usage": usage,
+        })
+
+    def _full_response(self, h, req: Request, chat: bool, model: str,
+                       stop_strings: list[str]) -> None:
+        text, finish_reason, fin = self._collect_text(req, stop_strings)
         usage = {
             "prompt_tokens": fin.num_prompt_tokens,
             "completion_tokens": fin.num_generated_tokens,

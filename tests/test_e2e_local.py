@@ -1,0 +1,116 @@
+"""Full-stack e2e on one host: operator + REAL engine subprocess + gateway.
+
+The "minimum end-to-end slice" (SURVEY.md §7 stage 4) plus the gateway:
+manifests -> controllers -> LocalProcessDriver spawns a real
+``python -m arks_tpu.server`` process -> Endpoint discovers it -> client
+calls the gateway with a token and gets an engine-generated completion with
+metered usage.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from arks_tpu.control import resources as res
+from arks_tpu.control.manager import build_manager
+from arks_tpu.control.workloads import LocalProcessDriver
+from arks_tpu.gateway.server import Gateway
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    root = tmp_path_factory.mktemp("e2e")
+    driver = LocalProcessDriver(log_dir=str(root / "logs"))
+    mgr = build_manager(models_root=str(root / "models"), driver=driver,
+                        local_platform="cpu")
+    mgr.start()
+    gw = Gateway(mgr.store, host="127.0.0.1", port=0, quota_sync_s=0.5)
+    gw.start(background=True)
+    yield mgr, gw
+    gw.stop()
+    mgr.stop()
+    # Tear down spawned engines.
+    for gs in mgr.store.list(res.GangSet):
+        driver.teardown(gs)
+
+
+def wait_for(predicate, timeout=120.0, interval=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def test_quickstart_end_to_end(stack):
+    mgr, gw = stack
+    store = mgr.store
+
+    store.create(res.Model(name="tiny-model", spec={"model": "test/tiny"}))
+    store.create(res.Application(name="tiny-app", spec={
+        "replicas": 1, "size": 1, "runtime": "jax",
+        "model": {"name": "tiny-model"},
+        "servedModelName": "tiny-served",
+        "tensorParallel": 1,
+        "modelConfig": "tiny",
+        "runtimeCommonArgs": ["--num-slots", "2", "--max-model-len", "64"],
+    }))
+    store.create(res.Endpoint(name="tiny-served", spec={"defaultWeight": 1}))
+    store.create(res.Token(name="e2e-user", spec={
+        "token": "sk-e2e",
+        "qos": [{"endpoint": {"name": "tiny-served"},
+                 "rateLimits": [{"type": "rpm", "value": 50}],
+                 "quota": {"name": "e2e-quota"}}]}))
+    store.create(res.Quota(name="e2e-quota", spec={
+        "quotas": [{"type": "total", "value": 100000}]}))
+
+    # Engine subprocess boot: jax import + compile, tens of seconds on CPU.
+    wait_for(lambda: store.get(res.Application, "tiny-app").status.get("phase")
+             == res.PHASE_RUNNING, timeout=180)
+    ep = wait_for(lambda: (store.get(res.Endpoint, "tiny-served").status.get("routes")
+                           or None), timeout=30)
+    assert ep[0]["backend"]["addresses"]
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+        data=json.dumps({
+            "model": "tiny-served",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 5, "temperature": 0, "ignore_eos": True,
+        }).encode(),
+        headers={"Content-Type": "application/json",
+                 "Authorization": "Bearer sk-e2e"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        data = json.load(r)
+    assert data["object"] == "chat.completion"
+    assert data["usage"]["completion_tokens"] == 5
+    assert data["choices"][0]["finish_reason"] == "length"
+
+    # Usage metered through the gateway into the quota service.
+    total = data["usage"]["total_tokens"]
+    assert gw.quota.get_usage("default", "e2e-quota")["total"] == total
+
+    # Streamed request through the whole stack.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+        data=json.dumps({
+            "model": "tiny-served",
+            "messages": [{"role": "user", "content": "again"}],
+            "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+            "stream": True, "stream_options": {"include_usage": True},
+        }).encode(),
+        headers={"Content-Type": "application/json",
+                 "Authorization": "Bearer sk-e2e"})
+    frames = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+    assert frames[-1] == "[DONE]"
+    wait_for(lambda: gw.quota.get_usage("default", "e2e-quota")["total"] > total,
+             timeout=10)

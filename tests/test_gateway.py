@@ -1,0 +1,259 @@
+"""Gateway data-plane tests: auth, QoS, rate limits, quota, routing, SSE
+usage extraction — the behaviors of the reference's ext_proc plugin
+(pkg/gateway), asserted over a stub OpenAI backend."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from arks_tpu.control import resources as res
+from arks_tpu.control.store import Store
+from arks_tpu.gateway.server import Gateway
+
+PROMPT_TOKENS, COMPLETION_TOKENS = 7, 5
+
+
+class _StubBackend:
+    """Minimal OpenAI-compatible backend with fixed usage numbers."""
+
+    def __init__(self, fail_with: int | None = None):
+        self.requests: list[dict] = []
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                stub.requests.append(
+                    {"body": body,
+                     "headers": {k.lower(): v for k, v in self.headers.items()}})
+                if stub.fail_with:
+                    self.send_response(stub.fail_with)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                usage = {"prompt_tokens": PROMPT_TOKENS,
+                         "completion_tokens": COMPLETION_TOKENS,
+                         "total_tokens": PROMPT_TOKENS + COMPLETION_TOKENS}
+                if body.get("stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    frames = [
+                        {"id": "x", "choices": [{"delta": {"content": "hi"}}]},
+                        {"id": "x", "choices": [], "usage": usage},
+                    ]
+                    payload = b"".join(
+                        b"data: " + json.dumps(f).encode() + b"\n\n" for f in frames
+                    ) + b"data: [DONE]\n\n"
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    data = json.dumps({"id": "x", "choices": [
+                        {"message": {"content": "hello"}}], "usage": usage}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+
+        self.fail_with = fail_with
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def addr(self):
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def world():
+    store = Store()
+    backend = _StubBackend()
+    store.create(res.Endpoint(name="m1", namespace="team-a", spec={}, status={
+        "routes": [{"backend": {"addresses": [backend.addr]}, "weight": 1}]}))
+    store.create(res.Token(name="alice", namespace="team-a", spec={
+        "token": "sk-alice",
+        "qos": [{"endpoint": {"name": "m1"},
+                 "rateLimits": [{"type": "rpm", "value": 4}],
+                 "quota": {"name": "alice-quota"}}]}))
+    store.create(res.Quota(name="alice-quota", namespace="team-a", spec={
+        "quotas": [{"type": "total", "value": 60}]}))
+    gw = Gateway(store, host="127.0.0.1", port=0, quota_sync_s=0.2)
+    gw.start(background=True)
+    deadline = time.monotonic() + 10
+    while not gw.qos.token_known("sk-alice") and time.monotonic() < deadline:
+        time.sleep(0.02)  # wait for the token index pump
+    yield gw, store, backend
+    gw.stop()
+    backend.stop()
+
+
+def _post(gw, body, token="sk-alice", path="/v1/chat/completions"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {})})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def _err(fn):
+    try:
+        fn()
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_auth_required(world):
+    gw, _, _ = world
+    code, body = _err(lambda: _post(gw, {"model": "m1"}, token=None))
+    assert code == 401 and "Authorization" in body["error"]["message"]
+
+
+def test_unknown_token_401(world):
+    gw, _, _ = world
+    code, _ = _err(lambda: _post(gw, {"model": "m1"}, token="sk-mallory"))
+    assert code == 401
+
+
+def test_model_not_in_qos_403(world):
+    gw, store, _ = world
+    store.create(res.Endpoint(name="m2", namespace="team-a", spec={}))
+    code, _ = _err(lambda: _post(gw, {"model": "m2"}))
+    assert code == 403
+
+
+def test_unknown_model_404(world):
+    gw, store, _ = world
+    t = store.get(res.Token, "alice", "team-a")
+    t.spec["qos"].append({"endpoint": {"name": "ghost"}, "rateLimits": []})
+    store.update(t)
+    time.sleep(0.2)
+    code, _ = _err(lambda: _post(gw, {"model": "ghost"}))
+    assert code == 404
+
+
+def test_stream_requires_include_usage(world):
+    gw, _, _ = world
+    code, body = _err(lambda: _post(gw, {"model": "m1", "stream": True}))
+    assert code == 400 and "include_usage" in body["error"]["message"]
+
+
+def test_proxy_non_stream_and_usage_accounting(world):
+    gw, store, backend = world
+    with _post(gw, {"model": "m1", "messages": []}) as r:
+        data = json.load(r)
+    assert data["usage"]["total_tokens"] == 12
+    # Routing headers injected toward the backend.
+    hdrs = backend.requests[-1]["headers"]
+    assert hdrs["x-arks-model"] == "m1"
+    assert hdrs["x-arks-namespace"] == "team-a"
+    assert hdrs["x-arks-username"] == "alice"
+    # Quota accounted + persisted into the CR status by the syncer.
+    assert gw.quota.get_usage("team-a", "alice-quota")["total"] == 12
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        q = store.get(res.Quota, "alice-quota", "team-a")
+        used = {s["type"]: s["used"] for s in q.status.get("quotaStatus", [])}
+        if used.get("total") == 12:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("quota status not synced")
+
+
+def test_streaming_relay_and_usage(world):
+    gw, _, _ = world
+    frames = []
+    with _post(gw, {"model": "m1", "stream": True,
+                    "stream_options": {"include_usage": True}}) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+    assert frames[-1] == "[DONE]"
+    assert gw.quota.get_usage("team-a", "alice-quota")["total"] == 12
+
+
+def test_rpm_limit_429(world):
+    gw, _, _ = world
+    for _ in range(4):
+        _post(gw, {"model": "m1"}).read()
+    code, body = _err(lambda: _post(gw, {"model": "m1"}))
+    assert code == 429 and "rpm" in body["error"]["message"]
+
+
+def test_quota_exhaustion_429(world):
+    gw, store, _ = world
+    t = store.get(res.Token, "alice", "team-a")
+    t.spec["qos"][0]["rateLimits"] = [{"type": "rpm", "value": 100}]
+    store.update(t)
+    time.sleep(0.3)  # token index pump
+    for _ in range(5):  # 5 * 12 = 60 >= limit 60
+        _post(gw, {"model": "m1"}).read()
+    code, body = _err(lambda: _post(gw, {"model": "m1"}))
+    assert code == 429 and "quota" in body["error"]["message"]
+
+
+def test_models_list_scoped_to_token(world):
+    gw, _, _ = world
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/models",
+        headers={"Authorization": "Bearer sk-alice"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        data = json.load(r)
+    assert [m["id"] for m in data["data"]] == ["m1"]
+
+
+def test_backend_failover(world):
+    gw, store, backend = world
+    ep = store.get(res.Endpoint, "m1", "team-a")
+    # Dead backend first; gateway must fail over to the live one.
+    ep.status["routes"] = [
+        {"backend": {"addresses": ["127.0.0.1:1", backend.addr]}, "weight": 1}]
+    store.update_status(ep)
+    ok = 0
+    for _ in range(4):
+        with _post(gw, {"model": "m1"}) as r:
+            ok += r.status == 200
+    assert ok == 4
+
+
+def test_restart_recovery_reseeds_from_cr(world):
+    gw, store, backend = world
+    _post(gw, {"model": "m1"}).read()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        q = store.get(res.Quota, "alice-quota", "team-a")
+        if q.status.get("quotaStatus"):
+            break
+        time.sleep(0.05)
+    # Simulate a gateway restart: fresh QuotaService, empty counters.
+    gw.quota._usage.clear()
+    gw.syncer.sync_once()
+    assert gw.quota.get_usage("team-a", "alice-quota")["total"] == 12
+
+
+def test_no_backends_503(world):
+    gw, store, _ = world
+    ep = store.get(res.Endpoint, "m1", "team-a")
+    ep.status["routes"] = []
+    store.update_status(ep)
+    code, _ = _err(lambda: _post(gw, {"model": "m1"}))
+    assert code == 503

@@ -184,3 +184,31 @@ def test_small_max_model_len_no_crash():
         if out.finished:
             break
     assert outs[-1].finished
+
+def test_batched_prompt_multi_choice(server):
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": ["ab", "cd"], "max_tokens": 3,
+        "temperature": 0, "ignore_eos": True,
+    }) as r:
+        data = json.load(r)
+    assert [c["index"] for c in data["choices"]] == [0, 1]
+    assert all(c["finish_reason"] == "length" for c in data["choices"])
+    assert data["usage"]["prompt_tokens"] == 4
+    assert data["usage"]["completion_tokens"] == 6
+
+
+def test_empty_prompt_400(server):
+    try:
+        _post(server, "/v1/completions", {"model": "tiny-serve", "prompt": ""})
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_stream_batch_prompt_400(server):
+    try:
+        _post(server, "/v1/completions", {
+            "model": "tiny-serve", "prompt": ["a", "b"], "stream": True})
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
