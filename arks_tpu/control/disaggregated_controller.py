@@ -1,0 +1,307 @@
+"""DisaggregatedApplication controller: prefill/decode-separated serving.
+
+Mirrors the reference ArksDisaggregatedApplicationReconciler
+(/root/reference/internal/controller/
+arksdisaggregatedapplication_controller.go):
+
+- same phase machine as the standalone controller (:208-216 precheck,
+  Pending -> Checking -> Loading -> Creating -> Running | Failed)
+- three workloads per app: router + prefill gang + decode gang
+  (legacy-mode layout ``<name>-prefill`` / ``<name>-decode`` + router
+  deployment :284-391; the router Service is ``<name>-router-svc`` :739-770)
+- per-component status {replicas, readyReplicas} synced back (:393-497)
+
+TPU-native differences:
+- runtime is the arks_tpu jax server with ``--disaggregation-mode
+  prefill|decode`` (flag parity with the reference's SGLang commands
+  :1672-1724) and ``python -m arks_tpu.router`` instead of sglang_router
+- service discovery: instead of the reference router's k8s label-selector
+  pod watch (:1630-1670), the controller maintains a discovery JSON file
+  (locally a tmp file; on k8s a ConfigMap volume) listing ready
+  prefill/decode addresses; the router hot-reloads it on mtime change.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import tempfile
+from typing import Iterable
+
+from arks_tpu.control.reconciler import Controller, Result
+from arks_tpu.control.resources import (
+    COND_LOADED, COND_PRECHECK, COND_READY, LABEL_APPLICATION,
+    LABEL_MANAGED_BY, LABEL_MODEL, LABEL_ROLE, MANAGED_BY, MODEL_PHASE_READY,
+    PHASE_CHECKING, PHASE_CREATING, PHASE_FAILED, PHASE_LOADING,
+    PHASE_PENDING, PHASE_RUNNING, RESERVED_MODELS_PATH, RUNTIME_JAX,
+    DisaggregatedApplication, GangSet, Model, Service,
+)
+from arks_tpu.control.store import NotFound, Store
+from arks_tpu.control.workloads import jax_serve_command
+
+log = logging.getLogger("arks_tpu.control.disaggregated")
+
+COMPONENTS = ("router", "prefill", "decode")
+
+
+def component_name(app: DisaggregatedApplication, component: str) -> str:
+    # reference naming: <name>-prefill / <name>-decode (:284-391)
+    return f"{app.name}-{component}"
+
+
+def router_service_name(app: DisaggregatedApplication) -> str:
+    # reference: <name>-router-svc (:739-770)
+    return f"{app.name}-router-svc"
+
+
+class DisaggregatedApplicationController(Controller):
+    KIND = DisaggregatedApplication
+    FINALIZER = "disaggregatedapplication.arks.ai/controller"
+
+    def __init__(self, store: Store, workers: int = 4,
+                 local_platform: str | None = None,
+                 discovery_dir: str | None = None):
+        super().__init__(store, workers=workers)
+        self.local_platform = local_platform
+        self.discovery_dir = discovery_dir or os.path.join(
+            tempfile.gettempdir(), "arks-tpu-discovery")
+        os.makedirs(self.discovery_dir, exist_ok=True)
+
+    def watches(self) -> Iterable:
+        def apps_for_model(model) -> list[tuple[str, str]]:
+            return [a.key for a in self.store.list(
+                DisaggregatedApplication, namespace=model.namespace)
+                if a.spec.get("model", {}).get("name") == model.name]
+
+        def app_for_gangset(gs) -> list[tuple[str, str]]:
+            for kind, name in gs.owner_refs:
+                if kind == DisaggregatedApplication.KIND:
+                    return [(gs.namespace, name)]
+            return []
+
+        return [(Model, apps_for_model), (GangSet, app_for_gangset)]
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, app: DisaggregatedApplication) -> Result | None:
+        status_before = app.deepcopy().status
+
+        if not app.status.get("phase"):
+            app.status["phase"] = PHASE_PENDING
+
+        # --- precheck: only the jax runtime supports native PD separation
+        # (the reference only supports sglang there, :208-216). ---
+        runtime = app.spec.get("runtime", RUNTIME_JAX)
+        if runtime != RUNTIME_JAX:
+            app.set_condition(COND_PRECHECK, False, "InvalidRuntime",
+                              f"disaggregated serving requires runtime "
+                              f"{RUNTIME_JAX!r}, got {runtime!r}")
+            app.status["phase"] = PHASE_FAILED
+            self._sync(app, status_before)
+            return None
+        app.set_condition(COND_PRECHECK, True, "PrecheckPassed", "")
+        if app.status["phase"] == PHASE_PENDING:
+            app.status["phase"] = PHASE_CHECKING
+
+        # --- model gate ---
+        model_name = app.spec.get("model", {}).get("name")
+        if not model_name:
+            app.set_condition(COND_PRECHECK, False, "NoModel",
+                              "spec.model.name required")
+            app.status["phase"] = PHASE_FAILED
+            self._sync(app, status_before)
+            return None
+        model = self.store.try_get(Model, model_name, app.namespace)
+        if model is None or model.phase != MODEL_PHASE_READY:
+            app.set_condition(COND_LOADED, False, "ModelNotReady",
+                              f"model {model_name} not ready")
+            app.status["phase"] = PHASE_LOADING
+            self._sync(app, status_before)
+            return Result(requeue_after=1.0)
+        app.set_condition(COND_LOADED, True, "ModelReady", "")
+        if app.status["phase"] in (PHASE_CHECKING, PHASE_LOADING):
+            app.status["phase"] = PHASE_CREATING
+
+        # --- workloads: prefill + decode gangs, then router ---
+        statuses: dict[str, dict] = {}
+        for component in ("prefill", "decode"):
+            self._ensure_gangset(
+                app, model, component,
+                self._worker_spec(app, model, component))
+            gs = self.store.try_get(
+                GangSet, component_name(app, component), app.namespace)
+            statuses[component] = gs.status if gs else {}
+
+        # Discovery file BEFORE the router so it starts with addresses.
+        self._write_discovery(app, statuses)
+        self._ensure_gangset(app, model, "router", self._router_spec(app))
+        gs = self.store.try_get(GangSet, component_name(app, "router"),
+                                app.namespace)
+        statuses["router"] = gs.status if gs else {}
+
+        self._ensure_router_service(app)
+
+        # --- status sync (:393-497) ---
+        for component in COMPONENTS:
+            st = statuses[component]
+            app.status[component] = {
+                "replicas": st.get("replicas", 0),
+                "readyReplicas": st.get("readyReplicas", 0),
+            }
+        if app.ready():
+            app.status["phase"] = PHASE_RUNNING
+            app.set_condition(COND_READY, True, "AllComponentsReady", "")
+        else:
+            waiting = ", ".join(
+                f"{c}={app.status[c]['readyReplicas']}/"
+                f"{app.spec.get(c, {}).get('replicas', 1)}"
+                for c in COMPONENTS)
+            app.set_condition(COND_READY, False, "WaitingForComponents", waiting)
+            if app.status["phase"] == PHASE_RUNNING:
+                app.status["phase"] = PHASE_CREATING
+
+        self._sync(app, status_before)
+        self._sync_router_addresses(app, statuses["router"])
+        return None
+
+    # ------------------------------------------------------------------
+    # Spec generation
+    # ------------------------------------------------------------------
+
+    def _worker_spec(self, app: DisaggregatedApplication, model: Model,
+                     component: str) -> dict:
+        ws = app.spec.get(component, {})
+        tp = ws.get("tensorParallel", app.spec.get("tensorParallel", 1))
+        size = ws.get("size", 1)
+        served = app.served_model_name or model.name
+        common = list(ws.get("runtimeCommonArgs",
+                             app.spec.get("runtimeCommonArgs", [])))
+        common += ["--disaggregation-mode", component]
+        model_path = model.status.get("path", RESERVED_MODELS_PATH)
+        model_arg = app.spec.get("modelConfig") or model_path
+        cmd = jax_serve_command(
+            model_arg=model_arg, served_model_name=served,
+            port_token="$(PORT)", tensor_parallel=tp, size=size,
+            common_args=common, model_path=model_path,
+            platform=self.local_platform)
+        return {
+            "replicas": ws.get("replicas", 1),
+            "size": size,
+            "leader": {"command": cmd, "env": {}},
+            "worker": {"command": cmd, "env": {}},
+            "ports": {"http": 8080},
+            "restartPolicy": "RecreateGroupOnPodRestart",
+            "runtime": RUNTIME_JAX,
+            "role": component,
+        }
+
+    def _router_spec(self, app: DisaggregatedApplication) -> dict:
+        rs = app.spec.get("router", {})
+        served = app.served_model_name or app.spec.get("model", {}).get("name", "")
+        cmd = [sys.executable, "-m", "arks_tpu.router",
+               "--port", "$(PORT)",
+               "--served-model-name", served,
+               "--discovery-file", self._discovery_path(app)]
+        return {
+            "replicas": rs.get("replicas", 1),
+            "size": 1,
+            "leader": {"command": cmd, "env": {}},
+            "worker": {"command": cmd, "env": {}},
+            "ports": {"http": 8080},
+            "restartPolicy": "RecreateGroupOnPodRestart",
+            "runtime": "router",
+            "role": "router",
+        }
+
+    def _ensure_gangset(self, app: DisaggregatedApplication, model: Model,
+                        component: str, spec: dict) -> None:
+        name = component_name(app, component)
+        existing = self.store.try_get(GangSet, name, app.namespace)
+        if existing is None:
+            gs = GangSet(name=name, namespace=app.namespace,
+                         labels={LABEL_MANAGED_BY: MANAGED_BY,
+                                 LABEL_APPLICATION: app.name,
+                                 LABEL_MODEL: model.name if model else "",
+                                 LABEL_ROLE: component},
+                         owner_refs=[(DisaggregatedApplication.KIND, app.name)],
+                         spec=spec)
+            self.store.create(gs)
+        elif existing.spec != spec:
+            existing.spec = spec
+            self.store.update(existing)
+
+    # ------------------------------------------------------------------
+    # Discovery + service
+    # ------------------------------------------------------------------
+
+    def _discovery_path(self, app: DisaggregatedApplication) -> str:
+        return os.path.join(self.discovery_dir,
+                            f"{app.namespace}-{app.name}.json")
+
+    def _write_discovery(self, app: DisaggregatedApplication,
+                         statuses: dict[str, dict]) -> None:
+        data = {}
+        for component in ("prefill", "decode"):
+            data[component] = [
+                g["leaderAddr"] for g in
+                statuses.get(component, {}).get("groups", [])
+                if g.get("phase") == "Running" and g.get("leaderAddr")]
+        path = self._discovery_path(app)
+        try:
+            with open(path) as f:
+                if json.load(f) == data:
+                    return  # unchanged; don't bump mtime
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    def _ensure_router_service(self, app: DisaggregatedApplication) -> None:
+        name = router_service_name(app)
+        if self.store.try_get(Service, name, app.namespace) is None:
+            svc = Service(
+                name=name, namespace=app.namespace,
+                labels={LABEL_MANAGED_BY: MANAGED_BY,
+                        LABEL_APPLICATION: app.name,
+                        "prometheus-discovery": "true"},
+                owner_refs=[(DisaggregatedApplication.KIND, app.name)],
+                spec={"selector": {LABEL_APPLICATION: app.name,
+                                   LABEL_ROLE: "router"},
+                      "port": 8080})
+            self.store.create(svc)
+
+    def _sync_router_addresses(self, app: DisaggregatedApplication,
+                               router_status: dict) -> None:
+        svc = self.store.try_get(Service, router_service_name(app),
+                                 app.namespace)
+        if svc is None:
+            return
+        addrs = [g["leaderAddr"] for g in router_status.get("groups", [])
+                 if g.get("phase") == "Running" and g.get("leaderAddr")]
+        if svc.status.get("addresses") != addrs:
+            svc.status["addresses"] = addrs
+            self.store.update_status(svc)
+
+    def _sync(self, app: DisaggregatedApplication, before: dict) -> None:
+        if app.status != before:
+            self.store.update_status(app)
+
+    def finalize(self, app: DisaggregatedApplication) -> None:
+        for component in COMPONENTS:
+            try:
+                self.store.delete(GangSet, component_name(app, component),
+                                  app.namespace)
+            except NotFound:
+                pass
+        try:
+            self.store.delete(Service, router_service_name(app), app.namespace)
+        except NotFound:
+            pass
+        try:
+            os.remove(self._discovery_path(app))
+        except OSError:
+            pass

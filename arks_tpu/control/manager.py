@@ -3,6 +3,9 @@
 from __future__ import annotations
 
 from arks_tpu.control.application_controller import ApplicationController
+from arks_tpu.control.disaggregated_controller import (
+    DisaggregatedApplicationController,
+)
 from arks_tpu.control.endpoint_controller import EndpointController
 from arks_tpu.control.gangset_controller import GangSetController
 from arks_tpu.control.model_controller import ModelController, default_fetcher
@@ -29,5 +32,7 @@ def build_manager(
     mgr.add(ModelController(mgr.store, models_root, fetcher=fetcher))
     mgr.add(GangSetController(mgr.store, driver))
     mgr.add(ApplicationController(mgr.store, local_platform=local_platform))
+    mgr.add(DisaggregatedApplicationController(
+        mgr.store, local_platform=local_platform))
     mgr.add(EndpointController(mgr.store))
     return mgr

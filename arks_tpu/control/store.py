@@ -186,12 +186,17 @@ class Store:
         oldest events — reconcilers are level-triggered, so a drop only costs
         latency, never correctness."""
         k = kind if isinstance(kind, str) else kind.KIND
-        q: "queue.Queue[tuple[str, Resource]]" = queue.Queue(maxsize=maxsize)
         with self._lock:
+            # Size the queue so the initial replay can never block while the
+            # store lock is held (the consumer only gets the queue after
+            # watch() returns, so a bounded q.put here would deadlock).
+            existing = list(self._objects.get(k, {}).values())
+            q: "queue.Queue[tuple[str, Resource]]" = queue.Queue(
+                maxsize=maxsize + len(existing))
             self._watchers.setdefault(k, []).append(q)
             # Replay current state (informer-style initial LIST).
-            for obj in self._objects.get(k, {}).values():
-                q.put(("ADDED", obj.deepcopy()))
+            for obj in existing:
+                q.put_nowait(("ADDED", obj.deepcopy()))
         return q
 
     def _notify(self, kind: str, event: str, obj: Resource) -> None:

@@ -37,7 +37,7 @@ import numpy as np
 
 from arks_tpu.engine import sampler as sampler_mod
 from arks_tpu.engine.tokenizer import Tokenizer
-from arks_tpu.engine.types import Request, RequestOutput
+from arks_tpu.engine.types import PrefilledState, Request, RequestOutput
 from arks_tpu.models.config import ModelConfig
 from arks_tpu.models import transformer as tf
 from arks_tpu.utils import metrics as prom
@@ -148,8 +148,12 @@ class InferenceEngine:
         self._free: list[int] = list(range(engine_cfg.num_slots))
 
         self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._queued_rids: set[str] = set()
         self._aborted: set[str] = set()
         self._abort_lock = threading.Lock()
+        # Detached prefill (disaggregated mode) runs on server threads, not
+        # the engine thread; serialize device access.
+        self._prefill_lock = threading.Lock()
         self._running = False
         self._thread: threading.Thread | None = None
         self._request_seed = engine_cfg.seed
@@ -196,6 +200,8 @@ class InferenceEngine:
 
     def add_request(self, request: Request) -> None:
         self.metrics.num_requests_waiting.inc(1)
+        with self._abort_lock:
+            self._queued_rids.add(request.request_id)
         self._queue.put(request)
 
     def abort(self, request_id: str) -> None:
@@ -249,6 +255,10 @@ class InferenceEngine:
             self.ecfg.num_slots, self.ecfg.seed)
         self._lengths[:] = 0
         self._last_token[:] = 0
+        # A fault between _free.pop() and slot registration would otherwise
+        # leak the slot index permanently.
+        self._free = [s for s in range(self.ecfg.num_slots)
+                      if s not in self._slots]
 
     def step(self, block_s: float = 0.05) -> bool:
         """One scheduler iteration: admit pending requests, then one decode
@@ -280,47 +290,83 @@ class InferenceEngine:
     def _admit_one(self, req: Request) -> None:
         self.metrics.num_requests_waiting.inc(-1)
         with self._abort_lock:
+            self._queued_rids.discard(req.request_id)
             if req.request_id in self._aborted:
                 self._aborted.discard(req.request_id)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort"))
                 return
-        # Cap the prompt so at least one decode dispatch fits in the cache.
-        max_prompt = min(self._buckets[-1],
-                         self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1)
-        ids = req.prompt_ids
-        if len(ids) > max_prompt:
-            ids = ids[-max_prompt:]  # keep the most recent context
-        bucket = next(b for b in self._buckets if b >= len(ids))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(ids)] = ids
+        if req.prefilled is not None:
+            return self._admit_prefilled(req)
+        ids, padded = self._prepare_prompt(req.prompt_ids)
 
         p = req.params
         self._request_seed += 1
         seed = p.seed if p.seed is not None else self._request_seed
         key = jax.random.PRNGKey(seed)
-        first_id, ks, vs = self._prefill_fn(
-            self.params, jnp.asarray(padded), jnp.asarray([len(ids)], jnp.int32),
-            jnp.float32(p.temperature), jnp.float32(p.top_p),
-            jnp.int32(p.top_k), key)
+        try:
+            first_id, ks, vs = self._prefill_fn(
+                self.params, jnp.asarray(padded), jnp.asarray([len(ids)], jnp.int32),
+                jnp.float32(p.temperature), jnp.float32(p.top_p),
+                jnp.int32(p.top_k), key)
 
-        slot = self._free.pop()
-        self._cache = self._insert_fn(self._cache, ks, vs, jnp.asarray(slot))
-        self._sampling = sampler_mod.set_slot(
-            self._sampling, slot, p.temperature, p.top_p, p.top_k,
-            jax.random.fold_in(key, 1))
+            slot = self._free.pop()
+            self._cache = self._insert_fn(self._cache, ks, vs, jnp.asarray(slot))
+            self._sampling = sampler_mod.set_slot(
+                self._sampling, slot, p.temperature, p.top_p, p.top_k,
+                jax.random.fold_in(key, 1))
+        except Exception:
+            # The request is in no slot yet, so _run's recovery path can't
+            # see it — fail it here or its client blocks forever.
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="abort", num_prompt_tokens=len(ids)))
+            raise
 
-        first = int(first_id)
+        self._register_slot(req, slot, int(first_id), len(ids))
+
+    def _admit_prefilled(self, req: Request) -> None:
+        """Admit a request whose prefill ran on another engine (disaggregated
+        decode side): insert the transferred KV, reconstruct the sampling key
+        stream, and continue decoding from the first token."""
+        pf = req.prefilled
+        usable = self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1
+        k, v = jnp.asarray(pf.k), jnp.asarray(pf.v)
+        if pf.num_prompt > usable:
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="abort", num_prompt_tokens=pf.num_prompt))
+            return
+        if k.shape[2] > self.ecfg.max_cache_len:
+            k = k[:, :, : self.ecfg.max_cache_len]
+            v = v[:, :, : self.ecfg.max_cache_len]
+        p = req.params
+        key = jax.random.PRNGKey(pf.seed)
+        try:
+            slot = self._free.pop()
+            self._cache = self._insert_fn(self._cache, k, v, jnp.asarray(slot))
+            self._sampling = sampler_mod.set_slot(
+                self._sampling, slot, p.temperature, p.top_p, p.top_k,
+                jax.random.fold_in(key, 1))
+        except Exception:
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="abort", num_prompt_tokens=pf.num_prompt))
+            raise
+        self._register_slot(req, slot, pf.first_token, pf.num_prompt)
+
+    def _register_slot(self, req: Request, slot: int, first: int,
+                       num_prompt: int) -> None:
         now = time.monotonic()
-        st = _Slot(request=req, num_prompt=len(ids))
+        st = _Slot(request=req, num_prompt=num_prompt)
         st.generated.append(first)
         st.first_token_time = now
         self._slots[slot] = st
-        self._lengths[slot] = len(ids)
+        self._lengths[slot] = num_prompt
         self._last_token[slot] = first
 
-        self.metrics.prompt_tokens_total.inc(len(ids))
+        self.metrics.prompt_tokens_total.inc(num_prompt)
         self.metrics.num_requests_running.set(len(self._slots))
         ttft = now - req.arrival_time
         self.metrics.time_to_first_token_seconds.observe(ttft)
@@ -330,7 +376,48 @@ class InferenceEngine:
         st.num_emitted = 1
         req.outputs.put(RequestOutput(
             request_id=req.request_id, token_ids=[first],
-            num_prompt_tokens=len(ids), ttft_s=ttft))
+            num_prompt_tokens=num_prompt, ttft_s=ttft))
+
+    # ------------------------------------------------------------------
+    # Detached prefill (disaggregated prefill side)
+    # ------------------------------------------------------------------
+
+    def _prepare_prompt(self, prompt_ids: list[int]) -> tuple[list[int], np.ndarray]:
+        """Truncate to the usable cache window (keeping the most recent
+        context, with a one-dispatch decode reserve) and pad to the smallest
+        prefill bucket.  Shared by the unified and disaggregated paths — the
+        bit-identity guarantee between them depends on this being one
+        implementation."""
+        max_prompt = min(self._buckets[-1],
+                         self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1)
+        ids = list(prompt_ids)
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        bucket = next(b for b in self._buckets if b >= len(ids))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(ids)] = ids
+        return ids, padded
+
+    def prefill_detached(self, prompt_ids: list[int],
+                         params) -> PrefilledState:
+        """Run prefill + first-token sampling and return the transferable
+        state instead of inserting into this engine's cache.  Thread-safe;
+        called from server threads on a prefill-only engine (no decode loop)."""
+        ids, padded = self._prepare_prompt(prompt_ids)
+
+        with self._prefill_lock:
+            self._request_seed += 1
+            seed = params.seed if params.seed is not None else self._request_seed
+            key = jax.random.PRNGKey(seed)
+            first_id, ks, vs = self._prefill_fn(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([len(ids)], jnp.int32),
+                jnp.float32(params.temperature), jnp.float32(params.top_p),
+                jnp.int32(params.top_k), key)
+            first = int(first_id)
+        self.metrics.prompt_tokens_total.inc(len(ids))
+        return PrefilledState(first_token=first, num_prompt=len(ids),
+                              seed=seed, k=np.asarray(ks), v=np.asarray(vs))
 
     def _decode_dispatch(self) -> None:
         K = self.ecfg.steps_per_dispatch
@@ -342,11 +429,14 @@ class InferenceEngine:
             if rid in aborted:
                 self._finish(slot, "abort")
                 consumed.add(rid)
-        if consumed:
-            # Aborts for requests still waiting in the admission queue stay
-            # in the set until _admit_one consumes them.
-            with self._abort_lock:
-                self._aborted -= consumed
+        # Aborts for requests still waiting in the admission queue stay in
+        # the set until _admit_one consumes them; anything else (request
+        # already finished, or never existed) is garbage — purge it so the
+        # set can't grow without bound.
+        active = {st.request.request_id for st in self._slots.values()}
+        with self._abort_lock:
+            self._aborted -= consumed
+            self._aborted &= active | self._queued_rids
         # Retire any slot that would overflow its cache this dispatch.
         for slot in list(self._slots):
             if int(self._lengths[slot]) + 1 + K > self.ecfg.max_cache_len:

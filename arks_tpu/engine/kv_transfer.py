@@ -1,0 +1,61 @@
+"""KV-cache wire format for prefill/decode disaggregation.
+
+The reference gets cross-worker KV transfer for free from SGLang's
+disaggregation backend (/root/reference/internal/controller/
+arksdisaggregatedapplication_controller.go:1672-1724 only wires
+``--disaggregation-mode`` flags).  The TPU-native build owns the transfer:
+
+- On one host (and in tests) the KV rides this compact binary format over
+  HTTP between the prefill and decode server processes.
+- Across TPU slices the same PrefilledState can instead be moved with
+  ``jax.device_put`` onto the decode slice's mesh (ICI/DCN does the actual
+  transport); the wire format is the host-RAM fallback and the e2e-testable
+  path.
+
+Layout: ``AKV1 | u32 header_len | header JSON | tensor bytes...`` where the
+header carries {meta, tensors: [{dtype, shape}]} and tensor bytes are
+concatenated raw buffers in header order.  bfloat16 is first-class (ml_dtypes
+backs the numpy dtype).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+import numpy as np
+
+MAGIC = b"AKV1"
+
+
+def pack(meta: dict[str, Any], tensors: list[np.ndarray]) -> bytes:
+    header = {
+        "meta": meta,
+        "tensors": [{"dtype": str(t.dtype), "shape": list(t.shape)}
+                    for t in tensors],
+    }
+    hbytes = json.dumps(header).encode()
+    parts = [MAGIC, struct.pack("<I", len(hbytes)), hbytes]
+    for t in tensors:
+        parts.append(np.ascontiguousarray(t).tobytes())
+    return b"".join(parts)
+
+
+def unpack(buf: bytes) -> tuple[dict[str, Any], list[np.ndarray]]:
+    if buf[:4] != MAGIC:
+        raise ValueError("bad KV transfer magic")
+    (hlen,) = struct.unpack_from("<I", buf, 4)
+    header = json.loads(buf[8:8 + hlen].decode())
+    tensors = []
+    off = 8 + hlen
+    for spec in header["tensors"]:
+        dtype = np.dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        n = int(np.prod(shape)) * dtype.itemsize
+        tensors.append(np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)),
+                                     offset=off).reshape(shape))
+        off += n
+    if off != len(buf):
+        raise ValueError(f"KV transfer length mismatch: {off} != {len(buf)}")
+    return header["meta"], tensors

@@ -18,6 +18,7 @@ amortized O(tokens) total (NOT re-decoding the full history per chunk):
 from __future__ import annotations
 
 import codecs
+import logging
 from typing import Protocol, Sequence
 
 
@@ -163,15 +164,27 @@ class _HFStreamDecoder:
         return out
 
 
-def load_tokenizer(path: str | None) -> Tokenizer:
-    if path is not None:
-        import os
+def load_tokenizer(path: str | None, strict: bool = False) -> Tokenizer:
+    """Best available tokenizer for a model dir (same policy as
+    ``weights.load_params``: real assets > byte-level fallback).
 
-        has_assets = any(
-            os.path.exists(os.path.join(path, f))
-            for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model"))
-        if has_assets:
-            return HFTokenizer(path)
+    A directory with real weights but no tokenizer assets is usually a
+    misconfiguration (wrong mount, partial download); pass ``strict=True``
+    to fail instead of falling back.
+    """
+    if path is None:
+        return ByteTokenizer()
+    import os
+
+    probed = ("tokenizer.json", "tokenizer_config.json", "tokenizer.model")
+    if any(os.path.exists(os.path.join(path, f)) for f in probed):
+        return HFTokenizer(path)
+    msg = (f"no tokenizer assets in {path!r} "
+           f"(looked for {', '.join(probed)})")
+    if strict:
+        raise FileNotFoundError(msg)
+    logging.getLogger("arks_tpu.tokenizer").warning(
+        "%s — falling back to byte-level tokenizer", msg)
     return ByteTokenizer()
 
 

@@ -19,6 +19,24 @@ class SamplingParams:
 
 
 @dataclasses.dataclass
+class PrefilledState:
+    """Result of a detached prefill, transferable between engines.
+
+    The KV tensors are [L, 1, T, Hkv, D] (T = prefill bucket length); the
+    decode engine inserts them into its own slotted cache.  ``seed`` lets the
+    decode engine reconstruct the sampling key stream exactly where the
+    prefill engine left it (prefill consumed the base key; decode starts from
+    fold_in(key, 1)).
+    """
+
+    first_token: int
+    num_prompt: int
+    seed: int
+    k: object  # np.ndarray | jax.Array [L, 1, T, Hkv, D]
+    v: object
+
+
+@dataclasses.dataclass
 class Request:
     request_id: str
     prompt_ids: list[int]
@@ -27,6 +45,9 @@ class Request:
     # Per-request output stream: the engine puts RequestOutput items here;
     # the server consumes them (None-terminated via ``finished``).
     outputs: "queue.Queue[RequestOutput]" = dataclasses.field(default_factory=queue.Queue)
+    # Disaggregated serving: KV produced by a prefill engine; when set, the
+    # decode engine inserts it instead of running its own prefill.
+    prefilled: PrefilledState | None = None
 
 
 @dataclasses.dataclass

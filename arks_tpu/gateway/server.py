@@ -328,33 +328,43 @@ class Gateway:
                     self.ejector.fail(addr)
                 else:
                     self.ejector.ok(addr)
+                def account(usage, _resp=resp):
+                    # Billing must never corrupt an in-flight response:
+                    # accounting failures are recorded, not raised.
+                    if _resp.status >= 500 or not usage:
+                        return
+                    try:
+                        self._account_usage(qos, usage, limits)
+                    except Exception:
+                        log.exception("usage accounting failed")
+                        self.metrics.errors_total.inc(stage="accounting")
                 if stream and resp.status == 200:
-                    usage = self._relay_stream(handler, resp)
+                    self._relay_stream(handler, resp, account)
                 else:
-                    usage = self._relay_full(handler, resp)
-                if resp.status < 500 and usage:
-                    self._account_usage(qos, usage, limits)
+                    self._relay_full(handler, resp, account)
                 return resp.status
             finally:
                 conn.close()
         raise _ApiError(503, f"all backends unreachable: {last_err}", "route")
 
-    def _relay_full(self, handler, resp) -> dict | None:
+    def _relay_full(self, handler, resp, account) -> None:
         data = resp.read()
+        # Account before the body reaches the client so usage is visible the
+        # moment the response is (billing ordering).
+        if resp.status == 200:
+            try:
+                obj = json.loads(data)
+            except (ValueError, json.JSONDecodeError):
+                obj = None
+            account(obj.get("usage") if isinstance(obj, dict) else None)
         handler.send_response(resp.status)
         handler.send_header("Content-Type",
                             resp.headers.get("Content-Type", "application/json"))
         handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)
-        if resp.status != 200:
-            return None
-        try:
-            return json.loads(data).get("usage")
-        except (ValueError, json.JSONDecodeError):
-            return None
 
-    def _relay_stream(self, handler, resp) -> dict | None:
+    def _relay_stream(self, handler, resp, account) -> None:
         """Relay SSE to the client, scanning frames for the usage object
         (handle_response.go:113-133). Robust to chunk fragmentation: frames
         are reassembled on blank-line boundaries."""
@@ -390,10 +400,10 @@ class Gateway:
                     if obj.get("usage"):
                         usage = obj["usage"]
             t_proc += time.monotonic() - tp
+        account(usage)
         handler.wfile.write(b"0\r\n\r\n")
         handler.wfile.flush()
         self.metrics.response_process_duration.observe(t_proc * 1000)
-        return usage
 
     # ------------------------------------------------------------------
     # Usage accounting (handle_response.go:184-223)

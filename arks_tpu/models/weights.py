@@ -143,6 +143,15 @@ def convert_hf_to_orbax(cfg: ModelConfig, model_path: str,
 # Entry point used by the serving pod
 # ---------------------------------------------------------------------------
 
+def has_real_weights(model_path: str | None) -> bool:
+    """True when ``load_params`` would load actual weights (Orbax or
+    safetensors) rather than falling back to random init."""
+    if not model_path or not os.path.isdir(model_path):
+        return False
+    return os.path.isdir(orbax_path(model_path)) or any(
+        f.endswith(".safetensors") for f in os.listdir(model_path))
+
+
 def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
                 dtype: Any = None) -> tf.Params:
     """Best available weights: Orbax (sharded) > safetensors > random init."""

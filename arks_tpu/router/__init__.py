@@ -1,0 +1,220 @@
+"""Disaggregated-serving router.
+
+The reference deploys ``sglang_router.launch_router --pd-disaggregation
+--service-discovery --prefill-selector ... --decode-selector ...``
+(/root/reference/internal/controller/
+arksdisaggregatedapplication_controller.go:1630-1670).  This is the native
+equivalent: an OpenAI-surface HTTP server that, per request, picks one
+prefill and one decode backend and forwards the request to the decode server
+with the chosen prefill address in the ``X-Arks-Prefill-Addr`` header; the
+decode server pulls the KV directly from the prefill server (one KV hop —
+the router never carries KV bytes).
+
+Service discovery: a JSON file ``{"prefill": ["host:port"...],
+"decode": [...]}`` re-read on mtime change.  Locally the controller
+maintains the file; on k8s it is a projected ConfigMap the controller
+updates — the moral equivalent of the reference router's label-selector
+pod discovery.
+
+Routing policy: round-robin over ready prefills; least-loaded is a
+cache-aware upgrade point (the reference router's ``--policy cache_aware``).
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from arks_tpu.utils import metrics as prom
+
+log = logging.getLogger("arks_tpu.router")
+
+HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
+
+
+class Discovery:
+    """mtime-cached backend lists from a discovery file (+ env fallback)."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self._mtime = 0.0
+        self._lock = threading.Lock()
+        self._prefill: list[str] = _env_addrs("ARKS_PREFILL_ADDRS")
+        self._decode: list[str] = _env_addrs("ARKS_DECODE_ADDRS")
+
+    def backends(self) -> tuple[list[str], list[str]]:
+        if self.path and os.path.exists(self.path):
+            try:
+                mtime = os.path.getmtime(self.path)
+                with self._lock:
+                    if mtime != self._mtime:
+                        with open(self.path) as f:
+                            data = json.load(f)
+                        self._prefill = list(data.get("prefill", []))
+                        self._decode = list(data.get("decode", []))
+                        self._mtime = mtime
+            except (OSError, ValueError, json.JSONDecodeError):
+                log.warning("bad discovery file %s", self.path, exc_info=True)
+        with self._lock:
+            return list(self._prefill), list(self._decode)
+
+
+def _env_addrs(name: str) -> list[str]:
+    v = os.environ.get(name, "")
+    return [a for a in v.split(",") if a]
+
+
+class Router:
+    def __init__(self, discovery: Discovery, served_model_name: str,
+                 host: str = "0.0.0.0", port: int = 8080):
+        self.discovery = discovery
+        self.served_model_name = served_model_name
+        self.host, self.port = host, port
+        self._rr = itertools.count()
+        self._httpd: ThreadingHTTPServer | None = None
+        self.registry = prom.Registry()
+        self.requests_total = self.registry.counter(
+            "router_requests_total", "Routed requests")
+        self.backends_gauge = self.registry.gauge(
+            "router_backends", "Known backends")
+
+    # ------------------------------------------------------------------
+
+    def start(self, background: bool = True) -> None:
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, code: int, message: str) -> None:
+                self._json(code, {"error": {"message": message, "code": code}})
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    self._json(200, {"object": "list", "data": [
+                        {"id": router.served_model_name, "object": "model",
+                         "created": int(time.time()), "owned_by": "arks-tpu"}]})
+                elif self.path == "/metrics":
+                    text = router.registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                elif self.path in ("/healthz", "/health"):
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/readiness":
+                    pre, dec = router.discovery.backends()
+                    if pre and dec:
+                        self._json(200, {"status": "ready"})
+                    else:
+                        self._error(503, "no prefill/decode backends yet")
+                else:
+                    self._error(404, f"no route {self.path}")
+
+            def do_POST(self):
+                if self.path not in ("/v1/chat/completions", "/v1/completions"):
+                    return self._error(404, f"no route {self.path}")
+                router._route(self)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        if background:
+            threading.Thread(target=self._httpd.serve_forever, name="router",
+                             daemon=True).start()
+        else:
+            self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+
+    # ------------------------------------------------------------------
+
+    def _route(self, h) -> None:
+        status = 500
+        started = [False]  # response headers already sent to the client
+        # Always drain the body first: an early error response with the body
+        # unread desyncs HTTP/1.1 keep-alive connections.
+        body = h.rfile.read(int(h.headers.get("Content-Length", 0)))
+        try:
+            prefill, decode = self.discovery.backends()
+            self.backends_gauge.set(len(prefill), role="prefill")
+            self.backends_gauge.set(len(decode), role="decode")
+            if not prefill or not decode:
+                status = 503
+                return h._error(503, "no ready prefill/decode backends")
+            n = next(self._rr)
+            p = prefill[n % len(prefill)]
+            d = decode[n % len(decode)]
+            status = self._forward(h, body, p, d, started)
+        except (BrokenPipeError, ConnectionResetError):
+            status = 499
+        except Exception as e:
+            log.exception("router failure")
+            if started[0]:
+                # Headers (and possibly chunks) already went out: a second
+                # response would corrupt the stream — just drop the
+                # connection so the client sees a clean truncation.
+                h.close_connection = True
+            else:
+                try:
+                    h._error(500, f"router error: {e}")
+                except Exception:
+                    pass
+        finally:
+            self.requests_total.inc(status=str(status))
+
+    def _forward(self, h, body: bytes, prefill_addr: str, decode_addr: str,
+                 started: list[bool]) -> int:
+        path = "/v1/disagg" + h.path[len("/v1"):]
+        host, _, port = decode_addr.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Content-Type": "application/json",
+                HDR_PREFILL_ADDR: prefill_addr,
+            })
+            resp = conn.getresponse()
+            started[0] = True
+            h.send_response(resp.status)
+            ctype = resp.headers.get("Content-Type", "application/json")
+            h.send_header("Content-Type", ctype)
+            clen = resp.headers.get("Content-Length")
+            if clen is not None:
+                h.send_header("Content-Length", clen)
+                h.end_headers()
+                h.wfile.write(resp.read())
+            else:
+                h.send_header("Transfer-Encoding", "chunked")
+                h.end_headers()
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    h.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk
+                                  + b"\r\n")
+                    h.wfile.flush()
+                h.wfile.write(b"0\r\n\r\n")
+                h.wfile.flush()
+            return resp.status
+        finally:
+            conn.close()

@@ -1,0 +1,37 @@
+"""Router entrypoint: python -m arks_tpu.router --port ... --discovery-file ...
+
+The reference router command line is generated at
+/root/reference/internal/controller/
+arksdisaggregatedapplication_controller.go:1630-1670; this is its
+TPU-native stand-in (no jax import — the router is pure I/O).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("arks_tpu.router")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--served-model-name", default="")
+    p.add_argument("--discovery-file", default=None,
+                   help="JSON {prefill: [addr], decode: [addr]}; falls back "
+                        "to ARKS_PREFILL_ADDRS/ARKS_DECODE_ADDRS env")
+    args = p.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from arks_tpu.router import Discovery, Router
+
+    router = Router(Discovery(args.discovery_file), args.served_model_name,
+                    host=args.host, port=args.port)
+    router.start(background=False)
+
+
+if __name__ == "__main__":
+    main()

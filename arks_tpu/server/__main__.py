@@ -39,6 +39,10 @@ def main() -> None:
     p.add_argument("--dtype", default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
+    p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
+                   default=None, dest="disagg",
+                   help="PD-separated serving role (reference flag parity: "
+                        "arksdisaggregatedapplication_controller.go:1672-1724)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO,
@@ -70,8 +74,15 @@ def main() -> None:
         model_path = args.model_path
 
     n_dev = len(jax.devices())
+    if args.dp < 1 or (args.tp is not None and args.tp < 1):
+        raise SystemExit("--tensor-parallel-size and --data-parallel-size "
+                         "must be >= 1")
     tp = args.tp or (n_dev // args.dp)
     want = tp * args.dp
+    if want > n_dev or (args.dp > 1 and tp == 0):
+        raise SystemExit(
+            f"requested tp={args.tp or tp} x dp={args.dp} needs {max(want, args.dp)} "
+            f"devices but only {n_dev} are visible")
     mesh = None
     if want > 1:
         from arks_tpu.parallel.mesh import make_mesh
@@ -93,13 +104,27 @@ def main() -> None:
         tensor_parallel=args.tp, data_parallel=args.dp,
         dtype=args.dtype, seed=args.seed,
     )
-    tokenizer = load_tokenizer(model_path if model_path and os.path.isdir(model_path) else None)
+    # Real weights without tokenizer assets = broken mount; fail fast then.
+    from arks_tpu.models.weights import has_real_weights
+    tokenizer = load_tokenizer(
+        model_path if model_path and os.path.isdir(model_path) else None,
+        strict=has_real_weights(model_path))
     engine = InferenceEngine(cfg, ecfg, tokenizer, params=params, mesh=mesh)
-    engine.start()
 
     served = args.served_model_name or cfg.name
-    server = OpenAIServer(engine, served, host=args.host, port=args.port)
-    log.info("serving %s on %s:%d (devices=%d)", served, args.host, args.port, n_dev)
+    if args.disagg == "prefill":
+        from arks_tpu.server.disagg import PrefillServer
+        # No decode loop: the engine only runs detached prefills.
+        server = PrefillServer(engine, served, host=args.host, port=args.port)
+    elif args.disagg == "decode":
+        from arks_tpu.server.disagg import DecodeServer
+        engine.start()
+        server = DecodeServer(engine, served, host=args.host, port=args.port)
+    else:
+        engine.start()
+        server = OpenAIServer(engine, served, host=args.host, port=args.port)
+    log.info("serving %s on %s:%d (devices=%d, mode=%s)",
+             served, args.host, args.port, n_dev, args.disagg or "unified")
     server.start(background=False)
 
 

@@ -1,0 +1,129 @@
+"""Prefill/decode-disaggregated serving servers.
+
+The reference delegates PD separation to SGLang (it only generates
+``--disaggregation-mode prefill|decode`` command lines and a router
+deployment — /root/reference/internal/controller/
+arksdisaggregatedapplication_controller.go:1630-1724).  Here both sides are
+native:
+
+- **PrefillServer**: tokenizes the OpenAI request, runs detached prefill
+  (compute-bound, MXU-heavy), returns the first token + KV in the
+  ``kv_transfer`` wire format.
+- **DecodeServer**: an OpenAIServer that additionally accepts
+  ``POST /v1/disagg/*``: it *pulls* the KV from the prefill server named in
+  the ``X-Arks-Prefill-Addr`` header, inserts it into its own continuous
+  batch, and streams the completion.  Pull-based transfer means the KV moves
+  prefill→decode directly (one hop), with the router only coordinating —
+  the same topology SGLang's disaggregation uses.
+
+Sampling-key continuity: the prefill side samples the first token from
+PRNGKey(seed); the decode side reconstructs fold_in(PRNGKey(seed), 1), so a
+disaggregated run is bit-identical to a single-engine run with that seed.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import uuid
+
+from arks_tpu.engine import kv_transfer
+from arks_tpu.engine.engine import InferenceEngine
+from arks_tpu.engine.types import PrefilledState, Request
+from arks_tpu.server.openai_server import (
+    OpenAIServer, _sampling_from_body,
+)
+
+PREFILL_PATH = "/v1/prefill"
+HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
+
+
+class PrefillServer(OpenAIServer):
+    """Serves POST /v1/prefill; the engine never starts its decode loop.
+
+    Inherits the OpenAI server's plumbing (health/metrics/models) but
+    replaces completions with the prefill API.  Regular completion endpoints
+    answer 501 to catch misrouted traffic loudly.
+    """
+
+    def _handle_completion(self, h, body: dict, chat: bool) -> None:
+        h._error(501, "this is a prefill-only server; use /v1/prefill")
+
+    def handle_post(self, h, body: dict, path: str) -> bool:
+        if path != PREFILL_PATH:
+            return False
+        chat = bool(body.get("_chat", False))
+        try:
+            batch = self._prompt_ids_batch(body, chat)
+        except ValueError as e:
+            h._error(400, str(e))
+            return True
+        if len(batch) > 1:
+            h._error(400, "disaggregated serving takes one prompt per request")
+            return True
+        params, _ = _sampling_from_body(body, self.engine.tokenizer)
+        pf = self.engine.prefill_detached(batch[0], params)
+        payload = kv_transfer.pack(
+            {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
+             "seed": pf.seed},
+            [pf.k, pf.v])
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(len(payload)))
+        h.end_headers()
+        h.wfile.write(payload)
+        return True
+
+
+class DecodeServer(OpenAIServer):
+    """OpenAIServer + /v1/disagg/* routes for router-coordinated requests."""
+
+    def handle_post(self, h, body: dict, path: str) -> bool:
+        if path == "/v1/disagg/chat/completions":
+            self._handle_disagg(h, body, chat=True)
+            return True
+        if path == "/v1/disagg/completions":
+            self._handle_disagg(h, body, chat=False)
+            return True
+        return False
+
+    def _handle_disagg(self, h, body: dict, chat: bool) -> None:
+        prefill_addr = h.headers.get(HDR_PREFILL_ADDR, "")
+        if not prefill_addr:
+            return h._error(400, f"missing {HDR_PREFILL_ADDR} header")
+        model = body.get("model") or self.served_model_name
+        if model != self.served_model_name:
+            return h._error(404, f"model {model!r} not found")
+
+        try:
+            meta, (k, v) = self._pull_kv(prefill_addr, body, chat)
+        except Exception as e:
+            return h._error(502, f"prefill pull failed: {e}")
+
+        params, stop_strings = _sampling_from_body(body, self.engine.tokenizer)
+        req = Request(
+            request_id=f"req-{uuid.uuid4().hex[:16]}",
+            prompt_ids=[], params=params,
+            prefilled=PrefilledState(
+                first_token=int(meta["first_token"]),
+                num_prompt=int(meta["num_prompt"]),
+                seed=int(meta["seed"]), k=k, v=v))
+        self.engine.add_request(req)
+        self._respond(h, req, chat, model, body, stop_strings)
+
+    def _pull_kv(self, addr: str, body: dict, chat: bool):
+        host, _, port = addr.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
+        try:
+            payload = dict(body)
+            payload["_chat"] = chat
+            conn.request("POST", PREFILL_PATH, body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"prefill {addr} -> {resp.status}: "
+                                   f"{data[:200]!r}")
+            return kv_transfer.unpack(data)
+        finally:
+            conn.close()

@@ -127,7 +127,9 @@ class OpenAIServer:
                 except (ValueError, json.JSONDecodeError):
                     return self._error(400, "invalid JSON body")
                 try:
-                    if self.path == "/v1/chat/completions":
+                    if server.handle_post(self, body, self.path):
+                        pass  # subclass route (disaggregated prefill/decode)
+                    elif self.path == "/v1/chat/completions":
                         server._handle_completion(self, body, chat=True)
                     elif self.path == "/v1/completions":
                         server._handle_completion(self, body, chat=False)
@@ -153,6 +155,10 @@ class OpenAIServer:
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()
+
+    def handle_post(self, h, body: dict, path: str) -> bool:
+        """Subclass hook for extra POST routes; True = handled."""
+        return False
 
     # ------------------------------------------------------------------
 
@@ -208,13 +214,21 @@ class OpenAIServer:
             self.engine.add_request(req)
             reqs.append(req)
 
-        if stream:
-            include_usage = bool((body.get("stream_options") or {}).get("include_usage"))
-            self._stream_response(h, reqs[0], chat, model, include_usage, stop_strings)
-        elif len(reqs) == 1:
-            self._full_response(h, reqs[0], chat, model, stop_strings)
-        else:
+        if len(reqs) > 1:
             self._batch_response(h, reqs, model, stop_strings)
+        else:
+            self._respond(h, reqs[0], chat, model, body, stop_strings)
+
+    def _respond(self, h, req: Request, chat: bool, model: str, body: dict,
+                 stop_strings: list[str]) -> None:
+        """Stream-or-full dispatch tail, shared with the disaggregated path."""
+        if bool(body.get("stream", False)):
+            include_usage = bool(
+                (body.get("stream_options") or {}).get("include_usage"))
+            self._stream_response(h, req, chat, model, include_usage,
+                                  stop_strings)
+        else:
+            self._full_response(h, req, chat, model, stop_strings)
 
     # ------------------------------------------------------------------
 

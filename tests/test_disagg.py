@@ -1,0 +1,296 @@
+"""Disaggregated (prefill/decode-separated) serving tests.
+
+Covers the three layers the reference outsources to SGLang + its router
+(SURVEY.md §2.4 "Prefill/Decode disaggregation"):
+1. engine: detached prefill -> KV wire format -> prefilled admission is
+   bit-identical to a unified run (greedy),
+2. control plane: DisaggregatedApplication phase machine, 3 gangsets,
+   router service, endpoint discovery (fake driver),
+3. full stack: real prefill/decode/router subprocesses behind the gateway.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from arks_tpu.control import resources as res
+from arks_tpu.control.manager import build_manager
+from arks_tpu.control.workloads import FakeGangDriver, LocalProcessDriver
+from arks_tpu.engine import kv_transfer
+from arks_tpu.engine.engine import EngineConfig, InferenceEngine
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.engine.types import PrefilledState, Request, SamplingParams
+from arks_tpu.gateway.server import Gateway
+from arks_tpu.models import get_config
+
+
+def wait_for(predicate, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# ---------------------------------------------------------------------------
+# 1. Engine-level KV handoff
+# ---------------------------------------------------------------------------
+
+
+def _drain(req: Request) -> list[int]:
+    toks: list[int] = []
+    while True:
+        out = req.outputs.get(timeout=60)
+        toks.extend(out.token_ids)
+        if out.finished:
+            return toks
+
+
+def test_kv_transfer_roundtrip():
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 1, 8, 2, 4)).astype(np.float32)
+    v = rng.standard_normal((2, 1, 8, 2, 4)).astype(np.float32)
+    meta = {"first_token": 7, "num_prompt": 5, "seed": 3}
+    buf = kv_transfer.pack(meta, [k, v])
+    meta2, (k2, v2) = kv_transfer.unpack(buf)
+    assert meta2 == meta
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_kv_transfer_bfloat16():
+    import jax.numpy as jnp
+
+    k = np.asarray(jnp.arange(16, dtype=jnp.bfloat16).reshape(1, 1, 4, 1, 4))
+    _, (k2,) = kv_transfer.unpack(kv_transfer.pack({}, [k]))
+    assert str(k2.dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(k, np.float32),
+                                  np.asarray(k2, np.float32))
+
+
+def test_disaggregated_matches_unified():
+    """Greedy prefill-on-A + decode-on-B == unified decode, token for token."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(16, 32), steps_per_dispatch=2)
+    tok = ByteTokenizer()
+    # Shared params: same seed => same init on both engines.
+    unified = InferenceEngine(cfg, ecfg, tok)
+    prompt = tok.encode("hello disaggregation")
+    params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+    unified.start()
+    try:
+        ureq = Request(request_id="u1", prompt_ids=prompt, params=params)
+        unified.add_request(ureq)
+        expected = _drain(ureq)
+    finally:
+        unified.stop()
+
+    prefill_engine = InferenceEngine(cfg, ecfg, tok)   # no decode loop
+    decode_engine = InferenceEngine(cfg, ecfg, tok)
+    pf = prefill_engine.prefill_detached(prompt, params)
+    assert pf.num_prompt == len(prompt)
+
+    # Through the wire format, as the servers would send it.
+    meta, tensors = kv_transfer.unpack(kv_transfer.pack(
+        {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
+         "seed": pf.seed}, [np.asarray(pf.k), np.asarray(pf.v)]))
+
+    decode_engine.start()
+    try:
+        dreq = Request(request_id="d1", prompt_ids=[], params=params,
+                       prefilled=PrefilledState(
+                           first_token=meta["first_token"],
+                           num_prompt=meta["num_prompt"],
+                           seed=meta["seed"], k=tensors[0], v=tensors[1]))
+        decode_engine.add_request(dreq)
+        got = _drain(dreq)
+    finally:
+        decode_engine.stop()
+
+    assert got == expected
+    assert len(got) == 8
+
+
+def test_prefilled_too_long_is_aborted():
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=1, max_cache_len=16,
+                        prefill_buckets=(8,), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    try:
+        req = Request(request_id="big", prompt_ids=[], params=SamplingParams(),
+                      prefilled=PrefilledState(
+                          first_token=1, num_prompt=100, seed=0,
+                          k=np.zeros((cfg.num_layers, 1, 8, cfg.num_kv_heads,
+                                      cfg.head_dim), np.float32),
+                          v=np.zeros((cfg.num_layers, 1, 8, cfg.num_kv_heads,
+                                      cfg.head_dim), np.float32)))
+        eng.add_request(req)
+        out = req.outputs.get(timeout=30)
+        assert out.finished and out.finish_reason == "abort"
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# 2. Control plane (fake driver)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fake_stack(tmp_path):
+    driver = FakeGangDriver()
+    mgr = build_manager(models_root=str(tmp_path / "models"), driver=driver)
+    mgr.start()
+    yield mgr, driver
+    mgr.stop()
+
+
+def test_disaggregated_phase_machine(fake_stack, tmp_path):
+    mgr, driver = fake_stack
+    store = mgr.store
+    store.create(res.Model(name="m", spec={"model": "test/m"}))
+    store.create(res.DisaggregatedApplication(name="pd", spec={
+        "model": {"name": "m"}, "servedModelName": "pd-served",
+        "modelConfig": "tiny",
+        "router": {"replicas": 1},
+        "prefill": {"replicas": 1, "tensorParallel": 1},
+        "decode": {"replicas": 2},
+    }))
+
+    wait_for(lambda: store.get(res.DisaggregatedApplication, "pd")
+             .status.get("phase") == res.PHASE_RUNNING)
+    app = store.get(res.DisaggregatedApplication, "pd")
+    assert app.status["decode"]["readyReplicas"] == 2
+    assert app.ready()
+
+    # Three gangsets with the right commands.
+    pre = store.get(res.GangSet, "pd-prefill")
+    dec = store.get(res.GangSet, "pd-decode")
+    rtr = store.get(res.GangSet, "pd-router")
+    assert "--disaggregation-mode" in pre.spec["leader"]["command"]
+    assert "prefill" in pre.spec["leader"]["command"]
+    assert "decode" in dec.spec["leader"]["command"]
+    assert "arks_tpu.router" in " ".join(rtr.spec["leader"]["command"])
+
+    # Router service + endpoint discovery.
+    svc = store.get(res.Service, "pd-router-svc")
+    assert svc.spec["selector"][res.LABEL_ROLE] == "router"
+
+    store.create(res.Endpoint(name="pd-served", spec={}))
+    routes = wait_for(lambda: store.get(res.Endpoint, "pd-served")
+                      .status.get("routes") or None)
+    assert routes[0]["backend"]["service"] == "pd-router-svc"
+
+    # Component failure flips readiness off.
+    driver.fail_group(("default", "pd-decode"), 0)
+    wait_for(lambda: not store.get(res.DisaggregatedApplication, "pd").ready())
+
+    # Deleting the app cascades its workloads.
+    store.delete(res.DisaggregatedApplication, "pd")
+    wait_for(lambda: store.try_get(res.GangSet, "pd-router") is None)
+
+
+def test_disaggregated_rejects_non_jax_runtime(fake_stack):
+    mgr, _ = fake_stack
+    store = mgr.store
+    store.create(res.DisaggregatedApplication(name="bad", spec={
+        "runtime": "vllm", "model": {"name": "nope"}}))
+    wait_for(lambda: store.get(res.DisaggregatedApplication, "bad")
+             .status.get("phase") == res.PHASE_FAILED)
+
+
+# ---------------------------------------------------------------------------
+# 3. Full-stack e2e: real subprocesses + gateway
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pd_stack(tmp_path_factory):
+    root = tmp_path_factory.mktemp("pd-e2e")
+    driver = LocalProcessDriver(log_dir=str(root / "logs"))
+    mgr = build_manager(models_root=str(root / "models"), driver=driver,
+                        local_platform="cpu")
+    mgr.start()
+    gw = Gateway(mgr.store, host="127.0.0.1", port=0, quota_sync_s=0.5)
+    gw.start(background=True)
+    yield mgr, gw
+    gw.stop()
+    mgr.stop()
+    for gs in mgr.store.list(res.GangSet):
+        driver.teardown(gs)
+
+
+def test_disaggregated_end_to_end(pd_stack):
+    mgr, gw = pd_stack
+    store = mgr.store
+
+    store.create(res.Model(name="pd-model", spec={"model": "test/pd"}))
+    store.create(res.DisaggregatedApplication(name="pd-app", spec={
+        "model": {"name": "pd-model"}, "servedModelName": "pd-served",
+        "modelConfig": "tiny",
+        "router": {"replicas": 1},
+        "prefill": {"replicas": 1,
+                    "runtimeCommonArgs": ["--num-slots", "2",
+                                          "--max-model-len", "64"]},
+        "decode": {"replicas": 1,
+                   "runtimeCommonArgs": ["--num-slots", "2",
+                                         "--max-model-len", "64"]},
+    }))
+    store.create(res.Endpoint(name="pd-served", spec={}))
+    store.create(res.Token(name="pd-user", spec={
+        "token": "sk-pd",
+        "qos": [{"endpoint": {"name": "pd-served"},
+                 "rateLimits": [{"type": "rpm", "value": 50}]}]}))
+
+    # Three subprocesses must boot (jax import + compile each).
+    wait_for(lambda: store.get(res.DisaggregatedApplication, "pd-app")
+             .status.get("phase") == res.PHASE_RUNNING, timeout=300,
+             interval=0.5)
+    wait_for(lambda: (store.get(res.Endpoint, "pd-served").status.get("routes")
+                      or None), timeout=30, interval=0.25)
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+        data=json.dumps({
+            "model": "pd-served",
+            "messages": [{"role": "user", "content": "hello pd"}],
+            "max_tokens": 6, "temperature": 0, "ignore_eos": True,
+        }).encode(),
+        headers={"Content-Type": "application/json",
+                 "Authorization": "Bearer sk-pd"})
+    with urllib.request.urlopen(req, timeout=180) as r:
+        data = json.load(r)
+    assert data["object"] == "chat.completion"
+    assert data["usage"]["completion_tokens"] == 6
+    assert data["choices"][0]["finish_reason"] == "length"
+
+    # Streaming through router + decode + gateway.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+        data=json.dumps({
+            "model": "pd-served",
+            "messages": [{"role": "user", "content": "stream pd"}],
+            "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+            "stream": True, "stream_options": {"include_usage": True},
+        }).encode(),
+        headers={"Content-Type": "application/json",
+                 "Authorization": "Bearer sk-pd"})
+    frames = []
+    with urllib.request.urlopen(req, timeout=180) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+    assert frames[-1] == "[DONE]"
+    usage_frames = [f for f in frames
+                    if f != "[DONE]" and json.loads(f).get("usage")]
+    assert usage_frames, "usage frame missing from disaggregated stream"
+    assert json.loads(usage_frames[-1])["usage"]["completion_tokens"] == 4
