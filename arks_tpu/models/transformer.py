@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from arks_tpu.models.config import ModelConfig
-from arks_tpu.ops.attention import decode_attention, prefill_attention
+from arks_tpu.ops.attention import decode_update_and_attend, prefill_attention
 from arks_tpu.ops.norms import rms_norm
 from arks_tpu.ops.rope import apply_rope
 
@@ -42,7 +42,12 @@ Params = dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    """Decode KV cache: [num_layers, num_slots, max_len, num_kv_heads, head_dim]."""
+    """Decode KV cache: [num_layers, num_slots, num_kv_heads, max_len, head_dim].
+
+    Head-major layout: each (slot, kv-head) sequence is a contiguous [S, D]
+    stripe, so the ragged Pallas decode kernel's block reads are dense DMAs
+    (arks_tpu.ops.pallas_attention).
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
@@ -53,7 +58,7 @@ class KVCache(NamedTuple):
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 # ---------------------------------------------------------------------------
@@ -131,14 +136,14 @@ def param_pspecs(cfg: ModelConfig, tp: int = 1) -> Params:
 def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
                dtype: jnp.dtype | None = None) -> KVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_len, cfg.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
 def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1) -> KVCache:
     batch = AXIS_DATA if dp > 1 else None
     heads = AXIS_MODEL if shard_kv_heads(cfg, tp) else None
-    spec = P(None, batch, None, heads, None)
+    spec = P(None, batch, heads, None, None)
     return KVCache(k=spec, v=spec)
 
 
@@ -254,8 +259,12 @@ def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
 
     T must be <= cache max_len; entries beyond the true length are masked by
     the per-slot length at decode time and overwritten as decoding proceeds.
+    Prefill emits time-major KV; the cache is head-major, so transpose here
+    (once per prompt — decode never pays for it).
     """
     start = (0, slot.astype(jnp.int32), 0, 0, 0)
+    k_new = jnp.swapaxes(k_new, 2, 3)  # [L, 1, Hkv, T, D]
+    v_new = jnp.swapaxes(v_new, 2, 3)
     return KVCache(
         k=jax.lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
         v=jax.lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start),
@@ -284,10 +293,15 @@ def decode_step(
     h = jnp.take(params["embed"], tokens, axis=0)  # [B, E]
     h = _constrain(h, mesh, batch_axis, None)
     write_idx = lengths.astype(jnp.int32)
+    kv_sharded = mesh is not None and shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1))
 
+    # The FULL cache rides the scan carry and each layer updates its own
+    # rows in place (decode_update_and_attend).  Scanning over the cache as
+    # xs/ys instead would make XLA slice + re-stack the whole cache every
+    # step — ~2x the model's entire HBM traffic.
     def body(carry, xs):
-        h = carry
-        lp, kc, vc = xs
+        h, kc, vc = carry
+        lp, layer = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(x, lp, cfg)
         q = q.reshape(b, cfg.num_heads, cfg.head_dim)
@@ -295,16 +309,18 @@ def decode_step(
         v = v.reshape(b, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, write_idx, cfg.rope_theta)
         k = apply_rope(k, write_idx, cfg.rope_theta)
-        kc = kc.at[jnp.arange(b), write_idx].set(k.astype(kc.dtype))
-        vc = vc.at[jnp.arange(b), write_idx].set(v.astype(vc.dtype))
-        attn = decode_attention(q, kc, vc, write_idx + 1).reshape(b, cfg.q_dim)
+        attn, kc, vc = decode_update_and_attend(
+            q, k, v, kc, vc, write_idx, layer, mesh, batch_axis, kv_sharded,
+            model_axis=AXIS_MODEL)
+        attn = attn.reshape(b, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, AXIS_MODEL)
         h = h + jnp.einsum("bq,qe->be", attn, lp["wo"])
         h = h + _mlp(h, lp, cfg, mesh, batch_axis)
-        return h, (kc, vc)
+        return (h, kc, vc), None
 
-    h, (ks, vs) = jax.lax.scan(
-        body, h, (params["layers"], cache.k, cache.v))
+    (h, ks, vs), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     logits = _unembed(h, params, cfg, mesh, batch_axis)
     return logits, KVCache(k=ks, v=vs)
 

@@ -1,16 +1,21 @@
-"""Attention ops (XLA path) for prefill and decode.
+"""Attention ops for prefill and decode.
 
 The reference delegates attention entirely to vLLM/SGLang CUDA kernels inside
 runtime containers (/root/reference/internal/controller/
 arksapplication_controller.go:941-1014 only builds their command lines).
-Here attention is ours.  This module is the pure-XLA formulation — large
-batched einsums that tile onto the MXU, masks as fused elementwise selects.
-A Pallas ragged/paged kernel (arks_tpu.ops.pallas_attention) can override the
-decode path; this is the portable fallback and the CPU-test reference.
+Here attention is ours.  Two decode implementations behind one dispatcher:
+
+- ``xla``: batched einsums that tile onto the MXU, masks as fused selects —
+  the portable fallback and the CPU-test oracle.  Reads the full cache.
+- ``pallas``: ragged flash-decoding kernel (arks_tpu.ops.pallas_attention)
+  that reads only each slot's valid KV prefix — the TPU default, since
+  decode is HBM-bandwidth-bound.
 
 Conventions:
 - GQA everywhere: q heads H = G * Hkv.  q is reshaped to [.., Hkv, G, ..] so
   the kv head dim lines up for a single einsum (no repeat_kv materialization).
+- Decode KV cache layout is ``[B, Hkv, S, D]`` — each (slot, head) sequence
+  contiguous, which is what makes ragged block reads dense stripes.
 - Inputs stay in their storage dtype (bf16 on TPU); matmuls accumulate in
   float32 via ``preferred_element_type`` — never materialize f32 casts of the
   KV cache (that would multiply decode HBM traffic by 2x).
@@ -19,9 +24,22 @@ Conventions:
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+
+
+def default_decode_impl() -> str:
+    """'pallas' on real TPU, 'xla' elsewhere; override via ARKS_ATTN_IMPL."""
+    impl = os.environ.get("ARKS_ATTN_IMPL", "auto")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"ARKS_ATTN_IMPL={impl!r}: expected auto|pallas|xla")
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
 
 
 def _softmax(scores: jnp.ndarray, axis: int) -> jnp.ndarray:
@@ -57,29 +75,110 @@ def prefill_attention(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
-def decode_attention(
-    q: jnp.ndarray,        # [B, H, D] — one new token per slot
-    k_cache: jnp.ndarray,  # [B, S, Hkv, D]
-    v_cache: jnp.ndarray,  # [B, S, Hkv, D]
+def decode_attention_xla(
+    q: jnp.ndarray,        # [B, Hkv, G, D] — one new token per slot
+    k_cache: jnp.ndarray,  # [B, Hkv, S, D]
+    v_cache: jnp.ndarray,  # [B, Hkv, S, D]
     lengths: jnp.ndarray,  # [B] int32 — number of valid cache entries per slot
 ) -> jnp.ndarray:
     """Masked attention of one query token per slot against the slot KV cache.
 
     Cache index s is valid iff s < lengths[b] (the caller writes the current
     token's K/V into the cache *before* calling, so lengths includes it).
-    Returns [B, H, D].
+    Returns [B, Hkv, G, D].
     """
-    b, h, d = q.shape
-    s = k_cache.shape[1]
-    hkv = k_cache.shape[2]
-    g = h // hkv
-    qg = q.reshape(b, hkv, g, d)
+    b, hkv, g, d = q.shape
+    s = k_cache.shape[2]
     scale = 1.0 / (d ** 0.5)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache,
+    scores = jnp.einsum("bkgd,bksd->bkgs", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
     valid = jnp.arange(s)[None] < lengths[:, None]  # [B, S]
     scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
     probs = _softmax(scores, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache,
+    out = jnp.einsum("bkgs,bksd->bkgd", probs, v_cache,
                      preferred_element_type=jnp.float32)
-    return out.reshape(b, h, d).astype(q.dtype)
+    return out.astype(q.dtype)
+
+
+def decode_update_and_attend(
+    q: jnp.ndarray,        # [B, H, D] — this step's query per slot
+    k_new: jnp.ndarray,    # [B, Hkv, D] — this step's KV per slot
+    v_new: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L, B, Hkv, S, D] — FULL stacked cache
+    v_cache: jnp.ndarray,
+    write_idx: jnp.ndarray,  # [B] int32 — tokens already in cache per slot
+    layer,                 # int32 — layer whose rows/blocks this step touches
+    mesh=None,
+    batch_axis: str | None = None,
+    kv_sharded: bool = False,
+    impl: str | None = None,
+    model_axis: str = "model",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Write this step's KV row at ``write_idx`` of ``layer``, then attend
+    over the valid prefix (now ``write_idx + 1`` entries).  Returns
+    (out [B, H, D], kc, vc).
+
+    Takes the full stacked cache so the decode layer loop can carry it and
+    the Pallas path (pallas_attention) can update/read it IN PLACE: both a
+    row scatter and a per-layer slice/re-stack lower to whole-cache HBM
+    traffic in XLA — each costs more than the rest of the model combined.
+
+    Under a mesh the op is embarrassingly parallel over (batch, kv-head), so
+    the kernels run inside ``shard_map`` with no collectives; when kv heads
+    don't divide the TP axis (replicated-KV regime) we stay on the XLA path,
+    which the partitioner reshards automatically.
+    """
+    b, h, d = q.shape
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    impl = impl or default_decode_impl()
+    # The kernels also serve dp-only meshes (trivial model axis): the op is
+    # embarrassingly parallel over batch.  Only the replicated-KV TP regime
+    # (tp > 1 not dividing Hkv) needs the XLA partitioner.
+    tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
+    use_pallas = impl == "pallas" and (kv_sharded or tp_trivial)
+
+    if not use_pallas:
+        kc_l = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
+        vc_l = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
+        b_idx = jnp.arange(b)[:, None]
+        h_idx = jnp.arange(hkv)[None, :]
+        kc_l = kc_l.at[b_idx, h_idx, write_idx[:, None]].set(
+            k_new.astype(k_cache.dtype))
+        vc_l = vc_l.at[b_idx, h_idx, write_idx[:, None]].set(
+            v_new.astype(v_cache.dtype))
+        out = decode_attention_xla(q.reshape(b, hkv, g, d), kc_l, vc_l,
+                                   write_idx + 1)
+        kc = jax.lax.dynamic_update_index_in_dim(k_cache, kc_l, layer, 0)
+        vc = jax.lax.dynamic_update_index_in_dim(v_cache, vc_l, layer, 0)
+        return out.reshape(b, h, d), kc, vc
+
+    from arks_tpu.ops.pallas_attention import kv_cache_update, ragged_decode_attention
+    interpret = jax.default_backend() != "tpu"
+
+    def local(qg, kn, vn, kc, vc, widx, lyr):
+        kc, vc = kv_cache_update(kc, vc, kn, vn, widx, lyr, interpret=interpret)
+        out = ragged_decode_attention(qg, kc, vc, widx + 1, lyr,
+                                      interpret=interpret)
+        return out, kc, vc
+
+    qg = q.reshape(b, hkv, g, d)
+    if mesh is None or mesh.size == 1:
+        out, kc, vc = local(qg, k_new, v_new, k_cache, v_cache, write_idx, layer)
+        return out.reshape(b, h, d), kc, vc
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    model = model_axis if kv_sharded else None
+    qspec = P(batch_axis, model, None, None)
+    kvspec = P(batch_axis, model, None)
+    cspec = P(None, batch_axis, model, None, None)
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, cspec, cspec, P(batch_axis), P()),
+        out_specs=(qspec, cspec, cspec),
+        check_rep=False,
+    )
+    out, kc, vc = fn(qg, k_new, v_new, k_cache, v_cache, write_idx,
+                     jnp.asarray(layer, jnp.int32))
+    return out.reshape(b, h, d), kc, vc

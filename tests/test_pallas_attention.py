@@ -1,0 +1,142 @@
+"""Ragged Pallas decode-attention + KV-update kernels vs the XLA oracle
+(interpret mode).
+
+The reference never tests its attention path (it has none — vLLM's kernels
+are opaque containers to it); here the kernels are first-class and testable
+on CPU via the Pallas interpreter.  Kernels take the FULL stacked cache
+[L, B, Hkv, S, D] plus a layer index (see pallas_attention module docs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arks_tpu.ops.attention import decode_attention_xla, decode_update_and_attend
+from arks_tpu.ops.pallas_attention import kv_cache_update, ragged_decode_attention
+
+
+def _rand_case(key, b, hkv, g, d, s, num_layers=3):
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (b, hkv, g, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (num_layers, b, hkv, s, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (num_layers, b, hkv, s, d), jnp.float32)
+    lengths = jax.random.randint(ks[3], (b,), 1, s + 1)
+    return q, kc, vc, lengths.astype(jnp.int32)
+
+
+@pytest.mark.parametrize("b,hkv,g,d,s,block", [
+    (4, 2, 4, 16, 64, 32),    # multi-block, GQA
+    (2, 1, 8, 8, 32, 32),     # single block
+    (3, 4, 1, 32, 96, 32),    # MQA-per-head (g=1), non-pow2 batch
+])
+def test_ragged_kernel_matches_xla(b, hkv, g, d, s, block):
+    q, kc, vc, lengths = _rand_case(jax.random.PRNGKey(0), b, hkv, g, d, s)
+    for layer in (0, 2):
+        ref = decode_attention_xla(q, kc[layer], vc[layer], lengths)
+        got = ragged_decode_attention(q, kc, vc, lengths, layer,
+                                      block_s=block, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_edge_lengths():
+    """Lengths at block boundaries and full cache."""
+    b, hkv, g, d, s = 5, 2, 2, 16, 64
+    q, kc, vc, _ = _rand_case(jax.random.PRNGKey(1), b, hkv, g, d, s)
+    lengths = jnp.asarray([1, 31, 32, 33, 64], jnp.int32)
+    ref = decode_attention_xla(q, kc[1], vc[1], lengths)
+    got = ragged_decode_attention(q, kc, vc, lengths, 1, block_s=32,
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_empty_slot_is_finite():
+    b, hkv, g, d, s = 2, 1, 2, 8, 32
+    q, kc, vc, _ = _rand_case(jax.random.PRNGKey(2), b, hkv, g, d, s)
+    lengths = jnp.asarray([0, 7], jnp.int32)
+    got = np.asarray(ragged_decode_attention(q, kc, vc, lengths, 0, block_s=32,
+                                             interpret=True))
+    assert np.isfinite(got).all()
+
+
+def test_kv_cache_update_inplace_rows():
+    l, b, hkv, s, d = 3, 4, 2, 64, 16
+    key = jax.random.PRNGKey(5)
+    kc = jax.random.normal(key, (l, b, hkv, s, d), jnp.float32)
+    vc = kc + 1.0
+    kn = jnp.full((b, hkv, d), 7.0, jnp.float32)
+    vn = jnp.full((b, hkv, d), 9.0, jnp.float32)
+    idx = jnp.asarray([0, 15, 16, 63], jnp.int32)
+    layer = 1
+    kc2, vc2 = kv_cache_update(kc, vc, kn, vn, idx, layer, interpret=True)
+    b_idx = jnp.arange(b)[:, None]
+    h_idx = jnp.arange(hkv)[None, :]
+    ref_k = kc.at[layer, b_idx, h_idx, idx[:, None]].set(kn)
+    ref_v = vc.at[layer, b_idx, h_idx, idx[:, None]].set(vn)
+    np.testing.assert_array_equal(np.asarray(kc2), np.asarray(ref_k))
+    np.testing.assert_array_equal(np.asarray(vc2), np.asarray(ref_v))
+
+
+def test_kv_cache_update_drops_out_of_range_writes():
+    """idx >= S must be dropped (JAX scatter semantics), not clamped into a
+    valid interior row."""
+    l, b, hkv, s, d = 1, 2, 1, 32, 8
+    kc = jnp.zeros((l, b, hkv, s, d), jnp.float32)
+    vc = jnp.zeros((l, b, hkv, s, d), jnp.float32)
+    kn = jnp.ones((b, hkv, d), jnp.float32)
+    vn = jnp.ones((b, hkv, d), jnp.float32)
+    idx = jnp.asarray([3, 32], jnp.int32)  # slot 1 overflows
+    kc2, _ = kv_cache_update(kc, vc, kn, vn, idx, 0, interpret=True)
+    kc2 = np.asarray(kc2)
+    assert kc2[0, 0, 0, 3].sum() == d     # slot 0 written
+    assert kc2[0, 1].sum() == 0           # slot 1 untouched
+
+
+@pytest.mark.parametrize("tp,dp", [(2, 2), (1, 4), (4, 1)])
+def test_decode_update_and_attend_sharded_pallas(tp, dp):
+    """The shard_map Pallas path (the production multi-chip decode) must
+    match the unsharded XLA oracle — including dp-only meshes, which also
+    take the kernels (the op is embarrassingly parallel over batch)."""
+    from arks_tpu.parallel.mesh import make_mesh
+    b, hkv, g, d, s = 8, 4, 2, 16, 64
+    key = jax.random.PRNGKey(8)
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (b, hkv * g, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (2, b, hkv, s, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (2, b, hkv, s, d), jnp.float32)
+    kn = jax.random.normal(ks[3], (b, hkv, d), jnp.float32)
+    vn = jax.random.normal(ks[4], (b, hkv, d), jnp.float32)
+    widx = jnp.asarray([0, 5, 17, 31, 32, 40, 55, 63], jnp.int32)
+    ref_o, ref_k, ref_v = decode_update_and_attend(
+        q, kn, vn, kc, vc, widx, 1, impl="xla")
+    mesh = make_mesh(tensor_parallel=tp, data_parallel=dp,
+                     devices=jax.devices()[: tp * dp])
+    kv_sharded = tp > 1 and hkv % tp == 0
+    got_o, got_k, got_v = decode_update_and_attend(
+        q, kn, vn, kc, vc, widx, 1, mesh=mesh,
+        batch_axis="data" if dp > 1 else None,
+        kv_sharded=kv_sharded, impl="pallas")
+    np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("layer", [0, 1])
+def test_decode_update_and_attend_pallas_matches_xla(layer):
+    b, hkv, g, d, s = 4, 2, 3, 16, 64
+    key = jax.random.PRNGKey(6)
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (b, hkv * g, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (2, b, hkv, s, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (2, b, hkv, s, d), jnp.float32)
+    kn = jax.random.normal(ks[3], (b, hkv, d), jnp.float32)
+    vn = jax.random.normal(ks[4], (b, hkv, d), jnp.float32)
+    widx = jnp.asarray([0, 5, 31, 63], jnp.int32)
+    ref_o, ref_k, ref_v = decode_update_and_attend(
+        q, kn, vn, kc, vc, widx, layer, impl="xla")
+    got_o, got_k, got_v = decode_update_and_attend(
+        q, kn, vn, kc, vc, widx, layer, impl="pallas")
+    np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o), rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
