@@ -209,10 +209,16 @@ def prefill_layer(
     positions: jnp.ndarray,  # [B, T]
     mesh: Mesh | None = None,
     batch_axis: str | None = None,
+    seq_axis: str | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer block over a full sequence. Returns (h, k, v) — the
     single layer body shared by serving prefill and the training forward
-    (train discards k/v; XLA dead-code-eliminates them there)."""
+    (train discards k/v; XLA dead-code-eliminates them there).
+
+    With ``seq_axis`` set (context parallelism), T is sharded over that mesh
+    axis and attention runs as a ring (arks_tpu.parallel.ring); every other
+    op in the block is pointwise over T, so XLA partitions it for free.
+    """
     b, t = h.shape[:2]
     x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
     q, k, v = _qkv(x, lp, cfg)
@@ -221,8 +227,18 @@ def prefill_layer(
     v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
-    attn = prefill_attention(q, k, v).reshape(b, t, cfg.q_dim)
-    attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
+    if seq_axis is not None and mesh is not None and mesh.shape.get(seq_axis, 1) > 1:
+        from arks_tpu.parallel.ring import ring_prefill_attention
+        heads_sharded = shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1)) \
+            and cfg.num_heads % mesh.shape.get(AXIS_MODEL, 1) == 0
+        attn = ring_prefill_attention(q, k, v, mesh, seq_axis, batch_axis,
+                                      heads_sharded=heads_sharded,
+                                      model_axis=AXIS_MODEL)
+        attn = attn.reshape(b, t, cfg.q_dim)
+        attn = _constrain(attn, mesh, batch_axis, seq_axis, AXIS_MODEL)
+    else:
+        attn = prefill_attention(q, k, v).reshape(b, t, cfg.q_dim)
+        attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
     h = h + jnp.einsum("...q,qe->...e", attn, lp["wo"])
     h = h + _mlp(h, lp, cfg, mesh, batch_axis)
     return h, k, v
@@ -234,16 +250,22 @@ def prefill(
     tokens: jnp.ndarray,   # [B, T] int32, padded to bucket length T
     lengths: jnp.ndarray,  # [B] int32 true lengths (<= T)
     mesh: Mesh | None = None,
+    seq_axis: str | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run full prompts. Returns (last-token logits [B, V] float32,
-    k [L, B, T, Hkv, D], v [L, B, T, Hkv, D]) for cache insertion."""
+    k [L, B, T, Hkv, D], v [L, B, T, Hkv, D]) for cache insertion.
+
+    ``seq_axis`` turns on context parallelism: T shards over that mesh axis
+    and attention runs as a ring (long-context prefill — prompts bigger than
+    one chip's budget).  Padded positions sit at the END of the sequence, so
+    under the global causal mask no valid query ever attends to them."""
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     h = jnp.take(params["embed"], tokens, axis=0)
-    h = _constrain(h, mesh, None, None, None)
+    h = _constrain(h, mesh, None, seq_axis, None)
 
     def body(h, lp):
-        h, k, v = prefill_layer(h, lp, cfg, positions, mesh)
+        h, k, v = prefill_layer(h, lp, cfg, positions, mesh, None, seq_axis)
         return h, (k, v)
 
     h, (ks, vs) = jax.lax.scan(body, h, params["layers"])

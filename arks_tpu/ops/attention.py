@@ -167,7 +167,7 @@ def decode_update_and_attend(
         out, kc, vc = local(qg, k_new, v_new, k_cache, v_cache, write_idx, layer)
         return out.reshape(b, h, d), kc, vc
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
     model = model_axis if kv_sharded else None
     qspec = P(batch_axis, model, None, None)
@@ -177,7 +177,7 @@ def decode_update_and_attend(
         local, mesh=mesh,
         in_specs=(qspec, kvspec, kvspec, cspec, cspec, P(batch_axis), P()),
         out_specs=(qspec, cspec, cspec),
-        check_rep=False,
+        check_vma=False,
     )
     out, kc, vc = fn(qg, k_new, v_new, k_cache, v_cache, write_idx,
                      jnp.asarray(layer, jnp.int32))

@@ -151,10 +151,12 @@ def ragged_decode_attention(
         return (bi, 0, 0)
 
     def kv_map(bi, si, layer, glens):
-        # Pin out-of-range blocks to an already-visited index: Mosaic skips
-        # the DMA for an unchanged block, so invalid KV is never read from HBM.
+        # Pin out-of-range blocks to the group's LAST VALID block (the one
+        # just visited): Mosaic skips the DMA for an unchanged block index,
+        # so invalid KV is never read from HBM.
+        last_valid = jnp.maximum(glens[bi] - 1, 0) // block_s
         valid = si * block_s < glens[bi]
-        return (layer[0], bi, 0, jax.lax.select(valid, si, 0), 0)
+        return (layer[0], bi, 0, jax.lax.select(valid, si, last_valid), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -264,11 +266,11 @@ def kv_cache_update(
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
-                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[
             pltpu.VMEM((1, 1, hkv, _UPDATE_CHUNK, d), k_cache.dtype),
             pltpu.VMEM((1, 1, hkv, _UPDATE_CHUNK, d), v_cache.dtype),

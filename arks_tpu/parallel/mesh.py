@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DATA = "data"
+AXIS_SEQ = "seq"
 AXIS_MODEL = "model"
 
 
@@ -26,37 +27,47 @@ class MeshPlan:
 
     tensor_parallel: int
     data_parallel: int
+    context_parallel: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.tensor_parallel * self.data_parallel
+        return self.tensor_parallel * self.data_parallel * self.context_parallel
 
 
 def resolve_plan(num_devices: int, tensor_parallel: int | None = None,
-                 data_parallel: int | None = None) -> MeshPlan:
+                 data_parallel: int | None = None,
+                 context_parallel: int = 1) -> MeshPlan:
+    assert num_devices % context_parallel == 0, (num_devices, context_parallel)
+    rem = num_devices // context_parallel
     if tensor_parallel is None and data_parallel is None:
-        tensor_parallel, data_parallel = num_devices, 1
+        tensor_parallel, data_parallel = rem, 1
     elif tensor_parallel is None:
-        assert num_devices % data_parallel == 0, (num_devices, data_parallel)
-        tensor_parallel = num_devices // data_parallel
+        assert rem % data_parallel == 0, (rem, data_parallel)
+        tensor_parallel = rem // data_parallel
     elif data_parallel is None:
-        assert num_devices % tensor_parallel == 0, (num_devices, tensor_parallel)
-        data_parallel = num_devices // tensor_parallel
-    plan = MeshPlan(tensor_parallel=tensor_parallel, data_parallel=data_parallel)
+        assert rem % tensor_parallel == 0, (rem, tensor_parallel)
+        data_parallel = rem // tensor_parallel
+    plan = MeshPlan(tensor_parallel=tensor_parallel, data_parallel=data_parallel,
+                    context_parallel=context_parallel)
     if plan.num_devices != num_devices:
         raise ValueError(f"plan {plan} does not cover {num_devices} devices")
     return plan
 
 
 def make_mesh(tensor_parallel: int | None = None, data_parallel: int | None = None,
-              devices=None) -> Mesh:
-    """Mesh with axes (data, model).
+              context_parallel: int = 1, devices=None) -> Mesh:
+    """Mesh with axes (data, seq, model).
 
     The model (TP) axis is innermost — on TPU, ``jax.devices()`` order follows
     physical topology, so innermost-axis neighbors are ICI-adjacent and TP
-    psums ride the fastest links (scaling-book recipe).
+    psums ride the fastest links (scaling-book recipe).  The seq (context-
+    parallel) axis sits between: ring-attention ppermutes are
+    neighbor-to-neighbor, so they too want ICI adjacency, but TP collectives
+    are latency-critical per layer while the ring overlaps with compute.
     """
     devices = list(devices if devices is not None else jax.devices())
-    plan = resolve_plan(len(devices), tensor_parallel, data_parallel)
-    grid = np.asarray(devices).reshape(plan.data_parallel, plan.tensor_parallel)
-    return Mesh(grid, (AXIS_DATA, AXIS_MODEL))
+    plan = resolve_plan(len(devices), tensor_parallel, data_parallel,
+                        context_parallel)
+    grid = np.asarray(devices).reshape(
+        plan.data_parallel, plan.context_parallel, plan.tensor_parallel)
+    return Mesh(grid, (AXIS_DATA, AXIS_SEQ, AXIS_MODEL))
