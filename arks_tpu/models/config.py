@@ -33,6 +33,14 @@ class ModelConfig:
     max_position_embeddings: int = 32768
     dtype: str = "bfloat16"
     eos_token_ids: tuple[int, ...] = ()
+    # Mixture-of-Experts (0 experts = dense FFN).  norm_topk_prob=True is
+    # Mixtral semantics (softmax over the selected experts); False is
+    # Qwen2-MoE (global softmax, selected probs used as-is).
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    shared_expert_intermediate_size: int = 0
+    norm_topk_prob: bool = True
 
     @property
     def q_dim(self) -> int:
@@ -48,7 +56,13 @@ class ModelConfig:
         attn = e * self.q_dim + 2 * e * self.kv_dim + self.q_dim * e
         if self.qkv_bias:
             attn += self.q_dim + 2 * self.kv_dim
-        mlp = 3 * e * f
+        if self.num_experts:
+            mlp = self.num_experts * 3 * e * self.moe_intermediate_size \
+                + e * self.num_experts
+            if self.shared_expert_intermediate_size:
+                mlp += 3 * e * self.shared_expert_intermediate_size + e
+        else:
+            mlp = 3 * e * f
         norms = 2 * e
         blocks = self.num_layers * (attn + mlp + norms)
         head = 0 if self.tie_word_embeddings else e * v
@@ -66,15 +80,20 @@ class ModelConfig:
         else:
             d = dict(path_or_dict)
         arch = (d.get("architectures") or [""])[0].lower()
-        qkv_bias = "qwen2" in arch or d.get("model_type", "") == "qwen2"
+        model_type = d.get("model_type", "")
+        qkv_bias = "qwen2" in arch or model_type in ("qwen2", "qwen2_moe")
         heads = d["num_attention_heads"]
         eos = d.get("eos_token_id")
         if eos is None:
             eos = ()
         elif isinstance(eos, int):
             eos = (eos,)
+        # MoE: HF calls the expert count num_local_experts (Mixtral) or
+        # num_experts (Qwen2-MoE).
+        num_experts = int(d.get("num_local_experts", d.get("num_experts", 0)) or 0)
+        is_mixtral = "mixtral" in arch or model_type == "mixtral"
         return ModelConfig(
-            name=name or d.get("model_type", "hf-model"),
+            name=name or model_type or "hf-model",
             vocab_size=d["vocab_size"],
             hidden_size=d["hidden_size"],
             intermediate_size=d["intermediate_size"],
@@ -88,6 +107,14 @@ class ModelConfig:
             qkv_bias=qkv_bias,
             max_position_embeddings=int(d.get("max_position_embeddings", 32768)),
             eos_token_ids=tuple(eos),
+            num_experts=num_experts,
+            num_experts_per_tok=int(d.get("num_experts_per_tok", 0) or 0),
+            moe_intermediate_size=int(
+                d.get("moe_intermediate_size",
+                      d["intermediate_size"] if num_experts else 0) or 0),
+            shared_expert_intermediate_size=int(
+                d.get("shared_expert_intermediate_size", 0) or 0),
+            norm_topk_prob=bool(d.get("norm_topk_prob", is_mixtral)),
         )
 
 
@@ -141,6 +168,38 @@ register_config(ModelConfig(
     name="qwen2.5-72b", vocab_size=152064, hidden_size=8192,
     intermediate_size=29568, num_layers=80, num_heads=64, num_kv_heads=8,
     head_dim=128, rope_theta=1000000.0, qkv_bias=True,
+    eos_token_ids=(151645, 151643),
+))
+
+# MoE tiny configs for CPU-mesh tests (dims divisible by 8).
+register_config(ModelConfig(
+    name="tiny-moe", vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8, qkv_bias=True,
+    num_experts=8, num_experts_per_tok=2, moe_intermediate_size=96,
+    shared_expert_intermediate_size=64, norm_topk_prob=False,
+    eos_token_ids=(0,),
+))
+register_config(ModelConfig(
+    name="tiny-mixtral", vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+    num_experts=4, num_experts_per_tok=2, moe_intermediate_size=96,
+    norm_topk_prob=True, eos_token_ids=(0,),
+))
+
+# MoE families (HF: mistralai/Mixtral-8x7B-Instruct-v0.1, Qwen/Qwen2-57B-A14B).
+register_config(ModelConfig(
+    name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1000000.0, rms_norm_eps=1e-5,
+    num_experts=8, num_experts_per_tok=2, moe_intermediate_size=14336,
+    norm_topk_prob=True, eos_token_ids=(2,),
+))
+register_config(ModelConfig(
+    name="qwen2-57b-a14b", vocab_size=151936, hidden_size=3584,
+    intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+    head_dim=128, rope_theta=1000000.0, qkv_bias=True,
+    num_experts=64, num_experts_per_tok=8, moe_intermediate_size=2560,
+    shared_expert_intermediate_size=20480, norm_topk_prob=False,
     eos_token_ids=(151645, 151643),
 ))
 

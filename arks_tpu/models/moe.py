@@ -1,0 +1,122 @@
+"""Mixture-of-Experts FFN (Mixtral / Qwen2-MoE families).
+
+The reference orchestrates MoE models only by passing their names to
+vLLM/SGLang containers (no MoE code of its own); here the block is native.
+
+TPU-first formulation:
+- **Dense dispatch**: every expert's FFN runs as one batched einsum over the
+  expert dim, with unselected experts zeroed by the router-weight tensor.
+  Decode is HBM-bound — all expert weights are read once per step no matter
+  how many tokens route to them — so compute-all costs nothing extra at
+  serving batch sizes while keeping shapes static for XLA.  (A block-sparse
+  Pallas dispatch for large-T prefill is a later optimization.)
+- **Expert parallelism = model-axis sharding**: expert dims shard over the
+  ``model`` mesh axis (each device holds E/tp experts); activations stay
+  replicated across that axis between blocks, so XLA turns the final
+  expert-contraction into one psum over ICI — the same Megatron pattern the
+  dense MLP already uses, no all-to-all needed.
+- Router math in float32 (softmax over expert logits is tiny but
+  precision-sensitive).
+
+Weight layout per layer (leading [L] from the stacked-layer convention):
+  router      [L, E, X]
+  w_gate/up   [L, X, E, Fm]     w_down [L, X, Fm, E]
+  shared gate/up [L, E, Fs], shared down [L, Fs, E], shared_gate [L, E]
+where X = num_experts, Fm = moe_intermediate_size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+def init_moe_params(cfg, key, dtype) -> Params:
+    l, e = cfg.num_layers, cfg.hidden_size
+    x, fm = cfg.num_experts, cfg.moe_intermediate_size
+    keys = iter(jax.random.split(key, 8))
+
+    def w(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    p: Params = {
+        "router": w(next(keys), (l, e, x)),
+        "w_gate": w(next(keys), (l, x, e, fm)),
+        "w_up": w(next(keys), (l, x, e, fm)),
+        "w_down": w(next(keys), (l, x, fm, e)),
+    }
+    if cfg.shared_expert_intermediate_size:
+        fs = cfg.shared_expert_intermediate_size
+        p["shared_gate_proj"] = w(next(keys), (l, e, fs))
+        p["shared_up"] = w(next(keys), (l, e, fs))
+        p["shared_down"] = w(next(keys), (l, fs, e))
+        p["shared_gate"] = w(next(keys), (l, e))
+    return p
+
+
+def moe_pspecs(cfg, axis_model: str, shard_experts: bool) -> Params:
+    """PartitionSpecs matching init_moe_params.  Experts shard over the model
+    axis when divisible (expert parallelism); else expert weights replicate
+    and only the shared expert uses tensor parallelism."""
+    from jax.sharding import PartitionSpec as P
+    ex = axis_model if shard_experts else None
+    p: Params = {
+        "router": P(None, None, None),
+        "w_gate": P(None, ex, None, None),
+        "w_up": P(None, ex, None, None),
+        "w_down": P(None, ex, None, None),
+    }
+    if cfg.shared_expert_intermediate_size:
+        p["shared_gate_proj"] = P(None, None, axis_model)
+        p["shared_up"] = P(None, None, axis_model)
+        p["shared_down"] = P(None, axis_model, None)
+        p["shared_gate"] = P(None, None)
+    return p
+
+
+def shard_experts(cfg, tp: int) -> bool:
+    return tp > 1 and cfg.num_experts % tp == 0
+
+
+def router_weights(logits: jnp.ndarray, cfg) -> jnp.ndarray:
+    """[.., X] router logits → [.., X] combine weights: softmax over all
+    experts, top-k selected, others zero; renormalized when
+    ``norm_topk_prob`` (Mixtral semantics — equal to softmax over the top-k
+    logits).  Float32 throughout."""
+    k = cfg.num_experts_per_tok
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(probs, k)          # [.., k]
+    if cfg.norm_topk_prob:
+        vals = vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-9)
+    onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=vals.dtype)  # [.., k, X]
+    return jnp.einsum("...k,...kx->...x", vals, onehot)
+
+
+def moe_ffn(x: jnp.ndarray, mp: Params, cfg, constrain=None) -> jnp.ndarray:
+    """MoE feed-forward on [..., E] activations (works for [B, T, E] prefill
+    and [B, E] decode).  ``constrain(t, expert_dim_index)`` optionally pins
+    the expert dim of intermediates to the model axis."""
+    logits = jnp.einsum("...e,ex->...x", x, mp["router"])
+    weights = router_weights(logits, cfg).astype(x.dtype)  # [.., X]
+
+    gate = jnp.einsum("...e,xef->...xf", x, mp["w_gate"])
+    up = jnp.einsum("...e,xef->...xf", x, mp["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
+    if constrain is not None:
+        act = constrain(act, act.ndim - 2)
+    down = jnp.einsum("...xf,xfe->...xe", act, mp["w_down"])  # per-expert out
+    out = jnp.einsum("...xe,...x->...e", down, weights)       # psum over EP
+
+    if cfg.shared_expert_intermediate_size:
+        sg = jnp.einsum("...e,ef->...f", x, mp["shared_gate_proj"])
+        su = jnp.einsum("...e,ef->...f", x, mp["shared_up"])
+        sact = jax.nn.silu(sg.astype(jnp.float32)).astype(sg.dtype) * su
+        if constrain is not None:
+            sact = constrain(sact, sact.ndim - 1)
+        shared = jnp.einsum("...f,fe->...e", sact, mp["shared_down"])
+        gatev = jax.nn.sigmoid(
+            jnp.einsum("...e,e->...", x, mp["shared_gate"]).astype(jnp.float32))
+        out = out + shared * gatev[..., None].astype(shared.dtype)
+    return out

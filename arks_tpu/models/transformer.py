@@ -82,10 +82,16 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype | None = None
         "wv": w(next(keys), (l, e, kvd)),
         "wo": w(next(keys), (l, qd, e)),
         "mlp_norm": jnp.ones((l, e), dtype),
-        "w_gate": w(next(keys), (l, e, f)),
-        "w_up": w(next(keys), (l, e, f)),
-        "w_down": w(next(keys), (l, f, e)),
     }
+    if cfg.num_experts:
+        from arks_tpu.models import moe
+        layers.update(moe.init_moe_params(cfg, next(keys), dtype))
+    else:
+        layers.update({
+            "w_gate": w(next(keys), (l, e, f)),
+            "w_up": w(next(keys), (l, e, f)),
+            "w_down": w(next(keys), (l, f, e)),
+        })
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((l, qd), dtype)
         layers["bk"] = jnp.zeros((l, kvd), dtype)
@@ -115,10 +121,16 @@ def param_pspecs(cfg: ModelConfig, tp: int = 1) -> Params:
         "wv": kv,
         "wo": P(None, AXIS_MODEL, None),
         "mlp_norm": P(None, None),
-        "w_gate": P(None, None, AXIS_MODEL),
-        "w_up": P(None, None, AXIS_MODEL),
-        "w_down": P(None, AXIS_MODEL, None),
     }
+    if cfg.num_experts:
+        from arks_tpu.models import moe
+        layers.update(moe.moe_pspecs(cfg, AXIS_MODEL, moe.shard_experts(cfg, tp)))
+    else:
+        layers.update({
+            "w_gate": P(None, None, AXIS_MODEL),
+            "w_up": P(None, None, AXIS_MODEL),
+            "w_down": P(None, AXIS_MODEL, None),
+        })
     if cfg.qkv_bias:
         layers["bq"] = P(None, AXIS_MODEL)
         layers["bk"] = kvb
@@ -185,12 +197,37 @@ def _qkv(h: jnp.ndarray, lp: Params, cfg: ModelConfig):
 
 
 def _mlp(h: jnp.ndarray, lp: Params, cfg: ModelConfig, mesh: Mesh | None,
-         batch_axis: str | None) -> jnp.ndarray:
+         batch_axis: str | None, seq_axis: str | None = None) -> jnp.ndarray:
     x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+
+    def _int_spec(ndim: int, sharded_dim: int) -> list:
+        # Intermediate spec: keep batch and (under context parallelism) the
+        # T dim sharded — a None dim means REPLICATED to the constraint, and
+        # regathering T across the seq axis would undo CP exactly where the
+        # wide intermediates make it matter.
+        spec = [None] * ndim
+        spec[0] = batch_axis
+        if ndim >= 3:
+            spec[1] = seq_axis
+        spec[sharded_dim] = AXIS_MODEL
+        return spec
+
+    if cfg.num_experts:
+        from arks_tpu.models import moe
+        tp = mesh.shape.get(AXIS_MODEL, 1) if mesh is not None else 1
+
+        def constrain(t, dim):
+            # Pin the expert (or shared-F) dim of MoE intermediates to the
+            # model axis so partial-expert outputs psum instead of regather.
+            if not moe.shard_experts(cfg, tp) and t.ndim - dim == 2:
+                return t  # expert dim replicated in this regime
+            return _constrain(t, mesh, *_int_spec(t.ndim, dim))
+
+        return moe.moe_ffn(x, lp, cfg, constrain if mesh is not None else None)
     gate = jnp.einsum("...e,ef->...f", x, lp["w_gate"])
     up = jnp.einsum("...e,ef->...f", x, lp["w_up"])
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
-    act = _constrain(act, mesh, *([batch_axis] + [None] * (act.ndim - 2) + [AXIS_MODEL]))
+    act = _constrain(act, mesh, *_int_spec(act.ndim, act.ndim - 1))
     return jnp.einsum("...f,fe->...e", act, lp["w_down"])
 
 
@@ -240,7 +277,7 @@ def prefill_layer(
         attn = prefill_attention(q, k, v).reshape(b, t, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
     h = h + jnp.einsum("...q,qe->...e", attn, lp["wo"])
-    h = h + _mlp(h, lp, cfg, mesh, batch_axis)
+    h = h + _mlp(h, lp, cfg, mesh, batch_axis, seq_axis)
     return h, k, v
 
 

@@ -60,8 +60,7 @@ def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None) -> tf.Params:
         return x.T if transpose else x
 
     def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
-        return jnp.asarray(
-            np.stack([get(fmt.format(i), transpose) for i in range(l)]), dtype)
+        return _stack_layers(t, l, dtype, fmt, transpose)
 
     layers: tf.Params = {
         "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
@@ -70,10 +69,15 @@ def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None) -> tf.Params:
         "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
         "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
-        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
-        "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
-        "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
     }
+    if cfg.num_experts:
+        layers.update(_moe_from_hf(cfg, t, dtype))
+    else:
+        layers.update({
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
+        })
     if cfg.qkv_bias:
         layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
         layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
@@ -86,6 +90,57 @@ def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None) -> tf.Params:
     if not cfg.tie_word_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight", True), dtype)
     return params
+
+
+def _stack_layers(t: dict[str, np.ndarray], l: int, dtype: Any, fmt: str,
+                  transpose: bool = False) -> jnp.ndarray:
+    """Stack one per-layer tensor family into the leading-[L] convention."""
+    xs = [t[fmt.format(i)] for i in range(l)]
+    if transpose:
+        xs = [x.T for x in xs]
+    return jnp.asarray(np.stack(xs), dtype)
+
+
+def _moe_from_hf(cfg: ModelConfig, t: dict[str, np.ndarray],
+                 dtype: Any) -> tf.Params:
+    """Expert weights for Mixtral (`block_sparse_moe.experts.{e}.w1/w3/w2`)
+    and Qwen2-MoE (`mlp.experts.{e}.gate_proj/up_proj/down_proj` + shared
+    expert) checkpoints, stacked [L, X, ..]."""
+    l, x = cfg.num_layers, cfg.num_experts
+    mixtral = any(".block_sparse_moe." in k for k in t)
+    if mixtral:
+        base = "model.layers.{}.block_sparse_moe"
+        router = base + ".gate.weight"
+        gate, up, down = (base + ".experts.{}.w1.weight",
+                          base + ".experts.{}.w3.weight",
+                          base + ".experts.{}.w2.weight")
+    else:
+        base = "model.layers.{}.mlp"
+        router = base + ".gate.weight"
+        gate, up, down = (base + ".experts.{}.gate_proj.weight",
+                          base + ".experts.{}.up_proj.weight",
+                          base + ".experts.{}.down_proj.weight")
+
+    def estack(fmt: str) -> jnp.ndarray:
+        return jnp.asarray(np.stack([
+            np.stack([t[fmt.format(i, e)].T for e in range(x)])
+            for i in range(l)]), dtype)
+
+    p: tf.Params = {
+        "router": _stack_layers(t, l, dtype, router, True),
+        "w_gate": estack(gate),
+        "w_up": estack(up),
+        "w_down": estack(down),
+    }
+    if cfg.shared_expert_intermediate_size:
+        sh = "model.layers.{}.mlp.shared_expert"
+        p["shared_gate_proj"] = _stack_layers(t, l, dtype, sh + ".gate_proj.weight", True)
+        p["shared_up"] = _stack_layers(t, l, dtype, sh + ".up_proj.weight", True)
+        p["shared_down"] = _stack_layers(t, l, dtype, sh + ".down_proj.weight", True)
+        p["shared_gate"] = jnp.asarray(np.stack(
+            [t["model.layers.{}.mlp.shared_expert_gate.weight".format(i)].reshape(-1)
+             for i in range(l)]), dtype)
+    return p
 
 
 # ---------------------------------------------------------------------------
