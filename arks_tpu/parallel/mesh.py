@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DATA = "data"
+AXIS_STAGE = "stage"
 AXIS_SEQ = "seq"
 AXIS_MODEL = "model"
 
@@ -28,17 +29,21 @@ class MeshPlan:
     tensor_parallel: int
     data_parallel: int
     context_parallel: int = 1
+    pipeline_parallel: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.tensor_parallel * self.data_parallel * self.context_parallel
+        return (self.tensor_parallel * self.data_parallel
+                * self.context_parallel * self.pipeline_parallel)
 
 
 def resolve_plan(num_devices: int, tensor_parallel: int | None = None,
                  data_parallel: int | None = None,
-                 context_parallel: int = 1) -> MeshPlan:
-    assert num_devices % context_parallel == 0, (num_devices, context_parallel)
-    rem = num_devices // context_parallel
+                 context_parallel: int = 1,
+                 pipeline_parallel: int = 1) -> MeshPlan:
+    fixed = context_parallel * pipeline_parallel
+    assert num_devices % fixed == 0, (num_devices, context_parallel, pipeline_parallel)
+    rem = num_devices // fixed
     if tensor_parallel is None and data_parallel is None:
         tensor_parallel, data_parallel = rem, 1
     elif tensor_parallel is None:
@@ -48,26 +53,31 @@ def resolve_plan(num_devices: int, tensor_parallel: int | None = None,
         assert rem % tensor_parallel == 0, (rem, tensor_parallel)
         data_parallel = rem // tensor_parallel
     plan = MeshPlan(tensor_parallel=tensor_parallel, data_parallel=data_parallel,
-                    context_parallel=context_parallel)
+                    context_parallel=context_parallel,
+                    pipeline_parallel=pipeline_parallel)
     if plan.num_devices != num_devices:
         raise ValueError(f"plan {plan} does not cover {num_devices} devices")
     return plan
 
 
 def make_mesh(tensor_parallel: int | None = None, data_parallel: int | None = None,
-              context_parallel: int = 1, devices=None) -> Mesh:
-    """Mesh with axes (data, seq, model).
+              context_parallel: int = 1, pipeline_parallel: int = 1,
+              devices=None) -> Mesh:
+    """Mesh with axes (data, stage, seq, model).
 
     The model (TP) axis is innermost — on TPU, ``jax.devices()`` order follows
     physical topology, so innermost-axis neighbors are ICI-adjacent and TP
     psums ride the fastest links (scaling-book recipe).  The seq (context-
-    parallel) axis sits between: ring-attention ppermutes are
+    parallel) axis sits next: ring-attention ppermutes are
     neighbor-to-neighbor, so they too want ICI adjacency, but TP collectives
     are latency-critical per layer while the ring overlaps with compute.
+    The stage (pipeline) axis is outermost of the model axes: its ppermutes
+    fire once per microbatch tick, the least latency-sensitive traffic.
     """
     devices = list(devices if devices is not None else jax.devices())
     plan = resolve_plan(len(devices), tensor_parallel, data_parallel,
-                        context_parallel)
+                        context_parallel, pipeline_parallel)
     grid = np.asarray(devices).reshape(
-        plan.data_parallel, plan.context_parallel, plan.tensor_parallel)
-    return Mesh(grid, (AXIS_DATA, AXIS_SEQ, AXIS_MODEL))
+        plan.data_parallel, plan.pipeline_parallel, plan.context_parallel,
+        plan.tensor_parallel)
+    return Mesh(grid, (AXIS_DATA, AXIS_STAGE, AXIS_SEQ, AXIS_MODEL))

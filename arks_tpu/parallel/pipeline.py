@@ -1,0 +1,151 @@
+"""Pipeline parallelism: layer stages over a mesh axis, microbatch pipeline.
+
+The reference has no pipeline-parallel code (its runtimes handle any model
+parallelism internally; SURVEY.md §2.4); here PP is a first-class mesh axis
+for training and offline forward passes over models deeper than one slice's
+memory.
+
+TPU-native formulation (collective-permute pipeline, scaling-book style):
+- The stacked layer params [L, ...] shard their leading dim over the
+  ``stage`` axis — no re-packing: each device simply holds L/S consecutive
+  layers, and the per-stage body is the same ``lax.scan`` the unsharded
+  model uses.
+- The batch splits into M microbatches.  For M + S - 1 ticks, every stage
+  runs its layers on its current microbatch and ``ppermute``s activations to
+  the next stage over ICI.  Bubbles are computed-and-discarded (standard:
+  utilization M / (M + S - 1)).
+- The last stage accumulates outputs; a masked psum over the stage axis
+  replicates them at the end.  Gradients flow backward through the
+  ppermute/psum transposes automatically, so one ``jax.grad`` differentiates
+  the whole pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from arks_tpu.models import transformer as tf
+from arks_tpu.parallel.mesh import AXIS_STAGE
+
+
+def shard_params_pp(params, mesh, stage_axis: str = AXIS_STAGE):
+    """Shard the stacked layer dim over the stage axis; everything else
+    (embed, final_norm, lm_head) replicated."""
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda x: put(x, P(stage_axis)), params["layers"])
+    for k in ("embed", "final_norm", "lm_head"):
+        if k in params:
+            out[k] = put(params[k], P())
+    return out
+
+
+def pipeline_forward(
+    params,
+    cfg,
+    tokens: jnp.ndarray,  # [B, T] int32
+    mesh,
+    num_microbatches: int,
+    stage_axis: str = AXIS_STAGE,
+) -> jnp.ndarray:
+    """Hidden states [B, T, E] (pre-final-norm), replicated across stages."""
+    num_stages = mesh.shape[stage_axis]
+    if cfg.num_layers % num_stages != 0:
+        raise ValueError(f"{cfg.num_layers} layers not divisible into "
+                         f"{num_stages} stages")
+    b, t = tokens.shape
+    m = num_microbatches
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    mb = b // m
+    x_mb = tokens.reshape(m, mb, t)
+
+    def local(layers_local, embed, x_mb):
+        s_ax = jax.lax.axis_size(stage_axis)
+        s_id = jax.lax.axis_index(stage_axis)
+        perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
+
+        def run_stage(h):
+            def body(h, lp):
+                h, _, _ = tf.prefill_layer(h, lp, cfg, positions, None)
+                return h, None
+            h, _ = jax.lax.scan(body, h, layers_local)
+            return h
+
+        e = embed.shape[1]
+        # Embed the whole microbatch stream ONCE (only stage 0's copy is
+        # read, but hoisting it keeps the vocab-table gather out of the
+        # per-tick loop on every stage).
+        x_emb = jnp.take(embed, x_mb, axis=0)  # [M, mb, T, E]
+        buf = jnp.zeros((mb, t, e), embed.dtype)
+        outputs = jnp.zeros((m, mb, t, e), embed.dtype)
+
+        def tick(carry, ti):
+            buf, outputs = carry
+            # Stage 0 feeds from the embedded microbatch stream; later
+            # stages from the ring buffer.  Clamped indices during bubble
+            # ticks write garbage that is overwritten before it's read
+            # (microbatch i's real result lands at tick i + S - 1).
+            x0 = jax.lax.dynamic_index_in_dim(
+                x_emb, jnp.clip(ti, 0, m - 1), 0, keepdims=False)
+            h_in = jnp.where(s_id == 0, x0, buf)
+            h_out = run_stage(h_in)
+            out_idx = jnp.clip(ti - (s_ax - 1), 0, m - 1)
+            outputs = jax.lax.dynamic_update_slice(
+                outputs, h_out[None].astype(outputs.dtype), (out_idx, 0, 0, 0))
+            buf = jax.lax.ppermute(h_out, stage_axis, perm)
+            return (buf, outputs), None
+
+        (buf, outputs), _ = jax.lax.scan(
+            tick, (buf, outputs), jnp.arange(m + s_ax - 1))
+        mask = (s_id == s_ax - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * mask, stage_axis)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = fn(params["layers"], params["embed"], x_mb)  # [M, mb, T, E]
+    return out.reshape(b, t, -1)
+
+
+def pp_loss_fn(params, cfg, tokens, targets, loss_mask, mesh,
+               num_microbatches: int):
+    from arks_tpu.train.sft import head_loss
+
+    h = pipeline_forward(params, cfg, tokens, mesh, num_microbatches)
+    return head_loss(params, cfg, h, targets, loss_mask)
+
+
+def make_pp_train_step(cfg, optimizer, mesh, num_microbatches: int):
+    """Jitted pipeline-parallel train step (same contract as
+    arks_tpu.train.sft.make_train_step — shares its loss head and
+    optimizer-step body)."""
+    from arks_tpu.train.sft import make_step_fn
+
+    step = make_step_fn(
+        lambda params, tokens, targets, loss_mask: pp_loss_fn(
+            params, cfg, tokens, targets, loss_mask, mesh, num_microbatches),
+        optimizer)
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def pp_train_init(cfg, key, optimizer, mesh, dtype=jnp.float32):
+    from arks_tpu.train.sft import TrainState
+
+    params = tf.init_params(cfg, key, dtype)
+    params = shard_params_pp(params, mesh)
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32))

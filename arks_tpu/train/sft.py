@@ -25,9 +25,24 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def forward_train(params, cfg: ModelConfig, tokens: jnp.ndarray,
-                  mesh: Mesh | None = None) -> jnp.ndarray:
-    """Full-sequence logits [B, T, V] (float32) for loss computation.
+def head_loss(params, cfg: ModelConfig, h: jnp.ndarray, targets,
+              loss_mask) -> jnp.ndarray:
+    """Final norm + unembed + masked mean CE on hidden states [B, T, E].
+
+    The single definition of loss semantics — the dense trainer and the
+    pipeline-parallel trainer (arks_tpu.parallel.pipeline) both end here, so
+    changes (z-loss, label smoothing, denominators) can't diverge."""
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    table = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("bte,ev->btv", h, table).astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return jnp.sum(ce * loss_mask) / denom
+
+
+def forward_hidden(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                   mesh: Mesh | None = None) -> jnp.ndarray:
+    """Pre-final-norm hidden states [B, T, E].
 
     Shares the layer body with serving prefill (tf.prefill_layer) so training
     and serving can never drift apart; the per-layer K/V outputs are unused
@@ -42,16 +57,33 @@ def forward_train(params, cfg: ModelConfig, tokens: jnp.ndarray,
         return h, None
 
     h, _ = jax.lax.scan(body, h, params["layers"])
+    return h
+
+
+def forward_train(params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  mesh: Mesh | None = None) -> jnp.ndarray:
+    """Full-sequence logits [B, T, V] (float32) for loss computation."""
+    h = forward_hidden(params, cfg, tokens, mesh)
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     table = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return jnp.einsum("bte,ev->btv", h, table).astype(jnp.float32)
 
 
 def loss_fn(params, cfg: ModelConfig, tokens, targets, loss_mask, mesh=None):
-    logits = forward_train(params, cfg, tokens, mesh)
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
-    return jnp.sum(ce * loss_mask) / denom
+    h = forward_hidden(params, cfg, tokens, mesh)
+    return head_loss(params, cfg, h, targets, loss_mask)
+
+
+def make_step_fn(loss, optimizer: optax.GradientTransformation):
+    """value_and_grad + optimizer update around any (params, tokens, targets,
+    loss_mask) -> scalar loss.  Shared by the dense and pipeline trainers."""
+    def step(state: TrainState, tokens, targets, loss_mask):
+        loss_val, grads = jax.value_and_grad(loss)(
+            state.params, tokens, targets, loss_mask)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss_val
+    return step
 
 
 def train_init(cfg: ModelConfig, key, optimizer: optax.GradientTransformation,
@@ -65,13 +97,10 @@ def train_init(cfg: ModelConfig, key, optimizer: optax.GradientTransformation,
 
 def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                     mesh: Mesh | None = None):
-    def step(state: TrainState, tokens, targets, loss_mask):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, cfg, tokens, targets, loss_mask, mesh)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
-
+    step = make_step_fn(
+        lambda params, tokens, targets, loss_mask: loss_fn(
+            params, cfg, tokens, targets, loss_mask, mesh),
+        optimizer)
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
     data_spec = NamedSharding(mesh, P(tf.AXIS_DATA, None))
