@@ -1,0 +1,166 @@
+"""ctypes bindings for the native gateway data-plane library (native/arksgw.cpp).
+
+The reference's gateway hot loops run in compiled Go; ours run here when the
+shared library is present (built on demand with g++ — baked into the image)
+and fall back to pure Python otherwise.  ``ARKS_NATIVE=0`` forces the
+fallback; ``ARKS_NATIVE_LIB`` points at a prebuilt .so.
+
+Two surfaces, mirroring pkg/gateway's hot paths:
+- ``NativeCounterBackend`` — fixed-window rate-limit counters
+  (ratelimiter/redis_impl.go semantics, in-process).  Drop-in for
+  arks_tpu.gateway.ratelimiter.CounterBackend.
+- ``SseUsageScanner`` — incremental SSE frame scanner extracting the final
+  usage object (handle_response.go:113-133), robust to arbitrary chunk
+  fragmentation including frames and keys split across feeds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("arks_tpu.gateway.native")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _source_dir() -> str:
+    # repo layout: <root>/native/arksgw.cpp with this file at
+    # <root>/arks_tpu/gateway/native.py
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "native")
+
+
+def _build() -> str | None:
+    src_dir = _source_dir()
+    src = os.path.join(src_dir, "arksgw.cpp")
+    if not os.path.isfile(src):
+        return None
+    out = os.path.join(src_dir, "build", "libarksgw.so")
+    if os.path.isfile(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    try:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out, src],
+            check=True, capture_output=True, timeout=120)
+        return out
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native gateway lib build failed (%s); using Python paths", e)
+        return None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("ARKS_NATIVE", "1") == "0":
+            return None
+        path = os.environ.get("ARKS_NATIVE_LIB") or _build()
+        if not path:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            log.warning("failed to load %s: %s", path, e)
+            return None
+        lib.arks_store_new.restype = ctypes.c_void_p
+        lib.arks_store_free.argtypes = [ctypes.c_void_p]
+        lib.arks_store_get.restype = ctypes.c_longlong
+        lib.arks_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_double]
+        lib.arks_store_incr.restype = ctypes.c_longlong
+        lib.arks_store_incr.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_longlong, ctypes.c_double,
+                                        ctypes.c_double]
+        lib.arks_store_size.restype = ctypes.c_longlong
+        lib.arks_store_size.argtypes = [ctypes.c_void_p]
+        lib.arks_sse_new.restype = ctypes.c_void_p
+        lib.arks_sse_free.argtypes = [ctypes.c_void_p]
+        lib.arks_sse_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_size_t]
+        lib.arks_sse_result.restype = ctypes.c_int
+        lib.arks_sse_result.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_longlong)] * 3
+        lib.arks_sse_done.restype = ctypes.c_int
+        lib.arks_sse_done.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeCounterBackend:
+    """CounterBackend over the C++ store (see ratelimiter.CounterBackend)."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native gateway library unavailable")
+        self._lib = lib
+        self._h = lib.arks_store_new()
+
+    def get(self, key: str) -> int:
+        import time
+        return self._lib.arks_store_get(self._h, key.encode(), time.time())
+
+    def incr(self, key: str, amount: int, ttl_s: int) -> int:
+        import time
+        return self._lib.arks_store_incr(self._h, key.encode(), amount,
+                                         float(ttl_s), time.time())
+
+    def __len__(self) -> int:
+        return self._lib.arks_store_size(self._h)
+
+    def __del__(self) -> None:
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.arks_store_free(h)
+
+
+class SseUsageScanner:
+    """Incremental usage extraction from an SSE byte stream."""
+
+    def __init__(self) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native gateway library unavailable")
+        self._lib = lib
+        self._h = lib.arks_sse_new()
+
+    def feed(self, chunk: bytes) -> None:
+        self._lib.arks_sse_feed(self._h, chunk, len(chunk))
+
+    def usage(self) -> dict | None:
+        p = ctypes.c_longlong()
+        c = ctypes.c_longlong()
+        t = ctypes.c_longlong()
+        if not self._lib.arks_sse_result(self._h, ctypes.byref(p),
+                                         ctypes.byref(c), ctypes.byref(t)):
+            return None
+        out = {}
+        if p.value >= 0:
+            out["prompt_tokens"] = p.value
+        if c.value >= 0:
+            out["completion_tokens"] = c.value
+        if t.value >= 0:
+            out["total_tokens"] = t.value
+        return out or None
+
+    @property
+    def done(self) -> bool:
+        return bool(self._lib.arks_sse_done(self._h))
+
+    def __del__(self) -> None:
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.arks_sse_free(h)

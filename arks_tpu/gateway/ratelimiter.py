@@ -8,8 +8,10 @@ and usage lands post-hoc (``DoLimit`` = INCRBY, :116-168).  Request-type
 rules (rpm/rpd) increment by 1 at admission; token-type rules (tpm/tpd)
 increment by actual usage at completion.
 
-Backends are pluggable: in-memory (single gateway) out of the box; a Redis
-backend can implement the same three-method surface for HA gateways.
+Backends are pluggable: the native C++ counter store (native/arksgw.cpp via
+arks_tpu.gateway.native — the compiled-data-plane counterpart of the
+reference's Go gateway) when buildable, a pure-Python in-memory store
+otherwise; a Redis backend can implement the same surface for HA gateways.
 """
 
 from __future__ import annotations
@@ -80,11 +82,18 @@ def window_key(namespace: str, user: str, model: str, rule: str,
     return f"arks:ns={namespace}:user={user}:model={model}:{rule}:{start}"
 
 
+def default_backend() -> CounterBackend:
+    from arks_tpu.gateway import native
+    if native.available():
+        return native.NativeCounterBackend()
+    return MemoryCounterBackend()
+
+
 class RateLimiter:
     """check_limit/do_limit over (namespace, user, model) identifiers."""
 
     def __init__(self, backend: CounterBackend | None = None):
-        self.backend = backend or MemoryCounterBackend()
+        self.backend = backend or default_backend()
 
     def check_limit(self, namespace: str, user: str, model: str,
                     rules: dict[str, int], requested: dict[str, int]) -> list[LimitResult]:

@@ -64,6 +64,47 @@ class _ApiError(Exception):
         self.code, self.message, self.stage = code, message, stage
 
 
+class PyUsageScanner:
+    """Pure-Python SSE usage scan — the fallback for (and the test oracle
+    of) arks_tpu.gateway.native.SseUsageScanner."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+        self._usage: dict | None = None
+
+    def feed(self, chunk: bytes) -> None:
+        self._buf += chunk
+        while b"\n\n" in self._buf or b"\r\n\r\n" in self._buf:
+            a = self._buf.find(b"\n\n")
+            b = self._buf.find(b"\r\n\r\n")
+            if b != -1 and (a == -1 or b < a):
+                frame, self._buf = self._buf[:b], self._buf[b + 4:]
+            else:
+                frame, self._buf = self._buf[:a], self._buf[a + 2:]
+            for line in frame.splitlines():
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[5:].strip()
+                if data == b"[DONE]":
+                    continue
+                try:
+                    obj = json.loads(data)
+                except (ValueError, json.JSONDecodeError):
+                    continue
+                if isinstance(obj, dict) and obj.get("usage"):
+                    self._usage = obj["usage"]
+
+    def usage(self) -> dict | None:
+        return self._usage
+
+
+def make_usage_scanner():
+    from arks_tpu.gateway import native
+    if native.available():
+        return native.SseUsageScanner()
+    return PyUsageScanner()
+
+
 class _Ejector:
     """Passive outlier detection per backend address."""
 
@@ -367,15 +408,15 @@ class Gateway:
     def _relay_stream(self, handler, resp, account) -> None:
         """Relay SSE to the client, scanning frames for the usage object
         (handle_response.go:113-133). Robust to chunk fragmentation: frames
-        are reassembled on blank-line boundaries."""
+        are reassembled on blank-line boundaries.  The scan runs in the
+        native library when available (arks_tpu.gateway.native)."""
         handler.send_response(resp.status)
         handler.send_header("Content-Type",
                             resp.headers.get("Content-Type", "text/event-stream"))
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
 
-        usage = None
-        buf = b""
+        scanner = make_usage_scanner()
         t_proc = 0.0
         while True:
             chunk = resp.read1(65536)
@@ -384,23 +425,9 @@ class Gateway:
             handler.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
             handler.wfile.flush()
             tp = time.monotonic()
-            buf += chunk
-            while b"\n\n" in buf:
-                frame, buf = buf.split(b"\n\n", 1)
-                for line in frame.splitlines():
-                    if not line.startswith(b"data: "):
-                        continue
-                    data = line[6:].strip()
-                    if data == b"[DONE]":
-                        continue
-                    try:
-                        obj = json.loads(data)
-                    except (ValueError, json.JSONDecodeError):
-                        continue
-                    if obj.get("usage"):
-                        usage = obj["usage"]
+            scanner.feed(chunk)
             t_proc += time.monotonic() - tp
-        account(usage)
+        account(scanner.usage())
         handler.wfile.write(b"0\r\n\r\n")
         handler.wfile.flush()
         self.metrics.response_process_duration.observe(t_proc * 1000)

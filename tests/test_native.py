@@ -1,0 +1,132 @@
+"""Native C++ gateway data-plane library vs the pure-Python oracles.
+
+The build environment has g++; the library compiles on demand.  Every test
+asserts native availability explicitly — a silent fallback to Python would
+make this suite vacuous.
+"""
+
+import json
+
+import pytest
+
+from arks_tpu.gateway import native
+from arks_tpu.gateway.ratelimiter import MemoryCounterBackend, RateLimiter
+from arks_tpu.gateway.server import PyUsageScanner, make_usage_scanner
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable (no g++?)")
+
+
+def test_native_lib_builds():
+    assert native.available()
+
+
+# ---------------------------------------------------------------------------
+# Counter store
+# ---------------------------------------------------------------------------
+
+
+def test_counter_basic_semantics():
+    b = native.NativeCounterBackend()
+    assert b.get("k") == 0
+    assert b.incr("k", 3, ttl_s=60) == 3
+    assert b.incr("k", 2, ttl_s=60) == 5
+    assert b.get("k") == 5
+    assert b.get("other") == 0
+
+
+def test_counter_expiry():
+    b = native.NativeCounterBackend()
+    b.incr("e", 7, ttl_s=0)  # expires immediately
+    assert b.get("e") == 0
+    assert b.incr("e", 1, ttl_s=60) == 1  # window restarted, not 8
+
+
+def test_counter_parity_with_python_backend():
+    nat, py = native.NativeCounterBackend(), MemoryCounterBackend()
+    ops = [("a", 1), ("b", 5), ("a", 2), ("c", 10), ("a", 1)]
+    for key, amt in ops:
+        assert nat.incr(key, amt, 60) == py.incr(key, amt, 60)
+    for key in ("a", "b", "c", "missing"):
+        assert nat.get(key) == py.get(key)
+
+
+def test_rate_limiter_uses_native_backend_by_default():
+    rl = RateLimiter()
+    assert type(rl.backend).__name__ == "NativeCounterBackend"
+    rl.do_limit("ns", "u", "m", {"rpm": 1})
+    res = rl.check_limit("ns", "u", "m", {"rpm": 1}, {})
+    assert res[0].over  # 1 used + 1 requested > limit 1
+
+
+# ---------------------------------------------------------------------------
+# SSE usage scanner
+# ---------------------------------------------------------------------------
+
+
+def _frames(usage_in_last=True):
+    chunks = [
+        {"id": "c1", "choices": [{"delta": {"content": "hi"}}], "usage": None},
+        {"id": "c1", "choices": [{"delta": {"content": "!"}}], "usage": None},
+    ]
+    final = {"id": "c1", "choices": [],
+             "usage": {"prompt_tokens": 11, "completion_tokens": 7,
+                       "total_tokens": 18}}
+    frames = [f"data: {json.dumps(c)}\n\n" for c in chunks]
+    if usage_in_last:
+        frames.append(f"data: {json.dumps(final)}\n\n")
+    frames.append("data: [DONE]\n\n")
+    return "".join(frames).encode()
+
+
+def test_sse_scanner_whole_stream():
+    s = native.SseUsageScanner()
+    s.feed(_frames())
+    assert s.usage() == {"prompt_tokens": 11, "completion_tokens": 7,
+                         "total_tokens": 18}
+    assert s.done
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16])
+def test_sse_scanner_fragmentation_parity(n):
+    """Any chunking (including keys split mid-token) must match the Python
+    oracle's result."""
+    raw = _frames()
+    pieces = [raw[i: i + n] for i in range(0, len(raw), n)]
+    nat, py = native.SseUsageScanner(), PyUsageScanner()
+    for p in pieces:
+        nat.feed(p)
+        py.feed(p)
+    assert nat.usage() == py.usage() == {
+        "prompt_tokens": 11, "completion_tokens": 7, "total_tokens": 18}
+
+
+def test_sse_scanner_later_usage_supersedes_fully():
+    """A later usage frame replaces the whole earlier dict — a missing field
+    must NOT leak through from a previous frame (continuous usage stats)."""
+    early = b'data: {"usage": {"prompt_tokens": 100, "completion_tokens": 1, "total_tokens": 101}}\n\n'
+    final = b'data: {"usage": {"prompt_tokens": 100, "completion_tokens": 50}}\n\n'
+    nat, py = native.SseUsageScanner(), PyUsageScanner()
+    for s in (nat, py):
+        s.feed(early)
+        s.feed(final)
+    assert nat.usage() == py.usage() == {"prompt_tokens": 100,
+                                         "completion_tokens": 50}
+
+
+def test_sse_scanner_ignores_tokens_outside_usage_object():
+    """Numbers after the usage object's closing brace must not be parsed."""
+    s = native.SseUsageScanner()
+    s.feed(b'data: {"usage": {"prompt_tokens": 4}, "total_tokens": 999}\n\n')
+    assert s.usage() == {"prompt_tokens": 4}
+
+
+def test_sse_scanner_crlf_and_no_usage():
+    s = native.SseUsageScanner()
+    s.feed(b'data: {"usage": null}\r\n\r\ndata: [DONE]\r\n\r\n')
+    assert s.usage() is None
+    assert s.done
+
+
+def test_make_usage_scanner_prefers_native():
+    assert type(make_usage_scanner()).__name__ == "SseUsageScanner"
