@@ -1,0 +1,85 @@
+"""Model download worker: ``python -m arks_tpu.control.download``.
+
+Env-driven like the reference's scripts/download.py (MODEL_NAME, MODEL_PATH,
+HF_TOKEN; exit code -> Job status), with the same bounded-retry behavior
+(3 attempts, 10s backoff, fatal-HTTP short-circuit — download.py:44-73).
+TPU twist (BASELINE.json north star): after download, optionally convert to
+an Orbax sharded checkpoint (ARKS_CONVERT_ORBAX=1) so multi-host slices load
+only their own shards.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+log = logging.getLogger("arks_tpu.download")
+
+RETRIES = 3
+BACKOFF_S = 10
+
+
+def fetch(repo: str, dest: str, token: str | None) -> None:
+    from huggingface_hub import snapshot_download
+    from huggingface_hub.errors import (
+        GatedRepoError, RepositoryNotFoundError,
+    )
+
+    last: Exception | None = None
+    for attempt in range(1, RETRIES + 1):
+        try:
+            snapshot_download(repo_id=repo, local_dir=dest, token=token)
+            return
+        except (GatedRepoError, RepositoryNotFoundError):
+            raise  # fatal: retrying can't help (reference download.py:58-66)
+        except Exception as e:  # transient (network, 5xx)
+            last = e
+            log.warning("download attempt %d/%d failed: %s", attempt, RETRIES, e)
+            if attempt < RETRIES:
+                time.sleep(BACKOFF_S)
+    raise RuntimeError(f"download failed after {RETRIES} attempts: {last}")
+
+
+def convert_orbax(dest: str) -> None:
+    from arks_tpu.models.config import ModelConfig
+    from arks_tpu.models.weights import convert_hf_to_orbax
+
+    cfg_path = os.path.join(dest, "config.json")
+    if not os.path.isfile(cfg_path):
+        log.warning("no config.json under %s; skipping Orbax conversion", dest)
+        return
+    cfg = ModelConfig.from_hf_config(dest, name=os.path.basename(dest))
+    path = convert_hf_to_orbax(cfg, dest)
+    log.info("Orbax checkpoint at %s", path)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    repo = os.environ.get("MODEL_NAME")
+    dest = os.environ.get("MODEL_PATH")
+    if not repo or not dest:
+        log.error("MODEL_NAME and MODEL_PATH are required")
+        return 2
+    token = os.environ.get("HF_TOKEN") or None
+    os.makedirs(dest, exist_ok=True)
+    try:
+        fetch(repo, dest, token)
+    except Exception as e:
+        log.error("model download failed: %s", e)
+        return 1
+    if os.environ.get("ARKS_CONVERT_ORBAX") == "1":
+        try:
+            convert_orbax(dest)
+        except Exception as e:
+            # Conversion is an optimization; raw safetensors still serve.
+            log.warning("Orbax conversion failed (serving falls back to "
+                        "safetensors): %s", e)
+    log.info("model %s ready at %s", repo, dest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,468 @@
+"""Kubernetes manifest rendering: arks resources -> GKE TPU YAML.
+
+The reference deploys by reconciling CRDs into LWS/RBGS/Deployments inside a
+live cluster (internal/controller/).  The TPU build has two deployment
+modes:
+
+- **Local/single-node** (arks_tpu.control.__main__): controllers drive real
+  subprocesses — the demo and test path.
+- **Kubernetes** (this module): the same resources render to plain K8s
+  manifests for GKE TPU node pools — gitops-style (`render` then
+  `kubectl apply`), so no LWS/RBGS operator dependency is needed:
+
+  * Model      -> PVC + one-shot download Job (arksmodel_controller.go:172-354
+                  semantics: storage then loader, /models contract)
+  * Application-> per-replica StatefulSet (gang of ``size`` hosts,
+                  ``podManagementPolicy: Parallel``, headless Service for the
+                  leader DNS — the LWS leader/worker contract rendered onto
+                  native objects) + a front Service
+                  ``arks-application-<name>`` on :8080
+                  (arksapplication_controller.go:376-415).  The front Service
+                  selects ALL gang pods; the engine's /readiness gates
+                  traffic to process 0, so multi-host workers receive none.
+  * DisaggregatedApplication -> prefill + decode gangs (same shape, with
+                  --disaggregation-mode) + per-tier Services + a router
+                  Deployment (arksdisaggregatedapplication_controller.go
+                  legacy-mode analogue)
+  * Endpoint   -> Gateway-API HTTPRoute with the {namespace, model} header
+                  matches the gateway injects (arksendpoint_controller.go:
+                  349-369)
+
+TPU topology: ``spec.accelerator`` (e.g. "tpu-v5e-8") resolves to the GKE
+nodeSelector pair (gke-tpu-accelerator, gke-tpu-topology), hosts per slice,
+and chips per host.  Multi-host slices get the JAX rendezvous env contract
+(ARKS_COORDINATOR_ADDRESS / ARKS_NUM_PROCESSES / ARKS_PROCESS_ID — the
+LWS_LEADER_ADDRESS/LWS_GROUP_SIZE/LWS_WORKER_INDEX translation, reference
+controller :560-569), with the worker index taken from the pod ordinal
+label (apps.kubernetes.io/pod-index).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from arks_tpu.control.resources import (
+    Application, DisaggregatedApplication, Endpoint, LABEL_APPLICATION,
+    LABEL_COMPONENT, LABEL_MANAGED_BY, MANAGED_BY, Model,
+    RESERVED_MODELS_PATH, RESERVED_MODELS_VOLUME,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuTopology:
+    accelerator: str      # GKE gke-tpu-accelerator label
+    topology: str         # GKE gke-tpu-topology label
+    hosts: int            # pods per slice (gang size)
+    chips_per_host: int
+
+
+# Common GKE TPU shapes (accelerator spec string -> node pool selectors).
+TPU_SHAPES: dict[str, TpuTopology] = {
+    "cpu": TpuTopology("", "", 1, 0),
+    "tpu-v5e-1": TpuTopology("tpu-v5-lite-podslice", "1x1", 1, 1),
+    "tpu-v5e-4": TpuTopology("tpu-v5-lite-podslice", "2x2", 1, 4),
+    "tpu-v5e-8": TpuTopology("tpu-v5-lite-podslice", "2x4", 1, 8),
+    "tpu-v5e-16": TpuTopology("tpu-v5-lite-podslice", "4x4", 4, 4),
+    "tpu-v5e-32": TpuTopology("tpu-v5-lite-podslice", "4x8", 8, 4),
+    "tpu-v5p-8": TpuTopology("tpu-v5p-slice", "2x2x1", 1, 4),
+    "tpu-v5p-16": TpuTopology("tpu-v5p-slice", "2x2x2", 2, 4),
+    "tpu-v6e-8": TpuTopology("tpu-v6e-slice", "2x4", 1, 8),
+}
+
+DEFAULT_IMAGE = "arks-tpu/engine:latest"
+DEFAULT_SCRIPTS_IMAGE = "arks-tpu/engine:latest"
+
+
+def _meta(name: str, namespace: str, labels: dict | None = None) -> dict:
+    return {"name": name, "namespace": namespace,
+            "labels": {LABEL_MANAGED_BY: MANAGED_BY, **(labels or {})}}
+
+
+def _shape(accelerator: str) -> TpuTopology:
+    shape = TPU_SHAPES.get(accelerator)
+    if shape is None:
+        raise ValueError(f"unknown accelerator {accelerator!r}; "
+                         f"known: {sorted(TPU_SHAPES)}")
+    return shape
+
+
+def _model_storage(model: Model | None, namespace: str,
+                   model_name: str) -> tuple[str, str]:
+    """(pvc claim name, model path) — honoring the Model's storage overrides
+    so workload mounts agree with what render_model provisions."""
+    storage = (model.spec.get("storage") or {}) if model is not None else {}
+    pvc = storage.get("pvc") or model_name or "models"
+    sub = storage.get("subPath") or f"models/{namespace}/{model_name}"
+    return pvc, f"{RESERVED_MODELS_PATH}/{sub}"
+
+
+# ---------------------------------------------------------------------------
+# Model -> PVC + download Job
+# ---------------------------------------------------------------------------
+
+
+def render_model(model: Model, scripts_image: str = DEFAULT_SCRIPTS_IMAGE) -> list[dict]:
+    storage = model.spec.get("storage") or {}
+    pvc_name = storage.get("pvc") or model.name
+    size = storage.get("size", "100Gi")
+    docs = [{
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": _meta(pvc_name, model.namespace),
+        "spec": {
+            "accessModes": ["ReadWriteMany"],
+            "resources": {"requests": {"storage": size}},
+        },
+    }]
+    if model.spec.get("source"):
+        # One-shot loader (arks-worker-<name> pod semantics; Job gives the
+        # retry/backoff the reference implements by hand in download.py).
+        _, model_path = _model_storage(model, model.namespace, model.name)
+        env = [
+            {"name": "MODEL_NAME", "value": model.spec.get("model", model.name)},
+            {"name": "MODEL_PATH", "value": model_path},
+        ]
+        hf = model.spec.get("source", {}).get("huggingface") or {}
+        if hf.get("tokenSecretRef"):
+            env.append({"name": "HF_TOKEN", "valueFrom": {"secretKeyRef": {
+                "name": hf["tokenSecretRef"], "key": "token"}}})
+        if model.spec.get("convertOrbax", True):
+            env.append({"name": "ARKS_CONVERT_ORBAX", "value": "1"})
+        docs.append({
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": _meta(f"arks-worker-{model.name}", model.namespace),
+            "spec": {
+                "backoffLimit": 3,
+                "template": {"spec": {
+                    "restartPolicy": "Never",
+                    "containers": [{
+                        "name": "download",
+                        "image": scripts_image,
+                        "command": ["python", "-m", "arks_tpu.control.download"],
+                        "env": env,
+                        "volumeMounts": [{"name": RESERVED_MODELS_VOLUME,
+                                          "mountPath": RESERVED_MODELS_PATH}],
+                    }],
+                    "volumes": [{"name": RESERVED_MODELS_VOLUME,
+                                 "persistentVolumeClaim": {"claimName": pvc_name}}],
+                }},
+            },
+        })
+    return docs
+
+
+# ---------------------------------------------------------------------------
+# Gang rendering (shared by Application and DisaggregatedApplication tiers)
+# ---------------------------------------------------------------------------
+
+
+def _engine_container(spec: dict, served_model: str, model_path: str | None,
+                      shape: TpuTopology, port: int,
+                      extra_args: list[str] | None = None) -> dict:
+    # Flag parity with the real entrypoint (arks_tpu/server/__main__.py).
+    args = ["-m", "arks_tpu.server",
+            "--model", spec.get("modelConfig") or model_path or "tiny",
+            "--served-model-name", served_model,
+            "--port", str(port),
+            "--tensor-parallel-size", str(spec.get("tensorParallel", 1))]
+    if model_path:
+        args += ["--model-path", model_path]
+    args += [str(a) for a in spec.get("runtimeCommonArgs", [])]
+    args += extra_args or []
+    container = {
+        "name": "engine",
+        "image": spec.get("runtimeImage", DEFAULT_IMAGE),
+        "command": ["python"],
+        "args": args,
+        "ports": [{"containerPort": port, "name": "http"}],
+        "env": [
+            # JAX multi-host rendezvous (LWS env contract translated).
+            {"name": "ARKS_NUM_PROCESSES", "value": str(shape.hosts)},
+            {"name": "ARKS_PROCESS_ID", "valueFrom": {"fieldRef": {
+                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}}},
+        ],
+        # /readiness is leader-only (process 0), so Services selecting the
+        # whole gang still route requests to the leader exclusively.
+        "readinessProbe": {
+            "httpGet": {"path": "/readiness", "port": port},
+            "failureThreshold": 120, "periodSeconds": 5,
+        },
+        "volumeMounts": [{"name": RESERVED_MODELS_VOLUME,
+                          "mountPath": RESERVED_MODELS_PATH,
+                          "readOnly": True}],
+    }
+    if shape.chips_per_host:
+        container["resources"] = {
+            "requests": {"google.com/tpu": str(shape.chips_per_host)},
+            "limits": {"google.com/tpu": str(shape.chips_per_host)},
+        }
+    return container
+
+
+def _render_gangs(prefix: str, namespace: str, base_labels: dict,
+                  replicas: int, shape: TpuTopology, spec: dict,
+                  served_model: str, model_path: str | None, pvc: str,
+                  port: int, extra_args: list[str] | None = None) -> list[dict]:
+    docs: list[dict] = []
+    for r in range(replicas):
+        group = f"{prefix}-{r}"
+        sel = {**base_labels, "arks.ai/group": group}
+        coordinator = f"{group}-0.{group}.{namespace}.svc:8476"
+        container = _engine_container(spec, served_model, model_path, shape,
+                                      port, extra_args)
+        container["env"].append(
+            {"name": "ARKS_COORDINATOR_ADDRESS", "value": coordinator})
+        pod_spec = {
+            "subdomain": group,
+            "containers": [container],
+            "volumes": [{"name": RESERVED_MODELS_VOLUME,
+                         "persistentVolumeClaim": {"claimName": pvc,
+                                                   "readOnly": True}}],
+        }
+        if shape.accelerator:
+            pod_spec["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator": shape.accelerator,
+                "cloud.google.com/gke-tpu-topology": shape.topology,
+            }
+        docs.append({
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(group, namespace, sel),
+            "spec": {"clusterIP": "None", "selector": sel,
+                     "ports": [{"port": port, "name": "http"}]},
+        })
+        docs.append({
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": _meta(group, namespace, sel),
+            "spec": {
+                "serviceName": group,
+                "replicas": shape.hosts,
+                # Gang semantics: all hosts start together; a slice is
+                # atomic, so any pod restart restarts the group
+                # (LWS RecreateGroupOnPodRestart analogue via TPU slice
+                # scheduling + shared fate of the jax coordinator).
+                "podManagementPolicy": "Parallel",
+                "selector": {"matchLabels": sel},
+                "template": {
+                    "metadata": {"labels": dict(sel)},
+                    "spec": pod_spec,
+                },
+            },
+        })
+    return docs
+
+
+# ---------------------------------------------------------------------------
+# Application -> StatefulSet gangs + front Service
+# ---------------------------------------------------------------------------
+
+
+def render_application(app: Application, model: Model | None = None,
+                       port: int = 8080) -> list[dict]:
+    spec = app.spec
+    shape = _shape(spec.get("accelerator", "cpu"))
+    model_name = spec.get("model", {}).get("name", "")
+    pvc, model_path = _model_storage(model, app.namespace, model_name)
+    base_labels = {LABEL_APPLICATION: app.name}
+    docs = _render_gangs(
+        f"arks-{app.name}", app.namespace, base_labels,
+        spec.get("replicas", 1), shape, spec, app.served_model_name,
+        model_path if model_name else None, pvc, port)
+
+    # Front service (reference: arks-application-<name>:8080 with the
+    # prometheus-discovery label — controller :376-415).  Selects every gang
+    # pod; the leader-only /readiness probe keeps traffic on process 0.
+    docs.append({
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"arks-application-{app.name}", app.namespace,
+                          {**base_labels, "prometheus-discovery": "true"}),
+        "spec": {
+            "selector": dict(base_labels),
+            "ports": [{"port": port, "targetPort": port, "name": "http"}],
+        },
+    })
+    return docs
+
+
+# ---------------------------------------------------------------------------
+# DisaggregatedApplication -> prefill/decode gangs + router
+# ---------------------------------------------------------------------------
+
+
+def render_disaggregated(dapp: DisaggregatedApplication,
+                         model: Model | None = None,
+                         port: int = 8080) -> list[dict]:
+    spec = dapp.spec
+    model_name = spec.get("model", {}).get("name", "")
+    pvc, model_path = _model_storage(model, dapp.namespace, model_name)
+    model_path = model_path if model_name else None
+    served = dapp.served_model_name
+    docs: list[dict] = []
+
+    tiers = {}
+    for tier in ("prefill", "decode"):
+        tspec = dict(spec)
+        tspec.update(spec.get(tier) or {})
+        shape = _shape(tspec.get("accelerator", "cpu"))
+        labels = {LABEL_APPLICATION: dapp.name, LABEL_COMPONENT: tier}
+        docs.extend(_render_gangs(
+            f"arks-{dapp.name}-{tier}", dapp.namespace, labels,
+            tspec.get("replicas", 1), shape, tspec, served, model_path, pvc,
+            port, extra_args=["--disaggregation-mode", tier]))
+        svc = f"arks-{dapp.name}-{tier}"
+        tiers[tier] = f"{svc}.{dapp.namespace}.svc:{port}"
+        docs.append({
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(svc, dapp.namespace, labels),
+            "spec": {"selector": dict(labels),
+                     "ports": [{"port": port, "name": "http"}]},
+        })
+
+    router = spec.get("router") or {}
+    rport = router.get("port", port)
+    rlabels = {LABEL_APPLICATION: dapp.name, LABEL_COMPONENT: "router"}
+    docs.append({
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(f"arks-{dapp.name}-router", dapp.namespace, rlabels),
+        "spec": {
+            "replicas": router.get("replicas", 1),
+            "selector": {"matchLabels": rlabels},
+            "template": {
+                "metadata": {"labels": dict(rlabels)},
+                "spec": {"containers": [{
+                    "name": "router",
+                    "image": router.get("image", DEFAULT_IMAGE),
+                    "command": ["python"],
+                    "args": ["-m", "arks_tpu.router",
+                             "--port", str(rport),
+                             "--served-model-name", served],
+                    "env": [
+                        {"name": "ARKS_PREFILL_ADDRS", "value": tiers["prefill"]},
+                        {"name": "ARKS_DECODE_ADDRS", "value": tiers["decode"]},
+                    ],
+                    "ports": [{"containerPort": rport, "name": "http"}],
+                    "readinessProbe": {
+                        "httpGet": {"path": "/readiness", "port": rport},
+                        "failureThreshold": 120, "periodSeconds": 5,
+                    },
+                }]},
+            },
+        },
+    })
+    # Router front service — the disagg app's traffic entry, named like a
+    # standalone app's front service so Endpoint routing treats both alike.
+    docs.append({
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(f"arks-application-{dapp.name}", dapp.namespace,
+                          {**rlabels, "prometheus-discovery": "true"}),
+        "spec": {"selector": dict(rlabels),
+                 "ports": [{"port": port, "targetPort": rport, "name": "http"}]},
+    })
+    return docs
+
+
+# ---------------------------------------------------------------------------
+# Endpoint -> HTTPRoute
+# ---------------------------------------------------------------------------
+
+
+def render_endpoint(ep: Endpoint, apps: list, gateway_name: str = "arks-eg",
+                    port: int = 8080) -> list[dict]:
+    backends = []
+    for rc in ep.spec.get("routeConfigs", []):
+        # Static routes ({backend: {service|host, port}, weight}) become
+        # Gateway-API backendRefs (name/port/weight).
+        be = rc.get("backend") or {}
+        backends.append({
+            "name": be.get("service") or be.get("host", ""),
+            "port": be.get("port", port),
+            "weight": rc.get("weight", ep.spec.get("defaultWeight", 1)),
+        })
+    for app in apps:
+        # Unlike the live controller (which adds only ready apps,
+        # arksendpoint_controller.go:293-347), static rendering includes
+        # every matching app in the ENDPOINT'S NAMESPACE: K8s readiness
+        # probes gate traffic at the Service level.
+        if app.namespace == ep.namespace and app.served_model_name == ep.name:
+            backends.append({
+                "name": f"arks-application-{app.name}", "port": port,
+                "weight": ep.spec.get("defaultWeight", 1)})
+    rules = [{
+        "matches": [{
+            "path": {"type": "PathPrefix", "value": "/"},
+            # Header matches injected by the gateway (parity with
+            # arksendpoint_controller.go:349-369).
+            "headers": [
+                {"name": "x-arks-namespace", "value": ep.namespace},
+                {"name": "x-arks-model", "value": ep.name},
+            ],
+        }],
+        "backendRefs": backends,
+    }]
+    return [{
+        "apiVersion": "gateway.networking.k8s.io/v1",
+        "kind": "HTTPRoute",
+        "metadata": _meta(ep.name, ep.namespace),
+        "spec": {
+            "parentRefs": [{"name": gateway_name}],
+            "rules": rules,
+        },
+    }]
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def render_store(store) -> list[dict]:
+    """Render every renderable resource in a store to K8s docs."""
+    docs: list[dict] = []
+    models = {(m.namespace, m.name): m for m in store.list(Model)}
+    apps = store.list(Application)
+    dapps = store.list(DisaggregatedApplication)
+
+    def model_for(obj):
+        name = obj.spec.get("model", {}).get("name", "")
+        return models.get((obj.namespace, name))
+
+    for m in models.values():
+        docs.extend(render_model(m))
+    for a in apps:
+        docs.extend(render_application(a, model_for(a)))
+    for d in dapps:
+        docs.extend(render_disaggregated(d, model_for(d)))
+    for e in store.list(Endpoint):
+        docs.extend(render_endpoint(e, apps + dapps))
+    return docs
+
+
+def main() -> None:
+    import argparse
+    import sys
+
+    import yaml
+
+    from arks_tpu.control.__main__ import apply_manifests
+    from arks_tpu.control.store import Store
+
+    p = argparse.ArgumentParser(
+        "arks_tpu.control.k8s_export",
+        description="Render arks manifests to Kubernetes YAML (stdout)")
+    p.add_argument("--manifests", action="append", required=True)
+    args = p.parse_args()
+
+    store = Store()
+    for path in args.manifests:
+        apply_manifests(store, path)
+    yaml.safe_dump_all(render_store(store), sys.stdout, sort_keys=False)
+
+
+if __name__ == "__main__":
+    main()

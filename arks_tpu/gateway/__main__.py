@@ -1,0 +1,54 @@
+"""Standalone gateway: ``python -m arks_tpu.gateway [flags]``.
+
+The analogue of the reference's gateway binary (cmd/gateway/main.go) in its
+``file`` config-provider mode: QoS resources (Token/Quota/Endpoint) come
+from YAML manifests instead of a live operator store.  When embedded next
+to the operator (python -m arks_tpu.control), the gateway shares the
+operator's store instead and this entrypoint is not used.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import time
+
+log = logging.getLogger("arks_tpu.gateway.main")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("arks_tpu.gateway")
+    p.add_argument("--manifests", action="append", default=[],
+                   help="YAML files with Token/Quota/Endpoint resources "
+                        "(the reference's file provider)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8081)
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from arks_tpu.control.__main__ import apply_manifests
+    from arks_tpu.control.store import Store
+    from arks_tpu.gateway.server import Gateway
+
+    store = Store()
+    for path in args.manifests:
+        apply_manifests(store, path)
+    gw = Gateway(store, host=args.host, port=args.port)
+    gw.start(background=True)
+    log.info("gateway on %s:%d (/v1/* + /metrics)", args.host, gw.port)
+
+    stop: list[int] = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        gw.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ engine thread; all device work stays on the engine thread.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -113,7 +114,13 @@ class OpenAIServer:
                 elif self.path in ("/healthz", "/health"):
                     self._json(200, {"status": "ok"})
                 elif self.path == "/readiness":
-                    if server._ready.is_set():
+                    # Multi-host gangs: only process 0 (the leader) accepts
+                    # traffic — workers participate in collectives but must
+                    # stay out of Service endpoints (the K8s front Service
+                    # selects the whole gang and relies on this gate).
+                    if os.environ.get("ARKS_PROCESS_ID", "0") not in ("", "0"):
+                        self._error(503, "worker process (leader serves)")
+                    elif server._ready.is_set():
                         self._json(200, {"status": "ready"})
                     else:
                         self._error(503, "not ready")

@@ -1,0 +1,79 @@
+"""Deployment assets stay loadable and the standalone entrypoints work."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_deploy_yaml_parses():
+    paths = glob.glob(os.path.join(ROOT, "deploy", "**", "*.yaml"), recursive=True)
+    assert len(paths) >= 4
+    for p in paths:
+        docs = [d for d in yaml.safe_load_all(open(p)) if d]
+        assert docs, p
+        for d in docs:
+            assert "kind" in d and "metadata" in d, p
+
+
+def test_grafana_dashboard_parses():
+    d = json.load(open(os.path.join(ROOT, "deploy", "grafana",
+                                    "runtime-dashboard.json")))
+    assert d["panels"] and all("targets" in p for p in d["panels"])
+
+
+def test_download_worker_requires_env():
+    out = subprocess.run(
+        [sys.executable, "-m", "arks_tpu.control.download"],
+        capture_output=True, text=True, timeout=60, env={
+            **os.environ, "MODEL_NAME": "", "MODEL_PATH": ""})
+    assert out.returncode == 2
+
+
+def test_standalone_gateway_file_provider(tmp_path):
+    """python -m arks_tpu.gateway --manifests ... serves /v1/models (the
+    reference gateway's file config-provider mode)."""
+    manifest = tmp_path / "gw.yaml"
+    manifest.write_text("""
+kind: Endpoint
+metadata: {name: m1, namespace: ns}
+spec: {}
+---
+kind: Token
+metadata: {name: t, namespace: ns}
+spec:
+  token: sk-file
+  qos:
+    - endpoint: {name: m1}
+""")
+    port = 18231
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "arks_tpu.gateway",
+         "--manifests", str(manifest), "--host", "127.0.0.1",
+         "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 30
+        body = None
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models",
+                    headers={"Authorization": "Bearer sk-file"})
+                body = json.load(urllib.request.urlopen(req, timeout=5))
+                break
+            except OSError:
+                time.sleep(0.2)
+        assert body is not None, "gateway never came up"
+        assert [m["id"] for m in body["data"]] == ["m1"]
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)

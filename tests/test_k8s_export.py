@@ -1,0 +1,171 @@
+"""K8s manifest rendering: resources -> GKE TPU YAML (gitops path)."""
+
+import subprocess
+import sys
+
+import yaml
+
+from arks_tpu.control.k8s_export import (
+    TPU_SHAPES, render_application, render_disaggregated, render_endpoint,
+    render_model,
+)
+from arks_tpu.control.resources import (
+    Application, DisaggregatedApplication, Endpoint, Model,
+)
+
+
+def _app(accelerator="tpu-v5e-16", replicas=2):
+    return Application(name="q7b", namespace="team-a", spec={
+        "replicas": replicas, "runtime": "jax", "accelerator": accelerator,
+        "model": {"name": "qwen25"}, "servedModelName": "qwen2.5-7b",
+        "modelConfig": "qwen2.5-7b", "tensorParallel": 4,
+        "runtimeCommonArgs": ["--num-slots", "64"],
+    })
+
+
+def test_application_renders_gangs_with_tpu_topology():
+    docs = render_application(_app())
+    sets = [d for d in docs if d["kind"] == "StatefulSet"]
+    assert len(sets) == 2  # one gang per replica
+    shape = TPU_SHAPES["tpu-v5e-16"]
+    for ss in sets:
+        assert ss["spec"]["replicas"] == shape.hosts
+        assert ss["spec"]["podManagementPolicy"] == "Parallel"
+        pod = ss["spec"]["template"]["spec"]
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] \
+            == shape.accelerator
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] \
+            == shape.topology
+        c = pod["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == str(shape.chips_per_host)
+        env = {e["name"]: e for e in c["env"]}
+        # JAX rendezvous contract (LWS env translation).
+        assert env["ARKS_NUM_PROCESSES"]["value"] == str(shape.hosts)
+        assert "ARKS_COORDINATOR_ADDRESS" in env
+        assert "pod-index" in str(env["ARKS_PROCESS_ID"])
+        # Reserved /models mount, read-only.
+        mount = c["volumeMounts"][0]
+        assert mount["mountPath"] == "/models" and mount["readOnly"]
+        # Real entrypoint flags.
+        assert c["args"][:2] == ["-m", "arks_tpu.server"]
+        assert "--tensor-parallel-size" in c["args"]
+        # Traffic gating: the front Service selects every gang pod, so the
+        # readiness probe must be the leader-only endpoint.
+        assert c["readinessProbe"]["httpGet"]["path"] == "/readiness"
+
+    # Front service parity: arks-application-<name>, prometheus-discovery.
+    front = [d for d in docs if d["kind"] == "Service"
+             and d["metadata"]["name"] == "arks-application-q7b"]
+    assert front and front[0]["metadata"]["labels"]["prometheus-discovery"] == "true"
+
+
+def test_application_honors_model_storage_overrides():
+    model = Model(name="qwen25", namespace="team-a", spec={
+        "model": "Qwen/Qwen2.5-7B-Instruct",
+        "storage": {"pvc": "shared-models", "subPath": "qwen"},
+    })
+    docs = render_application(_app(), model)
+    ss = [d for d in docs if d["kind"] == "StatefulSet"][0]
+    pod = ss["spec"]["template"]["spec"]
+    assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == "shared-models"
+    c = pod["containers"][0]
+    assert c["args"][c["args"].index("--model-path") + 1] == "/models/qwen"
+    # And render_model provisions the same claim.
+    assert render_model(model)[0]["metadata"]["name"] == "shared-models"
+
+
+def test_disaggregated_renders_tiers_and_router():
+    dapp = DisaggregatedApplication(name="pd", namespace="team-a", spec={
+        "runtime": "jax", "model": {"name": "qwen25"},
+        "servedModelName": "qwen2.5-7b", "modelConfig": "qwen2.5-7b",
+        "router": {"replicas": 1, "port": 8080},
+        "prefill": {"replicas": 2, "accelerator": "tpu-v5e-8"},
+        "decode": {"replicas": 3, "accelerator": "tpu-v5e-8"},
+    })
+    docs = render_disaggregated(dapp)
+    sets = [d for d in docs if d["kind"] == "StatefulSet"]
+    assert len(sets) == 5  # 2 prefill + 3 decode gangs
+    modes = [d["spec"]["template"]["spec"]["containers"][0]["args"] for d in sets]
+    assert sum("prefill" in a for a in modes) == 2
+    assert sum("decode" in a for a in modes) == 3
+    router = [d for d in docs if d["kind"] == "Deployment"][0]
+    env = {e["name"]: e["value"] for e in
+           router["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["ARKS_PREFILL_ADDRS"].startswith("arks-pd-prefill.team-a.svc:")
+    assert env["ARKS_DECODE_ADDRS"].startswith("arks-pd-decode.team-a.svc:")
+    # Router front service uses the standalone-app naming so endpoints
+    # route to both kinds alike.
+    assert any(d["kind"] == "Service"
+               and d["metadata"]["name"] == "arks-application-pd" for d in docs)
+
+
+def test_cpu_application_has_no_tpu_fields():
+    docs = render_application(_app(accelerator="cpu", replicas=1))
+    pod = [d for d in docs if d["kind"] == "StatefulSet"][0]["spec"]["template"]["spec"]
+    assert "nodeSelector" not in pod
+    assert "resources" not in pod["containers"][0]
+
+
+def test_model_renders_pvc_and_download_job():
+    m = Model(name="qwen25", namespace="team-a", spec={
+        "model": "Qwen/Qwen2.5-7B-Instruct",
+        "source": {"huggingface": {"tokenSecretRef": "hf-token"}},
+    })
+    docs = render_model(m)
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["PersistentVolumeClaim", "Job"]
+    job = docs[1]["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e for e in job["env"]}
+    assert env["MODEL_NAME"]["value"] == "Qwen/Qwen2.5-7B-Instruct"
+    assert env["MODEL_PATH"]["value"].startswith("/models/")
+    assert env["HF_TOKEN"]["valueFrom"]["secretKeyRef"]["name"] == "hf-token"
+    assert env["ARKS_CONVERT_ORBAX"]["value"] == "1"
+
+
+def test_model_without_source_renders_storage_only():
+    docs = render_model(Model(name="pre", namespace="x", spec={"model": "m"}))
+    assert [d["kind"] for d in docs] == ["PersistentVolumeClaim"]
+
+
+def test_endpoint_renders_httproute_with_header_matches():
+    ep = Endpoint(name="qwen2.5-7b", namespace="team-a",
+                  spec={"defaultWeight": 3})
+    docs = render_endpoint(ep, [_app()])
+    route = docs[0]
+    assert route["kind"] == "HTTPRoute"
+    rule = route["spec"]["rules"][0]
+    headers = {h["name"]: h["value"] for h in rule["matches"][0]["headers"]}
+    assert headers == {"x-arks-namespace": "team-a",
+                       "x-arks-model": "qwen2.5-7b"}
+    assert rule["backendRefs"] == [{"name": "arks-application-q7b",
+                                    "port": 8080, "weight": 3}]
+
+
+def test_endpoint_skips_other_models_and_namespaces():
+    ep = Endpoint(name="another-model", namespace="team-a", spec={})
+    docs = render_endpoint(ep, [_app()])
+    assert docs[0]["spec"]["rules"][0]["backendRefs"] == []
+    # Same model name in a different namespace must NOT be routed.
+    ep2 = Endpoint(name="qwen2.5-7b", namespace="team-b", spec={})
+    docs = render_endpoint(ep2, [_app()])
+    assert docs[0]["spec"]["rules"][0]["backendRefs"] == []
+
+
+def test_endpoint_static_route_configs_become_backend_refs():
+    ep = Endpoint(name="qwen2.5-7b", namespace="team-a", spec={
+        "routeConfigs": [{"backend": {"service": "ext-svc", "port": 9000},
+                          "weight": 2}]})
+    docs = render_endpoint(ep, [])
+    assert docs[0]["spec"]["rules"][0]["backendRefs"] == [
+        {"name": "ext-svc", "port": 9000, "weight": 2}]
+
+
+def test_cli_renders_quickstart():
+    out = subprocess.run(
+        [sys.executable, "-m", "arks_tpu.control.k8s_export",
+         "--manifests", "examples/quickstart/quickstart.yaml"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    docs = list(yaml.safe_load_all(out.stdout))
+    assert any(d["kind"] == "StatefulSet" for d in docs)
+    assert any(d["kind"] == "HTTPRoute" for d in docs)
