@@ -58,7 +58,25 @@ class EngineConfig:
     tensor_parallel: int | None = None
     data_parallel: int = 1
     dtype: str | None = None   # default: model config dtype
+    # "auto"|"bf16"|"int8": int8 halves KV HBM traffic and doubles cache
+    # capacity (per-token scales, dequantized inside the attention kernel).
+    # auto = int8 on real TPU (the production default bench.py measures),
+    # engine dtype elsewhere (CPU tests stay full-width).
+    kv_cache_dtype: str = "auto"
     seed: int = 0
+
+    def resolve_kv_cache_dtype(self) -> str:
+        """Returns 'int8' | 'bf16' | 'engine' (= use the engine dtype)."""
+        if self.kv_cache_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError(f"kv_cache_dtype={self.kv_cache_dtype!r}")
+        if self.kv_cache_dtype == "auto":
+            import jax
+            return "int8" if jax.default_backend() == "tpu" else "engine"
+        return self.kv_cache_dtype
+
+    @property
+    def kv_quantized(self) -> bool:
+        return self.resolve_kv_cache_dtype() == "int8"
 
     def resolve_buckets(self) -> list[int]:
         """Prefill buckets clamped to the cache; never empty."""
@@ -135,7 +153,10 @@ class InferenceEngine:
             params = tf.shard_params(params, cfg, mesh)
         self.params = params
 
-        self._cache = tf.init_cache(cfg, engine_cfg.num_slots, engine_cfg.max_cache_len, dtype)
+        self._cache = tf.init_cache(cfg, engine_cfg.num_slots,
+                                    engine_cfg.max_cache_len,
+                                    self._cache_dtype(dtype),
+                                    quantized=engine_cfg.kv_quantized)
         if mesh is not None:
             self._cache = tf.shard_cache(self._cache, cfg, mesh)
         self._sampling = sampler_mod.init_sampling_state(
@@ -228,6 +249,10 @@ class InferenceEngine:
     # Scheduler loop
     # ------------------------------------------------------------------
 
+    def _cache_dtype(self, engine_dtype):
+        kvd = self.ecfg.resolve_kv_cache_dtype()
+        return jnp.bfloat16 if kvd == "bf16" else engine_dtype
+
     def _run(self) -> None:
         while self._running:
             try:
@@ -248,7 +273,9 @@ class InferenceEngine:
     def _reset_device_state(self) -> None:
         dtype = jnp.dtype(self.ecfg.dtype or self.cfg.dtype)
         self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
-                                    self.ecfg.max_cache_len, dtype)
+                                    self.ecfg.max_cache_len,
+                                    self._cache_dtype(dtype),
+                                    quantized=self.ecfg.kv_quantized)
         if self.mesh is not None:
             self._cache = tf.shard_cache(self._cache, self.cfg, self.mesh)
         self._sampling = sampler_mod.init_sampling_state(

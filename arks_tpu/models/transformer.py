@@ -47,10 +47,19 @@ class KVCache(NamedTuple):
     Head-major layout: each (slot, kv-head) sequence is a contiguous [S, D]
     stripe, so the ragged Pallas decode kernel's block reads are dense DMAs
     (arks_tpu.ops.pallas_attention).
+
+    Quantized (int8) caches carry per-token scales
+    [L, B, Hkv, S] float32; ``k_scale is None`` means full-width storage.
     """
 
     k: jnp.ndarray
     v: jnp.ndarray
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @property
     def num_slots(self) -> int:
@@ -146,17 +155,25 @@ def param_pspecs(cfg: ModelConfig, tp: int = 1) -> Params:
 
 
 def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
-               dtype: jnp.dtype | None = None) -> KVCache:
+               dtype: jnp.dtype | None = None,
+               quantized: bool = False) -> KVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_len, cfg.head_dim)
+    if quantized:
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32))
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1) -> KVCache:
+def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1,
+                 quantized: bool = False) -> KVCache:
     batch = AXIS_DATA if dp > 1 else None
     heads = AXIS_MODEL if shard_kv_heads(cfg, tp) else None
     spec = P(None, batch, heads, None, None)
-    return KVCache(k=spec, v=spec)
+    sspec = P(None, batch, heads, None) if quantized else None
+    return KVCache(k=spec, v=spec, k_scale=sspec, v_scale=sspec)
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
@@ -169,7 +186,7 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
     tp = mesh.shape.get(AXIS_MODEL, 1)
     dp = mesh.shape.get(AXIS_DATA, 1)
-    specs = cache_pspecs(cfg, tp, dp)
+    specs = cache_pspecs(cfg, tp, dp, quantized=cache.quantized)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), cache, specs)
 
@@ -319,11 +336,23 @@ def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     T must be <= cache max_len; entries beyond the true length are masked by
     the per-slot length at decode time and overwritten as decoding proceeds.
     Prefill emits time-major KV; the cache is head-major, so transpose here
-    (once per prompt — decode never pays for it).
+    (once per prompt — decode never pays for it).  Quantized caches get the
+    rows quantized to int8 + per-token scales here.
     """
     start = (0, slot.astype(jnp.int32), 0, 0, 0)
     k_new = jnp.swapaxes(k_new, 2, 3)  # [L, 1, Hkv, T, D]
     v_new = jnp.swapaxes(v_new, 2, 3)
+    if cache.quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        kq, ks = quantize_kv(k_new)  # int8 [L,1,Hkv,T,D], f32 [L,1,Hkv,T]
+        vq, vs = quantize_kv(v_new)
+        sstart = (0, slot.astype(jnp.int32), 0, 0)
+        return KVCache(
+            k=jax.lax.dynamic_update_slice(cache.k, kq, start),
+            v=jax.lax.dynamic_update_slice(cache.v, vq, start),
+            k_scale=jax.lax.dynamic_update_slice(cache.k_scale, ks, sstart),
+            v_scale=jax.lax.dynamic_update_slice(cache.v_scale, vs, sstart),
+        )
     return KVCache(
         k=jax.lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
         v=jax.lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start),
@@ -359,7 +388,7 @@ def decode_step(
     # xs/ys instead would make XLA slice + re-stack the whole cache every
     # step — ~2x the model's entire HBM traffic.
     def body(carry, xs):
-        h, kc, vc = carry
+        h, kc, vc, ksc, vsc = carry
         lp, layer = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(x, lp, cfg)
@@ -368,20 +397,20 @@ def decode_step(
         v = v.reshape(b, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, write_idx, cfg.rope_theta)
         k = apply_rope(k, write_idx, cfg.rope_theta)
-        attn, kc, vc = decode_update_and_attend(
+        attn, kc, vc, ksc, vsc = decode_update_and_attend(
             q, k, v, kc, vc, write_idx, layer, mesh, batch_axis, kv_sharded,
-            model_axis=AXIS_MODEL)
+            model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
         attn = attn.reshape(b, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, AXIS_MODEL)
         h = h + jnp.einsum("bq,qe->be", attn, lp["wo"])
         h = h + _mlp(h, lp, cfg, mesh, batch_axis)
-        return (h, kc, vc), None
+        return (h, kc, vc, ksc, vsc), None
 
-    (h, ks, vs), _ = jax.lax.scan(
-        body, (h, cache.k, cache.v),
+    (h, ks, vs, kss, vss), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     logits = _unembed(h, params, cfg, mesh, batch_axis)
-    return logits, KVCache(k=ks, v=vs)
+    return logits, KVCache(k=ks, v=vs, k_scale=kss, v_scale=vss)
 
 
 # ---------------------------------------------------------------------------

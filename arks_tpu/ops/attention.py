@@ -100,6 +100,31 @@ def decode_attention_xla(
     return out.astype(q.dtype)
 
 
+def _decode_attention_xla_quant(
+    q: jnp.ndarray,        # [B, Hkv, G, D]
+    k_cache: jnp.ndarray,  # [B, Hkv, S, D] int8
+    v_cache: jnp.ndarray,
+    k_scale: jnp.ndarray,  # [B, Hkv, S] f32
+    v_scale: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    """int8 oracle/fallback: per-token scales applied to scores (K) and
+    probabilities (V), mirroring the Pallas kernel's folding."""
+    b, hkv, g, d = q.shape
+    s = k_cache.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bkgd,bksd->bkgs", q, k_cache.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    scores = scores * k_scale[:, :, None, :]
+    valid = jnp.arange(s)[None] < lengths[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+    probs = _softmax(scores, axis=-1) * v_scale[:, :, None, :]
+    out = jnp.einsum("bkgs,bksd->bkgd", probs.astype(q.dtype),
+                     v_cache.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def decode_update_and_attend(
     q: jnp.ndarray,        # [B, H, D] — this step's query per slot
     k_new: jnp.ndarray,    # [B, Hkv, D] — this step's KV per slot
@@ -113,15 +138,22 @@ def decode_update_and_attend(
     kv_sharded: bool = False,
     impl: str | None = None,
     model_axis: str = "model",
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    k_scale: jnp.ndarray | None = None,  # [L, B, Hkv, S] f32 — int8 caches
+    v_scale: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray | None, jnp.ndarray | None]:
     """Write this step's KV row at ``write_idx`` of ``layer``, then attend
     over the valid prefix (now ``write_idx + 1`` entries).  Returns
-    (out [B, H, D], kc, vc).
+    (out [B, H, D], kc, vc, k_scale, v_scale).
 
     Takes the full stacked cache so the decode layer loop can carry it and
     the Pallas path (pallas_attention) can update/read it IN PLACE: both a
     row scatter and a per-layer slice/re-stack lower to whole-cache HBM
     traffic in XLA — each costs more than the rest of the model combined.
+
+    With ``k_scale``/``v_scale`` the caches are int8 with per-token scales:
+    the update quantizes this step's rows, attention dequantizes in VMEM —
+    half the HBM read width where decode is bandwidth-bound.
 
     Under a mesh the op is embarrassingly parallel over (batch, kv-head), so
     the kernels run inside ``shard_map`` with no collectives; when kv heads
@@ -131,6 +163,7 @@ def decode_update_and_attend(
     b, h, d = q.shape
     hkv = k_cache.shape[2]
     g = h // hkv
+    quantized = k_scale is not None
     impl = impl or default_decode_impl()
     # The kernels also serve dp-only meshes (trivial model axis): the op is
     # embarrassingly parallel over batch.  Only the replicated-KV TP regime
@@ -139,33 +172,64 @@ def decode_update_and_attend(
     use_pallas = impl == "pallas" and (kv_sharded or tp_trivial)
 
     if not use_pallas:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+
         kc_l = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
         vc_l = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
         b_idx = jnp.arange(b)[:, None]
         h_idx = jnp.arange(hkv)[None, :]
-        kc_l = kc_l.at[b_idx, h_idx, write_idx[:, None]].set(
-            k_new.astype(k_cache.dtype))
-        vc_l = vc_l.at[b_idx, h_idx, write_idx[:, None]].set(
-            v_new.astype(v_cache.dtype))
-        out = decode_attention_xla(q.reshape(b, hkv, g, d), kc_l, vc_l,
-                                   write_idx + 1)
+        if quantized:
+            kq, ksn = quantize_kv(k_new)
+            vq, vsn = quantize_kv(v_new)
+            kc_l = kc_l.at[b_idx, h_idx, write_idx[:, None]].set(kq)
+            vc_l = vc_l.at[b_idx, h_idx, write_idx[:, None]].set(vq)
+            ks_l = jax.lax.dynamic_index_in_dim(k_scale, layer, 0, keepdims=False)
+            vs_l = jax.lax.dynamic_index_in_dim(v_scale, layer, 0, keepdims=False)
+            ks_l = ks_l.at[b_idx, h_idx, write_idx[:, None]].set(ksn)
+            vs_l = vs_l.at[b_idx, h_idx, write_idx[:, None]].set(vsn)
+            # Scales fold into the score/prob stages (same trick as the
+            # Pallas kernel) — never materialize a dequantized f32 cache.
+            out = _decode_attention_xla_quant(
+                q.reshape(b, hkv, g, d), kc_l, vc_l, ks_l, vs_l, write_idx + 1)
+            ks = jax.lax.dynamic_update_index_in_dim(k_scale, ks_l, layer, 0)
+            vs = jax.lax.dynamic_update_index_in_dim(v_scale, vs_l, layer, 0)
+        else:
+            kc_l = kc_l.at[b_idx, h_idx, write_idx[:, None]].set(
+                k_new.astype(k_cache.dtype))
+            vc_l = vc_l.at[b_idx, h_idx, write_idx[:, None]].set(
+                v_new.astype(v_cache.dtype))
+            out = decode_attention_xla(q.reshape(b, hkv, g, d), kc_l, vc_l,
+                                       write_idx + 1)
+            ks, vs = k_scale, v_scale
         kc = jax.lax.dynamic_update_index_in_dim(k_cache, kc_l, layer, 0)
         vc = jax.lax.dynamic_update_index_in_dim(v_cache, vc_l, layer, 0)
-        return out.reshape(b, h, d), kc, vc
+        return out.reshape(b, h, d), kc, vc, ks, vs
 
-    from arks_tpu.ops.pallas_attention import kv_cache_update, ragged_decode_attention
+    from arks_tpu.ops.pallas_attention import (
+        kv_cache_update, kv_cache_update_quant, ragged_decode_attention,
+    )
     interpret = jax.default_backend() != "tpu"
+    block_s = int(os.environ.get("ARKS_ATTN_BLOCK_S", "256"))
+    block_b = int(os.environ.get("ARKS_ATTN_BLOCK_B", "16"))
 
-    def local(qg, kn, vn, kc, vc, widx, lyr):
-        kc, vc = kv_cache_update(kc, vc, kn, vn, widx, lyr, interpret=interpret)
+    def local(qg, kn, vn, kc, vc, ks, vs, widx, lyr):
+        if quantized:
+            kc, vc, ks, vs = kv_cache_update_quant(
+                kc, vc, ks, vs, kn, vn, widx, lyr, interpret=interpret)
+        else:
+            kc, vc = kv_cache_update(kc, vc, kn, vn, widx, lyr,
+                                     interpret=interpret)
         out = ragged_decode_attention(qg, kc, vc, widx + 1, lyr,
+                                      k_scale=ks, v_scale=vs,
+                                      block_s=block_s, block_b=block_b,
                                       interpret=interpret)
-        return out, kc, vc
+        return out, kc, vc, ks, vs
 
     qg = q.reshape(b, hkv, g, d)
     if mesh is None or mesh.size == 1:
-        out, kc, vc = local(qg, k_new, v_new, k_cache, v_cache, write_idx, layer)
-        return out.reshape(b, h, d), kc, vc
+        out, kc, vc, ks, vs = local(qg, k_new, v_new, k_cache, v_cache,
+                                    k_scale, v_scale, write_idx, layer)
+        return out.reshape(b, h, d), kc, vc, ks, vs
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -173,12 +237,15 @@ def decode_update_and_attend(
     qspec = P(batch_axis, model, None, None)
     kvspec = P(batch_axis, model, None)
     cspec = P(None, batch_axis, model, None, None)
+    sspec = P(None, batch_axis, model, None) if quantized else None
     fn = shard_map(
         local, mesh=mesh,
-        in_specs=(qspec, kvspec, kvspec, cspec, cspec, P(batch_axis), P()),
-        out_specs=(qspec, cspec, cspec),
+        in_specs=(qspec, kvspec, kvspec, cspec, cspec, sspec, sspec,
+                  P(batch_axis), P()),
+        out_specs=(qspec, cspec, cspec, sspec, sspec),
         check_vma=False,
     )
-    out, kc, vc = fn(qg, k_new, v_new, k_cache, v_cache, write_idx,
-                     jnp.asarray(layer, jnp.int32))
-    return out.reshape(b, h, d), kc, vc
+    out, kc, vc, ks, vs = fn(qg, k_new, v_new, k_cache, v_cache,
+                             k_scale, v_scale, write_idx,
+                             jnp.asarray(layer, jnp.int32))
+    return out.reshape(b, h, d), kc, vc, ks, vs

@@ -37,6 +37,9 @@ def main() -> None:
     p.add_argument("--max-model-len", type=int, default=1024)
     p.add_argument("--steps-per-dispatch", type=int, default=4)
     p.add_argument("--dtype", default=None)
+    p.add_argument("--kv-cache-dtype", default="auto",
+                   choices=("auto", "bf16", "int8"),
+                   help="int8 halves KV HBM traffic and doubles cache capacity")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
@@ -102,7 +105,7 @@ def main() -> None:
                               if b <= args.max_model_len),
         steps_per_dispatch=args.steps_per_dispatch,
         tensor_parallel=args.tp, data_parallel=args.dp,
-        dtype=args.dtype, seed=args.seed,
+        dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype, seed=args.seed,
     )
     # Real weights without tokenizer assets = broken mount; fail fast then.
     from arks_tpu.models.weights import has_real_weights

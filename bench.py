@@ -11,7 +11,9 @@ honest way to time on a tunneled PJRT platform where per-dispatch latency
 dominates and block_until_ready can return early.
 
 Env knobs: ARKS_BENCH_MODEL (default qwen2.5-1.5b), ARKS_BENCH_BATCH,
-ARKS_BENCH_CACHE_LEN, ARKS_BENCH_STEPS, ARKS_BENCH_TRIALS.
+ARKS_BENCH_CACHE_LEN, ARKS_BENCH_STEPS, ARKS_BENCH_TRIALS,
+ARKS_BENCH_KV_DTYPE (int8|bf16, default int8 — matching the engine's
+kv_cache_dtype=auto resolution on TPU).
 """
 
 from __future__ import annotations
@@ -32,10 +34,15 @@ def main() -> None:
     from arks_tpu.models import transformer as tf
 
     model = os.environ.get("ARKS_BENCH_MODEL", "qwen2.5-1.5b")
-    batch = int(os.environ.get("ARKS_BENCH_BATCH", "64"))
+    batch = int(os.environ.get("ARKS_BENCH_BATCH", "128"))
     cache_len = int(os.environ.get("ARKS_BENCH_CACHE_LEN", "1024"))
     steps = int(os.environ.get("ARKS_BENCH_STEPS", "32"))
     trials = int(os.environ.get("ARKS_BENCH_TRIALS", "3"))
+    # int8 KV is the production serving default on TPU: ~12% faster decode
+    # and 2x cache capacity at a bounded precision cost (see
+    # tests/test_pallas_attention.py int8 tolerances).
+    kv_dtype = os.environ.get("ARKS_BENCH_KV_DTYPE", "int8")
+    kv_quant = kv_dtype == "int8"
 
     cfg = get_config(model)
     n_chips = len(jax.devices())
@@ -47,7 +54,8 @@ def main() -> None:
     params = tf.init_params(cfg, jax.random.PRNGKey(0))
     if mesh is not None:
         params = tf.shard_params(params, cfg, mesh)
-    cache = tf.init_cache(cfg, num_slots=batch, max_len=cache_len)
+    cache = tf.init_cache(cfg, num_slots=batch, max_len=cache_len,
+                          quantized=kv_quant)
 
     def multi_step(params, cache, tokens, lengths):
         def body(carry, _):
@@ -79,7 +87,7 @@ def main() -> None:
 
     tok_s_chip = batch * steps / best / max(n_chips, 1)
     print(json.dumps({
-        "metric": f"decode_throughput_{model}_b{batch}",
+        "metric": f"decode_throughput_{model}_b{batch}_kv-{kv_dtype}",
         "value": round(tok_s_chip, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),

@@ -108,18 +108,109 @@ def test_decode_update_and_attend_sharded_pallas(tp, dp):
     kn = jax.random.normal(ks[3], (b, hkv, d), jnp.float32)
     vn = jax.random.normal(ks[4], (b, hkv, d), jnp.float32)
     widx = jnp.asarray([0, 5, 17, 31, 32, 40, 55, 63], jnp.int32)
-    ref_o, ref_k, ref_v = decode_update_and_attend(
+    ref_o, ref_k, ref_v, _, _ = decode_update_and_attend(
         q, kn, vn, kc, vc, widx, 1, impl="xla")
     mesh = make_mesh(tensor_parallel=tp, data_parallel=dp,
                      devices=jax.devices()[: tp * dp])
     kv_sharded = tp > 1 and hkv % tp == 0
-    got_o, got_k, got_v = decode_update_and_attend(
+    got_o, got_k, got_v, _, _ = decode_update_and_attend(
         q, kn, vn, kc, vc, widx, 1, mesh=mesh,
         batch_axis="data" if dp > 1 else None,
         kv_sharded=kv_sharded, impl="pallas")
     np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o), rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k), rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v), rtol=1e-6, atol=1e-6)
+
+
+def test_quantize_kv_roundtrip():
+    from arks_tpu.ops.pallas_attention import quantize_kv
+    x = jax.random.normal(jax.random.PRNGKey(9), (3, 2, 16), jnp.float32) * 5
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (3, 2)
+    deq = q.astype(jnp.float32) * s[..., None]
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(x), atol=np.abs(x).max() / 100)
+
+
+def test_kv_cache_update_quant_inplace():
+    from arks_tpu.ops.pallas_attention import kv_cache_update_quant, quantize_kv
+    l, b, hkv, s, d = 2, 4, 2, 128, 16
+    kc = jnp.zeros((l, b, hkv, s, d), jnp.int8)
+    vc = jnp.zeros((l, b, hkv, s, d), jnp.int8)
+    kss = jnp.zeros((l, b, hkv, s), jnp.float32)
+    vss = jnp.zeros((l, b, hkv, s), jnp.float32)
+    key = jax.random.PRNGKey(10)
+    kn = jax.random.normal(key, (b, hkv, d), jnp.float32) * 3
+    vn = kn + 1.0
+    idx = jnp.asarray([0, 17, 100, 127], jnp.int32)
+    kc2, vc2, kss2, vss2 = kv_cache_update_quant(
+        kc, vc, kss, vss, kn, vn, idx, 1, interpret=True)
+    kq_ref, ks_ref = quantize_kv(kn)
+    for slot in range(b):
+        np.testing.assert_array_equal(
+            np.asarray(kc2[1, slot, :, idx[slot]]), np.asarray(kq_ref[slot]))
+        np.testing.assert_allclose(
+            np.asarray(kss2[1, slot, :, idx[slot]]), np.asarray(ks_ref[slot]),
+            rtol=1e-6)
+    assert np.asarray(kc2[0]).sum() == 0  # other layer untouched
+    # Dequantized row approximates the original.
+    deq = np.asarray(kc2[1, 0, :, idx[0]]).astype(np.float32) \
+        * np.asarray(kss2[1, 0, :, idx[0]])[:, None]
+    np.testing.assert_allclose(deq, np.asarray(kn[0]), atol=0.05)
+
+
+@pytest.mark.parametrize("mesh_kind", ["none", "tp"])
+def test_decode_update_and_attend_int8_close_to_fp(mesh_kind):
+    """int8 KV path (pallas kernels, incl. sharded) tracks the full-width
+    XLA oracle within quantization tolerance."""
+    b, hkv, g, d, s = 4, 2, 3, 16, 128
+    key = jax.random.PRNGKey(11)
+    ks_ = jax.random.split(key, 7)
+    q = jax.random.normal(ks_[0], (b, hkv * g, d), jnp.float32)
+    kf = jax.random.normal(ks_[1], (2, b, hkv, s, d), jnp.float32)
+    vf = jax.random.normal(ks_[2], (2, b, hkv, s, d), jnp.float32)
+    kn = jax.random.normal(ks_[3], (b, hkv, d), jnp.float32)
+    vn = jax.random.normal(ks_[4], (b, hkv, d), jnp.float32)
+    widx = jnp.asarray([0, 5, 64, 127], jnp.int32)
+    ref_o, *_ = decode_update_and_attend(q, kn, vn, kf, vf, widx, 1, impl="xla")
+
+    from arks_tpu.ops.pallas_attention import quantize_kv
+    kq, kss = quantize_kv(kf)
+    vq, vss = quantize_kv(vf)
+    kwargs = {}
+    if mesh_kind == "tp":
+        from arks_tpu.parallel.mesh import make_mesh
+        kwargs = dict(mesh=make_mesh(tensor_parallel=2,
+                                     devices=jax.devices()[:2]),
+                      kv_sharded=True)
+    got_o, kc2, vc2, kss2, vss2 = decode_update_and_attend(
+        q, kn, vn, kq, vq, widx, 1, impl="pallas",
+        k_scale=kss, v_scale=vss, **kwargs)
+    assert kc2.dtype == jnp.int8
+    np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o),
+                               rtol=0.05, atol=0.05)
+
+
+def test_model_decode_int8_cache_tracks_fp():
+    """Whole-model decode with an int8 cache stays close to the fp cache."""
+    from arks_tpu.models import get_config
+    from arks_tpu.models import transformer as tf
+    cfg = get_config("tiny-gqa")
+    params = tf.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0, cfg.vocab_size)
+
+    def run(quantized):
+        cache = tf.init_cache(cfg, num_slots=2, max_len=128,
+                              dtype=jnp.float32, quantized=quantized)
+        _, ks, vs = tf.prefill(params, cfg, ids, jnp.asarray([6], jnp.int32))
+        cache = tf.insert(cache, ks, vs, jnp.asarray(0))
+        lengths = jnp.zeros((2,), jnp.int32).at[0].set(6)
+        logits, cache = tf.decode_step(
+            params, cfg, cache, jnp.zeros((2,), jnp.int32), lengths)
+        return np.asarray(logits[0])
+
+    ref, got = run(False), run(True)
+    # Logits in f32; int8 KV error shows up at ~1e-2 scale.
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.1)
 
 
 @pytest.mark.parametrize("layer", [0, 1])
@@ -133,9 +224,9 @@ def test_decode_update_and_attend_pallas_matches_xla(layer):
     kn = jax.random.normal(ks[3], (b, hkv, d), jnp.float32)
     vn = jax.random.normal(ks[4], (b, hkv, d), jnp.float32)
     widx = jnp.asarray([0, 5, 31, 63], jnp.int32)
-    ref_o, ref_k, ref_v = decode_update_and_attend(
+    ref_o, ref_k, ref_v, _, _ = decode_update_and_attend(
         q, kn, vn, kc, vc, widx, layer, impl="xla")
-    got_o, got_k, got_v = decode_update_and_attend(
+    got_o, got_k, got_v, _, _ = decode_update_and_attend(
         q, kn, vn, kc, vc, widx, layer, impl="pallas")
     np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o), rtol=2e-5, atol=2e-5)
     np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
