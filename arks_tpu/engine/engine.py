@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
 import queue
 import threading
 import time
@@ -86,6 +87,41 @@ class EngineConfig:
             buckets.append(self.max_cache_len)
         return buckets
 
+    def cache_len_alignment(self) -> int:
+        """Required max_cache_len alignment for the Pallas decode path.
+
+        The in-place cache-update kernels DMA along S in fixed tiles (16 for
+        bf16, 128 for the int8 per-token scales) and the ragged attention
+        grid needs S % min(block_s, S) == 0 (block_s = ARKS_ATTN_BLOCK_S,
+        default 256) — so any cache length ≥ block_s must be a multiple of
+        block_s (block_s is itself tile-aligned), and shorter caches a
+        multiple of the update tile.
+        """
+        from arks_tpu.ops.attention import default_decode_impl
+        if default_decode_impl() != "pallas":
+            return 1
+        block_s = int(os.environ.get("ARKS_ATTN_BLOCK_S", "256"))
+        if self.max_cache_len >= block_s:
+            return block_s
+        return 128 if self.kv_quantized else 16
+
+    def align_cache_len(self) -> None:
+        """Round max_cache_len up to the kernel alignment (warn if changed).
+
+        Called at engine startup so a misconfigured --max-model-len fails
+        (or self-corrects) immediately instead of raising a ValueError deep
+        inside the first decode dispatch.
+        """
+        align = self.cache_len_alignment()
+        rounded = -(-self.max_cache_len // align) * align
+        if rounded != self.max_cache_len:
+            log.warning(
+                "max_cache_len=%d is not %d-aligned for the Pallas decode "
+                "kernels (kv=%s); rounding up to %d",
+                self.max_cache_len, align, self.resolve_kv_cache_dtype(),
+                rounded)
+            self.max_cache_len = rounded
+
 
 @dataclasses.dataclass
 class _Slot:
@@ -144,6 +180,7 @@ class InferenceEngine:
                              data_parallel=engine_cfg.data_parallel)
         self.mesh = mesh
         self.metrics = EngineMetrics(registry)
+        engine_cfg.align_cache_len()
         self._buckets = engine_cfg.resolve_buckets()
         dtype = jnp.dtype(engine_cfg.dtype or cfg.dtype)
 

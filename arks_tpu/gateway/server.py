@@ -91,8 +91,17 @@ class PyUsageScanner:
                     obj = json.loads(data)
                 except (ValueError, json.JSONDecodeError):
                     continue
-                if isinstance(obj, dict) and obj.get("usage"):
-                    self._usage = obj["usage"]
+                u = obj.get("usage") if isinstance(obj, dict) else None
+                # Replace only when the frame carries a countable usage
+                # object: a later empty/non-numeric usage frame must not
+                # clear previously captured counters (same rule as the
+                # native scanner, keeping metering backend-independent).
+                if isinstance(u, dict) and any(
+                        isinstance(u.get(k), (int, float))
+                        and not isinstance(u.get(k), bool)
+                        for k in ("prompt_tokens", "completion_tokens",
+                                  "total_tokens")):
+                    self._usage = u
 
     def usage(self) -> dict | None:
         return self._usage

@@ -42,15 +42,25 @@ def resolve_plan(num_devices: int, tensor_parallel: int | None = None,
                  context_parallel: int = 1,
                  pipeline_parallel: int = 1) -> MeshPlan:
     fixed = context_parallel * pipeline_parallel
-    assert num_devices % fixed == 0, (num_devices, context_parallel, pipeline_parallel)
+    if num_devices % fixed != 0:
+        raise ValueError(
+            f"context_parallel*pipeline_parallel={fixed} must divide "
+            f"num_devices={num_devices} "
+            f"(context_parallel={context_parallel}, pipeline_parallel={pipeline_parallel})")
     rem = num_devices // fixed
     if tensor_parallel is None and data_parallel is None:
         tensor_parallel, data_parallel = rem, 1
     elif tensor_parallel is None:
-        assert rem % data_parallel == 0, (rem, data_parallel)
+        if rem % data_parallel != 0:
+            raise ValueError(
+                f"data_parallel={data_parallel} must divide the remaining "
+                f"{rem} devices")
         tensor_parallel = rem // data_parallel
     elif data_parallel is None:
-        assert rem % tensor_parallel == 0, (rem, tensor_parallel)
+        if rem % tensor_parallel != 0:
+            raise ValueError(
+                f"tensor_parallel={tensor_parallel} must divide the remaining "
+                f"{rem} devices")
         data_parallel = rem // tensor_parallel
     plan = MeshPlan(tensor_parallel=tensor_parallel, data_parallel=data_parallel,
                     context_parallel=context_parallel,

@@ -96,11 +96,18 @@ void handle_frame(Scanner* sc, const std::string& frame) {
       else if (payload[uend] == '}' && --depth == 0) { uend++; break; }
     }
     std::string usage = payload.substr(q, uend - q);
-    long long v;
-    sc->prompt = sc->completion = sc->total = -1;
-    if (parse_ll_after(usage, "\"prompt_tokens\"", &v)) sc->prompt = v;
-    if (parse_ll_after(usage, "\"completion_tokens\"", &v)) sc->completion = v;
-    if (parse_ll_after(usage, "\"total_tokens\"", &v)) sc->total = v;
+    long long p, c, t;
+    bool hp = parse_ll_after(usage, "\"prompt_tokens\"", &p);
+    bool hc = parse_ll_after(usage, "\"completion_tokens\"", &c);
+    bool ht = parse_ll_after(usage, "\"total_tokens\"", &t);
+    // Replace all three fields per frame — later usage frames fully
+    // supersede earlier ones — but ONLY when the frame carries at least one
+    // numeric counter: an empty or non-numeric usage object must not clear
+    // previously captured usage (PyUsageScanner applies the same rule).
+    if (!(hp || hc || ht)) continue;
+    sc->prompt = hp ? p : -1;
+    sc->completion = hc ? c : -1;
+    sc->total = ht ? t : -1;
     sc->has_usage = true;
   }
 }

@@ -133,3 +133,35 @@ def test_metrics_populated(engine):
     assert "prompt_tokens_total" in text
     assert "generation_tokens_total" in text
     assert "time_to_first_token_seconds_bucket" in text
+
+
+def test_cache_len_alignment_rounds_up_for_pallas(monkeypatch):
+    """A misaligned --max-model-len must self-correct at startup, not raise
+    deep inside the first decode dispatch (kernel DMA tiling constraints)."""
+    monkeypatch.setenv("ARKS_ATTN_IMPL", "pallas")
+    ecfg = EngineConfig(model="tiny", max_cache_len=1000, kv_cache_dtype="int8")
+    ecfg.align_cache_len()
+    assert ecfg.max_cache_len == 1024  # multiple of 256 covers all kernels
+    ecfg2 = EngineConfig(model="tiny", max_cache_len=100, kv_cache_dtype="int8")
+    ecfg2.align_cache_len()
+    assert ecfg2.max_cache_len == 128  # int8 scale tile below 256
+    ecfg3 = EngineConfig(model="tiny", max_cache_len=50, kv_cache_dtype="bf16")
+    ecfg3.align_cache_len()
+    assert ecfg3.max_cache_len == 64  # bf16 update tile
+
+
+def test_cache_len_untouched_on_xla_path(monkeypatch):
+    monkeypatch.setenv("ARKS_ATTN_IMPL", "xla")
+    ecfg = EngineConfig(model="tiny", max_cache_len=1000, kv_cache_dtype="int8")
+    ecfg.align_cache_len()
+    assert ecfg.max_cache_len == 1000
+
+
+def test_mesh_plan_validation_raises_value_error():
+    from arks_tpu.parallel.mesh import resolve_plan
+    with pytest.raises(ValueError):
+        resolve_plan(8, tensor_parallel=3)
+    with pytest.raises(ValueError):
+        resolve_plan(8, context_parallel=3)
+    with pytest.raises(ValueError):
+        resolve_plan(8, data_parallel=3)

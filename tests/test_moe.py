@@ -80,6 +80,64 @@ def test_moe_expert_parallel_equivalence(tp, dp):
                                rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.parametrize("name", ["tiny-moe", "tiny-mixtral"])
+def test_moe_grouped_matches_dense(name):
+    """The dropless grouped (sort + ragged_dot) dispatch is numerically
+    equivalent to the dense all-expert dispatch."""
+    cfg = get_config(name)
+    mp = moe.init_moe_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    lp = jax.tree_util.tree_map(lambda t: t[0], mp)  # layer 0 slice
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 40, cfg.hidden_size),
+                          jnp.float32)
+    dense = moe.moe_ffn(x, lp, cfg, grouped=False)
+    grouped = moe.moe_ffn(x, lp, cfg, grouped=True)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_grouped_auto_threshold(monkeypatch):
+    """Auto mode routes large unsharded [B, T, E] batches through the
+    grouped path, decode-shaped [B, E] and small batches through dense —
+    verified by counting actual grouped-path invocations."""
+    cfg = get_config("tiny-moe")
+    mp = moe.init_moe_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    lp = jax.tree_util.tree_map(lambda t: t[0], mp)
+    calls = []
+    real = moe.moe_ffn_grouped
+    monkeypatch.setattr(moe, "moe_ffn_grouped",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    big = jax.random.normal(jax.random.PRNGKey(1), (2, 64, cfg.hidden_size))
+    moe.moe_ffn(big, lp, cfg)
+    assert len(calls) == 1  # large prefill → grouped
+    moe.moe_ffn(big[:, :4], lp, cfg)
+    assert len(calls) == 1  # small prefill → dense
+    decode = jax.random.normal(jax.random.PRNGKey(2), (128, cfg.hidden_size))
+    moe.moe_ffn(decode, lp, cfg)
+    assert len(calls) == 1  # decode stays dense no matter the slot count
+    moe.moe_ffn(big, lp, cfg, constrain=lambda t, d: t)
+    assert len(calls) == 1  # sharded (constrained) → dense
+
+
+def test_moe_grouped_grad():
+    """Training uses the grouped path when unsharded — it must be
+    differentiable (ragged_dot grads + scatter-add transpose)."""
+    cfg = get_config("tiny-moe")
+    mp = moe.init_moe_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    lp = jax.tree_util.tree_map(lambda t: t[0], mp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, cfg.hidden_size))
+
+    def loss(lp, grouped):
+        return jnp.sum(moe.moe_ffn(x, lp, cfg, grouped=grouped) ** 2)
+
+    g_dense = jax.grad(loss)(lp, False)
+    g_grouped = jax.grad(loss)(lp, True)
+    for k in g_dense:
+        np.testing.assert_allclose(np.asarray(g_grouped[k]),
+                                   np.asarray(g_dense[k]),
+                                   rtol=5e-4, atol=5e-4, err_msg=k)
+
+
 def test_moe_param_counts():
     assert 40e9 < get_config("mixtral-8x7b").num_params() < 50e9
     assert 50e9 < get_config("qwen2-57b-a14b").num_params() < 62e9

@@ -114,6 +114,40 @@ def test_sse_scanner_later_usage_supersedes_fully():
                                          "completion_tokens": 50}
 
 
+@pytest.mark.parametrize("later", [
+    b'data: {"usage": {}}\n\n',
+    b'data: {"usage": {"foo": "bar"}}\n\n',
+    b'data: {"usage": {"prompt_tokens": "NaN"}}\n\n',
+    b'data: {"usage": {"prompt_tokens": true}}\n\n',
+])
+def test_sse_scanner_empty_usage_does_not_clear(later):
+    """An empty or non-numeric usage frame after a real one must not clear
+    the captured counters, in either backend (they must agree: metering
+    can't depend on whether the C++ library built)."""
+    early = (b'data: {"usage": {"prompt_tokens": 3, "completion_tokens": 2,'
+             b' "total_tokens": 5}}\n\n')
+    nat, py = native.SseUsageScanner(), PyUsageScanner()
+    for s in (nat, py):
+        s.feed(early)
+        s.feed(later)
+    assert nat.usage() == py.usage() == {
+        "prompt_tokens": 3, "completion_tokens": 2, "total_tokens": 5}
+
+
+@pytest.mark.parametrize("n", [1, 3, 16])
+def test_sse_scanner_fragmented_empty_usage_parity(n):
+    """Fragmented feeds of an empty-usage stream agree across backends."""
+    raw = (b'data: {"usage": {"prompt_tokens": 9, "total_tokens": 9}}\n\n'
+           b'data: {"usage": {}}\n\n'
+           b'data: [DONE]\n\n')
+    nat, py = native.SseUsageScanner(), PyUsageScanner()
+    for i in range(0, len(raw), n):
+        nat.feed(raw[i: i + n])
+        py.feed(raw[i: i + n])
+    assert nat.usage() == py.usage() == {"prompt_tokens": 9,
+                                         "total_tokens": 9}
+
+
 def test_sse_scanner_ignores_tokens_outside_usage_object():
     """Numbers after the usage object's closing brace must not be parsed."""
     s = native.SseUsageScanner()
