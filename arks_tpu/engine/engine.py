@@ -64,6 +64,10 @@ class EngineConfig:
     # auto = int8 on real TPU (the production default bench.py measures),
     # engine dtype elsewhere (CPU tests stay full-width).
     kv_cache_dtype: str = "auto"
+    # "bf16"|"int8": int8 = weight-only quantization (w8a16, per-output-
+    # channel scales, dequant fused into the matmuls — models.quant). How
+    # 7B-class models fit a 16GB v5e chip; also halves decode weight reads.
+    weight_dtype: str = "bf16"
     seed: int = 0
 
     def resolve_kv_cache_dtype(self) -> str:
@@ -184,8 +188,21 @@ class InferenceEngine:
         self._buckets = engine_cfg.resolve_buckets()
         dtype = jnp.dtype(engine_cfg.dtype or cfg.dtype)
 
+        if engine_cfg.weight_dtype not in ("bf16", "int8"):
+            raise ValueError(f"weight_dtype={engine_cfg.weight_dtype!r}")
         if params is None:
-            params = tf.init_params(cfg, jax.random.PRNGKey(engine_cfg.seed), dtype)
+            if engine_cfg.weight_dtype == "int8":
+                # Direct quantized init: a full-width init of an HBM-limited
+                # model would OOM before quantization could shrink it.
+                from arks_tpu.models import quant
+                params = quant.init_params_quantized(
+                    cfg, jax.random.PRNGKey(engine_cfg.seed), dtype)
+            else:
+                params = tf.init_params(cfg, jax.random.PRNGKey(engine_cfg.seed), dtype)
+        elif engine_cfg.weight_dtype == "int8":
+            from arks_tpu.models import quant
+            if not quant.is_quantized(params["layers"].get("wq")):
+                params = quant.quantize_params(params)
         if mesh is not None:
             params = tf.shard_params(params, cfg, mesh)
         self.params = params

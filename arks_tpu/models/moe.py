@@ -124,6 +124,8 @@ def moe_ffn_grouped(x: jnp.ndarray, mp: Params, cfg) -> jnp.ndarray:
     x2 = x.reshape(-1, e)
     n = x2.shape[0]
 
+    from arks_tpu.models.quant import dequantize
+
     logits = jnp.einsum("te,ex->tx", x2, mp["router"])
     vals, idx = router_topk(logits, cfg)                    # [T, k]
 
@@ -133,19 +135,23 @@ def moe_ffn_grouped(x: jnp.ndarray, mp: Params, cfg) -> jnp.ndarray:
     xs = jnp.take(x2, token_of, axis=0)                     # [T*k, E] sorted
     group_sizes = jnp.bincount(flat_expert, length=nx)
 
-    gate = jax.lax.ragged_dot(xs, mp["w_gate"], group_sizes)
-    up = jax.lax.ragged_dot(xs, mp["w_up"], group_sizes)
+    # ragged_dot needs plain arrays; dequantized expert weights materialize
+    # here (prefill-only path — dense/decode keeps the fused dequant).
+    gate = jax.lax.ragged_dot(xs, dequantize(mp["w_gate"], x.dtype), group_sizes)
+    up = jax.lax.ragged_dot(xs, dequantize(mp["w_up"], x.dtype), group_sizes)
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
-    down = jax.lax.ragged_dot(act, mp["w_down"], group_sizes)  # [T*k, E]
+    down = jax.lax.ragged_dot(act, dequantize(mp["w_down"], x.dtype),
+                              group_sizes)                  # [T*k, E]
 
     w = jnp.take(vals.reshape(-1), order).astype(down.dtype)   # [T*k]
     out = jnp.zeros((n, e), down.dtype).at[token_of].add(down * w[:, None])
 
     if cfg.shared_expert_intermediate_size:
-        sg = jnp.einsum("te,ef->tf", x2, mp["shared_gate_proj"])
-        su = jnp.einsum("te,ef->tf", x2, mp["shared_up"])
+        from arks_tpu.models.quant import qeinsum
+        sg = qeinsum("te,ef->tf", x2, mp["shared_gate_proj"])
+        su = qeinsum("te,ef->tf", x2, mp["shared_up"])
         sact = jax.nn.silu(sg.astype(jnp.float32)).astype(sg.dtype) * su
-        shared = jnp.einsum("tf,fe->te", sact, mp["shared_down"])
+        shared = qeinsum("tf,fe->te", sact, mp["shared_down"])
         gatev = jax.nn.sigmoid(
             jnp.einsum("te,e->t", x2, mp["shared_gate"]).astype(jnp.float32))
         out = out + shared * gatev[:, None].astype(shared.dtype)
@@ -169,24 +175,26 @@ def moe_ffn(x: jnp.ndarray, mp: Params, cfg, constrain=None,
                    and n_tokens >= _GROUPED_MIN_TOKENS)
     if grouped:
         return moe_ffn_grouped(x, mp, cfg)
+    from arks_tpu.models.quant import qeinsum
+
     logits = jnp.einsum("...e,ex->...x", x, mp["router"])
     weights = router_weights(logits, cfg).astype(x.dtype)  # [.., X]
 
-    gate = jnp.einsum("...e,xef->...xf", x, mp["w_gate"])
-    up = jnp.einsum("...e,xef->...xf", x, mp["w_up"])
+    gate = qeinsum("...e,xef->...xf", x, mp["w_gate"])
+    up = qeinsum("...e,xef->...xf", x, mp["w_up"])
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
     if constrain is not None:
         act = constrain(act, act.ndim - 2)
-    down = jnp.einsum("...xf,xfe->...xe", act, mp["w_down"])  # per-expert out
+    down = qeinsum("...xf,xfe->...xe", act, mp["w_down"])  # per-expert out
     out = jnp.einsum("...xe,...x->...e", down, weights)       # psum over EP
 
     if cfg.shared_expert_intermediate_size:
-        sg = jnp.einsum("...e,ef->...f", x, mp["shared_gate_proj"])
-        su = jnp.einsum("...e,ef->...f", x, mp["shared_up"])
+        sg = qeinsum("...e,ef->...f", x, mp["shared_gate_proj"])
+        su = qeinsum("...e,ef->...f", x, mp["shared_up"])
         sact = jax.nn.silu(sg.astype(jnp.float32)).astype(sg.dtype) * su
         if constrain is not None:
             sact = constrain(sact, sact.ndim - 1)
-        shared = jnp.einsum("...f,fe->...e", sact, mp["shared_down"])
+        shared = qeinsum("...f,fe->...e", sact, mp["shared_down"])
         gatev = jax.nn.sigmoid(
             jnp.einsum("...e,e->...", x, mp["shared_gate"]).astype(jnp.float32))
         out = out + shared * gatev[..., None].astype(shared.dtype)

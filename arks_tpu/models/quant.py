@@ -1,0 +1,200 @@
+"""Weight-only int8 quantization (w8a16) for serving.
+
+Why: a 7B-class model in bf16 (~15 GB) does not fit a single v5e chip's
+16 GB HBM next to its KV cache — and decode is HBM-bandwidth-bound, so
+halving the bytes read per step is also the single biggest decode-throughput
+lever.  Weights are stored int8 with per-output-channel float scales;
+activations stay bf16.  The dequant is expressed as ``int8 -> bf16 convert
+feeding the einsum`` plus a per-channel scale on the OUTPUT, so XLA fuses
+the convert into the matmul's operand read and the full-width weight never
+materializes in HBM.  MXU FLOPs are unchanged (bf16); only weight bytes
+halve.
+
+The reference has no quantization of its own (it forwards dtype flags to
+vLLM/SGLang via runtimeCommonArgs, /root/reference/api/v1/
+arksapplication_types.go:292); this module is the TPU-native counterpart.
+
+A quantized leaf is a dict ``{"q": int8 array, "s": float32 scale}`` —
+pytree-compatible, so sharding/tree-mapping compose without special cases.
+Scale layout: matmul weights [.., K, N] carry s = [.., 1, N] (per output
+channel); the embedding table [V, E] carries s = [V, 1] (per row — the same
+orientation serves both the lookup and the tied unembed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Weights quantized per-output-channel along reduction dim -2 ([.., K, N]).
+MATMUL_KEYS = frozenset({
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+    "shared_gate_proj", "shared_up", "shared_down",
+})
+# Router logits feed a softmax over experts — tiny and precision-sensitive,
+# so it stays full width, as do norms, biases and the scalar shared gate.
+SKIP_KEYS = frozenset({
+    "attn_norm", "mlp_norm", "final_norm", "bq", "bk", "bv", "router",
+    "shared_gate",
+})
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_tensor(w: jnp.ndarray, axis: int = -2) -> dict:
+    """Symmetric int8 quantization with a shared scale along ``axis``."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """``jnp.einsum`` where ``w`` may be a quantized leaf.
+
+    The convert int8->x.dtype fuses into the dot's operand read; the
+    per-output-channel scale applies to the OUTPUT (valid because the scale
+    is constant along the contraction dim), broadcasting over trailing dims.
+    """
+    if not is_quantized(w):
+        return jnp.einsum(eq, x, w)
+    y = jnp.einsum(eq, x, w["q"].astype(x.dtype))
+    return y * jnp.squeeze(w["s"], axis=-2).astype(y.dtype)
+
+
+def dequantize(w, dtype: jnp.dtype) -> jnp.ndarray:
+    """Materialize the full-width weight (grouped-MoE ragged_dot path only —
+    everywhere else use qeinsum so the dequant stays fused)."""
+    if not is_quantized(w):
+        return w
+    return (w["q"].astype(dtype) * w["s"].astype(dtype))
+
+
+def embed_lookup(embed, tokens: jnp.ndarray, dtype: jnp.dtype) -> jnp.ndarray:
+    """Row gather from a possibly-quantized [V, E] table — gathers int8 rows
+    and their scales, never the dequantized table."""
+    if not is_quantized(embed):
+        return jnp.take(embed, tokens, axis=0)
+    rows = jnp.take(embed["q"], tokens, axis=0).astype(dtype)
+    scales = jnp.take(embed["s"], tokens, axis=0).astype(dtype)
+    return rows * scales
+
+
+def unembed_logits(h: jnp.ndarray, table, tied: bool) -> jnp.ndarray:
+    """[B, E] @ unembed table -> [B, V] float32, scale applied post-dot."""
+    if not is_quantized(table):
+        t = table.T if tied else table
+        return jnp.einsum("be,ev->bv", h, t).astype(jnp.float32)
+    if tied:  # table [V, E], s [V, 1]
+        logits = jnp.einsum("be,ve->bv", h, table["q"].astype(h.dtype))
+        return logits.astype(jnp.float32) * jnp.squeeze(table["s"], -1)
+    # lm_head [E, V], s [1, V]
+    logits = jnp.einsum("be,ev->bv", h, table["q"].astype(h.dtype))
+    return logits.astype(jnp.float32) * jnp.squeeze(table["s"], -2)
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize an already-materialized transformer Params tree.
+
+    NOTE: the caller's full-width tree stays alive while this runs, so peak
+    device memory is full tree + int8 tree.  Fine for small models and
+    trees already sharded across a mesh; for HBM-limited single-chip loads
+    use the bounded-peak paths instead — init_params_quantized (random
+    init) or weights.params_from_hf(weight_dtype='int8') (checkpoints),
+    both of which quantize leaf-by-leaf as leaves are created.
+    """
+    out: dict = {}
+    for name, leaf in params.items():
+        if isinstance(leaf, dict):
+            out[name] = quantize_params(leaf)
+        elif name == "embed":
+            out[name] = quantize_tensor(leaf, axis=-1)
+        elif name in MATMUL_KEYS:
+            out[name] = quantize_tensor(leaf, axis=-2)
+        else:
+            assert name in SKIP_KEYS, (
+                f"param leaf {name!r} is in neither MATMUL_KEYS nor "
+                "SKIP_KEYS — classify it so quantization coverage can't "
+                "silently drift")
+            out[name] = leaf
+    return out
+
+
+def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> dict:
+    """Random-init a transformer Params tree directly in quantized form.
+
+    Mirrors transformer.init_params' distributions (normal*0.02 weights,
+    ones norms, zeros biases) but generates + quantizes each leaf inside its
+    own jit, so peak device memory is the int8 tree plus ONE full-width leaf
+    — a bf16 init of a 7B model (~15 GB) would not even fit the chip that
+    the quantized model is for.  Used by bench.py and anywhere random
+    weights of an HBM-limited model are needed.
+    """
+    import functools
+
+    from arks_tpu.models import transformer as tf
+
+    shapes = jax.eval_shape(
+        functools.partial(tf.init_params, cfg, dtype=dtype), key)
+
+    @functools.partial(jax.jit, static_argnames=("shape", "kind", "axis"))
+    def gen(k, shape, kind, axis):
+        if kind == "ones":
+            return jnp.ones(shape, dtype)
+        if kind == "zeros":
+            return jnp.zeros(shape, dtype)
+        w = jax.random.normal(k, shape, jnp.float32) * 0.02
+        if kind == "quant":
+            return quantize_tensor(w.astype(dtype), axis=axis)
+        return w.astype(dtype)
+
+    counter = [0]
+
+    def build(subtree):
+        out = {}
+        for name, leaf in subtree.items():
+            if isinstance(leaf, dict):
+                out[name] = build(leaf)
+                continue
+            counter[0] += 1
+            sub = jax.random.fold_in(key, counter[0])
+            if name in ("attn_norm", "mlp_norm", "final_norm"):
+                kind, axis = "ones", 0
+            elif name in ("bq", "bk", "bv"):
+                kind, axis = "zeros", 0
+            elif name == "embed":
+                kind, axis = "quant", -1
+            elif name in MATMUL_KEYS:
+                kind, axis = "quant", -2
+            else:
+                kind, axis = "full", 0
+            out[name] = gen(sub, tuple(leaf.shape), kind, axis)
+        return out
+
+    return build(shapes)
+
+
+def quantize_pspecs(specs: dict) -> dict:
+    """PartitionSpec tree matching quantize_params' output structure: the
+    int8 payload keeps the original spec; the scale keeps the spec with the
+    reduced dim's axis dropped (scales are [.., 1, N] there)."""
+    from jax.sharding import PartitionSpec as P
+
+    out: dict = {}
+    for name, leaf in specs.items():
+        if isinstance(leaf, dict):
+            out[name] = quantize_pspecs(leaf)
+        elif name == "embed":
+            out[name] = {"q": leaf, "s": P(leaf[0], None)}
+        elif name in MATMUL_KEYS:
+            # All matmul specs are full-rank (param_pspecs/moe_pspecs emit
+            # one entry per dim), so the scale spec is the weight spec with
+            # the contraction dim (always -2) replicated.
+            s_entries = list(leaf)
+            s_entries[-2] = None
+            out[name] = {"q": leaf, "s": P(*s_entries)}
+        else:
+            out[name] = leaf
+    return out

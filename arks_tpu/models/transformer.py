@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from arks_tpu.models.config import ModelConfig
+from arks_tpu.models.quant import embed_lookup, qeinsum, unembed_logits
 from arks_tpu.ops.attention import decode_update_and_attend, prefill_attention
 from arks_tpu.ops.norms import rms_norm
 from arks_tpu.ops.rope import apply_rope
@@ -179,6 +180,9 @@ def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1,
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     tp = mesh.shape.get(AXIS_MODEL, 1)
     specs = param_pspecs(cfg, tp)
+    from arks_tpu.models.quant import is_quantized, quantize_pspecs
+    if is_quantized(params["layers"].get("wq")):
+        specs = quantize_pspecs(specs)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
 
@@ -203,9 +207,9 @@ def _constrain(x: jnp.ndarray, mesh: Mesh | None, *spec) -> jnp.ndarray:
 
 
 def _qkv(h: jnp.ndarray, lp: Params, cfg: ModelConfig):
-    q = jnp.einsum("...e,eq->...q", h, lp["wq"])
-    k = jnp.einsum("...e,ek->...k", h, lp["wk"])
-    v = jnp.einsum("...e,ek->...k", h, lp["wv"])
+    q = qeinsum("...e,eq->...q", h, lp["wq"])
+    k = qeinsum("...e,ek->...k", h, lp["wk"])
+    v = qeinsum("...e,ek->...k", h, lp["wv"])
     if cfg.qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -241,18 +245,19 @@ def _mlp(h: jnp.ndarray, lp: Params, cfg: ModelConfig, mesh: Mesh | None,
             return _constrain(t, mesh, *_int_spec(t.ndim, dim))
 
         return moe.moe_ffn(x, lp, cfg, constrain if mesh is not None else None)
-    gate = jnp.einsum("...e,ef->...f", x, lp["w_gate"])
-    up = jnp.einsum("...e,ef->...f", x, lp["w_up"])
+    gate = qeinsum("...e,ef->...f", x, lp["w_gate"])
+    up = qeinsum("...e,ef->...f", x, lp["w_up"])
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
     act = _constrain(act, mesh, *_int_spec(act.ndim, act.ndim - 1))
-    return jnp.einsum("...f,fe->...e", act, lp["w_down"])
+    return qeinsum("...f,fe->...e", act, lp["w_down"])
 
 
 def _unembed(h_last: jnp.ndarray, params: Params, cfg: ModelConfig,
              mesh: Mesh | None, batch_axis: str | None) -> jnp.ndarray:
     h_last = rms_norm(h_last, params["final_norm"], cfg.rms_norm_eps)
-    table = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = jnp.einsum("be,ev->bv", h_last, table).astype(jnp.float32)
+    tied = cfg.tie_word_embeddings
+    table = params["embed"] if tied else params["lm_head"]
+    logits = unembed_logits(h_last, table, tied)
     return _constrain(logits, mesh, batch_axis, None)
 
 
@@ -293,7 +298,7 @@ def prefill_layer(
     else:
         attn = prefill_attention(q, k, v).reshape(b, t, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
-    h = h + jnp.einsum("...q,qe->...e", attn, lp["wo"])
+    h = h + qeinsum("...q,qe->...e", attn, lp["wo"])
     h = h + _mlp(h, lp, cfg, mesh, batch_axis, seq_axis)
     return h, k, v
 
@@ -315,7 +320,8 @@ def prefill(
     under the global causal mask no valid query ever attends to them."""
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-    h = jnp.take(params["embed"], tokens, axis=0)
+    h = embed_lookup(params["embed"], tokens,
+                     params["layers"]["attn_norm"].dtype)
     h = _constrain(h, mesh, None, seq_axis, None)
 
     def body(h, lp):
@@ -378,7 +384,8 @@ def decode_step(
     engine must retire or evict a slot before it fills (see
     arks_tpu.engine.scheduler)."""
     b = tokens.shape[0]
-    h = jnp.take(params["embed"], tokens, axis=0)  # [B, E]
+    h = embed_lookup(params["embed"], tokens,
+                     params["layers"]["attn_norm"].dtype)  # [B, E]
     h = _constrain(h, mesh, batch_axis, None)
     write_idx = lengths.astype(jnp.int32)
     kv_sharded = mesh is not None and shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1))
@@ -402,7 +409,7 @@ def decode_step(
             model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
         attn = attn.reshape(b, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, AXIS_MODEL)
-        h = h + jnp.einsum("bq,qe->be", attn, lp["wo"])
+        h = h + qeinsum("bq,qe->be", attn, lp["wo"])
         h = h + _mlp(h, lp, cfg, mesh, batch_axis)
         return (h, kc, vc, ksc, vsc), None
 

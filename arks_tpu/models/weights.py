@@ -49,17 +49,26 @@ def _hf_tensors(path: str) -> dict[str, np.ndarray]:
     return out
 
 
-def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None) -> tf.Params:
-    """Convert a HuggingFace Qwen2/Llama checkpoint directory to arks params."""
+def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None,
+                   weight_dtype: str = "bf16") -> tf.Params:
+    """Convert a HuggingFace Qwen2/Llama checkpoint directory to arks params.
+
+    Leaves are assembled on the HOST (numpy) and moved to device one at a
+    time; with ``weight_dtype='int8'`` each matmul leaf is quantized on
+    arrival (models.quant w8a16) so peak device memory is the int8 tree plus
+    ONE full-width leaf — the only way a ~15GB bf16 7B checkpoint reaches a
+    16GB chip.
+    """
     dtype = jnp.dtype(dtype or cfg.dtype)
     t = _hf_tensors(path)
     l = cfg.num_layers
 
     def get(name: str, transpose: bool = False) -> np.ndarray:
         x = t[name]
-        return x.T if transpose else x
+        x = x.T if transpose else x
+        return np.asarray(x, dtype)
 
-    def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
+    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
         return _stack_layers(t, l, dtype, fmt, transpose)
 
     layers: tf.Params = {
@@ -83,22 +92,57 @@ def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None) -> tf.Params:
         layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
         layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
     params: tf.Params = {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "embed": get("model.embed_tokens.weight"),
         "layers": layers,
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "final_norm": get("model.norm.weight"),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = jnp.asarray(get("lm_head.weight", True), dtype)
-    return params
+        params["lm_head"] = get("lm_head.weight", True)
+    return _leaves_to_device(params, quantize=weight_dtype == "int8")
+
+
+def _quantize_leaf(leaf, axis: int):
+    import functools
+
+    from arks_tpu.models.quant import quantize_tensor
+
+    x = jnp.asarray(leaf)
+    # donate: the full-width device copy is freed as soon as the int8+scale
+    # outputs exist, bounding the transient to one leaf.
+    fn = jax.jit(functools.partial(quantize_tensor, axis=axis),
+                 donate_argnums=(0,))
+    return fn(x)
+
+
+def _leaves_to_device(host_params: dict, quantize: bool) -> tf.Params:
+    """Move a host-side (numpy) params tree to device leaf-by-leaf,
+    quantizing matmul leaves on arrival when requested."""
+    from arks_tpu.models.quant import MATMUL_KEYS
+
+    def walk(sub: dict) -> dict:
+        out = {}
+        for name, leaf in sub.items():
+            if isinstance(leaf, dict):
+                out[name] = walk(leaf)
+            elif quantize and name == "embed":
+                out[name] = _quantize_leaf(leaf, -1)
+            elif quantize and name in MATMUL_KEYS:
+                out[name] = _quantize_leaf(leaf, -2)
+            else:
+                out[name] = jnp.asarray(leaf)
+        return out
+
+    return walk(host_params)
 
 
 def _stack_layers(t: dict[str, np.ndarray], l: int, dtype: Any, fmt: str,
-                  transpose: bool = False) -> jnp.ndarray:
-    """Stack one per-layer tensor family into the leading-[L] convention."""
+                  transpose: bool = False) -> np.ndarray:
+    """Stack one per-layer tensor family into the leading-[L] convention
+    (host-side; device transfer happens in _leaves_to_device)."""
     xs = [t[fmt.format(i)] for i in range(l)]
     if transpose:
         xs = [x.T for x in xs]
-    return jnp.asarray(np.stack(xs), dtype)
+    return np.stack(xs).astype(dtype)
 
 
 def _moe_from_hf(cfg: ModelConfig, t: dict[str, np.ndarray],
@@ -121,10 +165,10 @@ def _moe_from_hf(cfg: ModelConfig, t: dict[str, np.ndarray],
                           base + ".experts.{}.up_proj.weight",
                           base + ".experts.{}.down_proj.weight")
 
-    def estack(fmt: str) -> jnp.ndarray:
-        return jnp.asarray(np.stack([
+    def estack(fmt: str) -> np.ndarray:
+        return np.stack([
             np.stack([t[fmt.format(i, e)].T for e in range(x)])
-            for i in range(l)]), dtype)
+            for i in range(l)]).astype(dtype)
 
     p: tf.Params = {
         "router": _stack_layers(t, l, dtype, router, True),
@@ -137,9 +181,9 @@ def _moe_from_hf(cfg: ModelConfig, t: dict[str, np.ndarray],
         p["shared_gate_proj"] = _stack_layers(t, l, dtype, sh + ".gate_proj.weight", True)
         p["shared_up"] = _stack_layers(t, l, dtype, sh + ".up_proj.weight", True)
         p["shared_down"] = _stack_layers(t, l, dtype, sh + ".down_proj.weight", True)
-        p["shared_gate"] = jnp.asarray(np.stack(
+        p["shared_gate"] = np.stack(
             [t["model.layers.{}.mlp.shared_expert_gate.weight".format(i)].reshape(-1)
-             for i in range(l)]), dtype)
+             for i in range(l)]).astype(dtype)
     return p
 
 
@@ -162,12 +206,19 @@ def save_orbax(params: tf.Params, model_path: str) -> str:
 
 
 def load_orbax(cfg: ModelConfig, model_path: str, mesh=None,
-               dtype: Any = None) -> tf.Params:
+               dtype: Any = None, weight_dtype: str = "bf16") -> tf.Params:
     """Load an Orbax checkpoint, sharded directly to the mesh when given —
-    each host reads only the shards it owns (multi-host friendly)."""
+    each host reads only the shards it owns (multi-host friendly).
+
+    With ``weight_dtype='int8'`` and no mesh, the checkpoint is restored to
+    HOST memory and quantized onto the device leaf-by-leaf (bounded peak —
+    the single-chip 7B path).  With a mesh, the full-width restore is
+    already spread across devices, so the tree-level quantize follows it.
+    """
     import orbax.checkpoint as ocp
 
     dtype = jnp.dtype(dtype or cfg.dtype)
+    quantize = weight_dtype == "int8"
     path = os.path.abspath(orbax_path(model_path))
     template = jax.eval_shape(
         lambda: tf.init_params(cfg, jax.random.PRNGKey(0), dtype))
@@ -179,8 +230,22 @@ def load_orbax(cfg: ModelConfig, model_path: str, mesh=None,
                 s.shape, s.dtype,
                 sharding=jax.sharding.NamedSharding(mesh, spec)),
             template, specs)
+    elif quantize:
+        cpu = jax.devices("cpu")[0]
+        template = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=jax.sharding.SingleDeviceSharding(cpu)),
+            template)
     ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(path, template)
+    params = ckptr.restore(path, template)
+    if quantize:
+        if mesh is not None:
+            from arks_tpu.models.quant import quantize_params
+            return quantize_params(params)
+        return _leaves_to_device(
+            jax.tree.map(np.asarray, params), quantize=True)
+    return params
 
 
 def convert_hf_to_orbax(cfg: ModelConfig, model_path: str,
@@ -208,22 +273,31 @@ def has_real_weights(model_path: str | None) -> bool:
 
 
 def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
-                dtype: Any = None) -> tf.Params:
-    """Best available weights: Orbax (sharded) > safetensors > random init."""
+                dtype: Any = None, weight_dtype: str = "bf16") -> tf.Params:
+    """Best available weights: Orbax (sharded) > safetensors > random init.
+
+    ``weight_dtype='int8'`` quantizes during load with bounded peak memory
+    (see params_from_hf / load_orbax) — quantizing after a full-width load
+    would OOM exactly the HBM-limited configs the flag exists for."""
     dtype = jnp.dtype(dtype or cfg.dtype)
+    quantize = weight_dtype == "int8"
     if model_path:
         if os.path.isdir(orbax_path(model_path)):
             log.info("loading Orbax checkpoint from %s", orbax_path(model_path))
-            return load_orbax(cfg, model_path, mesh, dtype)
+            return load_orbax(cfg, model_path, mesh, dtype, weight_dtype)
         if os.path.isdir(model_path) and any(
                 f.endswith(".safetensors") for f in os.listdir(model_path)):
             log.info("loading HF safetensors from %s", model_path)
-            params = params_from_hf(cfg, model_path, dtype)
+            params = params_from_hf(cfg, model_path, dtype, weight_dtype)
             if mesh is not None:
                 params = tf.shard_params(params, cfg, mesh)
             return params
         log.warning("no weights found under %s; using random init", model_path)
-    params = tf.init_params(cfg, jax.random.PRNGKey(0), dtype)
+    if quantize:
+        from arks_tpu.models.quant import init_params_quantized
+        params = init_params_quantized(cfg, jax.random.PRNGKey(0), dtype)
+    else:
+        params = tf.init_params(cfg, jax.random.PRNGKey(0), dtype)
     if mesh is not None:
         params = tf.shard_params(params, cfg, mesh)
     return params

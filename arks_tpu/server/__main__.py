@@ -40,6 +40,11 @@ def main() -> None:
     p.add_argument("--kv-cache-dtype", default="auto",
                    choices=("auto", "bf16", "int8"),
                    help="int8 halves KV HBM traffic and doubles cache capacity")
+    p.add_argument("--weight-dtype", default="bf16",
+                   choices=("bf16", "int8"),
+                   help="int8 = weight-only quantization (w8a16): fits "
+                        "7B-class models on one 16GB chip, halves decode "
+                        "weight reads")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
@@ -97,7 +102,8 @@ def main() -> None:
     params = None
     if model_path:
         from arks_tpu.models.weights import load_params
-        params = load_params(cfg, model_path, mesh=mesh, dtype=args.dtype)
+        params = load_params(cfg, model_path, mesh=mesh, dtype=args.dtype,
+                             weight_dtype=args.weight_dtype)
 
     ecfg = EngineConfig(
         model=cfg.name, num_slots=args.num_slots, max_cache_len=args.max_model_len,
@@ -105,7 +111,8 @@ def main() -> None:
                               if b <= args.max_model_len),
         steps_per_dispatch=args.steps_per_dispatch,
         tensor_parallel=args.tp, data_parallel=args.dp,
-        dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype, seed=args.seed,
+        dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype,
+        weight_dtype=args.weight_dtype, seed=args.seed,
     )
     # Real weights without tokenizer assets = broken mount; fail fast then.
     from arks_tpu.models.weights import has_real_weights

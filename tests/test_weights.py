@@ -103,3 +103,37 @@ def test_load_params_fallback_chain(tmp_path):
     p = w.load_params(cfg, str(tmp_path / "missing"))
     assert p["embed"].shape[0] == cfg.vocab_size
     assert not w.has_real_weights(str(tmp_path / "missing"))
+
+
+def test_load_params_int8_from_safetensors(tmp_path):
+    """--weight-dtype int8 quantizes during load (leaf-by-leaf, so a 7B
+    checkpoint never materializes full-width on a 16GB chip) and matches
+    the full-width model within quantization error."""
+    from arks_tpu.models import quant
+    cfg = get_config("tiny")
+    save_file(_rng_tensors(cfg), str(tmp_path / "model.safetensors"))
+    full = w.load_params(cfg, str(tmp_path), dtype=jnp.float32)
+    q = w.load_params(cfg, str(tmp_path), dtype=jnp.float32,
+                      weight_dtype="int8")
+    assert quant.is_quantized(q["layers"]["wq"])
+    assert quant.is_quantized(q["embed"])
+    toks = jnp.zeros((1, 4), jnp.int32).at[0, 1].set(7)
+    lens = jnp.asarray([4], jnp.int32)
+    ref, _, _ = tf.prefill(full, cfg, toks, lens)
+    got, _, _ = tf.prefill(q, cfg, toks, lens)
+    assert np.argmax(np.asarray(got)) == np.argmax(np.asarray(ref))
+
+
+def test_load_orbax_int8_single_chip(tmp_path):
+    """Orbax + int8 with no mesh restores via host memory, then quantizes
+    leaf-by-leaf onto the device."""
+    from arks_tpu.models import quant
+    cfg = get_config("tiny")
+    params = tf.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    w.save_orbax(params, str(tmp_path))
+    q = w.load_params(cfg, str(tmp_path), dtype=jnp.float32,
+                      weight_dtype="int8")
+    assert quant.is_quantized(q["layers"]["wq"])
+    deq = quant.dequantize(q["layers"]["wq"], jnp.float32)
+    err = np.abs(np.asarray(deq) - np.asarray(params["layers"]["wq"])).max()
+    assert err < np.abs(np.asarray(params["layers"]["wq"])).max() / 100
