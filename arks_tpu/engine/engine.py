@@ -46,6 +46,12 @@ from arks_tpu.utils import metrics as prom
 log = logging.getLogger("arks_tpu.engine")
 
 
+class ContextLengthExceededError(ValueError):
+    """Prompt does not fit the serving window.  OpenAI-compatible servers
+    must surface this as HTTP 400 with code ``context_length_exceeded`` —
+    silently truncating would corrupt long-context results and billing."""
+
+
 @dataclasses.dataclass
 class EngineConfig:
     model: str = "tiny"
@@ -53,6 +59,11 @@ class EngineConfig:
     max_cache_len: int = 1024
     prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
     steps_per_dispatch: int = 4
+    # Chunked prefill: prompts longer than the largest one-shot bucket are
+    # processed in chunks of this many tokens, one chunk per scheduler step,
+    # INTERLEAVED with decode dispatches — a burst of long prompts no longer
+    # freezes every decoding slot.  None disables (long prompts then 400).
+    prefill_chunk: int | None = 256
     # Parallelism: when a mesh isn't passed to InferenceEngine explicitly,
     # one is built from these over all visible devices (tp defaults to
     # devices/dp). Both 1 (or 1 visible device) → no mesh, single-chip path.
@@ -86,8 +97,13 @@ class EngineConfig:
     def resolve_buckets(self) -> list[int]:
         """Prefill buckets clamped to the cache; never empty."""
         buckets = sorted(b for b in self.prefill_buckets if b <= self.max_cache_len)
-        if not buckets or buckets[-1] < self.max_cache_len:
-            # Always allow full-cache-length prompts.
+        if not buckets:
+            buckets = [self.max_cache_len]
+        elif buckets[-1] < self.max_cache_len and not self.prefill_chunk:
+            # No chunked path: the one-shot buckets must cover full-cache-
+            # length prompts.  (With chunking, prompts beyond the largest
+            # bucket run chunked — appending a full-length bucket here would
+            # make every long prompt monolithic again.)
             buckets.append(self.max_cache_len)
         return buckets
 
@@ -134,6 +150,16 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     num_emitted: int = 0  # tokens already streamed to the request queue
     first_token_time: float | None = None
+
+
+@dataclasses.dataclass
+class _ChunkState:
+    """A chunked prefill in progress (slot reserved, not yet decoding)."""
+
+    request: Request
+    ids: list[int]
+    pos: int      # tokens already prefilled
+    key: jax.Array  # base sampling key (PRNGKey(seed))
 
 
 class EngineMetrics:
@@ -221,6 +247,21 @@ class InferenceEngine:
         self._last_token = np.zeros((engine_cfg.num_slots,), np.int32)
         self._slots: dict[int, _Slot] = {}
         self._free: list[int] = list(range(engine_cfg.num_slots))
+        # Chunked prefills in progress: slot -> _ChunkState (insertion order
+        # = FIFO processing).  These slots are reserved but not yet decoding.
+        self._prefilling: dict[int, _ChunkState] = {}
+
+        # Effective chunk size: the largest divisor of the cache length not
+        # exceeding the configured chunk.  Chunk starts are multiples of the
+        # chunk size, so divisibility guarantees every chunk's write window
+        # [start, start+C) stays inside the cache (dynamic_update_slice
+        # would otherwise clamp the start and corrupt earlier rows).
+        self._chunk = 0
+        if engine_cfg.prefill_chunk:
+            c = min(engine_cfg.prefill_chunk, engine_cfg.max_cache_len)
+            while engine_cfg.max_cache_len % c:
+                c -= 1
+            self._chunk = c
 
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._queued_rids: set[str] = set()
@@ -254,6 +295,21 @@ class InferenceEngine:
 
         self._prefill_fn = jax.jit(prefill_and_sample)
         self._insert_fn = jax.jit(tf.insert, donate_argnums=(0,))
+
+        def chunk_step(params, cache, slot, tokens, start, valid):
+            return tf.prefill_chunk(params, cfg, cache, slot, tokens, start,
+                                    valid, mesh)
+
+        self._chunk_fn = jax.jit(chunk_step, donate_argnums=(1,))
+
+        def sample_one(logits, temperature, top_p, top_k, key):
+            state = sampler_mod.SamplingState(
+                temperature=temperature[None], top_p=top_p[None],
+                top_k=top_k[None], key=key[None])
+            ids, _ = sampler_mod.sample(logits, state)
+            return ids[0]
+
+        self._sample_one_fn = jax.jit(sample_one)
 
         def decode_loop(params, cache, tokens, lengths, sstate):
             def body(carry, _):
@@ -319,6 +375,12 @@ class InferenceEngine:
                 log.exception("engine step failed; aborting in-flight requests")
                 for slot in list(self._slots):
                     self._finish(slot, "abort")
+                for slot, st in list(self._prefilling.items()):
+                    st.request.outputs.put(RequestOutput(
+                        request_id=st.request.request_id, token_ids=[],
+                        finished=True, finish_reason="abort",
+                        num_prompt_tokens=len(st.ids)))
+                self._prefilling.clear()
                 self._reset_device_state()
                 progressed = True
             if not progressed:
@@ -342,19 +404,25 @@ class InferenceEngine:
                       if s not in self._slots]
 
     def step(self, block_s: float = 0.05) -> bool:
-        """One scheduler iteration: admit pending requests, then one decode
-        dispatch. Returns True if any work was done."""
-        admitted = self._admit()
-        if not self._slots:
+        """One scheduler iteration: admit pending requests, advance at most
+        ONE prefill chunk, then one decode dispatch.  The chunk/decode
+        interleave bounds how long a long-prompt burst can stall decoding
+        slots: one chunk dispatch, not one whole prefill.  Returns True if
+        any work was done."""
+        worked = self._admit()
+        if self._prefilling:
+            self._process_chunk()
+            worked = True
+        if self._slots:
+            self._decode_dispatch()
+            worked = True
+        if not worked:
             # Idle: wait briefly for a request, then try admission again.
-            if not admitted:
-                try:
-                    req = self._queue.get(timeout=block_s)
-                except queue.Empty:
-                    return False
-                self._admit_one(req)
-            return True
-        self._decode_dispatch()
+            try:
+                req = self._queue.get(timeout=block_s)
+            except queue.Empty:
+                return False
+            self._admit_one(req)
         return True
 
     def _admit(self) -> bool:
@@ -380,7 +448,17 @@ class InferenceEngine:
                 return
         if req.prefilled is not None:
             return self._admit_prefilled(req)
-        ids, padded = self._prepare_prompt(req.prompt_ids)
+        try:
+            ids, padded = self._prepare_prompt(req.prompt_ids)
+        except ContextLengthExceededError as e:
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="error", error="context_length_exceeded",
+                num_prompt_tokens=len(req.prompt_ids)))
+            log.info("rejected %s: %s", req.request_id, e)
+            return
+        if padded is None:
+            return self._start_chunked(req, ids)
 
         p = req.params
         self._request_seed += 1
@@ -463,27 +541,116 @@ class InferenceEngine:
     # Detached prefill (disaggregated prefill side)
     # ------------------------------------------------------------------
 
-    def _prepare_prompt(self, prompt_ids: list[int]) -> tuple[list[int], np.ndarray]:
-        """Truncate to the usable cache window (keeping the most recent
-        context, with a one-dispatch decode reserve) and pad to the smallest
-        prefill bucket.  Shared by the unified and disaggregated paths — the
-        bit-identity guarantee between them depends on this being one
-        implementation."""
-        max_prompt = min(self._buckets[-1],
-                         self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1)
+    @property
+    def max_prompt_len(self) -> int:
+        """Largest admissible prompt (one-dispatch decode reserve kept).
+        Servers use this for the pre-queue 400 check."""
+        usable = self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1
+        if self._chunk:
+            return usable
+        return min(self._buckets[-1], usable)
+
+    def _one_shot_limit(self) -> int:
+        return min(self._buckets[-1],
+                   self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1)
+
+    def _prepare_prompt(self, prompt_ids: list[int]) -> tuple[list[int], np.ndarray | None]:
+        """Pad the prompt to the smallest prefill bucket.  Shared by the
+        unified and disaggregated paths — the bit-identity guarantee between
+        them depends on this being one implementation.
+
+        Returns (ids, padded) for the one-shot path, (ids, None) when the
+        prompt needs chunked prefill, and raises ContextLengthExceededError
+        when it cannot be served at all — silent truncation would corrupt
+        long-context results and billing."""
         ids = list(prompt_ids)
-        if len(ids) > max_prompt:
-            ids = ids[-max_prompt:]
+        if len(ids) > self.max_prompt_len:
+            raise ContextLengthExceededError(
+                f"prompt has {len(ids)} tokens but the maximum context "
+                f"length is {self.max_prompt_len}")
+        if len(ids) > self._one_shot_limit():
+            return ids, None  # chunked path
         bucket = next(b for b in self._buckets if b >= len(ids))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(ids)] = ids
         return ids, padded
 
+    # ------------------------------------------------------------------
+    # Chunked prefill
+    # ------------------------------------------------------------------
+
+    def _start_chunked(self, req: Request, ids: list[int]) -> None:
+        p = req.params
+        self._request_seed += 1
+        seed = p.seed if p.seed is not None else self._request_seed
+        slot = self._free.pop()
+        self._prefilling[slot] = _ChunkState(request=req, ids=ids, pos=0,
+                                             key=jax.random.PRNGKey(seed))
+        # Interleaved decode dispatches write garbage KV rows for every slot
+        # at its length index; pointing this slot's length at the FINAL
+        # prompt position keeps those writes beyond every masked read until
+        # real decode overwrites them.
+        self._lengths[slot] = len(ids)
+        self._last_token[slot] = 0
+
+    def _process_chunk(self) -> None:
+        slot, st = next(iter(self._prefilling.items()))
+        rid = st.request.request_id
+        with self._abort_lock:
+            if rid in self._aborted:
+                self._aborted.discard(rid)
+                del self._prefilling[slot]
+                self._free.append(slot)
+                st.request.outputs.put(RequestOutput(
+                    request_id=rid, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(st.ids)))
+                return
+        c = self._chunk
+        chunk = st.ids[st.pos: st.pos + c]
+        valid = len(chunk)
+        padded = np.zeros((c,), np.int32)
+        padded[:valid] = chunk
+        try:
+            logits, self._cache = self._chunk_fn(
+                self.params, self._cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded), jnp.asarray(st.pos, jnp.int32),
+                jnp.asarray(valid, jnp.int32))
+        except Exception:
+            # Free the reserved slot and fail the request: _run's recovery
+            # only sees registered slots.
+            del self._prefilling[slot]
+            self._free.append(slot)
+            st.request.outputs.put(RequestOutput(
+                request_id=st.request.request_id, token_ids=[], finished=True,
+                finish_reason="abort", num_prompt_tokens=len(st.ids)))
+            raise
+        st.pos += valid
+        if st.pos < len(st.ids):
+            return
+        # Final chunk: sample the first token (same key semantics as the
+        # one-shot prefill_and_sample) and promote the slot to decoding.
+        p = st.request.params
+        first = int(self._sample_one_fn(
+            logits, jnp.float32(p.temperature), jnp.float32(p.top_p),
+            jnp.int32(p.top_k), st.key))
+        del self._prefilling[slot]
+        self._sampling = sampler_mod.set_slot(
+            self._sampling, slot, p.temperature, p.top_p, p.top_k,
+            jax.random.fold_in(st.key, 1))
+        self._register_slot(st.request, slot, first, len(st.ids))
+
     def prefill_detached(self, prompt_ids: list[int],
                          params) -> PrefilledState:
         """Run prefill + first-token sampling and return the transferable
         state instead of inserting into this engine's cache.  Thread-safe;
-        called from server threads on a prefill-only engine (no decode loop)."""
+        called from server threads on a prefill-only engine (no decode loop).
+
+        One-shot only: the transferred KV is a single [T] block, so prompts
+        beyond the largest bucket are rejected (HTTP 400 at the server)."""
+        if len(prompt_ids) > self._one_shot_limit():
+            raise ContextLengthExceededError(
+                f"prompt has {len(prompt_ids)} tokens but the disaggregated "
+                f"prefill limit is {self._one_shot_limit()}")
         ids, padded = self._prepare_prompt(prompt_ids)
 
         with self._prefill_lock:
@@ -515,6 +682,7 @@ class InferenceEngine:
         # already finished, or never existed) is garbage — purge it so the
         # set can't grow without bound.
         active = {st.request.request_id for st in self._slots.values()}
+        active |= {st.request.request_id for st in self._prefilling.values()}
         with self._abort_lock:
             self._aborted -= consumed
             self._aborted &= active | self._queued_rids

@@ -55,7 +55,10 @@ class RequestOutput:
     request_id: str
     token_ids: list[int]          # newly generated token ids in this chunk
     finished: bool = False
-    finish_reason: str | None = None   # "stop" | "length" | "abort"
+    finish_reason: str | None = None   # "stop" | "length" | "abort" | "error"
     num_prompt_tokens: int = 0
     num_generated_tokens: int = 0      # cumulative, set when finished
     ttft_s: float | None = None        # set on the first chunk
+    # Machine-readable rejection code when finish_reason == "error"
+    # (e.g. "context_length_exceeded" -> HTTP 400 at the server).
+    error: str | None = None

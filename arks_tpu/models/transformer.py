@@ -217,6 +217,31 @@ def _qkv(h: jnp.ndarray, lp: Params, cfg: ModelConfig):
     return q, k, v
 
 
+def _block_qkv(h: jnp.ndarray, lp: Params, cfg: ModelConfig,
+               positions: jnp.ndarray):
+    """Pre-norm + qkv projection + head split + rope for a [B, T, E] block —
+    shared by one-shot and chunked prefill so their math can never diverge."""
+    b, t = h.shape[:2]
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(x, lp, cfg)
+    q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _block_tail(h: jnp.ndarray, attn: jnp.ndarray, lp: Params,
+                cfg: ModelConfig, mesh: Mesh | None, batch_axis: str | None,
+                seq_axis: str | None = None) -> jnp.ndarray:
+    """Output projection residual + MLP residual (post-attention half of the
+    block) — the other shared piece of the prefill paths."""
+    h = h + qeinsum("...q,qe->...e", attn, lp["wo"])
+    h = h + _mlp(h, lp, cfg, mesh, batch_axis, seq_axis)
+    return h
+
+
 def _mlp(h: jnp.ndarray, lp: Params, cfg: ModelConfig, mesh: Mesh | None,
          batch_axis: str | None, seq_axis: str | None = None) -> jnp.ndarray:
     x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -279,13 +304,7 @@ def prefill_layer(
     op in the block is pointwise over T, so XLA partitions it for free.
     """
     b, t = h.shape[:2]
-    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-    q, k, v = _qkv(x, lp, cfg)
-    q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
-    k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-    v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q, k, v = _block_qkv(h, lp, cfg, positions)
     if seq_axis is not None and mesh is not None and mesh.shape.get(seq_axis, 1) > 1:
         from arks_tpu.parallel.ring import ring_prefill_attention
         heads_sharded = shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1)) \
@@ -298,8 +317,7 @@ def prefill_layer(
     else:
         attn = prefill_attention(q, k, v).reshape(b, t, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
-    h = h + qeinsum("...q,qe->...e", attn, lp["wo"])
-    h = h + _mlp(h, lp, cfg, mesh, batch_axis, seq_axis)
+    h = _block_tail(h, attn, lp, cfg, mesh, batch_axis, seq_axis)
     return h, k, v
 
 
@@ -333,6 +351,89 @@ def prefill(
         h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
     logits = _unembed(h_last, params, cfg, mesh, None)
     return logits, ks, vs
+
+
+def prefill_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    cache: KVCache,
+    slot: jnp.ndarray,     # () int32 — cache slot being filled
+    tokens: jnp.ndarray,   # [C] int32 — chunk tokens (padded on the last chunk)
+    start: jnp.ndarray,    # () int32 — global position of tokens[0]
+    valid: jnp.ndarray,    # () int32 — true token count in this chunk (<= C)
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One chunk of an incremental (chunked) prefill for a single slot.
+
+    Writes the chunk's KV into the cache at [start, start+C) and attends
+    each query over the full cached prefix [0, start+i] — so a long prompt
+    is processed as a sequence of bounded dispatches that interleave with
+    decode steps instead of one monolithic prefill that stalls every
+    decoding slot.  Returns (logits [1, V] f32 for the chunk's LAST VALID
+    token — only meaningful on the final chunk — and the updated cache).
+
+    Numerically equivalent to one-shot prefill (same math, blockwise):
+    chunk-boundary differences are pure fp reassociation.  Padding rows on
+    the final chunk write garbage KV beyond the prompt length; every read
+    path masks by position, and decode overwrites them as generation
+    proceeds (same invariant as decode's slot-0 garbage writes).
+    """
+    c = tokens.shape[0]
+    positions = (start + jnp.arange(c, dtype=jnp.int32))[None]  # [1, C]
+    h = embed_lookup(params["embed"], tokens[None],
+                     params["layers"]["attn_norm"].dtype)       # [1, C, E]
+    quantized = cache.quantized
+
+    def body(carry, xs):
+        h, kc, vc, ksc, vsc = carry
+        lp, layer = xs
+        q, k, v = _block_qkv(h, lp, cfg, positions)
+
+        # Write the chunk's KV rows (head-major cache layout).
+        kt = jnp.swapaxes(k[0], 0, 1)  # [Hkv, C, D]
+        vt = jnp.swapaxes(v[0], 0, 1)
+        at = (layer, slot.astype(jnp.int32), 0, start.astype(jnp.int32), 0)
+        if quantized:
+            from arks_tpu.ops.pallas_attention import quantize_kv
+            kq, ks = quantize_kv(kt)
+            vq, vs = quantize_kv(vt)
+            kc = jax.lax.dynamic_update_slice(kc, kq[None, None], at)
+            vc = jax.lax.dynamic_update_slice(vc, vq[None, None], at)
+            ksc = jax.lax.dynamic_update_slice(ksc, ks[None, None], at[:-1])
+            vsc = jax.lax.dynamic_update_slice(vsc, vs[None, None], at[:-1])
+        else:
+            kc = jax.lax.dynamic_update_slice(kc, kt[None, None].astype(kc.dtype), at)
+            vc = jax.lax.dynamic_update_slice(vc, vt[None, None].astype(vc.dtype), at)
+
+        # Attend over this slot's cache prefix (chunk rows included).
+        kc_l = jax.lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
+        vc_l = jax.lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
+        kc_s = jax.lax.dynamic_index_in_dim(kc_l, slot, 0, keepdims=False)
+        vc_s = jax.lax.dynamic_index_in_dim(vc_l, slot, 0, keepdims=False)
+        ks_s = vs_s = None
+        if quantized:
+            ks_s = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(ksc, layer, 0, keepdims=False),
+                slot, 0, keepdims=False)
+            vs_s = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(vsc, layer, 0, keepdims=False),
+                slot, 0, keepdims=False)
+        g = cfg.num_heads // cfg.num_kv_heads
+        qg = jnp.transpose(
+            q[0].reshape(c, cfg.num_kv_heads, g, cfg.head_dim), (1, 2, 0, 3))
+        from arks_tpu.ops.attention import chunk_attention_xla
+        attn = chunk_attention_xla(qg, kc_s, vc_s, start, ks_s, vs_s)
+        attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(1, c, cfg.q_dim)
+        attn = _constrain(attn, mesh, None, None, AXIS_MODEL)
+        h = _block_tail(h, attn, lp, cfg, mesh, None)
+        return (h, kc, vc, ksc, vsc), None
+
+    (h, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    h_last = jax.lax.dynamic_index_in_dim(h[0], valid - 1, 0, keepdims=True)
+    logits = _unembed(h_last, params, cfg, mesh, None)
+    return logits, KVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
 
 
 def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,

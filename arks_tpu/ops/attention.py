@@ -125,6 +125,43 @@ def _decode_attention_xla_quant(
     return out.astype(q.dtype)
 
 
+def chunk_attention_xla(
+    q: jnp.ndarray,        # [Hkv, G, C, D] — a chunk of queries for ONE slot
+    k_cache: jnp.ndarray,  # [Hkv, S, D] — that slot's cache (chunk KV written)
+    v_cache: jnp.ndarray,
+    start: jnp.ndarray,    # () int32 — global position of the chunk's first query
+    k_scale: jnp.ndarray | None = None,  # [Hkv, S] f32 — int8 caches
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: C queries against the slot's cache prefix.
+
+    Query at chunk offset i (global position start+i) attends cache entries
+    [0, start+i] — earlier chunks plus the causal prefix of this one.  The
+    caller writes the chunk's KV into the cache *before* attending (same
+    write-then-attend contract as decode_update_and_attend).  Cache entries
+    beyond start+C (stale decode writes from interleaved dispatches, final-
+    chunk padding) are masked out here and overwritten before any decode
+    reads them.  Returns [Hkv, G, C, D].
+    """
+    hkv, g, c, d = q.shape
+    s = k_cache.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("kgcd,ksd->kgcs", q, k_cache.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:
+        scores = scores * k_scale[:, None, None, :]
+    qpos = start + jnp.arange(c)                    # [C] global positions
+    valid = jnp.arange(s)[None] <= qpos[:, None]    # [C, S]
+    scores = jnp.where(valid[None, None], scores, _NEG_INF)
+    probs = _softmax(scores, axis=-1)
+    if v_scale is not None:
+        probs = probs * v_scale[:, None, None, :]
+    out = jnp.einsum("kgcs,ksd->kgcd", probs.astype(q.dtype),
+                     v_cache.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def decode_update_and_attend(
     q: jnp.ndarray,        # [B, H, D] — this step's query per slot
     k_new: jnp.ndarray,    # [B, Hkv, D] — this step's KV per slot

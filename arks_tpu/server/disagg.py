@@ -62,7 +62,14 @@ class PrefillServer(OpenAIServer):
             h._error(400, "disaggregated serving takes one prompt per request")
             return True
         params, _ = _sampling_from_body(body, self.engine.tokenizer)
-        pf = self.engine.prefill_detached(batch[0], params)
+        from arks_tpu.engine.engine import ContextLengthExceededError
+        try:
+            pf = self.engine.prefill_detached(batch[0], params)
+        except ContextLengthExceededError as e:
+            h._json(400, {"error": {"message": str(e),
+                                    "type": "invalid_request_error",
+                                    "code": "context_length_exceeded"}})
+            return True
         payload = kv_transfer.pack(
             {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
              "seed": pf.seed},
@@ -95,8 +102,15 @@ class DecodeServer(OpenAIServer):
         if model != self.served_model_name:
             return h._error(404, f"model {model!r} not found")
 
+        from arks_tpu.engine.engine import ContextLengthExceededError
         try:
             meta, (k, v) = self._pull_kv(prefill_addr, body, chat)
+        except ContextLengthExceededError as e:
+            # Client input error, not a backend fault: a 502 here would make
+            # routers/gateways retry an unservable request.
+            return h._json(400, {"error": {"message": str(e),
+                                           "type": "invalid_request_error",
+                                           "code": "context_length_exceeded"}})
         except Exception as e:
             return h._error(502, f"prefill pull failed: {e}")
 
@@ -122,6 +136,15 @@ class DecodeServer(OpenAIServer):
             resp = conn.getresponse()
             data = resp.read()
             if resp.status != 200:
+                if resp.status == 400:
+                    try:
+                        err = json.loads(data).get("error") or {}
+                    except (ValueError, json.JSONDecodeError):
+                        err = {}
+                    if err.get("code") == "context_length_exceeded":
+                        from arks_tpu.engine.engine import ContextLengthExceededError
+                        raise ContextLengthExceededError(
+                            err.get("message") or "context length exceeded")
                 raise RuntimeError(f"prefill {addr} -> {resp.status}: "
                                    f"{data[:200]!r}")
             return kv_transfer.unpack(data)

@@ -214,6 +214,14 @@ class OpenAIServer:
         if stream and len(batch) > 1:
             return h._error(400, "streaming is not supported for batched prompts")
 
+        # Reject oversize prompts BEFORE queueing (OpenAI semantics: 400
+        # context_length_exceeded — never silent truncation, which would
+        # corrupt long-context results and billing).
+        limit = self.engine.max_prompt_len
+        for prompt_ids in batch:
+            if len(prompt_ids) > limit:
+                return self._context_length_error(h, len(prompt_ids), limit)
+
         reqs = []
         for prompt_ids in batch:
             req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
@@ -225,6 +233,14 @@ class OpenAIServer:
             self._batch_response(h, reqs, model, stop_strings)
         else:
             self._respond(h, reqs[0], chat, model, body, stop_strings)
+
+    def _context_length_error(self, h, got: int, limit: int) -> None:
+        h._json(400, {"error": {
+            "message": (f"This model's maximum context length is {limit} "
+                        f"tokens, but your prompt has {got} tokens."),
+            "type": "invalid_request_error",
+            "code": "context_length_exceeded",
+        }})
 
     def _respond(self, h, req: Request, chat: bool, model: str, body: dict,
                  stop_strings: list[str]) -> None:
@@ -283,6 +299,13 @@ class OpenAIServer:
     def _full_response(self, h, req: Request, chat: bool, model: str,
                        stop_strings: list[str]) -> None:
         text, finish_reason, fin = self._collect_text(req, stop_strings)
+        if finish_reason == "error":
+            # Engine-level rejection (defense for direct add_request users;
+            # the HTTP path normally pre-checks).
+            if fin.error == "context_length_exceeded":
+                return self._context_length_error(
+                    h, fin.num_prompt_tokens, self.engine.max_prompt_len)
+            return h._error(400, fin.error or "request rejected")
         usage = {
             "prompt_tokens": fin.num_prompt_tokens,
             "completion_tokens": fin.num_generated_tokens,

@@ -118,6 +118,19 @@ def test_disaggregated_matches_unified():
     assert len(got) == 8
 
 
+def test_detached_prefill_rejects_oversize_prompt():
+    """The disaggregated prefill engine raises the typed rejection (the
+    servers map it to HTTP 400 context_length_exceeded end-to-end, including
+    across the decode server's KV pull)."""
+    from arks_tpu.engine.engine import ContextLengthExceededError
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=1, max_cache_len=16,
+                        prefill_buckets=(8,), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    with pytest.raises(ContextLengthExceededError):
+        eng.prefill_detached(list(range(50)), SamplingParams())
+
+
 def test_prefilled_too_long_is_aborted():
     cfg = get_config("tiny")
     ecfg = EngineConfig(model="tiny", num_slots=1, max_cache_len=16,

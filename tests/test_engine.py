@@ -32,7 +32,8 @@ def _collect(req: Request, timeout=60):
 def _drive(engine, n_steps=200):
     for _ in range(n_steps):
         engine.step(block_s=0.01)
-        if engine.num_running == 0 and engine._queue.empty():
+        if (engine.num_running == 0 and engine._queue.empty()
+                and not engine._prefilling):
             break
 
 
@@ -107,8 +108,9 @@ def test_sampled_request_valid(engine):
     assert ids2 == ids
 
 
-def test_long_prompt_truncated(engine):
-    # 57 tokens fits the implicit max_cache_len bucket (64) minus headroom.
+def test_long_prompt_chunked_prefill(engine):
+    # 57 tokens exceeds the largest one-shot bucket (32) but fits the cache
+    # (64 - 4 - 1 = 59 usable): served via chunked prefill.
     req = Request("lp", list(range(3, 60)), SamplingParams(max_tokens=3, temperature=0.0,
                                                            ignore_eos=True))
     engine.add_request(req)
@@ -117,15 +119,85 @@ def test_long_prompt_truncated(engine):
     assert fin.finished and len(ids) == 3
     assert fin.num_prompt_tokens == 57
 
-    # 100 tokens exceeds the cache: truncated to max_cache_len - K - 1, and
-    # generation still proceeds.
-    req2 = Request("lp2", list(range(3, 103)), SamplingParams(max_tokens=3, temperature=0.0,
-                                                              ignore_eos=True))
-    engine.add_request(req2)
+
+def test_oversize_prompt_rejected_not_truncated(engine):
+    # 100 tokens exceeds the usable window: the request is REJECTED with a
+    # machine-readable error (silent truncation would corrupt long-context
+    # results and billing) — OpenAI servers surface this as HTTP 400.
+    req = Request("lp2", list(range(3, 103)), SamplingParams(max_tokens=3, temperature=0.0,
+                                                             ignore_eos=True))
+    engine.add_request(req)
     _drive(engine)
-    ids2, fin2 = _collect(req2)
-    assert fin2.finished and len(ids2) >= 1
-    assert fin2.num_prompt_tokens == 64 - 4 - 1
+    ids, fin = _collect(req)
+    assert fin.finished and not ids
+    assert fin.finish_reason == "error"
+    assert fin.error == "context_length_exceeded"
+    assert fin.num_prompt_tokens == 100
+
+
+def test_chunked_prefill_matches_one_shot():
+    """A prompt served via chunked prefill must produce the same greedy
+    tokens as the same prompt through one-shot prefill (same math,
+    blockwise — only fp reassociation differs)."""
+    cfg = get_config("tiny")
+    prompt = [int(x) % cfg.vocab_size for x in range(7, 55)]  # 48 tokens
+
+    def run(prefill_buckets, prefill_chunk):
+        ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                            prefill_buckets=prefill_buckets,
+                            steps_per_dispatch=4, prefill_chunk=prefill_chunk)
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+        req = Request("c", prompt, SamplingParams(max_tokens=6, temperature=0.0,
+                                                  ignore_eos=True, seed=7))
+        eng.add_request(req)
+        _drive(eng)
+        ids, fin = _collect(req)
+        return ids, fin
+
+    # One-shot: bucket 64 covers the prompt.  Chunked: largest bucket is 16,
+    # so the 48-token prompt runs as 16-token chunks.
+    ids_one, fin_one = run((16, 32, 64), None)
+    ids_chunk, fin_chunk = run((8, 16), 16)
+    assert fin_chunk.num_prompt_tokens == fin_one.num_prompt_tokens == 48
+    assert ids_chunk == ids_one
+
+
+def test_decode_flows_during_chunked_prefill():
+    """Decode slots must keep producing tokens while a long prompt is being
+    chunk-prefilled — the whole point of chunking (one chunk per scheduler
+    step, decode dispatch in the same step)."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8,), steps_per_dispatch=1,
+                        prefill_chunk=8)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+
+    # Short request occupies a decode slot first.
+    short = Request("s", [5, 6], SamplingParams(max_tokens=40, temperature=0.0,
+                                                ignore_eos=True))
+    eng.add_request(short)
+    eng.step(block_s=0.01)  # admits + first decode
+    # Long prompt: 48 tokens = 6 chunks of 8.
+    long_req = Request("l", [int(x) % cfg.vocab_size for x in range(3, 51)],
+                       SamplingParams(max_tokens=2, temperature=0.0,
+                                      ignore_eos=True))
+    eng.add_request(long_req)
+
+    # Step until the long prompt's first token appears; the short request
+    # must have produced tokens in the SAME window (interleaved).
+    short_tokens_during = 0
+    for _ in range(200):
+        eng.step(block_s=0.01)
+        if eng._prefilling:
+            # Chunked prefill still in progress — decode output must flow.
+            while not short.outputs.empty():
+                short_tokens_during += len(short.outputs.get().token_ids)
+        if long_req.outputs.qsize() > 0:
+            break
+    assert short_tokens_during > 0, "decode stalled during chunked prefill"
+    _drive(eng)
+    ids, fin = _collect(long_req)
+    assert fin.finished and fin.num_prompt_tokens == 48
 
 
 def test_metrics_populated(engine):

@@ -197,6 +197,41 @@ def test_batched_prompt_multi_choice(server):
     assert data["usage"]["completion_tokens"] == 6
 
 
+def test_context_length_exceeded_400(server):
+    """Oversize prompts get HTTP 400 with code context_length_exceeded
+    (OpenAI semantics) — never silent truncation.  The tiny server's usable
+    window is 64 - 4 - 1 = 59 tokens (ByteTokenizer: 1 byte = 1 token)."""
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server, "/v1/completions", {
+            "model": "tiny-serve", "prompt": "x" * 80, "max_tokens": 2,
+        })
+    assert ei.value.code == 400
+    err = json.load(ei.value)["error"]
+    assert err["code"] == "context_length_exceeded"
+    assert "80" in err["message"]
+
+    # Streaming path rejects the same way (before any SSE frame).
+    with pytest.raises(urllib.error.HTTPError) as ei2:
+        _post(server, "/v1/chat/completions", {
+            "model": "tiny-serve", "stream": True,
+            "messages": [{"role": "user", "content": "y" * 200}],
+        })
+    assert ei2.value.code == 400
+    assert json.load(ei2.value)["error"]["code"] == "context_length_exceeded"
+
+
+def test_long_prompt_chunked_through_server(server):
+    """A prompt beyond the one-shot buckets (32) but inside the window (59)
+    serves fine via chunked prefill."""
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "z" * 50, "max_tokens": 3,
+        "temperature": 0, "ignore_eos": True,
+    }) as r:
+        data = json.load(r)
+    assert data["choices"][0]["finish_reason"] == "length"
+    assert data["usage"]["prompt_tokens"] == 50
+
+
 def test_empty_prompt_400(server):
     try:
         _post(server, "/v1/completions", {"model": "tiny-serve", "prompt": ""})
