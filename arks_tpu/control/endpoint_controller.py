@@ -62,11 +62,15 @@ class EndpointController(Controller):
 
         default_weight = ep.spec.get("defaultWeight", 1)
         for app in self.store.list(Application, namespace=ep.namespace):
-            if app.served_model_name != ep.name or not app.ready():
+            # serving() (>=1 ready group), not ready() (ALL groups): during
+            # a rolling update readiness dips by maxUnavailable=1 and the
+            # route must survive on the remaining groups.
+            if app.served_model_name != ep.name or not app.serving():
                 continue
             routes.append(self._app_route(app, default_weight))
         for app in self.store.list(DisaggregatedApplication, namespace=ep.namespace):
-            if app.served_model_name != ep.name or not app.ready():
+            # serving(), not ready(), for the same rollout-survival reason.
+            if app.served_model_name != ep.name or not app.serving():
                 continue
             routes.append({
                 "backend": {"service": f"{app.name}-router-svc",

@@ -232,6 +232,12 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
             "spec": {"clusterIP": "None", "selector": sel,
                      "ports": [{"port": port, "name": "http"}]},
         })
+        # Revision stamp over the FULL pod spec (same hash helper as the
+        # gang drivers): nodeSelector/volume changes count as new revisions
+        # too.  Rollout tooling and the live-operator mode compare this to
+        # tell outdated groups from current ones.
+        from arks_tpu.control.workloads import stable_hash
+        revision = stable_hash(pod_spec)
         docs.append({
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
@@ -244,9 +250,19 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
                 # (LWS RecreateGroupOnPodRestart analogue via TPU slice
                 # scheduling + shared fate of the jax coordinator).
                 "podManagementPolicy": "Parallel",
+                # Within a gang the explicit strategy is RollingUpdate —
+                # restarting any host kills the jax coordinator, so the
+                # whole gang recreates regardless of per-pod ordering.
+                # CROSS-group sequencing (maxUnavailable=1 over replica
+                # groups, each its own StatefulSet) cannot be expressed in
+                # static manifests: gitops applies roll all groups at once;
+                # the operator's reconcile mode sequences them with the
+                # same pick_rolling_restart gating the local drivers use.
+                "updateStrategy": {"type": "RollingUpdate"},
                 "selector": {"matchLabels": sel},
                 "template": {
-                    "metadata": {"labels": dict(sel)},
+                    "metadata": {"labels": dict(sel),
+                                 "annotations": {"arks.ai/revision": revision}},
                     "spec": pod_spec,
                 },
             },

@@ -217,6 +217,15 @@ class Application(Resource):
         want = self.spec.get("replicas", 1)
         return self.status.get("readyReplicas", 0) >= want and want > 0
 
+    def serving(self) -> bool:
+        """At least one replica group can take traffic.  Deliberately looser
+        than ready(): during a rolling update (maxUnavailable=1) readiness
+        dips below spec.replicas, and dropping the whole route then — as the
+        reference's Replicas==ReadyReplicas gate does — would turn every
+        rollout into an outage.  The route's address list still contains
+        only Running groups (Service status sync)."""
+        return self.status.get("readyReplicas", 0) >= 1
+
 
 @dataclasses.dataclass
 class DisaggregatedApplication(Resource):
@@ -246,6 +255,16 @@ class DisaggregatedApplication(Resource):
                 >= self.spec.get("prefill", {}).get("replicas", 1)
                 and s.get("decode", {}).get("readyReplicas", 0)
                 >= self.spec.get("decode", {}).get("replicas", 1))
+
+    def serving(self) -> bool:
+        """One ready replica in EVERY tier can take traffic — the same
+        rolling-update route survival as Application.serving(): readiness
+        dips by maxUnavailable=1 during a rollout and dropping the route
+        then would make every disagg rollout an outage."""
+        s = self.status
+        return (s.get("router", {}).get("readyReplicas", 0) >= 1
+                and s.get("prefill", {}).get("readyReplicas", 0) >= 1
+                and s.get("decode", {}).get("readyReplicas", 0) >= 1)
 
 
 @dataclasses.dataclass

@@ -42,20 +42,70 @@ class GangDriver(Protocol):
     def teardown(self, gs: GangSet) -> None: ...
 
 
+def stable_hash(obj) -> str:
+    """Short deterministic content hash for revision stamps (shared by the
+    gang drivers and the k8s renderer so 'outdated' means the same thing
+    everywhere)."""
+    import hashlib
+    import json
+
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=str).encode()
+    ).hexdigest()[:12]
+
+
+def spec_hash(gs: GangSet) -> str:
+    """Hash of the spec fields that require a group restart to apply.
+
+    Stamped onto every launched group; a mismatch marks the group outdated
+    for the rolling update.  ``replicas`` is deliberately excluded — scaling
+    must not restart existing groups."""
+    return stable_hash({k: gs.spec.get(k)
+                        for k in ("size", "leader", "worker", "ports", "runtime")})
+
+
+def pick_rolling_restart(hashes: dict[int, str], want_hash: str,
+                         ready: dict[int, bool]) -> int | None:
+    """maxUnavailable=1 / maxSurge=0 rolling update (the reference's RBGS
+    RollingUpdate strategy, arksapplication_controller.go:867-874).
+
+    Unready outdated groups roll first — restarting a group that serves no
+    traffic cannot reduce availability, and without this a revision that
+    hangs (alive but never ready) would wedge the corrective rollout
+    forever.  A READY outdated group only rolls when every other group is
+    ready, so the endpoint's backend list never goes empty mid-rollout and
+    a stuck new revision halts the rollout instead of cascading."""
+    outdated = sorted(i for i, h in hashes.items() if h != want_hash)
+    if not outdated:
+        return None
+    for i in outdated:
+        if not ready.get(i, False):
+            return i
+    cand = outdated[0]
+    if all(ready.get(i, False) for i in hashes if i != cand):
+        return cand
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Fake driver (tests)
 # ---------------------------------------------------------------------------
 
 
 class FakeGangDriver:
-    """Marks groups Running after ``ready_after`` ensure() calls (0 =
-    immediately); tests can fail groups explicitly."""
+    """Marks each group Running after ``ready_after`` ensure() calls (0 =
+    ready from the first ensure); tests can fail groups explicitly.  Applies
+    the same rolling-update semantics as the real drivers (spec-hash stamp,
+    one restart at a time gated on the others' readiness) and records each
+    rolling restart in ``restarts`` for assertions."""
 
     def __init__(self, ready_after: int = 0):
         self.ready_after = ready_after
-        self._ensures: dict[tuple, int] = {}
+        # gs.key -> index -> {"hash": str, "ensures": int}
+        self._groups: dict[tuple, dict[int, dict]] = {}
         self._failed: set[tuple] = set()
         self.torn_down: list[tuple] = []
+        self.restarts: list[tuple] = []  # (gs.key, index) rolling restarts
 
     def fail_group(self, gs_key: tuple, index: int) -> None:
         self._failed.add((gs_key, index))
@@ -63,28 +113,46 @@ class FakeGangDriver:
     def recover_group(self, gs_key: tuple, index: int) -> None:
         self._failed.discard((gs_key, index))
 
+    def _is_ready(self, key: tuple, index: int, g: dict) -> bool:
+        return (key, index) not in self._failed and g["ensures"] > self.ready_after
+
     def ensure(self, gs: GangSet) -> None:
-        self._ensures[gs.key] = self._ensures.get(gs.key, 0) + 1
+        want = spec_hash(gs)
+        groups = self._groups.setdefault(gs.key, {})
+        replicas = gs.spec.get("replicas", 1)
+        for idx in range(replicas):
+            groups.setdefault(idx, {"hash": want, "ensures": 0})
+        for idx in [i for i in groups if i >= replicas]:
+            del groups[idx]
+        for g in groups.values():
+            g["ensures"] += 1
+        ready = {i: self._is_ready(gs.key, i, g) for i, g in groups.items()}
+        cand = pick_rolling_restart(
+            {i: g["hash"] for i, g in groups.items()}, want, ready)
+        if cand is not None:
+            groups[cand] = {"hash": want, "ensures": 0}
+            self.restarts.append((gs.key, cand))
 
     def status(self, gs: GangSet) -> dict:
         replicas = gs.spec.get("replicas", 1)
-        seen = self._ensures.get(gs.key, 0)
-        groups = []
+        groups = self._groups.get(gs.key, {})
+        out = []
         for i in range(replicas):
+            g = groups.get(i)
             if (gs.key, i) in self._failed:
                 phase = "Failed"
-            elif seen > self.ready_after:
+            elif g is not None and g["ensures"] > self.ready_after:
                 phase = "Running"
             else:
                 phase = "Pending"
-            groups.append({"index": i, "phase": phase,
-                           "leaderAddr": f"fake-{gs.name}-{i}:8080"})
-        ready = sum(1 for g in groups if g["phase"] == "Running")
-        return {"replicas": replicas, "readyReplicas": ready, "groups": groups}
+            out.append({"index": i, "phase": phase,
+                        "leaderAddr": f"fake-{gs.name}-{i}:8080"})
+        ready = sum(1 for g in out if g["phase"] == "Running")
+        return {"replicas": replicas, "readyReplicas": ready, "groups": out}
 
     def teardown(self, gs: GangSet) -> None:
         self.torn_down.append(gs.key)
-        self._ensures.pop(gs.key, None)
+        self._groups.pop(gs.key, None)
 
 
 # ---------------------------------------------------------------------------
@@ -93,9 +161,10 @@ class FakeGangDriver:
 
 
 class _Group:
-    def __init__(self, proc: subprocess.Popen, port: int):
+    def __init__(self, proc: subprocess.Popen, port: int, spec_hash: str):
         self.proc = proc
         self.port = port
+        self.spec_hash = spec_hash  # revision stamp for rolling updates
         self.started = time.monotonic()
 
 
@@ -131,10 +200,13 @@ class LocalProcessDriver:
             self._stop_group(g)
 
     def ensure(self, gs: GangSet) -> None:
+        want = spec_hash(gs)
         with self._lock:
             groups = self._groups.setdefault(gs.key, {})
             replicas = gs.spec.get("replicas", 1)
             # Reap dead groups → restart whole group (RecreateGroupOnPodRestart).
+            # Relaunches pick up the CURRENT spec, so a crashed outdated
+            # group rolls forward for free.
             for idx, g in list(groups.items()):
                 if g.proc.poll() is not None:
                     log.warning("gang %s group %d exited rc=%s; restarting",
@@ -147,8 +219,24 @@ class LocalProcessDriver:
             # Scale down.
             for idx in [i for i in groups if i >= replicas]:
                 self._stop_group(groups.pop(idx))
+            # Rolling update: restart at most ONE outdated group per ensure,
+            # gated on every other group being ready (maxUnavailable=1).
+            # Probe only when a rollout is actually pending — probing every
+            # group (2s timeout each) under the driver lock on every ensure
+            # would stall status() and every other gang's reconcile.
+            hashes = {i: g.spec_hash for i, g in groups.items()}
+            if all(h == want for h in hashes.values()):
+                return
+            ready = {i: self._probe(g.port) for i, g in groups.items()}
+            cand = pick_rolling_restart(hashes, want, ready)
+            if cand is not None:
+                log.info("gang %s/%s group %d: rolling restart to revision %s",
+                         gs.namespace, gs.name, cand, want)
+                self._stop_group(groups.pop(cand))
+                groups[cand] = self._launch(gs, cand)
 
     def _launch(self, gs: GangSet, index: int) -> _Group:
+        revision = spec_hash(gs)
         port = _free_port()
         cmd = list(gs.spec["leader"]["command"])
         cmd = [c.replace("$(PORT)", str(port)) for c in cmd]
@@ -164,7 +252,7 @@ class LocalProcessDriver:
         log.info("gang %s/%s group %d: %s (port %d)",
                  gs.namespace, gs.name, index, shlex.join(cmd), port)
         proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
-        return _Group(proc, port)
+        return _Group(proc, port, revision)
 
     def status(self, gs: GangSet) -> dict:
         with self._lock:
