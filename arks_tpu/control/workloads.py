@@ -161,11 +161,29 @@ class FakeGangDriver:
 
 
 class _Group:
-    def __init__(self, proc: subprocess.Popen, port: int, spec_hash: str):
-        self.proc = proc
+    """One replica group: the leader plus ``size - 1`` worker processes.
+
+    ``procs[0]`` is the leader (serves HTTP on ``port``); gang semantics
+    are all-or-nothing — any member dying recreates the whole group."""
+
+    def __init__(self, procs: list[subprocess.Popen], port: int,
+                 spec_hash: str):
+        self.procs = procs
         self.port = port
         self.spec_hash = spec_hash  # revision stamp for rolling updates
         self.started = time.monotonic()
+
+    @property
+    def proc(self) -> subprocess.Popen:  # leader, for probes/logs
+        return self.procs[0]
+
+    def poll_any_dead(self):
+        """Returncode of the first dead member, else None."""
+        for p in self.procs:
+            rc = p.poll()
+            if rc is not None:
+                return rc
+        return None
 
 
 def _free_port() -> int:
@@ -175,11 +193,12 @@ def _free_port() -> int:
 
 
 class LocalProcessDriver:
-    """Runs each replica group's leader as a local subprocess.
-
-    size > 1 gangs still launch only the leader here (one host); multi-host
-    members come from the k8s deployment path (arks_tpu.control.k8s_export).
-    """
+    """Runs each replica group as local subprocesses — ALL ``size`` members,
+    leader + workers, wired with the jax.distributed rendezvous env
+    (ARKS_COORDINATOR_ADDRESS / ARKS_NUM_PROCESSES / ARKS_PROCESS_ID), so a
+    size-N gang runs a real N-process distributed engine on one machine.
+    The k8s deployment path (arks_tpu.control.k8s_export) renders the same
+    contract across hosts."""
 
     def __init__(self, log_dir: str = "/tmp/arks-tpu-logs"):
         import atexit
@@ -201,16 +220,23 @@ class LocalProcessDriver:
 
     def ensure(self, gs: GangSet) -> None:
         want = spec_hash(gs)
+        # Groups to finish stopping OUTSIDE the lock: waiting for a member
+        # stuck in a native collective (up to 10s each) must not block
+        # status() and every other gang's reconcile.
+        to_reap: list[_Group] = []
         with self._lock:
             groups = self._groups.setdefault(gs.key, {})
             replicas = gs.spec.get("replicas", 1)
-            # Reap dead groups → restart whole group (RecreateGroupOnPodRestart).
-            # Relaunches pick up the CURRENT spec, so a crashed outdated
-            # group rolls forward for free.
+            # Reap groups with ANY dead member → restart whole group
+            # (RecreateGroupOnPodRestart).  Relaunches pick up the CURRENT
+            # spec, so a crashed outdated group rolls forward for free.
             for idx, g in list(groups.items()):
-                if g.proc.poll() is not None:
-                    log.warning("gang %s group %d exited rc=%s; restarting",
-                                gs.name, idx, g.proc.returncode)
+                rc = g.poll_any_dead()
+                if rc is not None:
+                    log.warning("gang %s group %d member exited rc=%s; "
+                                "restarting group", gs.name, idx, rc)
+                    self._signal_stop(g)
+                    to_reap.append(g)
                     del groups[idx]
             for idx in range(replicas):
                 if idx in groups:
@@ -218,41 +244,73 @@ class LocalProcessDriver:
                 groups[idx] = self._launch(gs, idx)
             # Scale down.
             for idx in [i for i in groups if i >= replicas]:
-                self._stop_group(groups.pop(idx))
+                g = groups.pop(idx)
+                self._signal_stop(g)
+                to_reap.append(g)
             # Rolling update: restart at most ONE outdated group per ensure,
             # gated on every other group being ready (maxUnavailable=1).
             # Probe only when a rollout is actually pending — probing every
             # group (2s timeout each) under the driver lock on every ensure
             # would stall status() and every other gang's reconcile.
             hashes = {i: g.spec_hash for i, g in groups.items()}
-            if all(h == want for h in hashes.values()):
-                return
-            ready = {i: self._probe(g.port) for i, g in groups.items()}
-            cand = pick_rolling_restart(hashes, want, ready)
-            if cand is not None:
-                log.info("gang %s/%s group %d: rolling restart to revision %s",
-                         gs.namespace, gs.name, cand, want)
-                self._stop_group(groups.pop(cand))
-                groups[cand] = self._launch(gs, cand)
+            rolling = not all(h == want for h in hashes.values())
+            if rolling:
+                ready = {i: self._probe(g.port) for i, g in groups.items()}
+                cand = pick_rolling_restart(hashes, want, ready)
+                if cand is not None:
+                    log.info("gang %s/%s group %d: rolling restart to "
+                             "revision %s", gs.namespace, gs.name, cand, want)
+                    g = groups.pop(cand)
+                    self._signal_stop(g)
+                    to_reap.append(g)
+                    groups[cand] = self._launch(gs, cand)
+        for g in to_reap:
+            self._reap_stop(g)
 
     def _launch(self, gs: GangSet, index: int) -> _Group:
+        import secrets
+
         revision = spec_hash(gs)
-        port = _free_port()
-        cmd = list(gs.spec["leader"]["command"])
-        cmd = [c.replace("$(PORT)", str(port)) for c in cmd]
-        env = dict(os.environ)
-        env.update(gs.spec["leader"].get("env", {}))
-        env.update({
-            "ARKS_GANG_LEADER_ADDRESS": f"127.0.0.1:{port}",
-            "ARKS_GANG_SIZE": str(gs.spec.get("size", 1)),
-            "ARKS_GANG_WORKER_INDEX": "0",
-        })
-        logf = open(os.path.join(
-            self.log_dir, f"{gs.namespace}-{gs.name}-{index}.log"), "ab")
-        log.info("gang %s/%s group %d: %s (port %d)",
-                 gs.namespace, gs.name, index, shlex.join(cmd), port)
-        proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
-        return _Group(proc, port, revision)
+        size = gs.spec.get("size", 1)
+        leader_port = _free_port()
+        coord_port = _free_port() if size > 1 else 0
+        # Explicitly allocated (not derived from coord_port) — derived ports
+        # collide with other allocations on a shared host.
+        dispatch_port = _free_port() if size > 1 else 0
+        gang_secret = secrets.token_hex(16)
+        procs: list[subprocess.Popen] = []
+        for member in range(size):
+            role = "leader" if member == 0 else "worker"
+            spec = gs.spec.get(role) or gs.spec["leader"]
+            port = leader_port if member == 0 else _free_port()
+            cmd = [c.replace("$(PORT)", str(port)) for c in spec["command"]]
+            env = dict(os.environ)
+            env.update(spec.get("env", {}))
+            env.update({
+                "ARKS_GANG_LEADER_ADDRESS": f"127.0.0.1:{leader_port}",
+                "ARKS_GANG_SIZE": str(size),
+                "ARKS_GANG_WORKER_INDEX": str(member),
+            })
+            if size > 1:
+                # jax.distributed rendezvous (the LWS env contract
+                # translated — reference :560-569) + the authenticated
+                # dispatch channel (arks_tpu.engine.multihost).
+                env.update({
+                    "ARKS_COORDINATOR_ADDRESS": f"127.0.0.1:{coord_port}",
+                    "ARKS_NUM_PROCESSES": str(size),
+                    "ARKS_PROCESS_ID": str(member),
+                    "ARKS_DISPATCH_ADDRESS": f"127.0.0.1:{dispatch_port}",
+                    "ARKS_GANG_SECRET": gang_secret,
+                })
+            logf = open(os.path.join(
+                self.log_dir,
+                f"{gs.namespace}-{gs.name}-{index}-{member}.log"), "ab")
+            log.info("gang %s/%s group %d member %d: %s (port %d)",
+                     gs.namespace, gs.name, index, member,
+                     shlex.join(cmd), port)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                          stderr=logf))
+        return _Group(procs, leader_port, revision)
 
     def status(self, gs: GangSet) -> dict:
         with self._lock:
@@ -261,7 +319,7 @@ class LocalProcessDriver:
         out = []
         for i in range(replicas):
             g = groups.get(i)
-            if g is None or g.proc.poll() is not None:
+            if g is None or g.poll_any_dead() is not None:
                 out.append({"index": i, "phase": "Pending", "leaderAddr": ""})
                 continue
             phase = "Running" if self._probe(g.port) else "Starting"
@@ -278,13 +336,29 @@ class LocalProcessDriver:
         except Exception:
             return False
 
-    def _stop_group(self, g: _Group) -> None:
-        if g.proc.poll() is None:
-            g.proc.terminate()
+    def _signal_stop(self, g: _Group) -> None:
+        """Fast half of a group stop: deliver SIGTERM to every member."""
+        for p in g.procs:
+            if p.poll() is None:
+                p.terminate()
+
+    def _reap_stop(self, g: _Group) -> None:
+        """Slow half: wait for exits, escalate to SIGKILL.  Call WITHOUT the
+        driver lock — a member wedged in a native collective ignores
+        SIGTERM until the call returns."""
+        for p in g.procs:
             try:
-                g.proc.wait(timeout=10)
+                p.wait(timeout=10)
             except subprocess.TimeoutExpired:
-                g.proc.kill()
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    def _stop_group(self, g: _Group) -> None:
+        self._signal_stop(g)
+        self._reap_stop(g)
 
     def teardown(self, gs: GangSet) -> None:
         with self._lock:

@@ -159,6 +159,7 @@ class _ChunkState:
     request: Request
     ids: list[int]
     pos: int      # tokens already prefilled
+    seed: int     # sampling seed (key = PRNGKey(seed))
     key: jax.Array  # base sampling key (PRNGKey(seed))
 
 
@@ -273,6 +274,9 @@ class InferenceEngine:
         self._running = False
         self._thread: threading.Thread | None = None
         self._request_seed = engine_cfg.seed
+        # Multi-host: a DispatchLeader when this engine drives follower
+        # processes (arks_tpu.engine.multihost); None single-host.
+        self.dispatcher = None
 
         self._build_programs()
 
@@ -363,6 +367,27 @@ class InferenceEngine:
         kvd = self.ecfg.resolve_kv_cache_dtype()
         return jnp.bfloat16 if kvd == "bf16" else engine_dtype
 
+    def _emit(self, op: str, **payload) -> None:
+        """Broadcast a device dispatch to follower processes (multi-host);
+        no-op single-host.  MUST precede the local dispatch at every site —
+        followers replay the identical jit sequence, which is what keeps
+        the gang's collectives in lockstep.
+
+        A broken dispatch channel is fatal to the whole gang: without it the
+        followers stop mirroring and the next collective hangs forever, with
+        every process alive — invisible to the gang driver's liveness checks.
+        Exit instead, so the driver restarts the group (the same policy
+        jax's own coordination service applies when a peer dies)."""
+        if self.dispatcher is None:
+            return
+        try:
+            self.dispatcher.broadcast(op, payload)
+        except OSError:
+            log.critical(
+                "dispatch channel to followers broke; exiting so the gang "
+                "driver restarts the whole group", exc_info=True)
+            os._exit(70)
+
     def _run(self) -> None:
         while self._running:
             try:
@@ -387,6 +412,9 @@ class InferenceEngine:
                 time.sleep(0.001)
 
     def _reset_device_state(self) -> None:
+        # Followers rebuild too (their _run path never sees the exception).
+        if self.dispatcher is not None:
+            self._emit("reset")
         dtype = jnp.dtype(self.ecfg.dtype or self.cfg.dtype)
         self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
                                     self.ecfg.max_cache_len,
@@ -465,13 +493,19 @@ class InferenceEngine:
         seed = p.seed if p.seed is not None else self._request_seed
         key = jax.random.PRNGKey(seed)
         try:
+            self._emit("prefill", tokens=padded, length=len(ids),
+                       temperature=p.temperature, top_p=p.top_p,
+                       top_k=p.top_k, seed=seed)
             first_id, ks, vs = self._prefill_fn(
                 self.params, jnp.asarray(padded), jnp.asarray([len(ids)], jnp.int32),
                 jnp.float32(p.temperature), jnp.float32(p.top_p),
                 jnp.int32(p.top_k), key)
 
             slot = self._free.pop()
+            self._emit("insert", slot=slot)
             self._cache = self._insert_fn(self._cache, ks, vs, jnp.asarray(slot))
+            self._emit("set_slot", slot=slot, temperature=p.temperature,
+                       top_p=p.top_p, top_k=p.top_k, seed=seed)
             self._sampling = sampler_mod.set_slot(
                 self._sampling, slot, p.temperature, p.top_p, p.top_k,
                 jax.random.fold_in(key, 1))
@@ -504,7 +538,10 @@ class InferenceEngine:
         key = jax.random.PRNGKey(pf.seed)
         try:
             slot = self._free.pop()
+            self._emit("insert_kv", slot=slot, k=np.asarray(k), v=np.asarray(v))
             self._cache = self._insert_fn(self._cache, k, v, jnp.asarray(slot))
+            self._emit("set_slot", slot=slot, temperature=p.temperature,
+                       top_p=p.top_p, top_k=p.top_k, seed=pf.seed)
             self._sampling = sampler_mod.set_slot(
                 self._sampling, slot, p.temperature, p.top_p, p.top_k,
                 jax.random.fold_in(key, 1))
@@ -585,6 +622,7 @@ class InferenceEngine:
         seed = p.seed if p.seed is not None else self._request_seed
         slot = self._free.pop()
         self._prefilling[slot] = _ChunkState(request=req, ids=ids, pos=0,
+                                             seed=seed,
                                              key=jax.random.PRNGKey(seed))
         # Interleaved decode dispatches write garbage KV rows for every slot
         # at its length index; pointing this slot's length at the FINAL
@@ -611,6 +649,8 @@ class InferenceEngine:
         padded = np.zeros((c,), np.int32)
         padded[:valid] = chunk
         try:
+            self._emit("chunk", slot=slot, tokens=padded, start=st.pos,
+                       valid=valid)
             logits, self._cache = self._chunk_fn(
                 self.params, self._cache, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(padded), jnp.asarray(st.pos, jnp.int32),
@@ -630,10 +670,14 @@ class InferenceEngine:
         # Final chunk: sample the first token (same key semantics as the
         # one-shot prefill_and_sample) and promote the slot to decoding.
         p = st.request.params
+        self._emit("sample_one", temperature=p.temperature, top_p=p.top_p,
+                   top_k=p.top_k, seed=st.seed)
         first = int(self._sample_one_fn(
             logits, jnp.float32(p.temperature), jnp.float32(p.top_p),
             jnp.int32(p.top_k), st.key))
         del self._prefilling[slot]
+        self._emit("set_slot", slot=slot, temperature=p.temperature,
+                   top_p=p.top_p, top_k=p.top_k, seed=st.seed)
         self._sampling = sampler_mod.set_slot(
             self._sampling, slot, p.temperature, p.top_p, p.top_k,
             jax.random.fold_in(st.key, 1))
@@ -647,6 +691,10 @@ class InferenceEngine:
 
         One-shot only: the transferred KV is a single [T] block, so prompts
         beyond the largest bucket are rejected (HTTP 400 at the server)."""
+        if self.dispatcher is not None:
+            raise NotImplementedError(
+                "detached prefill on a multi-host gang is not supported; "
+                "run the prefill tier single-host per group")
         if len(prompt_ids) > self._one_shot_limit():
             raise ContextLengthExceededError(
                 f"prompt has {len(prompt_ids)} tokens but the disaggregated "
@@ -694,6 +742,8 @@ class InferenceEngine:
             return
 
         t0 = time.monotonic()
+        self._emit("decode", tokens=np.array(self._last_token),
+                   lengths=np.array(self._lengths))
         self._cache, self._sampling, toks = self._decode_fn(
             self.params, self._cache, jnp.asarray(self._last_token),
             jnp.asarray(self._lengths), self._sampling)

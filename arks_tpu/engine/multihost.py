@@ -1,0 +1,268 @@
+"""Multi-host serving: leader dispatch replication.
+
+Under ``jax.distributed`` every process must execute the SAME jitted
+computations in the same order — collectives hang otherwise.  The engine's
+scheduler runs only on the leader (process 0, the one that serves HTTP);
+follower processes mirror its device dispatches.
+
+Mechanism: before each device dispatch the leader broadcasts a tiny
+(op, host-args) record over a TCP channel; followers execute the identical
+jit call against their OWN device state (params/cache/sampling are
+constructed identically on every process — same spec, same seed or same
+checkpoint shards).  Device-side lockstep then comes for free: the leader's
+host-sync on a dispatch result cannot complete until followers join the
+collectives.
+
+This replaces what the reference gets from Ray/NCCL inside vLLM containers
+(/root/reference/internal/controller/arksapplication_controller.go:941-1014
+only wires rendezvous env vars; the engine brings its own execution model —
+SURVEY.md §2.4).  The channel is a trusted intra-gang link (same security
+domain as the NCCL/gloo sockets themselves).
+
+Wire format: 4-byte big-endian length + pickled (op, payload) tuple, after
+a mutual shared-secret handshake (the secret comes from the gang's env —
+ARKS_GANG_SECRET — injected by whoever launches the gang).  Followers prove
+identity with the secret; the leader proves itself with a derived ack, so a
+port-squatting process can neither take a follower slot nor feed a follower
+pickles.  Beyond the handshake the link is trusted, like the gloo/NCCL
+sockets beside it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+log = logging.getLogger("arks_tpu.multihost")
+
+DISPATCH_PORT_OFFSET = 1  # default dispatch port = coordinator port + 1
+
+
+def dispatch_address(coordinator: str) -> tuple[str, int]:
+    """Dispatch endpoint: explicit ARKS_DISPATCH_ADDRESS when the launcher
+    reserved one (the local gang driver does — derived ports can collide on
+    a shared host), else coordinator port + 1 (fine where each process has
+    its own network namespace, e.g. one pod per host)."""
+    explicit = os.environ.get("ARKS_DISPATCH_ADDRESS")
+    if explicit:
+        host, _, port = explicit.partition(":")
+        return host, int(port)
+    host, _, port = coordinator.partition(":")
+    return host, int(port) + DISPATCH_PORT_OFFSET
+
+
+def _secret() -> bytes:
+    return os.environ.get("ARKS_GANG_SECRET", "arks-gang").encode()
+
+
+def _leader_ack(secret: bytes) -> bytes:
+    return hashlib.sha256(secret + b"/leader-ack").digest()
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, 4)
+    (n,) = struct.unpack(">I", hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("dispatch channel closed")
+        buf += chunk
+    return buf
+
+
+class DispatchLeader:
+    """Leader side: accepts follower connections, broadcasts dispatches."""
+
+    def __init__(self, bind_host: str, port: int, num_followers: int,
+                 accept_timeout_s: float = 120.0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind_host, port))
+        self._srv.listen(num_followers)
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+        secret = _secret()
+        deadline = time.monotonic() + accept_timeout_s
+        while len(self._conns) < num_followers:
+            self._srv.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                conn, addr = self._srv.accept()
+            except socket.timeout:
+                raise TimeoutError(
+                    f"only {len(self._conns)}/{num_followers} followers "
+                    "connected to the dispatch channel")
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Handshake: follower proves the gang secret; a stray connection
+            # (port scanner) must not consume a follower slot.
+            try:
+                conn.settimeout(10)
+                proof = _recv_exact(conn, 32)
+                if proof != hashlib.sha256(secret).digest():
+                    raise ConnectionError("bad gang secret")
+                conn.sendall(_leader_ack(secret))
+                conn.settimeout(None)
+            except (OSError, ConnectionError) as e:
+                log.warning("rejecting dispatch connection from %s: %s",
+                            addr, e)
+                conn.close()
+                continue
+            log.info("follower connected from %s", addr)
+            self._conns.append(conn)
+
+    def broadcast(self, op: str, payload: dict) -> None:
+        # Serialize ONCE: insert_kv payloads carry whole KV tensors.
+        data = pickle.dumps((op, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        framed = struct.pack(">I", len(data)) + data
+        with self._lock:
+            for conn in self._conns:
+                conn.sendall(framed)
+
+    def close(self) -> None:
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    _send_msg(conn, ("stop", {}))
+                except OSError:
+                    pass
+                conn.close()
+            self._conns.clear()
+        self._srv.close()
+
+
+class DispatchFollower:
+    """Follower side: mirrors the leader's dispatches onto a local engine.
+
+    Holds the transient cross-op state the leader keeps in locals (the last
+    prefill's KV) and executes each op with this process's own device state.
+    """
+
+    def __init__(self, engine, leader_host: str, port: int,
+                 connect_timeout_s: float = 120.0):
+        import jax
+
+        self.engine = engine
+        self._jax = jax
+        self._last_kv = None  # (ks, vs) from the most recent prefill
+        secret = _secret()
+        deadline = time.monotonic() + connect_timeout_s
+        while True:
+            try:
+                self._sock = socket.create_connection((leader_host, port),
+                                                      timeout=5)
+                # Mutual handshake: prove the gang secret, then require the
+                # leader's derived ack — never unpickle bytes from an
+                # unauthenticated peer (a port squatter could otherwise
+                # feed arbitrary pickles = code execution).
+                self._sock.settimeout(10)
+                self._sock.sendall(hashlib.sha256(secret).digest())
+                ack = _recv_exact(self._sock, 32)
+                if ack != _leader_ack(secret):
+                    raise ConnectionError("leader failed gang-secret handshake")
+                self._sock.settimeout(None)
+                break
+            except OSError:
+                sock = getattr(self, "_sock", None)
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def run(self) -> None:
+        """Dispatch loop; returns when the leader sends stop/disconnects."""
+        import jax
+        import jax.numpy as jnp
+
+        from arks_tpu.engine import sampler as sampler_mod
+
+        eng = self.engine
+        while True:
+            try:
+                op, p = _recv_msg(self._sock)
+            except (ConnectionError, OSError):
+                log.info("dispatch channel closed; follower exiting")
+                return
+            if op == "stop":
+                return
+            try:
+                self._apply(eng, jax, jnp, op, p)
+            except Exception:
+                # A deterministic device fault raises here AND on the
+                # leader; the leader's recovery broadcasts "reset" next,
+                # which rebuilds this process's device state too.  (A
+                # follower-only fault diverges instead — the next
+                # collective then hangs and jax's coordination service
+                # kills the gang, which the driver restarts.)
+                log.exception("dispatch op %r failed; awaiting reset", op)
+
+    def _apply(self, eng, jax, jnp, op: str, p: dict) -> None:
+        from arks_tpu.engine import sampler as sampler_mod
+
+        if op == "prefill":
+            key = self._jax.random.PRNGKey(p["seed"])
+            _first, ks, vs = eng._prefill_fn(
+                eng.params, jnp.asarray(p["tokens"]),
+                jnp.asarray([p["length"]], jnp.int32),
+                jnp.float32(p["temperature"]), jnp.float32(p["top_p"]),
+                jnp.int32(p["top_k"]), key)
+            self._last_kv = (ks, vs)
+        elif op == "insert":
+            ks, vs = self._last_kv
+            eng._cache = eng._insert_fn(eng._cache, ks, vs,
+                                        jnp.asarray(p["slot"]))
+            self._last_kv = None
+        elif op == "insert_kv":
+            # Disaggregated decode: KV arrives by value (the leader got
+            # it over the wire, not from a local prefill).
+            eng._cache = eng._insert_fn(
+                eng._cache, jnp.asarray(p["k"]), jnp.asarray(p["v"]),
+                jnp.asarray(p["slot"]))
+        elif op == "set_slot":
+            key = self._jax.random.PRNGKey(p["seed"])
+            eng._sampling = sampler_mod.set_slot(
+                eng._sampling, p["slot"], p["temperature"], p["top_p"],
+                p["top_k"], self._jax.random.fold_in(key, 1))
+        elif op == "chunk":
+            _logits, eng._cache = eng._chunk_fn(
+                eng.params, eng._cache, jnp.asarray(p["slot"], jnp.int32),
+                jnp.asarray(p["tokens"]),
+                jnp.asarray(p["start"], jnp.int32),
+                jnp.asarray(p["valid"], jnp.int32))
+            self._last_logits = _logits
+        elif op == "sample_one":
+            key = self._jax.random.PRNGKey(p["seed"])
+            eng._sample_one_fn(self._last_logits,
+                               jnp.float32(p["temperature"]),
+                               jnp.float32(p["top_p"]),
+                               jnp.int32(p["top_k"]), key)
+        elif op == "decode":
+            eng._cache, eng._sampling, toks = eng._decode_fn(
+                eng.params, eng._cache, jnp.asarray(p["tokens"]),
+                jnp.asarray(p["lengths"]), eng._sampling)
+            # Host-sync like the leader, but via block_until_ready —
+            # a follower may not address every shard of toks.
+            jax.block_until_ready(toks)
+        elif op == "reset":
+            eng._reset_device_state()
+        else:
+            log.warning("unknown dispatch op %r", op)

@@ -91,13 +91,37 @@ def main() -> None:
         raise SystemExit(
             f"requested tp={args.tp or tp} x dp={args.dp} needs {max(want, args.dp)} "
             f"devices but only {n_dev} are visible")
+    nproc = int(os.environ.get("ARKS_NUM_PROCESSES", "1"))
     mesh = None
     if want > 1:
         from arks_tpu.parallel.mesh import make_mesh
-        # Use exactly the devices the plan asks for; a host may expose more
-        # (e.g. a forced multi-device CPU platform) than the spec wants.
+        if nproc > 1:
+            # Multi-host: the mesh MUST span processes with equal local
+            # device counts, or some processes own no shard and every
+            # cross-process collective deadlocks.  Take want/nproc devices
+            # from each process (jax.devices()[:want] would grab them all
+            # from process 0 when a host exposes extras).
+            if want % nproc:
+                raise SystemExit(
+                    f"tp*dp={want} must be divisible by the gang size {nproc}")
+            per = want // nproc
+            taken: dict[int, int] = {}
+            devices = []
+            for d in jax.devices():
+                if taken.get(d.process_index, 0) < per:
+                    taken[d.process_index] = taken.get(d.process_index, 0) + 1
+                    devices.append(d)
+            if len(devices) < want:
+                raise SystemExit(
+                    f"gang of {nproc} processes exposes only {len(devices)} "
+                    f"usable devices, need {want}")
+        else:
+            # Use exactly the devices the plan asks for; a host may expose
+            # more (e.g. a forced multi-device CPU platform) than the spec
+            # wants.
+            devices = jax.devices()[:want]
         mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp,
-                         devices=jax.devices()[:want])
+                         devices=devices)
 
     params = None
     if model_path:
@@ -122,6 +146,21 @@ def main() -> None:
     engine = InferenceEngine(cfg, ecfg, tokenizer, params=params, mesh=mesh)
 
     served = args.served_model_name or cfg.name
+
+    # Multi-host gang: process 0 serves HTTP and broadcasts every device
+    # dispatch; the other processes mirror them so the gang's collectives
+    # stay in lockstep (arks_tpu.engine.multihost).
+    if coord and nproc > 1:
+        from arks_tpu.engine.multihost import (
+            DispatchFollower, DispatchLeader, dispatch_address)
+        dhost, dport = dispatch_address(coord)
+        pid = int(os.environ.get("ARKS_PROCESS_ID", "0"))
+        if pid != 0:
+            log.info("follower %d/%d: mirroring leader dispatches", pid, nproc)
+            DispatchFollower(engine, dhost, dport).run()
+            return
+        engine.dispatcher = DispatchLeader("0.0.0.0", dport, nproc - 1)
+
     if args.disagg == "prefill":
         from arks_tpu.server.disagg import PrefillServer
         # No decode loop: the engine only runs detached prefills.
