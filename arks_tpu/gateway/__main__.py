@@ -24,6 +24,14 @@ def main() -> None:
                         "(the reference's file provider)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--backend", choices=("auto", "memory", "native", "redis"),
+                   default="auto",
+                   help="counter/quota store: auto = native C++ if built "
+                        "else memory (single replica); redis = shared store "
+                        "for HA gateways (reference redis_impl.go parity)")
+    p.add_argument("--redis-addr", default="127.0.0.1:6379",
+                   help="RESP server address for --backend redis (real "
+                        "Redis, or python -m arks_tpu.gateway.rediskv)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO,
@@ -36,9 +44,30 @@ def main() -> None:
     store = Store()
     for path in args.manifests:
         apply_manifests(store, path)
-    gw = Gateway(store, host=args.host, port=args.port)
+
+    rate_limiter = quota = None
+    if args.backend == "redis":
+        from arks_tpu.gateway.ratelimiter import RateLimiter
+        from arks_tpu.gateway.rediskv import (
+            RedisCounterBackend, RedisQuotaService, RespClient)
+        host, _, port = args.redis_addr.partition(":")
+        client = RespClient(host, int(port or 6379))
+        rate_limiter = RateLimiter(RedisCounterBackend(client))
+        quota = RedisQuotaService(client)
+    elif args.backend == "memory":
+        from arks_tpu.gateway.ratelimiter import (
+            MemoryCounterBackend, RateLimiter)
+        rate_limiter = RateLimiter(MemoryCounterBackend())
+    elif args.backend == "native":
+        from arks_tpu.gateway import native
+        from arks_tpu.gateway.ratelimiter import RateLimiter
+        rate_limiter = RateLimiter(native.NativeCounterBackend())
+
+    gw = Gateway(store, host=args.host, port=args.port,
+                 rate_limiter=rate_limiter, quota=quota)
     gw.start(background=True)
-    log.info("gateway on %s:%d (/v1/* + /metrics)", args.host, gw.port)
+    log.info("gateway on %s:%d (/v1/* + /metrics, backend=%s)",
+             args.host, gw.port, args.backend)
 
     stop: list[int] = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))

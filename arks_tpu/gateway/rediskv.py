@@ -1,0 +1,399 @@
+"""Shared counter store over the Redis protocol (RESP2).
+
+Why: the reference gateway keeps rate-limit windows and quota usage in
+Redis (/root/reference/pkg/gateway/ratelimiter/redis_impl.go:47-168,
+quota/redis_impl.go:38-107, dist/gateway.yaml:199-228) precisely so that a
+SECOND gateway replica shares the same counters — in-process stores would
+let N replicas each grant the full limit.  This module gives the TPU-native
+gateway the same HA story:
+
+- ``RespClient`` — a minimal, dependency-free RESP2 client (the image has
+  no redis-py).  Pipelining + the handful of commands the gateway needs.
+- ``RedisCounterBackend`` — ratelimiter.CounterBackend over any
+  RESP-speaking server (real Redis in production).  Same key layout and
+  fixed-window semantics as the in-memory/native backends.
+- ``RedisQuotaService`` — gateway.quota.QuotaService over the same server
+  (plain non-expiring counters keyed namespace/quotaname/type, reference
+  quota/redis_impl.go).
+- ``RespServer`` — a tiny in-process RESP server (GET/SET/INCRBY/EXPIRE/
+  TTL/DEL/PING/FLUSHALL with expiry).  The test double for the above, and
+  a single-binary alternative for small deployments:
+  ``python -m arks_tpu.gateway.rediskv --port 6380``.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+import time
+
+from arks_tpu.gateway.quota import QuotaService
+
+log = logging.getLogger("arks_tpu.gateway.rediskv")
+
+
+# ---------------------------------------------------------------------------
+# RESP2 client
+# ---------------------------------------------------------------------------
+
+
+class RespError(RuntimeError):
+    pass
+
+
+def _encode_command(args: tuple) -> bytes:
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, bytes):
+            b = a
+        else:
+            b = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+class _Conn:
+    """One RESP connection with its read buffer."""
+
+    def __init__(self, host: str, port: int, timeout_s: float):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n:]
+        return data
+
+    def read_reply(self):
+        """One reply; error replies come back as RespError VALUES so the
+        caller always consumes every reply of a pipelined batch — raising
+        mid-batch would leave replies buffered and desynchronize the
+        stream for every later command."""
+        line = self.read_line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            return RespError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self.read_exact(n)
+            self.read_exact(2)  # trailing \r\n
+            return data
+        if t == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self.read_reply() for _ in range(n)]
+        raise ConnectionError(f"unexpected reply type {line!r}")
+
+
+class RespClient:
+    """Minimal RESP2 client with one connection PER THREAD — the gateway
+    calls from concurrent request-handler threads, and a single locked
+    connection would serialize every admission's round-trips head-of-line
+    behind the slowest one."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 5.0):
+        self.host, self.port, self.timeout_s = host, port, timeout_s
+        self._tls = threading.local()
+        self._all: list[_Conn] = []
+        self._all_lock = threading.Lock()
+        self._conn()  # fail fast on a bad address
+
+    def _conn(self) -> _Conn:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = _Conn(self.host, self.port, self.timeout_s)
+            self._tls.conn = conn
+            with self._all_lock:
+                self._all.append(conn)
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            conn.close()
+            with self._all_lock:
+                if conn in self._all:
+                    self._all.remove(conn)
+            self._tls.conn = None
+
+    def close(self) -> None:
+        with self._all_lock:
+            for c in self._all:
+                c.close()
+            self._all.clear()
+
+    def pipeline(self, *commands: tuple) -> list:
+        """Send several commands in one write, read all replies (the
+        reference pipelines GET+TTL and INCRBY+EXPIRE the same way).
+
+        Retry policy: a failure during SEND reconnects and resends once
+        (the server cannot have executed a partially-delivered batch, and
+        pipelined writes are small enough to fit the send buffer whole); a
+        failure while READING replies does NOT resend — the server may
+        have executed the commands, and re-applying INCRBYs would double-
+        count rate windows and permanently inflate quota ledgers.
+        """
+        payload = b"".join(_encode_command(c) for c in commands)
+        try:
+            conn = self._conn()
+            conn.sock.sendall(payload)
+        except (OSError, ConnectionError):
+            # Reconnect once (gateway pods outlive store restarts).
+            self._drop_conn()
+            conn = self._conn()
+            conn.sock.sendall(payload)
+        try:
+            replies = [conn.read_reply() for _ in commands]
+        except (OSError, ConnectionError):
+            self._drop_conn()
+            raise
+        for r in replies:
+            if isinstance(r, RespError):
+                raise r
+        return replies
+
+    def command(self, *args):
+        return self.pipeline(tuple(args))[0]
+
+
+# ---------------------------------------------------------------------------
+# Gateway backends over RESP
+# ---------------------------------------------------------------------------
+
+
+class RedisCounterBackend:
+    """ratelimiter.CounterBackend over a RESP server — the HA replacement
+    for the in-process stores (two gateway replicas share one window)."""
+
+    def __init__(self, client: RespClient):
+        self.client = client
+
+    def get(self, key: str) -> int:
+        val = self.client.command("GET", key)
+        return int(val) if val is not None else 0
+
+    def incr(self, key: str, amount: int, ttl_s: int) -> int:
+        # Pipelined INCRBY + TTL, then EXPIRE only when the key has no
+        # expiry yet (reference redis_impl.go:116-168).
+        val, ttl = self.client.pipeline(("INCRBY", key, amount), ("TTL", key))
+        if ttl is not None and int(ttl) < 0:
+            self.client.command("EXPIRE", key, ttl_s)
+        return int(val)
+
+
+def quota_key(namespace: str, quota_name: str, typ: str) -> str:
+    # key layout parity: prefix:namespace=..quotaname=..type=..
+    # (reference quota/redis_impl.go)
+    return f"arks:quota:namespace={namespace}:quotaname={quota_name}:type={typ}"
+
+
+class RedisQuotaService(QuotaService):
+    """gateway.quota.QuotaService over a RESP server (plain non-expiring
+    counters; reference quota/redis_impl.go:38-107).  Only the storage
+    methods are overridden — ``check`` is inherited so over-limit
+    semantics can never diverge from the single-replica path."""
+
+    def __init__(self, client: RespClient):
+        self.client = client
+
+    def incr_usage(self, namespace: str, quota_name: str,
+                   amounts: dict[str, int]) -> None:
+        from arks_tpu.control.resources import VALID_QUOTAS
+        cmds = [("INCRBY", quota_key(namespace, quota_name, t), a)
+                for t, a in amounts.items() if t in VALID_QUOTAS and a > 0]
+        if cmds:
+            self.client.pipeline(*cmds)
+
+    def get_usage(self, namespace: str, quota_name: str) -> dict[str, int]:
+        from arks_tpu.control.resources import VALID_QUOTAS
+        types = list(VALID_QUOTAS)
+        vals = self.client.pipeline(
+            *(("GET", quota_key(namespace, quota_name, t)) for t in types))
+        return {t: int(v) if v is not None else 0
+                for t, v in zip(types, vals)}
+
+    def set_usage(self, namespace: str, quota_name: str, typ: str,
+                  value: int) -> None:
+        self.client.command("SET", quota_key(namespace, quota_name, typ), value)
+
+
+# ---------------------------------------------------------------------------
+# Tiny RESP server (test double + single-binary deployments)
+# ---------------------------------------------------------------------------
+
+
+class _KV:
+    _GC_THRESHOLD = 65536
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.data: dict[bytes, bytes] = {}
+        self.expiry: dict[bytes, float] = {}
+        self._gc_at = self._GC_THRESHOLD
+
+    def _alive(self, key: bytes, now: float) -> bool:
+        exp = self.expiry.get(key)
+        if exp is not None and exp <= now:
+            self.data.pop(key, None)
+            self.expiry.pop(key, None)
+            return False
+        return key in self.data
+
+    def gc(self, now: float) -> None:
+        """Amortized sweep of expired keys.  Rate-limit window keys embed
+        their window start and are never read again after the window rolls,
+        so lazy-on-access expiry alone would grow the store without bound
+        (one key per user/model/rule/window, forever)."""
+        if len(self.data) <= self._gc_at:
+            return
+        dead = [k for k, exp in self.expiry.items() if exp <= now]
+        for k in dead:
+            self.data.pop(k, None)
+            self.expiry.pop(k, None)
+        # If most keys are live (long windows), wait for the map to double
+        # before re-scanning rather than sweeping every write.
+        self._gc_at = max(self._GC_THRESHOLD, len(self.data) * 2)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        kv: _KV = self.server.kv  # type: ignore[attr-defined]
+        while True:
+            try:
+                line = self.rfile.readline()
+            except OSError:
+                return
+            if not line:
+                return
+            if not line.startswith(b"*"):
+                self.wfile.write(b"-ERR protocol error\r\n")
+                return
+            try:
+                nargs = int(line[1:].strip())
+                args = []
+                for _ in range(nargs):
+                    hdr = self.rfile.readline()
+                    n = int(hdr[1:].strip())
+                    args.append(self.rfile.read(n))
+                    self.rfile.read(2)
+            except (ValueError, OSError):
+                return
+            try:
+                self.wfile.write(self._dispatch(kv, args))
+                self.wfile.flush()
+            except OSError:
+                return
+
+    def _dispatch(self, kv: _KV, args: list[bytes]) -> bytes:
+        cmd = args[0].upper()
+        now = time.time()
+        with kv.lock:
+            if cmd in (b"SET", b"INCRBY"):
+                kv.gc(now)
+            if cmd == b"PING":
+                return b"+PONG\r\n"
+            if cmd == b"GET":
+                if not kv._alive(args[1], now):
+                    return b"$-1\r\n"
+                v = kv.data[args[1]]
+                return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == b"SET":
+                kv.data[args[1]] = args[2]
+                kv.expiry.pop(args[1], None)
+                return b"+OK\r\n"
+            if cmd == b"INCRBY":
+                cur = int(kv.data[args[1]]) if kv._alive(args[1], now) else 0
+                cur += int(args[2])
+                kv.data[args[1]] = str(cur).encode()
+                return b":%d\r\n" % cur
+            if cmd == b"EXPIRE":
+                if not kv._alive(args[1], now):
+                    return b":0\r\n"
+                kv.expiry[args[1]] = now + int(args[2])
+                return b":1\r\n"
+            if cmd == b"TTL":
+                if not kv._alive(args[1], now):
+                    return b":-2\r\n"
+                exp = kv.expiry.get(args[1])
+                return b":-1\r\n" if exp is None else b":%d\r\n" % int(exp - now)
+            if cmd == b"DEL":
+                n = 0
+                for key in args[1:]:
+                    if kv._alive(key, now):
+                        kv.data.pop(key, None)
+                        kv.expiry.pop(key, None)
+                        n += 1
+                return b":%d\r\n" % n
+            if cmd == b"FLUSHALL":
+                kv.data.clear()
+                kv.expiry.clear()
+                return b"+OK\r\n"
+        return b"-ERR unknown command '%s'\r\n" % cmd
+
+
+class RespServer:
+    """Threaded RESP server over an in-memory KV with expiry."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.kv = _KV()  # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address
+
+    def start(self, background: bool = True) -> None:
+        if background:
+            threading.Thread(target=self._srv.serve_forever,
+                             name="rediskv", daemon=True).start()
+        else:
+            self._srv.serve_forever()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser("arks_tpu.gateway.rediskv")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=6380)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    srv = RespServer(args.host, args.port)
+    log.info("rediskv serving on %s:%d", srv.host, srv.port)
+    srv.start(background=False)
+
+
+if __name__ == "__main__":
+    main()

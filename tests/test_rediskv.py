@@ -1,0 +1,188 @@
+"""Shared-counter backend over the Redis protocol (gateway.rediskv):
+client/server roundtrips, parity with the in-memory oracle, and the HA
+property the reference gets from Redis — two gateway replicas sharing one
+rate-limit window and one quota ledger (redis_impl.go parity)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from arks_tpu.control import resources as res
+from arks_tpu.control.store import Store
+from arks_tpu.gateway.ratelimiter import (
+    MemoryCounterBackend, RateLimiter, window_key)
+from arks_tpu.gateway.rediskv import (
+    RedisCounterBackend, RedisQuotaService, RespClient, RespServer)
+from arks_tpu.gateway.quota import QuotaService
+from arks_tpu.gateway.server import Gateway
+
+
+@pytest.fixture()
+def resp():
+    srv = RespServer()
+    srv.start()
+    client = RespClient(srv.host, srv.port)
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Protocol roundtrips
+# ---------------------------------------------------------------------------
+
+
+def test_resp_roundtrip(resp):
+    _, c = resp
+    assert c.command("PING") == "PONG"
+    assert c.command("GET", "missing") is None
+    assert c.command("SET", "k", "5") == "OK"
+    assert c.command("GET", "k") == b"5"
+    assert c.command("INCRBY", "k", 3) == 8
+    assert c.command("TTL", "k") == -1
+    assert c.command("EXPIRE", "k", 100) == 1
+    assert 0 < c.command("TTL", "k") <= 100
+    assert c.command("DEL", "k") == 1
+    assert c.command("TTL", "k") == -2
+
+
+def test_resp_error_mid_pipeline_keeps_stream_aligned(resp):
+    """An -ERR reply inside a pipelined batch raises, but every reply is
+    consumed first — the next command must read ITS OWN reply, not a stale
+    one (the desync would corrupt every later rate-limit read)."""
+    from arks_tpu.gateway.rediskv import RespError
+    _, c = resp
+    c.command("SET", "ok", "1")
+    with pytest.raises(RespError):
+        c.pipeline(("BOGUSCMD", "x"), ("INCRBY", "ok", 5))
+    # Stream still aligned: the INCRBY above was executed (6) and this GET
+    # returns its own value.
+    assert c.command("GET", "ok") == b"6"
+
+
+def test_resp_pipeline_and_expiry(resp):
+    _, c = resp
+    vals = c.pipeline(("INCRBY", "p", 2), ("TTL", "p"), ("INCRBY", "p", 2))
+    assert vals == [2, -1, 4]
+    c.command("EXPIRE", "p", 1)
+    time.sleep(1.2)
+    assert c.command("GET", "p") is None
+
+
+# ---------------------------------------------------------------------------
+# Counter backend parity + shared-window semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_backend_parity(resp):
+    _, c = resp
+    redis_b, mem_b = RedisCounterBackend(c), MemoryCounterBackend()
+    ops = [("a", 1), ("b", 5), ("a", 2), ("c", 10), ("a", 1)]
+    for key, amt in ops:
+        assert redis_b.incr(key, amt, 60) == mem_b.incr(key, amt, 60)
+    for key in ("a", "b", "c", "missing"):
+        assert redis_b.get(key) == mem_b.get(key)
+
+
+def test_rate_limiter_over_redis(resp):
+    _, c = resp
+    rl = RateLimiter(RedisCounterBackend(c))
+    rl.do_limit("ns", "u", "m", {"rpm": 1})
+    out = rl.check_limit("ns", "u", "m", {"rpm": 1}, {})
+    assert out[0].over and out[0].current == 1
+    # Window keys carry the wall-clock window start (fixed-window parity).
+    assert str(int(time.time() // 60) * 60) in window_key("ns", "u", "m", "rpm")
+
+
+def test_two_limiters_share_one_window(resp):
+    """The HA property: limiters in two gateway replicas consume ONE
+    budget, not one each."""
+    srv, _ = resp
+    a = RateLimiter(RedisCounterBackend(RespClient(srv.host, srv.port)))
+    b = RateLimiter(RedisCounterBackend(RespClient(srv.host, srv.port)))
+    a.do_limit("ns", "u", "m", {"rpm": 1})
+    b.do_limit("ns", "u", "m", {"rpm": 1})
+    assert a.check_limit("ns", "u", "m", {"rpm": 3}, {})[0].current == 2
+    assert b.check_limit("ns", "u", "m", {"rpm": 2}, {})[0].over
+
+
+# ---------------------------------------------------------------------------
+# Quota service parity + sharing
+# ---------------------------------------------------------------------------
+
+
+def test_quota_service_parity_and_sharing(resp):
+    srv, c = resp
+    rq = RedisQuotaService(c)
+    mq = QuotaService()
+    for q in (rq, mq):
+        q.incr_usage("ns", "qa", {"prompt": 10, "response": 5, "total": 15})
+        q.incr_usage("ns", "qa", {"total": 5})
+    assert rq.get_usage("ns", "qa") == mq.get_usage("ns", "qa")
+    assert rq.check("ns", "qa", {"total": 20}) == mq.check("ns", "qa", {"total": 20})
+    assert rq.check("ns", "qa", {"total": 21}) == mq.check("ns", "qa", {"total": 21})
+    rq.set_usage("ns", "qa", "total", 3)
+    assert rq.get_usage("ns", "qa")["total"] == 3
+
+    # A second service instance (second gateway) sees the same ledger.
+    rq2 = RedisQuotaService(RespClient(srv.host, srv.port))
+    assert rq2.get_usage("ns", "qa")["total"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Two full gateways sharing one store — end to end
+# ---------------------------------------------------------------------------
+
+
+def _mk_gateway(store, srv):
+    client = RespClient(srv.host, srv.port)
+    gw = Gateway(store, host="127.0.0.1", port=0, quota_sync_s=60,
+                 rate_limiter=RateLimiter(RedisCounterBackend(client)),
+                 quota=RedisQuotaService(client))
+    gw.start(background=True)
+    return gw
+
+
+def test_two_gateways_share_rate_limit(resp):
+    srv, _ = resp
+    store = Store()
+    store.create(res.Endpoint(name="m1", namespace="t", spec={}, status={
+        "routes": [{"backend": {"addresses": ["127.0.0.1:9"]}, "weight": 1}]}))
+    store.create(res.Token(name="bob", namespace="t", spec={
+        "token": "sk-bob",
+        "qos": [{"endpoint": {"name": "m1"},
+                 "rateLimits": [{"type": "rpm", "value": 2}]}]}))
+    gw1, gw2 = _mk_gateway(store, srv), _mk_gateway(store, srv)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not (
+            gw1.qos.token_known("sk-bob") and gw2.qos.token_known("sk-bob")):
+        time.sleep(0.02)
+
+    def post(gw):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+            data=json.dumps({"model": "m1",
+                             "messages": [{"role": "user", "content": "x"}]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer sk-bob"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            return 200
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        # rpm=2 TOTAL across both replicas: the first two admissions consume
+        # the shared window (the dead backend turns them into 502s — past
+        # admission), the third is 429 no matter which replica it hits.
+        assert post(gw1) in (502, 503)
+        assert post(gw2) in (502, 503)
+        assert post(gw1) == 429
+        assert post(gw2) == 429
+    finally:
+        gw1.stop()
+        gw2.stop()
