@@ -184,6 +184,16 @@ class ApplicationController(Controller):
             "ports": {"http": 8080},
             "restartPolicy": "RecreateGroupOnPodRestart",
             "runtime": runtime,
+            # Consumed by the K8s driver (live mode): pod image, TPU node
+            # selection, and the models-PVC mount.  Local drivers ignore
+            # these.  The PVC default is the SHARED "models" claim the
+            # operator itself downloads into (deploy/operator.yaml) — in
+            # live mode nothing provisions per-model PVCs, so engine pods
+            # must mount the volume the weights actually landed on.
+            "image": app.spec.get("runtimeImage", "arks-tpu/engine:latest"),
+            "accelerator": app.spec.get("accelerator", "cpu"),
+            "modelPvc": (model.spec.get("storage") or {}).get("pvc")
+            or "models",
         }
 
     def _ensure_service(self, app: Application) -> None:

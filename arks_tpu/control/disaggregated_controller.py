@@ -195,6 +195,13 @@ class DisaggregatedApplicationController(Controller):
             "restartPolicy": "RecreateGroupOnPodRestart",
             "runtime": RUNTIME_JAX,
             "role": component,
+            # K8s-driver (live mode) fields — see application_controller.
+            "image": ws.get("runtimeImage",
+                            app.spec.get("runtimeImage", "arks-tpu/engine:latest")),
+            "accelerator": ws.get("accelerator",
+                                  app.spec.get("accelerator", "cpu")),
+            "modelPvc": (model.spec.get("storage") or {}).get("pvc")
+            or "models",  # shared operator claim (see application_controller)
         }
 
     def _router_spec(self, app: DisaggregatedApplication) -> dict:
@@ -213,6 +220,9 @@ class DisaggregatedApplicationController(Controller):
             "restartPolicy": "RecreateGroupOnPodRestart",
             "runtime": "router",
             "role": "router",
+            "image": rs.get("runtimeImage",
+                            app.spec.get("runtimeImage", "arks-tpu/engine:latest")),
+            "accelerator": "cpu",
         }
 
     def _ensure_gangset(self, app: DisaggregatedApplication, model: Model,

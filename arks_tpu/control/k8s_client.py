@@ -1,0 +1,286 @@
+"""Minimal Kubernetes API client + in-process fake.
+
+The reference operator is a controller-runtime process against a live
+apiserver (/root/reference/cmd/main.go:255-301).  The TPU-native operator's
+live mode (arks_tpu.control.live) needs the same — but this image has no
+kubernetes python package, and the k8s API is plain REST+JSON, so a small
+dependency-free client suffices: CRUD + merge-patch + status subresource
+over HTTPS with bearer-token auth (in-cluster service account or explicit
+flags).
+
+``FakeKubeApi`` implements the same surface over an in-memory dict with the
+apiserver behaviors the operator depends on (resourceVersion bumps,
+finalizer-gated deletion, status subresource isolation) and records every
+mutation — the envtest analogue for this repo's test tiers (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.request
+
+log = logging.getLogger("arks_tpu.control.k8s")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+def _gv_path(gv: str) -> str:
+    # "v1" -> /api/v1 ; "apps/v1" | "arks.ai/v1" -> /apis/<group>/<version>
+    return f"/api/{gv}" if "/" not in gv else f"/apis/{gv}"
+
+
+class KubeApi:
+    """REST client over one apiserver.
+
+    Paths are built from (group_version, plural, namespace, name); payloads
+    are plain dicts in wire form.  PATCH uses merge-patch, which is how the
+    controllers avoid resourceVersion conflicts on status updates.
+    """
+
+    def __init__(self, base_url: str, token: str | None = None,
+                 ca_file: str | None = None, verify: bool = True,
+                 timeout_s: float = 15.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+        if ca_file:
+            ctx = ssl.create_default_context(cafile=ca_file)
+        elif verify:
+            ctx = ssl.create_default_context()
+        else:
+            ctx = ssl._create_unverified_context()
+        self._ctx = ctx
+
+    @classmethod
+    def in_cluster(cls) -> "KubeApi":
+        """Service-account config, like client-go's rest.InClusterConfig."""
+        with open(os.path.join(SA_DIR, "token")) as f:
+            token = f.read().strip()
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        return cls(f"https://{host}:{port}", token=token,
+                   ca_file=os.path.join(SA_DIR, "ca.crt"))
+
+    @staticmethod
+    def namespace_in_cluster() -> str:
+        try:
+            with open(os.path.join(SA_DIR, "namespace")) as f:
+                return f.read().strip()
+        except OSError:
+            return "default"
+
+    # -- wire ----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 content_type: str = "application/json"):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base_url + path, data=data,
+                                     method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                        context=self._ctx) as r:
+                payload = r.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")[:500])
+
+    def _obj_path(self, gv: str, plural: str, namespace: str | None,
+                  name: str | None = None, subresource: str | None = None) -> str:
+        path = _gv_path(gv)
+        if namespace:
+            path += f"/namespaces/{namespace}"
+        path += f"/{plural}"
+        if name:
+            path += f"/{name}"
+        if subresource:
+            path += f"/{subresource}"
+        return path
+
+    # -- resource ops --------------------------------------------------
+
+    def list(self, gv: str, plural: str, namespace: str | None = None) -> list[dict]:
+        out = self._request("GET", self._obj_path(gv, plural, namespace))
+        return out.get("items", []) if out else []
+
+    def get(self, gv: str, plural: str, namespace: str, name: str) -> dict | None:
+        try:
+            return self._request("GET", self._obj_path(gv, plural, namespace, name))
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def create(self, gv: str, plural: str, namespace: str, obj: dict) -> dict:
+        return self._request("POST", self._obj_path(gv, plural, namespace), obj)
+
+    def patch(self, gv: str, plural: str, namespace: str, name: str,
+              patch: dict, subresource: str | None = None) -> dict:
+        return self._request(
+            "PATCH", self._obj_path(gv, plural, namespace, name, subresource),
+            patch, content_type="application/merge-patch+json")
+
+    def replace(self, gv: str, plural: str, namespace: str, name: str,
+                obj: dict) -> dict:
+        """PUT — full replacement (merge-patch cannot remove keys).  The
+        object must carry the current metadata.resourceVersion."""
+        return self._request("PUT", self._obj_path(gv, plural, namespace, name),
+                             obj)
+
+    def delete(self, gv: str, plural: str, namespace: str, name: str) -> None:
+        try:
+            self._request("DELETE", self._obj_path(gv, plural, namespace, name))
+        except ApiError as e:
+            if e.status != 404:
+                raise
+
+
+# ---------------------------------------------------------------------------
+# Fake apiserver (tests + local dry runs)
+# ---------------------------------------------------------------------------
+
+
+def _merge(base, patch):
+    """RFC 7386 merge-patch."""
+    if not isinstance(patch, dict) or not isinstance(base, dict):
+        return patch
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge(out.get(k), v)
+    return out
+
+
+class FakeKubeApi:
+    """In-memory KubeApi with the apiserver behaviors controllers rely on:
+    resourceVersion bumps on every write, finalizer-gated deletion
+    (deletionTimestamp until finalizers empty), and a status subresource
+    that only touches .status.  Records (verb, path) tuples in ``actions``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # (gv, plural, namespace, name) -> obj dict
+        self._objs: dict[tuple, dict] = {}
+        self._rv = 0
+        self.actions: list[tuple[str, str]] = []
+
+    def _key(self, gv, plural, namespace, name):
+        return (gv, plural, namespace or "", name)
+
+    def _bump(self, obj: dict) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+
+    def _record(self, verb, gv, plural, namespace, name=""):
+        self.actions.append((verb, f"{gv}/{plural}/{namespace or ''}/{name}"))
+
+    def list(self, gv, plural, namespace=None) -> list[dict]:
+        with self._lock:
+            return [json.loads(json.dumps(o)) for (g, p, ns, _), o
+                    in sorted(self._objs.items())
+                    if g == gv and p == plural
+                    and (namespace is None or ns == namespace)]
+
+    def get(self, gv, plural, namespace, name) -> dict | None:
+        with self._lock:
+            obj = self._objs.get(self._key(gv, plural, namespace, name))
+            return json.loads(json.dumps(obj)) if obj else None
+
+    def create(self, gv, plural, namespace, obj) -> dict:
+        with self._lock:
+            name = obj["metadata"]["name"]
+            key = self._key(gv, plural, namespace, name)
+            if key in self._objs:
+                raise ApiError(409, f"{plural}/{name} already exists")
+            stored = json.loads(json.dumps(obj))
+            stored["metadata"].setdefault("namespace", namespace)
+            self._bump(stored)
+            self._objs[key] = stored
+            self._record("create", gv, plural, namespace, name)
+            return json.loads(json.dumps(stored))
+
+    def patch(self, gv, plural, namespace, name, patch, subresource=None) -> dict:
+        with self._lock:
+            key = self._key(gv, plural, namespace, name)
+            obj = self._objs.get(key)
+            if obj is None:
+                raise ApiError(404, f"{plural}/{name} not found")
+            if subresource == "status":
+                obj["status"] = _merge(obj.get("status", {}),
+                                       patch.get("status", patch))
+            else:
+                merged = _merge(obj, patch)
+                merged["metadata"]["name"] = name  # immutable
+                # Emulate the controller-manager: a StatefulSet template
+                # change restarts pods, so readiness drops until the test
+                # (playing kubelet) marks the new revision ready again.
+                if (plural == "statefulsets"
+                        and "template" in (patch.get("spec") or {})):
+                    merged.setdefault("status", {})["readyReplicas"] = 0
+                self._objs[key] = obj = merged
+            self._bump(obj)
+            self._record(f"patch{':' + subresource if subresource else ''}",
+                         gv, plural, namespace, name)
+            self._maybe_finish_delete(key)
+            return json.loads(json.dumps(self._objs[key])) \
+                if key in self._objs else {}
+
+    def replace(self, gv, plural, namespace, name, obj) -> dict:
+        with self._lock:
+            key = self._key(gv, plural, namespace, name)
+            cur = self._objs.get(key)
+            if cur is None:
+                raise ApiError(404, f"{plural}/{name} not found")
+            stored = json.loads(json.dumps(obj))
+            stored["metadata"]["name"] = name
+            stored["metadata"].setdefault("namespace", namespace)
+            # PUT on the main resource keeps status (status subresource).
+            if "status" in cur:
+                old_tmpl = (cur.get("spec") or {}).get("template")
+                stored["status"] = cur["status"]
+                # Emulate the controller-manager: template change restarts
+                # pods (see patch()).
+                if (plural == "statefulsets"
+                        and (stored.get("spec") or {}).get("template") != old_tmpl):
+                    stored["status"]["readyReplicas"] = 0
+            self._bump(stored)
+            self._objs[key] = stored
+            self._record("replace", gv, plural, namespace, name)
+            return json.loads(json.dumps(stored))
+
+    def delete(self, gv, plural, namespace, name) -> None:
+        with self._lock:
+            key = self._key(gv, plural, namespace, name)
+            obj = self._objs.get(key)
+            if obj is None:
+                return
+            self._record("delete", gv, plural, namespace, name)
+            if obj["metadata"].get("finalizers"):
+                obj["metadata"]["deletionTimestamp"] = "now"
+                self._bump(obj)
+            else:
+                del self._objs[key]
+
+    def _maybe_finish_delete(self, key) -> None:
+        obj = self._objs.get(key)
+        if (obj is not None and obj["metadata"].get("deletionTimestamp")
+                and not obj["metadata"].get("finalizers")):
+            del self._objs[key]

@@ -40,6 +40,7 @@ label (apps.kubernetes.io/pod-index).
 from __future__ import annotations
 
 import dataclasses
+import json
 
 from arks_tpu.control.resources import (
     Application, DisaggregatedApplication, Endpoint, LABEL_APPLICATION,
@@ -153,6 +154,125 @@ def render_model(model: Model, scripts_image: str = DEFAULT_SCRIPTS_IMAGE) -> li
 
 
 # ---------------------------------------------------------------------------
+# GangSet -> one group's StatefulSet + headless Service
+# (consumed by the live operator's K8sGangDriver — arks_tpu.control.live)
+# ---------------------------------------------------------------------------
+
+
+def render_group_from_gangset(gs, index: int, port: int = 8080,
+                              revision: str | None = None) -> tuple[dict, dict]:
+    """Render group ``index`` of a GangSet as (StatefulSet, Service).
+
+    The GangSet spec carries the already-compiled command (the controllers'
+    jax_serve_command output), plus image/accelerator/modelPvc; this
+    function owns the POD mechanics, kept consistent with the gitops
+    renderer below (_engine_container): TPU shape -> nodeSelector +
+    topology + google.com/tpu requests, models-PVC mount, the
+    jax.distributed env contract with per-pod process index, leader-only
+    readiness, and a group-independent revision annotation.
+    """
+    from arks_tpu.control.workloads import stable_hash
+
+    spec = gs.spec
+    shape = _shape(spec.get("accelerator", "cpu"))
+    group = f"arks-{gs.name}-{index}"
+    sel = {LABEL_MANAGED_BY: MANAGED_BY,
+           "arks.ai/gangset": gs.name, "arks.ai/group": str(index)}
+    size = spec.get("size", 1)
+    cmd = [c.replace("$(PORT)", str(port)) for c in spec["leader"]["command"]]
+    env = [{"name": k, "value": str(v)}
+           for k, v in sorted(spec.get("leader", {}).get("env", {}).items())]
+    env.append({"name": "ARKS_GANG_SIZE", "value": str(size)})
+    if size > 1:
+        env += [
+            # jax.distributed rendezvous: pod-index label -> process id.
+            {"name": "ARKS_COORDINATOR_ADDRESS",
+             "value": f"$(GROUP)-0.$(GROUP):8476"},
+            {"name": "ARKS_NUM_PROCESSES", "value": str(size)},
+            {"name": "ARKS_PROCESS_ID", "valueFrom": {"fieldRef": {
+                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}}},
+            # Dispatch-channel handshake secret: stable per GangSet so the
+            # revision hash is stable.  In-cluster it is as visible as any
+            # pod env; override via leader.env with a Secret-backed value
+            # where pod-spec visibility matters.
+            {"name": "ARKS_GANG_SECRET",
+             "value": stable_hash((gs.namespace, gs.name, "gang-secret"))},
+        ]
+    container = {
+        "name": "engine",
+        "image": spec.get("image", DEFAULT_IMAGE),
+        "command": cmd,
+        "env": env,
+        "ports": [{"containerPort": port, "name": "http"}],
+        "readinessProbe": {
+            "httpGet": {"path": "/readiness", "port": port},
+            "failureThreshold": 120, "periodSeconds": 5,
+        },
+    }
+    if shape.chips_per_host:
+        container["resources"] = {
+            "requests": {"google.com/tpu": str(shape.chips_per_host)},
+            "limits": {"google.com/tpu": str(shape.chips_per_host)},
+        }
+    pod: dict = {"subdomain": "$(GROUP)", "containers": [container]}
+    pvc = spec.get("modelPvc")
+    if pvc:
+        container["volumeMounts"] = [{"name": RESERVED_MODELS_VOLUME,
+                                      "mountPath": RESERVED_MODELS_PATH,
+                                      "readOnly": True}]
+        pod["volumes"] = [{"name": RESERVED_MODELS_VOLUME,
+                           "persistentVolumeClaim": {"claimName": pvc,
+                                                     "readOnly": True}}]
+    if shape.accelerator:
+        pod["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": shape.accelerator,
+            "cloud.google.com/gke-tpu-topology": shape.topology,
+        }
+    if revision is None:
+        # Group-independent: hash BEFORE substituting the group name (it
+        # feeds the coordinator address/subdomain).
+        revision = stable_hash(pod)
+    pod = json.loads(json.dumps(pod).replace("$(GROUP)", group))
+
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": _meta(group, gs.namespace, sel),
+        "spec": {
+            "serviceName": group,
+            "replicas": size,
+            "podManagementPolicy": "Parallel",
+            "updateStrategy": {"type": "RollingUpdate"},
+            "selector": {"matchLabels": sel},
+            "template": {
+                "metadata": {"labels": dict(sel),
+                             "annotations": {"arks.ai/revision": revision}},
+                "spec": pod,
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(group, gs.namespace, sel),
+        # publishNotReadyAddresses: per-pod DNS must exist BEFORE readiness
+        # — workers resolve the leader's coordinator address during
+        # jax.distributed init, and the leader only readies after init
+        # completes (LWS sets this for the same reason).
+        "spec": {"clusterIP": "None", "selector": sel,
+                 "publishNotReadyAddresses": True,
+                 "ports": [{"port": port, "name": "http"}]},
+    }
+    return sts, svc
+
+
+def gangset_revision(gs, port: int = 8080) -> str:
+    """The group-independent revision a current group must carry."""
+    sts, _ = render_group_from_gangset(gs, 0, port)
+    return sts["spec"]["template"]["metadata"]["annotations"]["arks.ai/revision"]
+
+
+# ---------------------------------------------------------------------------
 # Gang rendering (shared by Application and DisaggregatedApplication tiers)
 # ---------------------------------------------------------------------------
 
@@ -229,7 +349,10 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": _meta(group, namespace, sel),
+            # Pre-readiness per-pod DNS for the coordinator rendezvous
+            # (see render_group_from_gangset).
             "spec": {"clusterIP": "None", "selector": sel,
+                     "publishNotReadyAddresses": True,
                      "ports": [{"port": port, "name": "http"}]},
         })
         # Revision stamp over the FULL pod spec (same hash helper as the

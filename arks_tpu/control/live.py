@@ -1,0 +1,361 @@
+"""Live-operator mode: drive a real Kubernetes apiserver.
+
+The gitops path (arks_tpu.control.k8s_export) renders manifests once and
+walks away; nothing owns, repairs, or status-syncs the objects after
+``kubectl apply``.  This module is the missing half — the reference's
+controller-runtime process (/root/reference/cmd/main.go:255-301) rebuilt
+around this repo's existing controllers:
+
+- ``LiveOperator`` ingests the six arks.ai CRs from the apiserver into the
+  in-memory Store (spec is apiserver-authoritative), lets the UNCHANGED
+  controller set reconcile them, and projects Store status back through the
+  status subresource (status is controller-authoritative).  Deletion is
+  finalizer-gated end to end: the bridge stamps a finalizer on ingested
+  CRs, mirrors apiserver deletion into the Store (which runs teardown),
+  and strips the finalizer once the Store object is gone.
+- ``K8sGangDriver`` materializes GangSets as per-group StatefulSets +
+  headless Services (the LWS/RBGS role — SURVEY.md §1 external deps),
+  owns them (labels + revision annotations), repairs drift, sequences
+  cross-group rolling updates with the same pick_rolling_restart gating
+  the local drivers use, and reads group readiness back from StatefulSet
+  status.
+
+Polling model: list-based resync every ``interval_s`` (the watch-stream
+upgrade is an optimization, not a correctness need — controllers are
+level-triggered).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from arks_tpu.control import resources as res
+from arks_tpu.control.store import Conflict, NotFound, Store
+from arks_tpu.control.workloads import pick_rolling_restart
+
+log = logging.getLogger("arks_tpu.control.live")
+
+GV = "arks.ai/v1"
+FINALIZER = "live.arks.ai/operator"
+
+# (store kind, plural, wire Kind) — names match the reference CRDs
+# (/root/reference/config/crd/bases/).
+KINDS = [
+    (res.Model, "arksmodels", "ArksModel"),
+    (res.Application, "arksapplications", "ArksApplication"),
+    (res.DisaggregatedApplication, "arksdisaggregatedapplications",
+     "ArksDisaggregatedApplication"),
+    (res.Endpoint, "arksendpoints", "ArksEndpoint"),
+    (res.Token, "arkstokens", "ArksToken"),
+    (res.Quota, "arksquotas", "ArksQuota"),
+]
+
+
+# ---------------------------------------------------------------------------
+# Gang driver over the apps/v1 API
+# ---------------------------------------------------------------------------
+
+
+class K8sGangDriver:
+    """GangDriver that owns per-group StatefulSets on a real apiserver.
+
+    Rendering is delegated to k8s_export.render_group_from_gangset — ONE
+    pod renderer for the gitops and live paths (TPU shape mapping, models
+    PVC, jax.distributed env contract, probes) so they cannot drift.
+    Group naming matches the gitops renderer (``arks-<name>-<i>``), so a
+    cluster can migrate from rendered manifests to the live operator: the
+    operator takes ownership of the existing objects and — because the
+    gitops pod spec differs slightly (no gang secret env, app-level
+    container args) — converges them to its own revision via ONE sequenced
+    maxUnavailable=1 rolling pass, never a simultaneous restart.
+
+    Known limitation: disaggregated ROUTER gangs rely on the local-mode
+    discovery file the operator writes to its own filesystem; live-mode
+    routers need the label-selector service discovery (roadmap) — standalone
+    Applications are fully supported.
+    """
+
+    def __init__(self, api, serve_port: int = 8080):
+        self.api = api
+        self.serve_port = serve_port
+
+    def _render(self, gs, index: int) -> tuple[dict, dict]:
+        from arks_tpu.control.k8s_export import render_group_from_gangset
+        return render_group_from_gangset(gs, index, self.serve_port)
+
+    def _want_revision(self, gs) -> str:
+        from arks_tpu.control.k8s_export import gangset_revision
+        return gangset_revision(gs, self.serve_port)
+
+    # -- GangDriver ----------------------------------------------------
+
+    def _existing(self, gs) -> dict[int, dict]:
+        out = {}
+        for sts in self.api.list("apps/v1", "statefulsets", gs.namespace):
+            labels = sts["metadata"].get("labels", {})
+            if labels.get("arks.ai/gangset") == gs.name:
+                out[int(labels.get("arks.ai/group", -1))] = sts
+        return out
+
+    @staticmethod
+    def _revision(sts: dict) -> str:
+        return (sts["spec"]["template"]["metadata"].get("annotations", {})
+                .get("arks.ai/revision", ""))
+
+    @staticmethod
+    def _sts_ready(sts: dict) -> bool:
+        # readyReplicas >= 1, NOT >= size: /readiness is leader-only by
+        # design (worker processes return 503 so Services route to the
+        # leader — openai_server), so a healthy size-N gang always reports
+        # exactly one ready pod.
+        return sts.get("status", {}).get("readyReplicas", 0) >= 1
+
+    def ensure(self, gs) -> None:
+        existing = self._existing(gs)
+        replicas = gs.spec.get("replicas", 1)
+        want_rev = self._want_revision(gs)
+
+        # Create missing groups + headless services; adopt current ones.
+        for i in range(replicas):
+            sts, svc = self._render(gs, i)
+            name = sts["metadata"]["name"]
+            if self.api.get("v1", "services", gs.namespace, name) is None:
+                self.api.create("v1", "services", gs.namespace, svc)
+            if i not in existing:
+                self.api.create("apps/v1", "statefulsets", gs.namespace, sts)
+        # Scale down.
+        for i, sts in existing.items():
+            if i >= replicas:
+                name = sts["metadata"]["name"]
+                self.api.delete("apps/v1", "statefulsets", gs.namespace, name)
+                self.api.delete("v1", "services", gs.namespace, name)
+
+        # Cross-group rolling update: static manifests cannot sequence
+        # per-group StatefulSets; here the same maxUnavailable=1 gating as
+        # the local drivers updates ONE outdated group per reconcile.
+        current = {i: s for i, s in existing.items() if i < replicas}
+        hashes = {i: self._revision(s) for i, s in current.items()}
+        if hashes and not all(h == want_rev for h in hashes.values()):
+            ready = {i: self._sts_ready(s) for i, s in current.items()}
+            cand = pick_rolling_restart(hashes, want_rev, ready)
+            if cand is not None:
+                log.info("gang %s/%s group %d: rolling to revision %s",
+                         gs.namespace, gs.name, cand, want_rev)
+                desired, _ = self._render(gs, cand)
+                name = desired["metadata"]["name"]
+                cur = current[cand]
+                # REPLACE, not merge-patch: merge cannot remove keys (a
+                # dropped nodeSelector would silently survive while the
+                # revision annotation claimed the group was current).
+                desired["metadata"]["resourceVersion"] = (
+                    cur["metadata"].get("resourceVersion", ""))
+                self.api.replace("apps/v1", "statefulsets", gs.namespace,
+                                 name, desired)
+
+    def status(self, gs) -> dict:
+        existing = self._existing(gs)
+        replicas = gs.spec.get("replicas", 1)
+        groups = []
+        for i in range(replicas):
+            sts = existing.get(i)
+            group = f"arks-{gs.name}-{i}"
+            if sts is None:
+                groups.append({"index": i, "phase": "Pending", "leaderAddr": ""})
+                continue
+            # Readiness is revision-INDEPENDENT: a ready-but-outdated group
+            # still serves traffic, and gating readiness on the revision
+            # would empty the endpoint's backend list the instant a spec
+            # change lands (before any pod restarted).
+            phase = "Running" if self._sts_ready(sts) else (
+                "Starting" if sts.get("status", {}).get("readyReplicas", 0)
+                else "Pending")
+            addr = f"{group}-0.{group}.{gs.namespace}.svc:{self.serve_port}"
+            groups.append({"index": i, "phase": phase,
+                           "leaderAddr": addr if phase == "Running" else ""})
+        ready = sum(1 for g in groups if g["phase"] == "Running")
+        return {"replicas": replicas, "readyReplicas": ready, "groups": groups}
+
+    def teardown(self, gs) -> None:
+        for i, sts in self._existing(gs).items():
+            name = sts["metadata"]["name"]
+            self.api.delete("apps/v1", "statefulsets", gs.namespace, name)
+            self.api.delete("v1", "services", gs.namespace, name)
+
+
+# ---------------------------------------------------------------------------
+# CR <-> Store bridge
+# ---------------------------------------------------------------------------
+
+
+class LiveOperator:
+    """Runs the existing controller set against a real apiserver."""
+
+    def __init__(self, api, models_root: str, interval_s: float = 1.0,
+                 serve_port: int = 8080):
+        from arks_tpu.control.manager import build_manager
+
+        self.api = api
+        self.interval_s = interval_s
+        self.store = Store()
+        self.driver = K8sGangDriver(api, serve_port=serve_port)
+        self.manager = build_manager(models_root=models_root,
+                                     driver=self.driver, store=self.store)
+        self._running = False
+        self._thread: threading.Thread | None = None
+        # Last status we projected per (plural, ns, name) — avoids writing
+        # an unchanged status every poll.
+        self._projected: dict[tuple, dict] = {}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self.manager.start()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="live-sync",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.manager.stop()
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self.sync_once()
+            except Exception:
+                log.exception("live sync iteration failed")
+            time.sleep(self.interval_s)
+
+    # -- one sync pass -------------------------------------------------
+
+    def sync_once(self) -> None:
+        for kind, plural, wire_kind in KINDS:
+            try:
+                items = self.api.list(GV, plural)
+            except Exception:
+                log.exception("listing %s failed", plural)
+                continue
+            seen = set()
+            for cr in items:
+                meta = cr.get("metadata", {})
+                ns = meta.get("namespace", "default")
+                name = meta["name"]
+                seen.add((ns, name))
+                if meta.get("deletionTimestamp"):
+                    self._handle_cr_deletion(kind, plural, ns, name)
+                    continue
+                self._ensure_finalizer(plural, ns, name, meta)
+                self._ingest(kind, cr, ns, name)
+                self._project_status(kind, plural, ns, name, cr)
+            # CRs force-removed from the apiserver (finalizer bypassed)
+            # still tear down their store objects.
+            for obj in self.manager.store.list(kind):
+                if (obj.namespace, obj.name) not in seen:
+                    try:
+                        self.store.delete(kind, obj.name, obj.namespace)
+                    except NotFound:
+                        pass
+
+    def _ensure_finalizer(self, plural, ns, name, meta) -> None:
+        fins = meta.get("finalizers") or []
+        if FINALIZER not in fins:
+            self.api.patch(GV, plural, ns, name,
+                           {"metadata": {"finalizers": fins + [FINALIZER]}})
+
+    def _ingest(self, kind, cr: dict, ns: str, name: str) -> None:
+        spec = cr.get("spec", {})
+        labels = cr.get("metadata", {}).get("labels", {}) or {}
+        obj = self.store.try_get(kind, name, ns)
+        if obj is None:
+            self.store.create(kind(name=name, namespace=ns, labels=labels,
+                                   spec=spec))
+        elif obj.spec != spec or obj.labels != labels:
+            obj.spec = spec
+            obj.labels = labels
+            try:
+                self.store.update(obj)
+            except Conflict:
+                pass  # next poll retries against the fresh object
+
+    def _project_status(self, kind, plural, ns, name, cr: dict) -> None:
+        obj = self.store.try_get(kind, name, ns)
+        if obj is None or not obj.status:
+            return
+        key = (plural, ns, name)
+        if self._projected.get(key) == obj.status:
+            return
+        self.api.patch(GV, plural, ns, name, {"status": obj.status},
+                       subresource="status")
+        self._projected[key] = {k: v for k, v in obj.status.items()}
+
+    def _handle_cr_deletion(self, kind, plural, ns, name) -> None:
+        obj = self.store.try_get(kind, name, ns)
+        if obj is not None and not obj.deletion_requested:
+            try:
+                self.store.delete(kind, name, ns)
+            except NotFound:
+                pass
+            return
+        if obj is None:
+            # Store teardown finished (finalizers ran) — release the CR.
+            cr = self.api.get(GV, plural, ns, name)
+            if cr is not None:
+                fins = [f for f in cr["metadata"].get("finalizers", [])
+                        if f != FINALIZER]
+                self.api.patch(GV, plural, ns, name,
+                               {"metadata": {"finalizers": fins}})
+                self._projected.pop((plural, ns, name), None)
+
+
+def main() -> None:
+    import argparse
+
+    from arks_tpu.control.k8s_client import KubeApi
+
+    p = argparse.ArgumentParser("arks_tpu.control.live")
+    p.add_argument("--models-root", default="/models")
+    p.add_argument("--kube-api", default=None,
+                   help="apiserver URL (default: in-cluster config)")
+    p.add_argument("--kube-token-file", default=None)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--gateway-port", type=int, default=8081,
+                   help="embedded QoS gateway over the live store (0 = off) "
+                        "— ArksToken/Quota/Endpoint CRs gate traffic here")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.kube_api:
+        token = None
+        if args.kube_token_file:
+            with open(args.kube_token_file) as f:
+                token = f.read().strip()
+        api = KubeApi(args.kube_api, token=token, verify=False)
+    else:
+        api = KubeApi.in_cluster()
+    op = LiveOperator(api, models_root=args.models_root,
+                      interval_s=args.interval)
+    op.start()
+    gw = None
+    if args.gateway_port:
+        from arks_tpu.gateway.server import Gateway
+        gw = Gateway(op.store, host="0.0.0.0", port=args.gateway_port)
+        gw.start(background=True)
+    log.info("live operator running (interval=%.1fs, gateway=%s)",
+             args.interval, args.gateway_port or "off")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        if gw is not None:
+            gw.stop()
+        op.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,9 @@ def spec_hash(gs: GangSet) -> str:
     for the rolling update.  ``replicas`` is deliberately excluded — scaling
     must not restart existing groups."""
     return stable_hash({k: gs.spec.get(k)
-                        for k in ("size", "leader", "worker", "ports", "runtime")})
+                        for k in ("size", "leader", "worker", "ports",
+                                  "runtime", "image", "accelerator",
+                                  "modelPvc")})
 
 
 def pick_rolling_restart(hashes: dict[int, str], want_hash: str,
