@@ -94,6 +94,18 @@ class ApplicationController(Controller):
             app.status["phase"] = PHASE_FAILED
             self._sync(app, status_before)
             return None
+        # Reserved-name + pod-group validation (reference precheck :236-264
+        # rejects the reserved 'models' volume; PodGroupPolicy is one-of).
+        from arks_tpu.control.k8s_export import (
+            validate_instance_spec, validate_pod_group_policy)
+        try:
+            validate_instance_spec(app.spec.get("instanceSpec"))
+            validate_pod_group_policy(app.spec.get("podGroupPolicy"))
+        except ValueError as e:
+            app.set_condition(COND_PRECHECK, False, "InvalidSpec", str(e))
+            app.status["phase"] = PHASE_FAILED
+            self._sync(app, status_before)
+            return None
         app.set_condition(COND_PRECHECK, True, "PrecheckPassed", "")
         if app.status["phase"] == PHASE_PENDING:
             app.status["phase"] = PHASE_CHECKING
@@ -194,6 +206,13 @@ class ApplicationController(Controller):
             "accelerator": app.spec.get("accelerator", "cpu"),
             "modelPvc": (model.spec.get("storage") or {}).get("pvc")
             or "models",
+            # Pod-spec passthrough + gang scheduling, consumed by the K8s
+            # renderer (reference: InstanceSpec arksapplication_types.go:
+            # 80-250, PodGroupPolicy utils.go:9-26).
+            **({"instanceSpec": app.spec["instanceSpec"]}
+               if app.spec.get("instanceSpec") else {}),
+            **({"podGroupPolicy": app.spec["podGroupPolicy"]}
+               if app.spec.get("podGroupPolicy") else {}),
         }
 
     def _ensure_service(self, app: Application) -> None:

@@ -101,6 +101,18 @@ class DisaggregatedApplicationController(Controller):
             app.status["phase"] = PHASE_FAILED
             self._sync(app, status_before)
             return None
+        from arks_tpu.control.k8s_export import (
+            validate_instance_spec, validate_pod_group_policy)
+        try:
+            validate_pod_group_policy(app.spec.get("podGroupPolicy"))
+            for section in ("prefill", "decode", "router"):
+                validate_instance_spec(
+                    (app.spec.get(section) or {}).get("instanceSpec"))
+        except ValueError as e:
+            app.set_condition(COND_PRECHECK, False, "InvalidSpec", str(e))
+            app.status["phase"] = PHASE_FAILED
+            self._sync(app, status_before)
+            return None
         app.set_condition(COND_PRECHECK, True, "PrecheckPassed", "")
         if app.status["phase"] == PHASE_PENDING:
             app.status["phase"] = PHASE_CHECKING
@@ -202,6 +214,10 @@ class DisaggregatedApplicationController(Controller):
                                   app.spec.get("accelerator", "cpu")),
             "modelPvc": (model.spec.get("storage") or {}).get("pvc")
             or "models",  # shared operator claim (see application_controller)
+            **({"instanceSpec": ws["instanceSpec"]}
+               if ws.get("instanceSpec") else {}),
+            **({"podGroupPolicy": app.spec["podGroupPolicy"]}
+               if app.spec.get("podGroupPolicy") else {}),
         }
 
     def _router_spec(self, app: DisaggregatedApplication) -> dict:
@@ -210,7 +226,10 @@ class DisaggregatedApplicationController(Controller):
         cmd = [sys.executable, "-m", "arks_tpu.router",
                "--port", "$(PORT)",
                "--served-model-name", served,
-               "--discovery-file", self._discovery_path(app)]
+               "--discovery-file", self._discovery_path(app),
+               # RouterArgs passthrough (reference:
+               # arksdisaggregatedapplication_types.go:69-84).
+               *[str(a) for a in rs.get("routerArgs", [])]]
         return {
             "replicas": rs.get("replicas", 1),
             "size": 1,
@@ -223,6 +242,8 @@ class DisaggregatedApplicationController(Controller):
             "image": rs.get("runtimeImage",
                             app.spec.get("runtimeImage", "arks-tpu/engine:latest")),
             "accelerator": "cpu",
+            **({"instanceSpec": rs["instanceSpec"]}
+               if rs.get("instanceSpec") else {}),
         }
 
     def _ensure_gangset(self, app: DisaggregatedApplication, model: Model,

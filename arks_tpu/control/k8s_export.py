@@ -39,6 +39,7 @@ label (apps.kubernetes.io/pod-index).
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import json
 
@@ -72,6 +73,168 @@ TPU_SHAPES: dict[str, TpuTopology] = {
 
 DEFAULT_IMAGE = "arks-tpu/engine:latest"
 DEFAULT_SCRIPTS_IMAGE = "arks-tpu/engine:latest"
+
+# ---------------------------------------------------------------------------
+# InstanceSpec passthrough (reference: ArksInstanceSpec,
+# api/v1/arksapplication_types.go:80-250 — the ~35-field pod-spec channel
+# every workload-bearing CRD embeds).  Fields are grouped by where they land:
+# engine container, pod spec, or pod template metadata.
+# ---------------------------------------------------------------------------
+
+# Copied verbatim onto the engine container when present.
+_INSTANCE_CONTAINER_FIELDS = (
+    "livenessProbe", "readinessProbe", "startupProbe", "lifecycle",
+    "securityContext",
+)
+
+# Copied verbatim onto the pod spec when present.
+_INSTANCE_POD_FIELDS = (
+    "affinity", "tolerations", "schedulerName", "serviceAccountName",
+    "priorityClassName", "priority", "terminationGracePeriodSeconds",
+    "activeDeadlineSeconds", "dnsPolicy", "dnsConfig", "hostNetwork",
+    "hostPID", "hostIPC", "shareProcessNamespace",
+    "automountServiceAccountToken", "nodeName", "hostAliases",
+    "runtimeClassName", "enableServiceLinks", "preemptionPolicy", "overhead",
+    "topologySpreadConstraints", "setHostnameAsFQDN", "os", "hostUsers",
+    "schedulingGates", "resourceClaims", "initContainers",
+)
+
+# Env names the renderer owns — user env may not shadow the rendezvous
+# contract (a wrong ARKS_PROCESS_ID would scramble the gang).
+_RESERVED_ENV = {"ARKS_COORDINATOR_ADDRESS", "ARKS_NUM_PROCESSES",
+                 "ARKS_PROCESS_ID", "ARKS_GANG_SIZE", "ARKS_GANG_SECRET"}
+
+
+def validate_instance_spec(inst: dict | None) -> None:
+    """Reserved-name precheck (reference precheck :236-264: the 'models'
+    volume / '/models' mount belong to ArksModel)."""
+    if not inst:
+        return
+    for v in inst.get("volumes") or []:
+        if v.get("name") == RESERVED_MODELS_VOLUME:
+            raise ValueError(
+                f"instanceSpec volume name {RESERVED_MODELS_VOLUME!r} is "
+                "reserved for the model mount")
+    for vm in inst.get("volumeMounts") or []:
+        if vm.get("mountPath") == RESERVED_MODELS_PATH:
+            raise ValueError(
+                f"instanceSpec mountPath {RESERVED_MODELS_PATH!r} is "
+                "reserved for the model mount")
+    for e in inst.get("env") or []:
+        if e.get("name") in _RESERVED_ENV:
+            raise ValueError(
+                f"instanceSpec env {e.get('name')!r} is reserved for the "
+                "gang rendezvous contract")
+
+
+def apply_instance_spec(pod_spec: dict, container: dict,
+                        inst: dict | None) -> tuple[dict, dict]:
+    """Merge an instanceSpec into (pod_spec, container) in place.
+
+    Returns (extra_labels, extra_annotations) for the pod template metadata.
+    Generated fields win where they are load-bearing (TPU chip requests,
+    rendezvous env, models mount); user fields win for probes and
+    scheduling knobs the renderer only defaults.
+    """
+    if not inst:
+        return {}, {}
+    validate_instance_spec(inst)
+
+    if inst.get("env"):
+        container["env"] = container.get("env", []) + [dict(e) for e in inst["env"]]
+    if inst.get("volumeMounts"):
+        container["volumeMounts"] = (container.get("volumeMounts", [])
+                                     + [dict(m) for m in inst["volumeMounts"]])
+    if inst.get("volumes"):
+        pod_spec["volumes"] = (pod_spec.get("volumes", [])
+                               + [dict(v) for v in inst["volumes"]])
+    if inst.get("resources"):
+        # User resources first, then re-overlay the TPU chip request — the
+        # accelerator shape, not the user, owns google.com/tpu.
+        merged = {k: dict(v) for k, v in inst["resources"].items()}
+        for bucket, vals in (container.get("resources") or {}).items():
+            merged.setdefault(bucket, {}).update(
+                {k: v for k, v in vals.items() if k == "google.com/tpu"})
+        container["resources"] = merged
+    for f in _INSTANCE_CONTAINER_FIELDS:
+        if f in inst:
+            container[f] = copy.deepcopy(inst[f])
+    for f in _INSTANCE_POD_FIELDS:
+        if f in inst:
+            pod_spec[f] = copy.deepcopy(inst[f])
+    if inst.get("nodeSelector"):
+        # User selector merges under the TPU selector (TPU keys win).
+        pod_spec["nodeSelector"] = {**inst["nodeSelector"],
+                                    **pod_spec.get("nodeSelector", {})}
+    return dict(inst.get("labels") or {}), dict(inst.get("annotations") or {})
+
+
+# ---------------------------------------------------------------------------
+# Gang scheduling (reference: PodGroupPolicy,
+# api/v1/arksdisaggregatedapplication_types.go:27-67 +
+# internal/controller/utils.go:9-26).  A slice gang of ``size`` hosts is
+# all-or-nothing: render a PodGroup (kube scheduler-plugins coscheduling or
+# Volcano) with minMember = size and stamp the pod markers each plugin keys
+# on.
+# ---------------------------------------------------------------------------
+
+PODGROUP_LABEL_COSCHED = "scheduling.x-k8s.io/pod-group"
+PODGROUP_ANNOTATION_VOLCANO = "scheduling.k8s.io/group-name"
+
+
+def validate_pod_group_policy(policy: dict | None) -> None:
+    if not policy:
+        return
+    srcs = [k for k in ("kubeScheduling", "volcanoScheduling") if policy.get(k) is not None]
+    if len(srcs) != 1:
+        raise ValueError(
+            "podGroupPolicy must set exactly one of kubeScheduling / "
+            f"volcanoScheduling (got {srcs or 'neither'})")
+
+
+def apply_pod_group_policy(pod_spec: dict, group: str,
+                           policy: dict | None) -> tuple[dict, dict]:
+    """Stamp per-pod gang markers; returns (extra_labels, extra_annotations)
+    for the pod template metadata."""
+    if not policy:
+        return {}, {}
+    validate_pod_group_policy(policy)
+    if policy.get("kubeScheduling") is not None:
+        return {PODGROUP_LABEL_COSCHED: group}, {}
+    pod_spec["schedulerName"] = pod_spec.get("schedulerName") or "volcano"
+    return {}, {PODGROUP_ANNOTATION_VOLCANO: group}
+
+
+def render_podgroup(group: str, namespace: str, policy: dict | None,
+                    min_member: int, labels: dict | None = None) -> dict | None:
+    """The PodGroup object for one gang group (minMember = gang size)."""
+    if not policy:
+        return None
+    validate_pod_group_policy(policy)
+    if policy.get("kubeScheduling") is not None:
+        ks = policy["kubeScheduling"] or {}
+        return {
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+            "kind": "PodGroup",
+            "metadata": _meta(group, namespace, labels),
+            "spec": {
+                "minMember": min_member,
+                # Reference default 60s (arksdisaggregatedapplication_types.go:50-53).
+                "scheduleTimeoutSeconds": ks.get("scheduleTimeoutSeconds", 60),
+            },
+        }
+    vs = policy["volcanoScheduling"] or {}
+    spec: dict = {"minMember": min_member}
+    if vs.get("queue"):
+        spec["queue"] = vs["queue"]
+    if vs.get("priorityClassName"):
+        spec["priorityClassName"] = vs["priorityClassName"]
+    return {
+        "apiVersion": "scheduling.volcano.sh/v1beta1",
+        "kind": "PodGroup",
+        "metadata": _meta(group, namespace, labels),
+        "spec": spec,
+    }
 
 
 def _meta(name: str, namespace: str, labels: dict | None = None) -> dict:
@@ -228,10 +391,23 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
             "cloud.google.com/gke-tpu-accelerator": shape.accelerator,
             "cloud.google.com/gke-tpu-topology": shape.topology,
         }
+    # InstanceSpec passthrough + gang-scheduling markers (controllers copy
+    # the app's spec.instanceSpec / spec.podGroupPolicy into the GangSet).
+    il, ia = apply_instance_spec(pod, container, spec.get("instanceSpec"))
+    pl, pa = apply_pod_group_policy(pod, group, spec.get("podGroupPolicy"))
+    extra_labels = {**il, **pl}
+    extra_annotations = {**ia, **pa}
     if revision is None:
         # Group-independent: hash BEFORE substituting the group name (it
-        # feeds the coordinator address/subdomain).
-        revision = stable_hash(pod)
+        # feeds the coordinator address/subdomain; pod-group markers are
+        # group-NAMED, so hash the policy input rather than the stamped
+        # label value).  Specs without the new fields keep the legacy hash
+        # input — an operator upgrade must not re-revision (and roll) every
+        # unchanged gang in the fleet.
+        if il or ia or spec.get("podGroupPolicy"):
+            revision = stable_hash((pod, il, ia, spec.get("podGroupPolicy")))
+        else:
+            revision = stable_hash(pod)
     pod = json.loads(json.dumps(pod).replace("$(GROUP)", group))
 
     sts = {
@@ -245,8 +421,9 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
             "updateStrategy": {"type": "RollingUpdate"},
             "selector": {"matchLabels": sel},
             "template": {
-                "metadata": {"labels": dict(sel),
-                             "annotations": {"arks.ai/revision": revision}},
+                "metadata": {"labels": {**sel, **extra_labels},
+                             "annotations": {"arks.ai/revision": revision,
+                                             **extra_annotations}},
                 "spec": pod,
             },
         },
@@ -270,6 +447,16 @@ def gangset_revision(gs, port: int = 8080) -> str:
     """The group-independent revision a current group must carry."""
     sts, _ = render_group_from_gangset(gs, 0, port)
     return sts["spec"]["template"]["metadata"]["annotations"]["arks.ai/revision"]
+
+
+def render_podgroup_from_gangset(gs, index: int) -> dict | None:
+    """The gang-scheduling PodGroup for group ``index`` (None if the
+    GangSet carries no podGroupPolicy)."""
+    group = f"arks-{gs.name}-{index}"
+    sel = {LABEL_MANAGED_BY: MANAGED_BY,
+           "arks.ai/gangset": gs.name, "arks.ai/group": str(index)}
+    return render_podgroup(group, gs.namespace, gs.spec.get("podGroupPolicy"),
+                           min_member=gs.spec.get("size", 1), labels=sel)
 
 
 # ---------------------------------------------------------------------------
@@ -345,6 +532,17 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
                 "cloud.google.com/gke-tpu-accelerator": shape.accelerator,
                 "cloud.google.com/gke-tpu-topology": shape.topology,
             }
+        # InstanceSpec passthrough + gang-scheduling markers.
+        il, ia = apply_instance_spec(pod_spec, container,
+                                     spec.get("instanceSpec"))
+        pl, pa = apply_pod_group_policy(pod_spec, group,
+                                        spec.get("podGroupPolicy"))
+        extra_labels = {**il, **pl}
+        extra_annotations = {**ia, **pa}
+        pg = render_podgroup(group, namespace, spec.get("podGroupPolicy"),
+                             min_member=shape.hosts, labels=sel)
+        if pg is not None:
+            docs.append(pg)
         docs.append({
             "apiVersion": "v1",
             "kind": "Service",
@@ -358,9 +556,13 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
         # Revision stamp over the FULL pod spec (same hash helper as the
         # gang drivers): nodeSelector/volume changes count as new revisions
         # too.  Rollout tooling and the live-operator mode compare this to
-        # tell outdated groups from current ones.
+        # tell outdated groups from current ones.  Legacy hash input when no
+        # instanceSpec/podGroup extras exist (upgrade stability).
         from arks_tpu.control.workloads import stable_hash
-        revision = stable_hash(pod_spec)
+        if extra_labels or extra_annotations:
+            revision = stable_hash((pod_spec, extra_labels, extra_annotations))
+        else:
+            revision = stable_hash(pod_spec)
         docs.append({
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
@@ -384,8 +586,9 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
                 "updateStrategy": {"type": "RollingUpdate"},
                 "selector": {"matchLabels": sel},
                 "template": {
-                    "metadata": {"labels": dict(sel),
-                                 "annotations": {"arks.ai/revision": revision}},
+                    "metadata": {"labels": {**sel, **extra_labels},
+                                 "annotations": {"arks.ai/revision": revision,
+                                                 **extra_annotations}},
                     "spec": pod_spec,
                 },
             },
@@ -464,6 +667,26 @@ def render_disaggregated(dapp: DisaggregatedApplication,
     router = spec.get("router") or {}
     rport = router.get("port", port)
     rlabels = {LABEL_APPLICATION: dapp.name, LABEL_COMPONENT: "router"}
+    rcontainer = {
+        "name": "router",
+        "image": router.get("image", DEFAULT_IMAGE),
+        "command": ["python"],
+        "args": ["-m", "arks_tpu.router",
+                 "--port", str(rport),
+                 "--served-model-name", served,
+                 *[str(a) for a in router.get("routerArgs", [])]],
+        "env": [
+            {"name": "ARKS_PREFILL_ADDRS", "value": tiers["prefill"]},
+            {"name": "ARKS_DECODE_ADDRS", "value": tiers["decode"]},
+        ],
+        "ports": [{"containerPort": rport, "name": "http"}],
+        "readinessProbe": {
+            "httpGet": {"path": "/readiness", "port": rport},
+            "failureThreshold": 120, "periodSeconds": 5,
+        },
+    }
+    rpod: dict = {"containers": [rcontainer]}
+    ril, ria = apply_instance_spec(rpod, rcontainer, router.get("instanceSpec"))
     docs.append({
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -472,24 +695,9 @@ def render_disaggregated(dapp: DisaggregatedApplication,
             "replicas": router.get("replicas", 1),
             "selector": {"matchLabels": rlabels},
             "template": {
-                "metadata": {"labels": dict(rlabels)},
-                "spec": {"containers": [{
-                    "name": "router",
-                    "image": router.get("image", DEFAULT_IMAGE),
-                    "command": ["python"],
-                    "args": ["-m", "arks_tpu.router",
-                             "--port", str(rport),
-                             "--served-model-name", served],
-                    "env": [
-                        {"name": "ARKS_PREFILL_ADDRS", "value": tiers["prefill"]},
-                        {"name": "ARKS_DECODE_ADDRS", "value": tiers["decode"]},
-                    ],
-                    "ports": [{"containerPort": rport, "name": "http"}],
-                    "readinessProbe": {
-                        "httpGet": {"path": "/readiness", "port": rport},
-                        "failureThreshold": 120, "periodSeconds": 5,
-                    },
-                }]},
+                "metadata": {"labels": {**rlabels, **ril},
+                             **({"annotations": ria} if ria else {})},
+                "spec": rpod,
             },
         },
     })

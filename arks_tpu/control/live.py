@@ -39,6 +39,8 @@ log = logging.getLogger("arks_tpu.control.live")
 
 GV = "arks.ai/v1"
 FINALIZER = "live.arks.ai/operator"
+PODGROUP_FLAVORS = ("scheduling.x-k8s.io/v1alpha1",
+                    "scheduling.volcano.sh/v1beta1")
 
 # (store kind, plural, wire Kind) — names match the reference CRDs
 # (/root/reference/config/crd/bases/).
@@ -117,20 +119,24 @@ class K8sGangDriver:
         replicas = gs.spec.get("replicas", 1)
         want_rev = self._want_revision(gs)
 
-        # Create missing groups + headless services; adopt current ones.
+        # Create missing groups + headless services (and their gang
+        # PodGroups, when a podGroupPolicy asks for one); adopt current ones.
         for i in range(replicas):
             sts, svc = self._render(gs, i)
             name = sts["metadata"]["name"]
             if self.api.get("v1", "services", gs.namespace, name) is None:
                 self.api.create("v1", "services", gs.namespace, svc)
+            self._ensure_podgroup(gs, i, name)
             if i not in existing:
                 self.api.create("apps/v1", "statefulsets", gs.namespace, sts)
-        # Scale down.
+        # Scale down (the group's PodGroups go with it, whatever flavor).
         for i, sts in existing.items():
             if i >= replicas:
                 name = sts["metadata"]["name"]
                 self.api.delete("apps/v1", "statefulsets", gs.namespace, name)
                 self.api.delete("v1", "services", gs.namespace, name)
+                for gv in PODGROUP_FLAVORS:
+                    self.api.delete(gv, "podgroups", gs.namespace, name)
 
         # Cross-group rolling update: static manifests cannot sequence
         # per-group StatefulSets; here the same maxUnavailable=1 gating as
@@ -153,6 +159,35 @@ class K8sGangDriver:
                     cur["metadata"].get("resourceVersion", ""))
                 self.api.replace("apps/v1", "statefulsets", gs.namespace,
                                  name, desired)
+
+    def _ensure_podgroup(self, gs, index: int, name: str) -> None:
+        """Converge both PodGroup flavors for one group: the rendered one is
+        created or replaced on drift; the other (policy removed or flavor
+        switched) is deleted — but only when it actually exists, so steady
+        state costs reads, not blind writes."""
+        from arks_tpu.control.k8s_export import render_podgroup_from_gangset
+        pg = render_podgroup_from_gangset(gs, index)
+        for gv in PODGROUP_FLAVORS:
+            cur = self.api.get(gv, "podgroups", gs.namespace, name)
+            if pg is not None and gv == pg["apiVersion"]:
+                if cur is None:
+                    self.api.create(gv, "podgroups", gs.namespace, pg)
+                elif cur.get("spec") != pg["spec"]:
+                    # REPLACE, not merge-patch: a dropped optional key
+                    # (volcano queue/priorityClassName) must actually go
+                    # away, or the spec comparison never converges and the
+                    # stale key keeps steering the scheduler.  A stale
+                    # minMember above the real gang size would deadlock
+                    # scheduling forever.
+                    desired = dict(pg)
+                    desired["metadata"] = {
+                        **pg["metadata"],
+                        "resourceVersion": cur["metadata"].get(
+                            "resourceVersion", "")}
+                    self.api.replace(gv, "podgroups", gs.namespace, name,
+                                     desired)
+            elif cur is not None:
+                self.api.delete(gv, "podgroups", gs.namespace, name)
 
     def status(self, gs) -> dict:
         existing = self._existing(gs)
@@ -182,6 +217,10 @@ class K8sGangDriver:
             name = sts["metadata"]["name"]
             self.api.delete("apps/v1", "statefulsets", gs.namespace, name)
             self.api.delete("v1", "services", gs.namespace, name)
+            # Unconditional: a policy REMOVED from the spec must not orphan
+            # PodGroups created under the old spec.
+            for gv in PODGROUP_FLAVORS:
+                self.api.delete(gv, "podgroups", gs.namespace, name)
 
 
 # ---------------------------------------------------------------------------
@@ -322,6 +361,11 @@ def main() -> None:
     p.add_argument("--kube-api", default=None,
                    help="apiserver URL (default: in-cluster config)")
     p.add_argument("--kube-token-file", default=None)
+    p.add_argument("--kube-ca", default=None,
+                   help="CA bundle for --kube-api TLS verification")
+    p.add_argument("--insecure-skip-tls-verify", action="store_true",
+                   help="disable apiserver TLS verification (dev only — "
+                        "the bearer token rides this connection)")
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--gateway-port", type=int, default=8081,
                    help="embedded QoS gateway over the live store (0 = off) "
@@ -335,7 +379,8 @@ def main() -> None:
         if args.kube_token_file:
             with open(args.kube_token_file) as f:
                 token = f.read().strip()
-        api = KubeApi(args.kube_api, token=token, verify=False)
+        api = KubeApi(args.kube_api, token=token, ca_file=args.kube_ca,
+                      verify=not args.insecure_skip_tls_verify)
     else:
         api = KubeApi.in_cluster()
     op = LiveOperator(api, models_root=args.models_root,

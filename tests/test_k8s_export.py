@@ -169,3 +169,147 @@ def test_cli_renders_quickstart():
     docs = list(yaml.safe_load_all(out.stdout))
     assert any(d["kind"] == "StatefulSet" for d in docs)
     assert any(d["kind"] == "HTTPRoute" for d in docs)
+
+
+# ---------------------------------------------------------------------------
+# InstanceSpec passthrough + gang scheduling
+# ---------------------------------------------------------------------------
+
+
+def _inst_spec():
+    return {
+        "env": [{"name": "HF_HOME", "value": "/tmp/hf"}],
+        "resources": {"requests": {"memory": "100Gi"},
+                      "limits": {"memory": "120Gi"}},
+        "labels": {"team": "a"},
+        "annotations": {"example.com/note": "x"},
+        "volumes": [{"name": "scratch", "emptyDir": {}}],
+        "volumeMounts": [{"name": "scratch", "mountPath": "/scratch"}],
+        "nodeSelector": {"pool": "tpu"},
+        "tolerations": [{"key": "google.com/tpu", "operator": "Exists"}],
+        "initContainers": [{"name": "warmup", "image": "busybox",
+                            "command": ["true"]}],
+        "livenessProbe": {"httpGet": {"path": "/health", "port": 8080}},
+        "serviceAccountName": "arks-engine",
+        "terminationGracePeriodSeconds": 30,
+    }
+
+
+def test_instance_spec_passthrough():
+    app = _app()
+    app.spec["instanceSpec"] = _inst_spec()
+    ss = [d for d in render_application(app) if d["kind"] == "StatefulSet"][0]
+    tmpl = ss["spec"]["template"]
+    pod = tmpl["spec"]
+    c = pod["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["HF_HOME"] == "/tmp/hf"
+    # User resources merged, TPU chips still owned by the accelerator shape.
+    assert c["resources"]["requests"]["memory"] == "100Gi"
+    assert c["resources"]["requests"]["google.com/tpu"] == "4"
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    # Volumes appended after the reserved models volume.
+    assert [v["name"] for v in pod["volumes"]] == ["models", "scratch"]
+    assert {"name": "scratch", "mountPath": "/scratch"} in c["volumeMounts"]
+    # TPU nodeSelector keys win over user selector; user keys survive.
+    assert pod["nodeSelector"]["pool"] == "tpu"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+    assert pod["tolerations"][0]["key"] == "google.com/tpu"
+    assert pod["initContainers"][0]["name"] == "warmup"
+    assert pod["serviceAccountName"] == "arks-engine"
+    assert pod["terminationGracePeriodSeconds"] == 30
+    assert c["livenessProbe"]["httpGet"]["path"] == "/health"
+    assert tmpl["metadata"]["labels"]["team"] == "a"
+    assert tmpl["metadata"]["annotations"]["example.com/note"] == "x"
+
+
+def test_instance_spec_reserved_names_rejected():
+    import pytest
+
+    from arks_tpu.control.k8s_export import validate_instance_spec
+    with pytest.raises(ValueError, match="reserved"):
+        validate_instance_spec({"volumes": [{"name": "models"}]})
+    with pytest.raises(ValueError, match="reserved"):
+        validate_instance_spec(
+            {"volumeMounts": [{"name": "x", "mountPath": "/models"}]})
+    with pytest.raises(ValueError, match="reserved"):
+        validate_instance_spec(
+            {"env": [{"name": "ARKS_PROCESS_ID", "value": "7"}]})
+
+
+def test_instance_spec_changes_revision():
+    plain = [d for d in render_application(_app())
+             if d["kind"] == "StatefulSet"][0]
+    app = _app()
+    app.spec["instanceSpec"] = {"env": [{"name": "A", "value": "1"}]}
+    changed = [d for d in render_application(app)
+               if d["kind"] == "StatefulSet"][0]
+    rev = lambda s: s["spec"]["template"]["metadata"]["annotations"]["arks.ai/revision"]  # noqa: E731
+    assert rev(plain) != rev(changed)
+
+
+def test_pod_group_policy_kube_scheduling():
+    app = _app()
+    app.spec["podGroupPolicy"] = {"kubeScheduling": {}}
+    docs = render_application(app)
+    pgs = [d for d in docs if d["kind"] == "PodGroup"]
+    assert len(pgs) == 2  # one per replica gang
+    shape = TPU_SHAPES["tpu-v5e-16"]
+    for pg in pgs:
+        assert pg["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+        assert pg["spec"]["minMember"] == shape.hosts  # all-or-nothing slice
+        assert pg["spec"]["scheduleTimeoutSeconds"] == 60  # reference default
+    ss = [d for d in docs if d["kind"] == "StatefulSet"][0]
+    labels = ss["spec"]["template"]["metadata"]["labels"]
+    assert labels["scheduling.x-k8s.io/pod-group"] == ss["metadata"]["name"]
+
+
+def test_pod_group_policy_volcano():
+    app = _app(replicas=1)
+    app.spec["podGroupPolicy"] = {"volcanoScheduling": {
+        "queue": "tpu-high", "priorityClassName": "prod"}}
+    docs = render_application(app)
+    pg = [d for d in docs if d["kind"] == "PodGroup"][0]
+    assert pg["apiVersion"] == "scheduling.volcano.sh/v1beta1"
+    assert pg["spec"]["queue"] == "tpu-high"
+    assert pg["spec"]["priorityClassName"] == "prod"
+    ss = [d for d in docs if d["kind"] == "StatefulSet"][0]
+    tmpl = ss["spec"]["template"]
+    assert tmpl["spec"]["schedulerName"] == "volcano"
+    assert tmpl["metadata"]["annotations"]["scheduling.k8s.io/group-name"] \
+        == ss["metadata"]["name"]
+
+
+def test_pod_group_policy_one_of():
+    import pytest
+
+    from arks_tpu.control.k8s_export import validate_pod_group_policy
+    with pytest.raises(ValueError, match="exactly one"):
+        validate_pod_group_policy({"kubeScheduling": {},
+                                   "volcanoScheduling": {}})
+    with pytest.raises(ValueError, match="exactly one"):
+        validate_pod_group_policy({"unknown": {}})
+
+
+def test_disagg_tier_instance_spec_and_router_args():
+    dapp = DisaggregatedApplication(name="pd", namespace="team-a", spec={
+        "runtime": "jax", "model": {"name": "qwen25"},
+        "servedModelName": "qwen2.5-7b", "modelConfig": "qwen2.5-7b",
+        "prefill": {"replicas": 1, "accelerator": "tpu-v5e-8",
+                    "instanceSpec": {"labels": {"tier": "prefill"}}},
+        "decode": {"replicas": 1, "accelerator": "tpu-v5e-8"},
+        "router": {"replicas": 1, "routerArgs": ["--policy", "cache_aware"],
+                   "instanceSpec": {"env": [{"name": "RUST_LOG",
+                                             "value": "info"}]}},
+    })
+    docs = render_disaggregated(dapp)
+    prefill = [d for d in docs if d["kind"] == "StatefulSet"
+               and "prefill" in d["metadata"]["name"]][0]
+    assert prefill["spec"]["template"]["metadata"]["labels"]["tier"] == "prefill"
+    decode = [d for d in docs if d["kind"] == "StatefulSet"
+              and "decode" in d["metadata"]["name"]][0]
+    assert "tier" not in decode["spec"]["template"]["metadata"]["labels"]
+    router = [d for d in docs if d["kind"] == "Deployment"][0]
+    rc = router["spec"]["template"]["spec"]["containers"][0]
+    assert {"name": "RUST_LOG", "value": "info"} in rc["env"]
+    assert "cache_aware" in rc["args"]

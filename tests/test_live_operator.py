@@ -193,3 +193,68 @@ def test_force_removed_cr_tears_down(live):
     api.delete(GV, "arksapplications", "default", "app1")
     assert api.get(GV, "arksapplications", "default", "app1") is None
     wait_for(lambda: _sts_names(api) == [])
+
+
+def test_live_instance_spec_and_podgroup(live):
+    """instanceSpec flows from the CR into live-rendered pods, and a
+    podGroupPolicy yields a PodGroup with minMember = gang size plus the
+    coscheduling pod label."""
+    api, op = live
+    api.create(GV, "arksmodels", "default",
+               _cr("ArksModel", "m1", {"model": "org/m"}))
+    api.create(GV, "arksapplications", "default", _cr(
+        "ArksApplication", "gapp", {
+            "replicas": 1, "size": 2, "runtime": "jax",
+            "model": {"name": "m1"}, "servedModelName": "g-served",
+            "modelConfig": "tiny", "accelerator": "tpu-v5p-16",
+            "instanceSpec": {
+                "env": [{"name": "HF_HOME", "value": "/tmp/hf"}],
+                "tolerations": [{"key": "google.com/tpu",
+                                 "operator": "Exists"}],
+            },
+            "podGroupPolicy": {"kubeScheduling": {
+                "scheduleTimeoutSeconds": 120}},
+        }))
+    sts = wait_for(lambda: api.get("apps/v1", "statefulsets", "default",
+                                   "arks-gapp-0"))
+    pod = sts["spec"]["template"]["spec"]
+    env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
+    assert env["HF_HOME"] == "/tmp/hf"
+    assert pod["tolerations"][0]["key"] == "google.com/tpu"
+    labels = sts["spec"]["template"]["metadata"]["labels"]
+    assert labels["scheduling.x-k8s.io/pod-group"] == "arks-gapp-0"
+    pg = wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                                  "default", "arks-gapp-0"))
+    assert pg["spec"]["minMember"] == 2
+    assert pg["spec"]["scheduleTimeoutSeconds"] == 120
+
+    # Gang-size changes must propagate into minMember — a stale value above
+    # the real size would deadlock the coscheduling plugin forever.
+    api.patch(GV, "arksapplications", "default", "gapp", {"spec": {"size": 1}})
+    wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                             "default", "arks-gapp-0")["spec"]["minMember"] == 1)
+
+    # Removing the policy must delete the PodGroup, not orphan it.
+    api.patch(GV, "arksapplications", "default", "gapp",
+              {"spec": {"podGroupPolicy": None}})
+    wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                             "default", "arks-gapp-0") is None)
+
+
+def test_live_invalid_instance_spec_fails_precheck(live):
+    api, op = live
+    api.create(GV, "arksmodels", "default",
+               _cr("ArksModel", "m1", {"model": "org/m"}))
+    api.create(GV, "arksapplications", "default", _cr(
+        "ArksApplication", "bad", {
+            "replicas": 1, "size": 1, "runtime": "jax",
+            "model": {"name": "m1"}, "servedModelName": "bad-served",
+            "modelConfig": "tiny",
+            "instanceSpec": {"volumes": [{"name": "models",
+                                          "emptyDir": {}}]},
+        }))
+    wait_for(lambda: (api.get(GV, "arksapplications", "default", "bad")
+                      .get("status", {}).get("phase")) == "Failed")
+    conds = api.get(GV, "arksapplications", "default", "bad")["status"]["conditions"]
+    pre = [c for c in conds if c["type"] == "Precheck"][0]
+    assert pre["status"] == "False" and "reserved" in pre["message"]
