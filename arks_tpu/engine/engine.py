@@ -79,6 +79,12 @@ class EngineConfig:
     # channel scales, dequant fused into the matmuls — models.quant). How
     # 7B-class models fit a 16GB v5e chip; also halves decode weight reads.
     weight_dtype: str = "bf16"
+    # Host-RAM budget for the prefix KV cache (0 disables).  Shared prompt
+    # prefixes (system prompts, few-shot preambles, multi-turn history)
+    # skip recomputation: cached blocks are inserted and only the tail is
+    # prefilled.  Requires prefill_chunk (reuse lands on chunk boundaries);
+    # single-host only (harvest needs fully-addressable arrays).
+    prefix_cache_mb: int = 256
     seed: int = 0
 
     def resolve_kv_cache_dtype(self) -> str:
@@ -190,6 +196,18 @@ class EngineMetrics:
             buckets=[0.1, 0.25, 0.5, 1, 2.5, 5, 10, 20, 40, 80, 160])
         self.request_success_total = r.counter(
             "request_success_total", "Finished requests by reason")
+        # Prefix-cache family (reference dashboard's cache hit-rate panel —
+        # docs/monitoring.md:118-144 — normalized like the other names).
+        self.prefix_cache_query_tokens_total = r.counter(
+            "prefix_cache_query_tokens_total",
+            "Prompt tokens checked against the prefix cache")
+        self.prefix_cache_hit_tokens_total = r.counter(
+            "prefix_cache_hit_tokens_total",
+            "Prompt tokens served from the prefix cache")
+        self.prefix_cache_usage_bytes = r.gauge(
+            "prefix_cache_usage_bytes", "Host bytes held by the prefix cache")
+        self.prefix_cache_hit_rate = r.gauge(
+            "prefix_cache_hit_rate", "Lifetime prefix-cache token hit rate")
 
 
 class InferenceEngine:
@@ -264,6 +282,14 @@ class InferenceEngine:
                 c -= 1
             self._chunk = c
 
+        # Prefix KV cache: block size = chunk size, so a reused prefix ends
+        # exactly where the chunked tail prefill starts.
+        self._prefix = None
+        if engine_cfg.prefix_cache_mb and self._chunk:
+            from arks_tpu.engine.prefix_cache import PrefixKVCache
+            self._prefix = PrefixKVCache(
+                self._chunk, engine_cfg.prefix_cache_mb * 2**20)
+
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._queued_rids: set[str] = set()
         self._aborted: set[str] = set()
@@ -314,6 +340,10 @@ class InferenceEngine:
             return ids[0]
 
         self._sample_one_fn = jax.jit(sample_one)
+
+        dtype = jnp.dtype(self.ecfg.dtype or cfg.dtype)
+        self._extract_fn = jax.jit(
+            lambda cache, slot: tf.extract(cache, slot, dtype))
 
         def decode_loop(params, cache, tokens, lengths, sstate):
             def body(carry, _):
@@ -485,6 +515,20 @@ class InferenceEngine:
                 num_prompt_tokens=len(req.prompt_ids)))
             log.info("rejected %s: %s", req.request_id, e)
             return
+
+        # Prefix reuse: insert the cached blocks, chunk-prefill only the
+        # tail (at least one tail token is always computed — its logits
+        # feed first-token sampling).
+        if self._prefix is not None and self.dispatcher is None:
+            plen = min(self._prefix.match(ids),
+                       (len(ids) - 1) // self._chunk * self._chunk)
+            self._prefix.record_query(len(ids), plen)
+            self.metrics.prefix_cache_query_tokens_total.inc(len(ids))
+            self.metrics.prefix_cache_hit_tokens_total.inc(plen)
+            self.metrics.prefix_cache_hit_rate.set(self._prefix.hit_rate)
+            if plen:
+                return self._start_chunked(req, ids, prefix_len=plen)
+
         if padded is None:
             return self._start_chunked(req, ids)
 
@@ -518,6 +562,14 @@ class InferenceEngine:
             raise
 
         self._register_slot(req, slot, int(first_id), len(ids))
+        # Harvest full blocks into the prefix cache (device->host copy only
+        # when at least one block is actually new).
+        if self._prefix is not None and self.dispatcher is None:
+            nfull = len(ids) // self._chunk * self._chunk
+            if nfull and self._prefix.missing_blocks(ids, nfull):
+                self._prefix.put(ids, np.asarray(ks[:, :, :nfull]),
+                                 np.asarray(vs[:, :, :nfull]), nfull)
+                self.metrics.prefix_cache_usage_bytes.set(self._prefix.bytes_used)
 
     def _admit_prefilled(self, req: Request) -> None:
         """Admit a request whose prefill ran on another engine (disaggregated
@@ -591,6 +643,17 @@ class InferenceEngine:
         return min(self._buckets[-1],
                    self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1)
 
+    def _insert_pad_len(self, plen: int) -> int:
+        """Bucketed insert length for a cached prefix: the next prefill
+        bucket, or beyond the largest bucket the next multiple of it —
+        bounding distinct compiled insert shapes to
+        O(len(buckets) + max_cache_len / last_bucket)."""
+        for b in self._buckets:
+            if plen <= b:
+                return b
+        last = self._buckets[-1]
+        return min(-(-plen // last) * last, self.ecfg.max_cache_len)
+
     def _prepare_prompt(self, prompt_ids: list[int]) -> tuple[list[int], np.ndarray | None]:
         """Pad the prompt to the smallest prefill bucket.  Shared by the
         unified and disaggregated paths — the bit-identity guarantee between
@@ -616,13 +679,39 @@ class InferenceEngine:
     # Chunked prefill
     # ------------------------------------------------------------------
 
-    def _start_chunked(self, req: Request, ids: list[int]) -> None:
+    def _start_chunked(self, req: Request, ids: list[int],
+                       prefix_len: int = 0) -> None:
         p = req.params
         self._request_seed += 1
         seed = p.seed if p.seed is not None else self._request_seed
         slot = self._free.pop()
-        self._prefilling[slot] = _ChunkState(request=req, ids=ids, pos=0,
-                                             seed=seed,
+        if prefix_len:
+            # Cached prefix blocks land in the slot first; chunked prefill
+            # then continues from prefix_len (a chunk boundary by
+            # construction).  The insert is padded to a BUCKETED length so
+            # the jitted program compiles O(buckets) shapes, not one per
+            # distinct prefix length (the padding rows are garbage the tail
+            # chunks overwrite / the per-slot length masks — same invariant
+            # as one-shot bucket padding).
+            k, v = self._prefix.get(ids, prefix_len)
+            pad = self._insert_pad_len(prefix_len)
+            if pad > prefix_len:
+                width = [(0, 0)] * 5
+                width[2] = (0, pad - prefix_len)
+                k = np.pad(k, width)
+                v = np.pad(v, width)
+            try:
+                self._cache = self._insert_fn(
+                    self._cache, jnp.asarray(k), jnp.asarray(v),
+                    jnp.asarray(slot))
+            except Exception:
+                self._free.append(slot)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(ids)))
+                raise
+        self._prefilling[slot] = _ChunkState(request=req, ids=ids,
+                                             pos=prefix_len, seed=seed,
                                              key=jax.random.PRNGKey(seed))
         # Interleaved decode dispatches write garbage KV rows for every slot
         # at its length index; pointing this slot's length at the FINAL
@@ -682,6 +771,17 @@ class InferenceEngine:
             self._sampling, slot, p.temperature, p.top_p, p.top_k,
             jax.random.fold_in(st.key, 1))
         self._register_slot(st.request, slot, first, len(st.ids))
+        # Harvest the chunk-prefilled prompt (its KV exists only inside the
+        # slotted cache — read it back out before decode grows past it).
+        if self._prefix is not None and self.dispatcher is None:
+            nfull = len(st.ids) // self._chunk * self._chunk
+            if nfull and self._prefix.missing_blocks(st.ids, nfull):
+                k, v = self._extract_fn(self._cache, jnp.asarray(slot, jnp.int32))
+                # Slice on device: the host copy is nfull rows, not the whole
+                # max_cache_len slot.
+                self._prefix.put(st.ids, np.asarray(k[:, :, :nfull]),
+                                 np.asarray(v[:, :, :nfull]), nfull)
+                self.metrics.prefix_cache_usage_bytes.set(self._prefix.bytes_used)
 
     def prefill_detached(self, prompt_ids: list[int],
                          params) -> PrefilledState:

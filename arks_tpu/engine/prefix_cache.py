@@ -1,0 +1,138 @@
+"""Prefix KV cache: hash-chained block reuse for shared prompt prefixes.
+
+The reference delegates prefix caching to its runtime containers (vLLM
+automatic prefix caching / SGLang radix cache; the reference itself only
+surfaces the router's ``--policy cache_aware`` flag —
+/root/reference/internal/controller/arksdisaggregatedapplication_controller.go
+:1630-1670).  TPU-native rebuild:
+
+- Prompts are split into fixed **blocks** of ``block_tokens`` (= the
+  engine's chunked-prefill size, so a reused prefix lands exactly on a
+  chunk boundary and the tail continues through the existing chunked-
+  prefill program — no new compiled code paths).
+- Each block is keyed by a digest of the ENTIRE token prefix up to the
+  block's end (hash-chaining by content, like vLLM's block hash), so two
+  prompts share cache entries exactly as far as their tokens agree.
+- Values are host-resident time-major KV slices ``[L, 1, C, Hkv, D]`` —
+  precisely what ``transformer.insert`` consumes.  Host RAM is the right
+  home on TPU: HBM is the scarce resource, the PCIe/ICI copy for a hit
+  costs far less than recomputing the prefill FLOPs, and eviction never
+  fights the decode cache for device memory.
+- LRU eviction by byte budget; a block is one entry, shared by every
+  prompt whose prefix contains it.
+
+Thread-safety: the engine calls match/get/put from the engine thread only;
+a lock still guards the map because the disaggregated prefill path may run
+on server threads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class PrefixKVCache:
+    def __init__(self, block_tokens: int, capacity_bytes: int) -> None:
+        if block_tokens <= 0:
+            raise ValueError("block_tokens must be positive")
+        self.block = block_tokens
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        # digest -> (k_block, v_block), LRU order (oldest first).
+        self._blocks: "OrderedDict[bytes, tuple[np.ndarray, np.ndarray]]" = OrderedDict()
+        self._bytes = 0
+        # Stats (read by EngineMetrics).
+        self.hit_tokens = 0
+        self.query_tokens = 0
+
+    # -- keys ----------------------------------------------------------
+
+    def _keys(self, ids, nblocks: int) -> list[bytes]:
+        """Chained digests for blocks 1..nblocks (digest j covers
+        ids[: j*block])."""
+        h = hashlib.sha1()
+        arr = np.asarray(ids, np.int32)
+        keys = []
+        for j in range(nblocks):
+            h.update(arr[j * self.block:(j + 1) * self.block].tobytes())
+            keys.append(h.digest())
+        return keys
+
+    # -- read ----------------------------------------------------------
+
+    def match(self, ids) -> int:
+        """Longest cached prefix of ``ids`` in tokens (multiple of block;
+        0 = miss).  Does not touch LRU order or stats."""
+        nblocks = len(ids) // self.block
+        if nblocks == 0:
+            return 0
+        keys = self._keys(ids, nblocks)
+        with self._lock:
+            plen = 0
+            for key in keys:
+                if key not in self._blocks:
+                    break
+                plen += self.block
+        return plen
+
+    def get(self, ids, plen: int) -> tuple[np.ndarray, np.ndarray]:
+        """The cached KV for ids[:plen] as one time-major pair
+        ``[L, 1, plen, Hkv, D]``.  plen must be a match() result."""
+        nblocks = plen // self.block
+        keys = self._keys(ids, nblocks)
+        with self._lock:
+            ks, vs = [], []
+            for key in keys:
+                k, v = self._blocks[key]
+                self._blocks.move_to_end(key)
+                ks.append(k)
+                vs.append(v)
+        return np.concatenate(ks, axis=2), np.concatenate(vs, axis=2)
+
+    # -- write ---------------------------------------------------------
+
+    def missing_blocks(self, ids, length: int) -> list[int]:
+        """Indices of full blocks of ids[:length] not yet cached — lets the
+        engine skip the device→host KV transfer entirely on a full hit."""
+        nblocks = length // self.block
+        keys = self._keys(ids, nblocks)
+        with self._lock:
+            return [j for j, key in enumerate(keys) if key not in self._blocks]
+
+    def put(self, ids, k: np.ndarray, v: np.ndarray, length: int) -> None:
+        """Store every full block of ids[:length] from time-major KV
+        ``[L, 1, T, Hkv, D]`` (T >= length)."""
+        nblocks = length // self.block
+        if nblocks == 0:
+            return
+        keys = self._keys(ids, nblocks)
+        with self._lock:
+            for j, key in enumerate(keys):
+                if key in self._blocks:
+                    self._blocks.move_to_end(key)
+                    continue
+                kb = np.ascontiguousarray(k[:, :, j * self.block:(j + 1) * self.block])
+                vb = np.ascontiguousarray(v[:, :, j * self.block:(j + 1) * self.block])
+                self._blocks[key] = (kb, vb)
+                self._bytes += kb.nbytes + vb.nbytes
+            while self._bytes > self.capacity and self._blocks:
+                _, (kb, vb) = self._blocks.popitem(last=False)
+                self._bytes -= kb.nbytes + vb.nbytes
+
+    # -- stats ---------------------------------------------------------
+
+    def record_query(self, num_tokens: int, hit: int) -> None:
+        self.query_tokens += num_tokens
+        self.hit_tokens += hit
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hit_tokens / self.query_tokens if self.query_tokens else 0.0

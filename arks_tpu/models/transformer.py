@@ -466,6 +466,28 @@ def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     )
 
 
+def extract(cache: KVCache, slot: jnp.ndarray,
+            dtype: jnp.dtype | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Read one slot's KV back out time-major ``[L, 1, S, Hkv, D]`` — the
+    inverse of ``insert`` (dequantized for int8 caches; re-inserting
+    round-trips exactly because quantize(dequantize(x)) reproduces the same
+    int8 values and scales).  Serves the prefix cache's harvest of
+    chunk-prefilled prompts, whose KV exists only inside the slotted cache.
+    """
+    k = jax.lax.dynamic_index_in_dim(cache.k, slot, 1, keepdims=True)
+    v = jax.lax.dynamic_index_in_dim(cache.v, slot, 1, keepdims=True)
+    if cache.quantized:
+        ks = jax.lax.dynamic_index_in_dim(cache.k_scale, slot, 1, keepdims=True)
+        vs = jax.lax.dynamic_index_in_dim(cache.v_scale, slot, 1, keepdims=True)
+        out = dtype or jnp.bfloat16
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(out)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(out)
+    elif dtype is not None:
+        k = k.astype(dtype)
+        v = v.astype(dtype)
+    return jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3)
+
+
 def decode_step(
     params: Params,
     cfg: ModelConfig,

@@ -16,12 +16,21 @@ maintains the file; on k8s it is a projected ConfigMap the controller
 updates — the moral equivalent of the reference router's label-selector
 pod discovery.
 
-Routing policy: round-robin over ready prefills; least-loaded is a
-cache-aware upgrade point (the reference router's ``--policy cache_aware``).
+Routing policies (the reference router's ``--policy`` flag, default
+``cache_aware`` in its generated command line):
+
+- ``round_robin``: rotate over ready backends.
+- ``cache_aware``: rendezvous-hash the request's prompt *prefix* (system
+  prompt / few-shot preamble) to a backend, so requests sharing a prefix
+  land on the same prefill AND decode engines — whose prefix KV caches
+  (arks_tpu.engine.prefix_cache) then serve the shared blocks without
+  recompute.  Rendezvous hashing keeps remapping minimal when backends
+  come and go (only the moved backend's keys reshuffle).
 """
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import itertools
 import json
@@ -70,12 +79,60 @@ def _env_addrs(name: str) -> list[str]:
     return [a for a in v.split(",") if a]
 
 
+# Prompt-prefix window the cache_aware policy keys on.  Long enough to
+# separate distinct system prompts, short enough that divergent tails (the
+# user turn) don't defeat the affinity.
+_PREFIX_KEY_CHARS = 512
+
+
+def _prefix_key(body: bytes) -> bytes | None:
+    """Locality key: the first _PREFIX_KEY_CHARS of the prompt text."""
+    try:
+        obj = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(obj, dict):
+        return None
+    if isinstance(obj.get("messages"), list):
+        parts = []
+        total = 0
+        for m in obj["messages"]:
+            c = m.get("content") if isinstance(m, dict) else None
+            if isinstance(c, str):
+                parts.append(c)
+                total += len(c)
+                if total >= _PREFIX_KEY_CHARS:
+                    break
+        text = "\x00".join(parts)
+    elif isinstance(obj.get("prompt"), str):
+        text = obj["prompt"]
+    else:
+        return None
+    if not text:
+        # Content-parts bodies (list-valued content) and empty prompts have
+        # no usable text key — round-robin, don't pin them all to one
+        # backend via a shared empty key.
+        return None
+    return text[:_PREFIX_KEY_CHARS].encode("utf-8", "surrogatepass")
+
+
+def _rendezvous(key: bytes, backends: list[str]) -> str:
+    """Highest-random-weight choice: stable per key, minimal remap on
+    backend churn."""
+    return max(backends,
+               key=lambda b: hashlib.sha1(key + b"\x00" + b.encode()).digest())
+
+
 class Router:
     def __init__(self, discovery: Discovery, served_model_name: str,
-                 host: str = "0.0.0.0", port: int = 8080):
+                 host: str = "0.0.0.0", port: int = 8080,
+                 policy: str = "cache_aware"):
+        if policy not in ("round_robin", "cache_aware"):
+            raise ValueError(f"unknown policy {policy!r}")
         self.discovery = discovery
         self.served_model_name = served_model_name
         self.host, self.port = host, port
+        self.policy = policy
         self._rr = itertools.count()
         self._httpd: ThreadingHTTPServer | None = None
         self.registry = prom.Registry()
@@ -162,9 +219,7 @@ class Router:
             if not prefill or not decode:
                 status = 503
                 return h._error(503, "no ready prefill/decode backends")
-            n = next(self._rr)
-            p = prefill[n % len(prefill)]
-            d = decode[n % len(decode)]
+            p, d = self._pick(body, prefill, decode)
             status = self._forward(h, body, p, d, started)
         except (BrokenPipeError, ConnectionResetError):
             status = 499
@@ -182,6 +237,15 @@ class Router:
                     pass
         finally:
             self.requests_total.inc(status=str(status))
+
+    def _pick(self, body: bytes, prefill: list[str],
+              decode: list[str]) -> tuple[str, str]:
+        if self.policy == "cache_aware":
+            key = _prefix_key(body)
+            if key is not None:
+                return _rendezvous(key, prefill), _rendezvous(key, decode)
+        n = next(self._rr)
+        return prefill[n % len(prefill)], decode[n % len(decode)]
 
     def _forward(self, h, body: bytes, prefill_addr: str, decode_addr: str,
                  started: list[bool]) -> int:

@@ -20,6 +20,11 @@ def main() -> None:
     p.add_argument("--discovery-file", default=None,
                    help="JSON {prefill: [addr], decode: [addr]}; falls back "
                         "to ARKS_PREFILL_ADDRS/ARKS_DECODE_ADDRS env")
+    p.add_argument("--policy", default="cache_aware",
+                   choices=("round_robin", "cache_aware"),
+                   help="cache_aware pins shared prompt prefixes to one "
+                        "backend so engine prefix caches hit (reference "
+                        "router default)")
     args = p.parse_args()
 
     logging.basicConfig(
@@ -29,7 +34,7 @@ def main() -> None:
     from arks_tpu.router import Discovery, Router
 
     router = Router(Discovery(args.discovery_file), args.served_model_name,
-                    host=args.host, port=args.port)
+                    host=args.host, port=args.port, policy=args.policy)
     router.start(background=False)
 
 

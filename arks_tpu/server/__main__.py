@@ -45,6 +45,8 @@ def main() -> None:
                    help="int8 = weight-only quantization (w8a16): fits "
                         "7B-class models on one 16GB chip, halves decode "
                         "weight reads")
+    p.add_argument("--prefix-cache-mb", type=int, default=256,
+                   help="host-RAM budget for prefix KV reuse (0 disables)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
@@ -137,6 +139,7 @@ def main() -> None:
         tensor_parallel=args.tp, data_parallel=args.dp,
         dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype,
         weight_dtype=args.weight_dtype, seed=args.seed,
+        prefix_cache_mb=args.prefix_cache_mb,
     )
     # Real weights without tokenizer assets = broken mount; fail fast then.
     from arks_tpu.models.weights import has_real_weights

@@ -307,3 +307,62 @@ def test_disaggregated_end_to_end(pd_stack):
                     if f != "[DONE]" and json.loads(f).get("usage")]
     assert usage_frames, "usage frame missing from disaggregated stream"
     assert json.loads(usage_frames[-1])["usage"]["completion_tokens"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Router policy: cache_aware prefix affinity
+# ---------------------------------------------------------------------------
+
+
+def test_cache_aware_policy_pins_shared_prefixes():
+    import json as _json
+
+    from arks_tpu.router import Discovery, Router, _prefix_key, _rendezvous
+
+    r = Router(Discovery(None), "m", policy="cache_aware")
+    prefill = ["p1:1", "p2:1", "p3:1"]
+    decode = ["d1:1", "d2:1"]
+    sys_prompt = "You are a helpful assistant. " * 40  # > key window
+    def body(user):
+        return _json.dumps({"model": "m", "messages": [
+            {"role": "system", "content": sys_prompt},
+            {"role": "user", "content": user}]}).encode()
+
+    picks = {r._pick(body(f"question {i}"), prefill, decode)
+             for i in range(10)}
+    # Same (long) system prompt -> same prefill AND decode every time,
+    # regardless of the divergent user turn.
+    assert len(picks) == 1
+
+    # A different system prompt is free to land elsewhere; the key differs.
+    k1 = _prefix_key(body("x"))
+    k2 = _prefix_key(_json.dumps({"model": "m", "messages": [
+        {"role": "system", "content": "Terse answers only. " * 40}]}).encode())
+    assert k1 != k2
+
+    # Rendezvous: removing an unrelated backend keeps the assignment.
+    chosen = _rendezvous(k1, prefill)
+    rest = [b for b in prefill if b != chosen]
+    survivors = [b for b in prefill if b in ([chosen] + rest[:1])]
+    assert _rendezvous(k1, survivors) == chosen
+
+
+def test_round_robin_policy_spreads():
+    import json as _json
+
+    from arks_tpu.router import Discovery, Router
+
+    r = Router(Discovery(None), "m", policy="round_robin")
+    prefill = ["p1:1", "p2:1"]
+    decode = ["d1:1", "d2:1"]
+    b = _json.dumps({"model": "m", "prompt": "same"}).encode()
+    picks = {r._pick(b, prefill, decode) for _ in range(4)}
+    assert len(picks) == 2  # alternates
+
+
+def test_prefix_key_robust_to_garbage():
+    from arks_tpu.router import _prefix_key
+    assert _prefix_key(b"not json") is None
+    assert _prefix_key(b"{}") is None
+    assert _prefix_key(b'{"messages": "nope"}') is None
+    assert _prefix_key(b'{"prompt": "hi"}') is not None
