@@ -32,6 +32,12 @@ def main() -> None:
     p.add_argument("--redis-addr", default="127.0.0.1:6379",
                    help="RESP server address for --backend redis (real "
                         "Redis, or python -m arks_tpu.gateway.rediskv)")
+    p.add_argument("--max-body-bytes", type=int, default=4 * 1024 * 1024,
+                   help="request-body cap -> 413 (reference "
+                        "ClientTrafficPolicy 4MiB client buffer)")
+    p.add_argument("--process-timeout", type=float, default=5.0,
+                   help="per-stage processing deadline in seconds "
+                        "(reference ext_proc messageTimeout)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO,
@@ -64,7 +70,9 @@ def main() -> None:
         rate_limiter = RateLimiter(native.NativeCounterBackend())
 
     gw = Gateway(store, host=args.host, port=args.port,
-                 rate_limiter=rate_limiter, quota=quota)
+                 rate_limiter=rate_limiter, quota=quota,
+                 max_body_bytes=args.max_body_bytes,
+                 process_timeout_s=args.process_timeout)
     gw.start(background=True)
     log.info("gateway on %s:%d (/v1/* + /metrics, backend=%s)",
              args.host, gw.port, args.backend)

@@ -53,6 +53,17 @@ DEFAULT_TPM_MULTIPLIER = 1000
 EJECT_AFTER_CONSECUTIVE_5XX = 3   # dist/gateway.yaml:230-248
 EJECT_SECONDS = 30.0
 
+# Edge policies (dist/gateway.yaml:250-282): the reference fronts the plugin
+# with Envoy's ClientTrafficPolicy 4MiB client buffer and a 5s ext_proc
+# messageTimeout per processing stage.  Here the gateway IS the proxy, so it
+# enforces both itself: oversized bodies are rejected with 413 before
+# buffering, and the admission stage (body read + parse + QoS + limit
+# checks) runs under a deadline that turns a slow stage into a clean 504
+# instead of an unbounded latency hit (wedged counter backends are bounded
+# by their own socket timeouts).
+MAX_BODY_BYTES = 4 * 1024 * 1024
+PROCESS_TIMEOUT_S = 5.0
+
 HDR_MODEL = "x-arks-model"
 HDR_NAMESPACE = "x-arks-namespace"
 HDR_USER = "x-arks-username"
@@ -146,7 +157,9 @@ class Gateway:
     def __init__(self, store: Store, host: str = "0.0.0.0", port: int = 8081,
                  rate_limiter: RateLimiter | None = None,
                  quota: QuotaService | None = None,
-                 quota_sync_s: float = 2.0):
+                 quota_sync_s: float = 2.0,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 process_timeout_s: float = PROCESS_TIMEOUT_S):
         self.store = store
         self.host, self.port = host, port
         self.qos = QosProvider(store)
@@ -155,6 +168,8 @@ class Gateway:
         self.syncer = QuotaStatusSyncer(store, self.quota, sync_s=quota_sync_s)
         self.metrics = GatewayMetrics()
         self.ejector = _Ejector()
+        self.max_body_bytes = max_body_bytes
+        self.process_timeout_s = process_timeout_s
         self._httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
@@ -243,12 +258,31 @@ class Gateway:
         return limits
 
     def _admit(self, handler) -> tuple[TokenQos, dict, dict[str, int]]:
+        deadline = time.monotonic() + self.process_timeout_s
         secret = self._bearer(handler.headers)
         try:
             length = int(handler.headers.get("Content-Length", 0))
+        except ValueError:
+            handler.close_connection = True  # body never drained
+            raise _ApiError(400, "invalid Content-Length", "parse")
+        if length > self.max_body_bytes:
+            # Client-buffer parity (dist/gateway.yaml:250-261): reject before
+            # reading — buffering an unbounded body is the DoS vector.  The
+            # unread body would desync this keep-alive connection, so drop it.
+            handler.close_connection = True
+            raise _ApiError(413, f"request body {length} bytes exceeds the "
+                            f"{self.max_body_bytes}-byte limit", "parse")
+        try:
+            # Slow-loris protection: the body read shares the stage deadline.
+            handler.connection.settimeout(self.process_timeout_s)
             body = json.loads(handler.rfile.read(length) or b"{}")
+        except TimeoutError:
+            handler.close_connection = True  # partial body left on the wire
+            raise _ApiError(408, "timed out reading request body", "parse")
         except (ValueError, json.JSONDecodeError):
             raise _ApiError(400, "invalid JSON body", "parse")
+        finally:
+            handler.connection.settimeout(None)
         model = body.get("model", "")
         if not model:
             raise _ApiError(400, "missing model field", "parse")
@@ -286,6 +320,16 @@ class Gateway:
             for typ, limit in q_limits.items():
                 self.metrics.quota_limit.set(
                     limit, namespace=qos.namespace, quota=qos.quota_name, type=typ)
+
+        # Processing-stage deadline (EnvoyExtensionPolicy 5s messageTimeout,
+        # dist/gateway.yaml:263-282): a SLOW counter backend fails the
+        # request with 504 instead of silently eating the latency budget.
+        # (A fully wedged backend is bounded separately by its own socket
+        # timeout — RespClient — since a blocked call can't observe this
+        # deadline until it returns.)
+        if time.monotonic() > deadline:
+            raise _ApiError(504, "request processing exceeded "
+                            f"{self.process_timeout_s}s", "timeout")
 
         # Count the admitted request (rpm/rpd).
         self.limiter.do_limit(qos.namespace, qos.username, model,

@@ -257,3 +257,35 @@ def test_no_backends_503(world):
     store.update_status(ep)
     code, _ = _err(lambda: _post(gw, {"model": "m1"}))
     assert code == 503
+
+
+def test_oversize_body_413(world):
+    """Client-buffer parity (dist/gateway.yaml:250-261): bodies beyond the
+    cap are rejected up front, before buffering."""
+    gw, _, _ = world
+    gw.max_body_bytes = 1024
+    big = {"model": "m1", "messages": [{"role": "user", "content": "x" * 4096}]}
+    code, body = _err(lambda: _post(gw, big))
+    assert code == 413
+    assert "exceeds" in body["error"]["message"]
+
+
+def test_processing_deadline_504(world):
+    """Per-stage timeout (ext_proc messageTimeout parity): a wedged counter
+    backend turns into a clean 504, not a hung connection."""
+    gw, _, _ = world
+
+    class SlowLimiter:
+        def check_limit(self, *a, **k):
+            time.sleep(0.2)
+            return []
+
+        def do_limit(self, *a, **k):
+            return None
+
+    gw.limiter = SlowLimiter()
+    gw.process_timeout_s = 0.05
+    code, body = _err(lambda: _post(
+        gw, {"model": "m1", "messages": [{"role": "user", "content": "hi"}]}))
+    assert code == 504
+    assert "processing" in body["error"]["message"]
