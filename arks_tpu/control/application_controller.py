@@ -184,7 +184,8 @@ class ApplicationController(Controller):
                 model_arg=model_arg, served_model_name=served,
                 port_token="$(PORT)", tensor_parallel=tp, size=size,
                 common_args=common, model_path=model_path,
-                platform=self.local_platform)
+                platform=self.local_platform,
+                context_parallel=app.spec.get("contextParallel", 1))
         else:
             leader_cmd = gpu_runtime_command(
                 runtime, model_path, served, tp, size, common)

@@ -197,7 +197,11 @@ class DisaggregatedApplicationController(Controller):
             model_arg=model_arg, served_model_name=served,
             port_token="$(PORT)", tensor_parallel=tp, size=size,
             common_args=common, model_path=model_path,
-            platform=self.local_platform)
+            platform=self.local_platform,
+            # Ring-attention prefill for long prompts — most useful on the
+            # prefill tier (decode replicates over the seq axis).
+            context_parallel=ws.get("contextParallel",
+                                    app.spec.get("contextParallel", 1)))
         return {
             "replicas": ws.get("replicas", 1),
             "size": size,

@@ -473,6 +473,8 @@ def _engine_container(spec: dict, served_model: str, model_path: str | None,
             "--served-model-name", served_model,
             "--port", str(port),
             "--tensor-parallel-size", str(spec.get("tensorParallel", 1))]
+    if spec.get("contextParallel", 1) > 1:
+        args += ["--context-parallel-size", str(spec["contextParallel"])]
     if model_path:
         args += ["--model-path", model_path]
     args += [str(a) for a in spec.get("runtimeCommonArgs", [])]

@@ -378,12 +378,15 @@ class LocalProcessDriver:
 def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
                       tensor_parallel: int, size: int, common_args: list[str],
                       model_path: str | None = None,
-                      platform: str | None = None) -> list[str]:
+                      platform: str | None = None,
+                      context_parallel: int = 1) -> list[str]:
     cmd = [sys.executable, "-m", "arks_tpu.server",
            "--model", model_arg,
            "--served-model-name", served_model_name,
            "--port", port_token,
            "--tensor-parallel-size", str(tensor_parallel)]
+    if context_parallel > 1:
+        cmd += ["--context-parallel-size", str(context_parallel)]
     if model_path:
         cmd += ["--model-path", model_path]
     if platform:

@@ -66,9 +66,14 @@ class EngineConfig:
     prefill_chunk: int | None = 256
     # Parallelism: when a mesh isn't passed to InferenceEngine explicitly,
     # one is built from these over all visible devices (tp defaults to
-    # devices/dp). Both 1 (or 1 visible device) → no mesh, single-chip path.
+    # devices/dp). All 1 (or 1 visible device) → no mesh, single-chip path.
+    # context_parallel > 1 shards prefill's T over the 'seq' axis and runs
+    # ring attention — the long-context serving path (prompts beyond one
+    # chip's prefill budget; decode replicates over the seq axis, so cp
+    # belongs on prefill-heavy tiers, e.g. the disaggregated prefill role).
     tensor_parallel: int | None = None
     data_parallel: int = 1
+    context_parallel: int = 1
     dtype: str | None = None   # default: model config dtype
     # "auto"|"bf16"|"int8": int8 halves KV HBM traffic and doubles cache
     # capacity (per-token scales, dequantized inside the attention kernel).
@@ -223,14 +228,41 @@ class InferenceEngine:
         self.cfg = cfg
         self.ecfg = engine_cfg
         self.tokenizer = tokenizer
-        if mesh is None and (engine_cfg.tensor_parallel or 1) * engine_cfg.data_parallel > 1:
+        if mesh is None and ((engine_cfg.tensor_parallel or 1)
+                             * engine_cfg.data_parallel
+                             * engine_cfg.context_parallel > 1):
             from arks_tpu.parallel.mesh import make_mesh
             mesh = make_mesh(tensor_parallel=engine_cfg.tensor_parallel,
-                             data_parallel=engine_cfg.data_parallel)
+                             data_parallel=engine_cfg.data_parallel,
+                             context_parallel=engine_cfg.context_parallel)
         self.mesh = mesh
         self.metrics = EngineMetrics(registry)
         engine_cfg.align_cache_len()
         self._buckets = engine_cfg.resolve_buckets()
+        # Effective context parallelism comes from the MESH's seq axis (an
+        # explicitly passed mesh wins over engine_cfg.context_parallel —
+        # keying off the config here while _build_programs keys off the mesh
+        # would let them disagree).
+        self._cp = mesh.shape.get("seq", 1) if mesh is not None else 1
+        if self._cp > 1:
+            # Ring prefill shards T over 'seq': buckets must divide evenly.
+            kept = [b for b in self._buckets if b % self._cp == 0]
+            if not kept:
+                raise ValueError(
+                    f"no prefill bucket in {self._buckets} is divisible by "
+                    f"the mesh seq axis ({self._cp})")
+            # The whole point of cp is prompts beyond one chip's prefill
+            # budget: extend the one-shot buckets to the full cache window
+            # (doubling) so long prompts ride the ring instead of falling
+            # into the unsharded chunked path.  Chunked prefill still serves
+            # prefix-cache tails; whole-prompt chunking is pointless when
+            # the ring makes one-shot prefill cp-times faster.
+            while kept[-1] < engine_cfg.max_cache_len:
+                nxt = min(kept[-1] * 2, engine_cfg.max_cache_len)
+                if nxt % self._cp:
+                    break
+                kept.append(nxt)
+            self._buckets = kept
         dtype = jnp.dtype(engine_cfg.dtype or cfg.dtype)
 
         if engine_cfg.weight_dtype not in ("bf16", "int8"):
@@ -313,10 +345,15 @@ class InferenceEngine:
     def _build_programs(self) -> None:
         cfg, mesh = self.cfg, self.mesh
         batch_axis = tf.AXIS_DATA if (mesh is not None and mesh.shape.get(tf.AXIS_DATA, 1) > 1) else None
+        # Context parallelism: prefill's T shards over 'seq' and attention
+        # runs as a ring (parallel.ring) — serving reaches the same
+        # long-context path the trainer and dryrun exercise.
+        seq_axis = "seq" if self._cp > 1 else None
         K = self.ecfg.steps_per_dispatch
 
         def prefill_and_sample(params, tokens, length, temperature, top_p, top_k, key):
-            logits, ks, vs = tf.prefill(params, cfg, tokens, length, mesh)
+            logits, ks, vs = tf.prefill(params, cfg, tokens, length, mesh,
+                                        seq_axis=seq_axis)
             state = sampler_mod.SamplingState(
                 temperature=temperature[None], top_p=top_p[None],
                 top_k=top_k[None], key=key[None])

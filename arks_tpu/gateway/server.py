@@ -273,9 +273,23 @@ class Gateway:
             raise _ApiError(413, f"request body {length} bytes exceeds the "
                             f"{self.max_body_bytes}-byte limit", "parse")
         try:
-            # Slow-loris protection: the body read shares the stage deadline.
+            # Slow-loris protection: read incrementally (read1 returns what
+            # has arrived, not a full block) and check the TOTAL deadline
+            # between reads — a per-recv socket timeout alone would let a
+            # client trickling one byte per few seconds pin this thread for
+            # hours while every individual recv stays "fast".
             handler.connection.settimeout(self.process_timeout_s)
-            body = json.loads(handler.rfile.read(length) or b"{}")
+            chunks: list[bytes] = []
+            got = 0
+            while got < length:
+                if time.monotonic() > deadline:
+                    raise TimeoutError
+                chunk = handler.rfile.read1(min(65536, length - got))
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                got += len(chunk)
+            body = json.loads(b"".join(chunks) or b"{}")
         except TimeoutError:
             handler.close_connection = True  # partial body left on the wire
             raise _ApiError(408, "timed out reading request body", "parse")

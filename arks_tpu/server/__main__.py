@@ -33,6 +33,12 @@ def main() -> None:
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--tensor-parallel-size", "--tp", type=int, default=None, dest="tp")
     p.add_argument("--data-parallel-size", "--dp", type=int, default=1, dest="dp")
+    p.add_argument("--context-parallel-size", "--cp", type=int, default=1,
+                   dest="cp",
+                   help="shard prefill T over a 'seq' mesh axis with ring "
+                        "attention (long-context prefill; best on the "
+                        "disaggregated prefill tier — decode replicates "
+                        "across this axis)")
     p.add_argument("--num-slots", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=1024)
     p.add_argument("--steps-per-dispatch", type=int, default=4)
@@ -84,15 +90,16 @@ def main() -> None:
         model_path = args.model_path
 
     n_dev = len(jax.devices())
-    if args.dp < 1 or (args.tp is not None and args.tp < 1):
-        raise SystemExit("--tensor-parallel-size and --data-parallel-size "
-                         "must be >= 1")
-    tp = args.tp or (n_dev // args.dp)
-    want = tp * args.dp
-    if want > n_dev or (args.dp > 1 and tp == 0):
+    if args.dp < 1 or args.cp < 1 or (args.tp is not None and args.tp < 1):
+        raise SystemExit("--tensor-parallel-size, --data-parallel-size and "
+                         "--context-parallel-size must be >= 1")
+    tp = args.tp or (n_dev // (args.dp * args.cp))
+    want = tp * args.dp * args.cp
+    if want > n_dev or (args.dp * args.cp > 1 and tp == 0):
         raise SystemExit(
-            f"requested tp={args.tp or tp} x dp={args.dp} needs {max(want, args.dp)} "
-            f"devices but only {n_dev} are visible")
+            f"requested tp={args.tp or tp} x dp={args.dp} x cp={args.cp} "
+            f"needs {max(want, args.dp * args.cp)} devices but only "
+            f"{n_dev} are visible")
     nproc = int(os.environ.get("ARKS_NUM_PROCESSES", "1"))
     mesh = None
     if want > 1:
@@ -105,7 +112,7 @@ def main() -> None:
             # from process 0 when a host exposes extras).
             if want % nproc:
                 raise SystemExit(
-                    f"tp*dp={want} must be divisible by the gang size {nproc}")
+                    f"tp*dp*cp={want} must be divisible by the gang size {nproc}")
             per = want // nproc
             taken: dict[int, int] = {}
             devices = []
@@ -123,7 +130,7 @@ def main() -> None:
             # wants.
             devices = jax.devices()[:want]
         mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp,
-                         devices=devices)
+                         context_parallel=args.cp, devices=devices)
 
     params = None
     if model_path:
@@ -137,6 +144,7 @@ def main() -> None:
                               if b <= args.max_model_len),
         steps_per_dispatch=args.steps_per_dispatch,
         tensor_parallel=args.tp, data_parallel=args.dp,
+        context_parallel=args.cp,
         dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype,
         weight_dtype=args.weight_dtype, seed=args.seed,
         prefix_cache_mb=args.prefix_cache_mb,

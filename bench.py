@@ -29,6 +29,7 @@ ARKS_BENCH_KV_DTYPE (int8|bf16), ARKS_BENCH_WEIGHT_DTYPE (int8|bf16).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -39,6 +40,43 @@ import numpy as np
 
 BASELINE_TOK_S_CHIP = 2000.0
 TARGET_TTFT_MS = 200.0
+
+
+def pallas_parity_check(kv_quant: bool) -> float:
+    """On-device parity: the Pallas decode path (cache update + ragged
+    attention) vs the XLA oracle on the same random inputs — the compiled-TPU
+    counterpart of the interpret-mode unit tests (tests/
+    test_pallas_attention.py necessarily run interpret on CPU).  Returns the
+    max |pallas - xla| over the attention output; the shapes satisfy the
+    kernel tiling constraints (S % 256, B % 16)."""
+    from arks_tpu.ops.attention import decode_update_and_attend
+
+    L, B, Hkv, G, S, D = 2, 16, 4, 7, 512, 128
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    q = jax.random.normal(ks[0], (B, Hkv * G, D), jnp.bfloat16)
+    k_new = jax.random.normal(ks[1], (B, Hkv, D), jnp.bfloat16)
+    v_new = jax.random.normal(ks[2], (B, Hkv, D), jnp.bfloat16)
+    if kv_quant:
+        kc = jax.random.randint(ks[3], (L, B, Hkv, S, D), -127, 128, jnp.int8)
+        vc = jax.random.randint(ks[4], (L, B, Hkv, S, D), -127, 128, jnp.int8)
+        kscale = jax.random.uniform(ks[5], (L, B, Hkv, S), jnp.float32, 0.01, 0.03)
+        vscale = jax.random.uniform(ks[6], (L, B, Hkv, S), jnp.float32, 0.01, 0.03)
+    else:
+        kc = jax.random.normal(ks[3], (L, B, Hkv, S, D), jnp.bfloat16)
+        vc = jax.random.normal(ks[4], (L, B, Hkv, S, D), jnp.bfloat16)
+        kscale = vscale = None
+    widx = jnp.arange(B, dtype=jnp.int32) * 17 % (S - 1)
+    layer = jnp.asarray(1, jnp.int32)
+
+    def run(impl):
+        out, *_ = jax.jit(functools.partial(
+            decode_update_and_attend, impl=impl))(
+            q, k_new, v_new, kc, vc, widx, layer,
+            k_scale=kscale, v_scale=vscale)
+        return np.asarray(out, np.float32)
+
+    return float(np.max(np.abs(run("pallas") - run("xla"))))
 
 
 def main() -> None:
@@ -119,6 +157,14 @@ def main() -> None:
         best = min(best, time.perf_counter() - t0)
 
     tok_s_chip = batch * steps / best / max(n_chips, 1)
+
+    # TPU-side kernel parity rides every bench run: the Pallas decode path
+    # must agree with the XLA oracle ON DEVICE, not just in CPU interpret
+    # mode.  bf16 accumulation + (for int8) requantization of the new row
+    # bound the tolerance.
+    parity_diff = pallas_parity_check(kv_quant)
+    parity_ok = parity_diff < (0.075 if kv_quant else 0.05)
+
     print(json.dumps({
         "metric": f"decode_throughput_{model}_b{batch}_w-{weight_dtype}_kv-{kv_dtype}",
         "value": round(tok_s_chip, 1),
@@ -127,6 +173,8 @@ def main() -> None:
         "ttft_p50_ms": round(ttft_p50, 1),
         "ttft_prompt_len": prompt_len,
         "ttft_vs_target": round(TARGET_TTFT_MS / ttft_p50, 3),
+        "pallas_parity_maxdiff": round(parity_diff, 5),
+        "pallas_parity_ok": parity_ok,
     }))
 
 

@@ -289,3 +289,29 @@ def test_processing_deadline_504(world):
         gw, {"model": "m1", "messages": [{"role": "user", "content": "hi"}]}))
     assert code == 504
     assert "processing" in body["error"]["message"]
+
+
+def test_slow_body_trickle_408(world):
+    """A client trickling its body cannot pin the handler past the total
+    deadline: the incremental read aborts with 408."""
+    import socket as _socket
+
+    gw, _, _ = world
+    gw.process_timeout_s = 0.3
+    s = _socket.create_connection(("127.0.0.1", gw.port), timeout=10)
+    try:
+        s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
+                  b"Host: x\r\nAuthorization: Bearer sk-alice\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: 1000\r\n\r\n")
+        t0 = time.monotonic()
+        # Trickle a few bytes, then just wait for the server's verdict.
+        for _ in range(3):
+            s.sendall(b"{")
+            time.sleep(0.1)
+        s.settimeout(10)
+        resp = s.recv(4096)
+        assert b"408" in resp.split(b"\r\n")[0]
+        assert time.monotonic() - t0 < 5
+    finally:
+        s.close()
