@@ -102,8 +102,10 @@ class DisaggregatedApplicationController(Controller):
             self._sync(app, status_before)
             return None
         from arks_tpu.control.k8s_export import (
-            validate_instance_spec, validate_pod_group_policy)
+            validate_dapp_mode, validate_instance_spec,
+            validate_pod_group_policy)
         try:
+            validate_dapp_mode(app.spec.get("mode", "legacy"))
             validate_pod_group_policy(app.spec.get("podGroupPolicy"))
             for section in ("prefill", "decode", "router"):
                 validate_instance_spec(
@@ -222,6 +224,8 @@ class DisaggregatedApplicationController(Controller):
                if ws.get("instanceSpec") else {}),
             **({"podGroupPolicy": app.spec["podGroupPolicy"]}
                if app.spec.get("podGroupPolicy") else {}),
+            **({"podGroupUnit": unit}
+               if (unit := self._pod_group_unit(app)) else {}),
         }
 
     def _router_spec(self, app: DisaggregatedApplication) -> dict:
@@ -248,7 +252,27 @@ class DisaggregatedApplicationController(Controller):
             "accelerator": "cpu",
             **({"instanceSpec": rs["instanceSpec"]}
                if rs.get("instanceSpec") else {}),
+            # Unified layout: the router (scheduler role) joins the unit
+            # PodGroup too (reference unified RBGS :1316-1320).
+            **({"podGroupPolicy": app.spec["podGroupPolicy"],
+                "podGroupUnit": unit}
+               if (unit := self._pod_group_unit(app)) else {}),
         }
+
+    def _pod_group_unit(self, app: DisaggregatedApplication) -> dict | None:
+        """Unified layout: ONE PodGroup spans every router/prefill/decode
+        pod (minMember = the whole PD unit), so a unit schedules atomically
+        — the GangSet carries it for the K8s driver."""
+        if (app.spec.get("mode", "legacy") != "unified"
+                or not app.spec.get("podGroupPolicy")):
+            return None
+        from arks_tpu.control.k8s_export import _shape
+        total = (app.spec.get("router") or {}).get("replicas", 1)
+        for tier in ("prefill", "decode"):
+            ws = {**app.spec, **(app.spec.get(tier) or {})}
+            total += ws.get("replicas", 1) * _shape(
+                ws.get("accelerator", "cpu")).hosts
+        return {"name": f"arks-{app.name}", "minMember": total}
 
     def _ensure_gangset(self, app: DisaggregatedApplication, model: Model,
                         component: str, spec: dict) -> None:

@@ -284,3 +284,167 @@ class FakeKubeApi:
         if (obj is not None and obj["metadata"].get("deletionTimestamp")
                 and not obj["metadata"].get("finalizers")):
             del self._objs[key]
+
+
+# ---------------------------------------------------------------------------
+# Fake apiserver over HTTP (the cluster-e2e tier without a cluster)
+# ---------------------------------------------------------------------------
+
+
+class FakeApiServer:
+    """Serve a FakeKubeApi over real HTTP with apiserver-shaped REST paths.
+
+    This is the e2e tier the reference gets from a Kind cluster
+    (test/e2e/e2e_test.go): the REAL ``KubeApi`` client — URL building,
+    merge-patch content types, status subresource routing, error mapping —
+    exercises the same wire protocol it speaks to a production apiserver,
+    against in-memory state.  Also runnable standalone for local dry runs:
+    ``python -m arks_tpu.control.k8s_client --port 8001``.
+    """
+
+    def __init__(self, fake: "FakeKubeApi | None" = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        import http.server
+
+        self.fake = fake or FakeKubeApi()
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _route(self, method: str) -> None:
+                try:
+                    parsed = server._parse(self.path)
+                except ValueError as e:
+                    return self._send(400, {"message": str(e)})
+                try:
+                    code, payload = server._dispatch(method, *parsed,
+                                                     body=self._body()
+                                                     if method in ("POST", "PATCH", "PUT")
+                                                     else None)
+                except ApiError as e:
+                    return self._send(e.status, {"message": str(e)})
+                self._send(code, payload)
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_PATCH(self):
+                self._route("PATCH")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="fake-apiserver", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()  # release the listening socket
+
+    # -- path + dispatch -----------------------------------------------
+
+    @staticmethod
+    def _parse(path: str):
+        """/api/v1/... or /apis/<group>/<version>/... ->
+        (gv, plural, namespace, name, subresource)."""
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if not parts:
+            raise ValueError("empty path")
+        if parts[0] == "api":
+            gv, rest = parts[1], parts[2:]
+        elif parts[0] == "apis":
+            if len(parts) < 3:
+                raise ValueError(f"bad path {path}")
+            gv, rest = f"{parts[1]}/{parts[2]}", parts[3:]
+        else:
+            raise ValueError(f"bad path {path}")
+        namespace = None
+        if rest[:1] == ["namespaces"] and len(rest) >= 2:
+            namespace, rest = rest[1], rest[2:]
+        if not rest:
+            raise ValueError(f"no resource in {path}")
+        plural, rest = rest[0], rest[1:]
+        name = rest[0] if rest else None
+        sub = rest[1] if len(rest) > 1 else None
+        return gv, plural, namespace, name, sub
+
+    def _dispatch(self, method, gv, plural, namespace, name, sub, body):
+        f = self.fake
+        if method == "GET" and name is None:
+            return 200, {"kind": "List", "items": f.list(gv, plural, namespace)}
+        if method == "GET":
+            obj = f.get(gv, plural, namespace, name)
+            if obj is None:
+                raise ApiError(404, f"{plural}/{name} not found")
+            return 200, obj
+        if method == "POST":
+            return 201, f.create(gv, plural, namespace, body)
+        if method == "PATCH":
+            return 200, f.patch(gv, plural, namespace, name, body,
+                                subresource=sub)
+        if method == "PUT":
+            return 200, f.replace(gv, plural, namespace, name, body)
+        if method == "DELETE":
+            # A real apiserver 404s a missing object — the client's
+            # delete-swallows-404 branch must see the real status code.
+            if f.get(gv, plural, namespace, name) is None:
+                raise ApiError(404, f"{plural}/{name} not found")
+            f.delete(gv, plural, namespace, name)
+            return 200, {"status": "Success"}
+        raise ApiError(405, f"method {method}")
+
+
+def main() -> None:
+    import argparse
+    import time as _time
+
+    p = argparse.ArgumentParser(
+        "arks_tpu.control.k8s_client",
+        description="Standalone fake apiserver for local dry runs")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8001)
+    args = p.parse_args()
+    srv = FakeApiServer(host=args.host, port=args.port)
+    srv.start()
+    print(f"fake apiserver on {srv.url}")
+    try:
+        while True:
+            _time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -181,6 +181,16 @@ def apply_instance_spec(pod_spec: dict, container: dict,
 PODGROUP_LABEL_COSCHED = "scheduling.x-k8s.io/pod-group"
 PODGROUP_ANNOTATION_VOLCANO = "scheduling.k8s.io/group-name"
 
+# DisaggregatedApplication layouts (reference determineBackend :269).
+VALID_DAPP_MODES = ("legacy", "unified")
+
+
+def validate_dapp_mode(mode: str) -> None:
+    if mode not in VALID_DAPP_MODES:
+        raise ValueError(
+            f"spec.mode must be one of {'|'.join(VALID_DAPP_MODES)}, "
+            f"got {mode!r}")
+
 
 def validate_pod_group_policy(policy: dict | None) -> None:
     if not policy:
@@ -393,8 +403,12 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
         }
     # InstanceSpec passthrough + gang-scheduling markers (controllers copy
     # the app's spec.instanceSpec / spec.podGroupPolicy into the GangSet).
+    # A podGroupUnit (unified disaggregated layout) points every pod at the
+    # shared unit-wide PodGroup instead of a per-group one.
     il, ia = apply_instance_spec(pod, container, spec.get("instanceSpec"))
-    pl, pa = apply_pod_group_policy(pod, group, spec.get("podGroupPolicy"))
+    unit_name = (spec.get("podGroupUnit") or {}).get("name")
+    pl, pa = apply_pod_group_policy(pod, unit_name or group,
+                                    spec.get("podGroupPolicy"))
     extra_labels = {**il, **pl}
     extra_annotations = {**ia, **pa}
     if revision is None:
@@ -451,7 +465,17 @@ def gangset_revision(gs, port: int = 8080) -> str:
 
 def render_podgroup_from_gangset(gs, index: int) -> dict | None:
     """The gang-scheduling PodGroup for group ``index`` (None if the
-    GangSet carries no podGroupPolicy)."""
+    GangSet carries no podGroupPolicy).  With a podGroupUnit (unified
+    disaggregated layout) every group of every tier shares ONE PodGroup
+    whose minMember spans the whole PD unit — the renderings are identical
+    across tiers, so each tier's driver converges the same object."""
+    unit = gs.spec.get("podGroupUnit")
+    if unit:
+        return render_podgroup(
+            unit["name"], gs.namespace, gs.spec.get("podGroupPolicy"),
+            min_member=unit["minMember"],
+            labels={LABEL_MANAGED_BY: MANAGED_BY,
+                    "arks.ai/unit": unit["name"]})
     group = f"arks-{gs.name}-{index}"
     sel = {LABEL_MANAGED_BY: MANAGED_BY,
            "arks.ai/gangset": gs.name, "arks.ai/group": str(index)}
@@ -512,7 +536,11 @@ def _engine_container(spec: dict, served_model: str, model_path: str | None,
 def _render_gangs(prefix: str, namespace: str, base_labels: dict,
                   replicas: int, shape: TpuTopology, spec: dict,
                   served_model: str, model_path: str | None, pvc: str,
-                  port: int, extra_args: list[str] | None = None) -> list[dict]:
+                  port: int, extra_args: list[str] | None = None,
+                  podgroup_unit: str | None = None) -> list[dict]:
+    """``podgroup_unit``: unified-mode override — pods join the named
+    UNIT-wide PodGroup (rendered once by the caller) instead of per-group
+    PodGroups rendered here."""
     docs: list[dict] = []
     for r in range(replicas):
         group = f"{prefix}-{r}"
@@ -537,14 +565,15 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
         # InstanceSpec passthrough + gang-scheduling markers.
         il, ia = apply_instance_spec(pod_spec, container,
                                      spec.get("instanceSpec"))
-        pl, pa = apply_pod_group_policy(pod_spec, group,
+        pl, pa = apply_pod_group_policy(pod_spec, podgroup_unit or group,
                                         spec.get("podGroupPolicy"))
         extra_labels = {**il, **pl}
         extra_annotations = {**ia, **pa}
-        pg = render_podgroup(group, namespace, spec.get("podGroupPolicy"),
-                             min_member=shape.hosts, labels=sel)
-        if pg is not None:
-            docs.append(pg)
+        if podgroup_unit is None:
+            pg = render_podgroup(group, namespace, spec.get("podGroupPolicy"),
+                                 min_member=shape.hosts, labels=sel)
+            if pg is not None:
+                docs.append(pg)
         docs.append({
             "apiVersion": "v1",
             "kind": "Service",
@@ -639,7 +668,23 @@ def render_application(app: Application, model: Model | None = None,
 def render_disaggregated(dapp: DisaggregatedApplication,
                          model: Model | None = None,
                          port: int = 8080) -> list[dict]:
+    """Two layouts, selected by ``spec.mode`` (reference parity:
+    determineBackend, arksdisaggregatedapplication_controller.go:269 —
+    legacy = two LWS + router Deployment, unified = ONE RBGS group with
+    scheduler/prefill/decode roles, :1265-1326):
+
+    - ``legacy`` (default): independent per-tier gangs; per-group
+      PodGroups when a podGroupPolicy is set.
+    - ``unified``: the same pods join ONE unit-wide PodGroup whose
+      minMember spans every router/prefill/decode pod — the whole PD unit
+      schedules atomically (a half-placed unit serves nothing: decode
+      without prefill is idle, prefill without decode leaks KV).
+    """
     spec = dapp.spec
+    mode = spec.get("mode", "legacy")
+    validate_dapp_mode(mode)
+    unit = f"arks-{dapp.name}" if mode == "unified" else None
+    unit_members = 0
     model_name = spec.get("model", {}).get("name", "")
     pvc, model_path = _model_storage(model, dapp.namespace, model_name)
     model_path = model_path if model_name else None
@@ -652,10 +697,12 @@ def render_disaggregated(dapp: DisaggregatedApplication,
         tspec.update(spec.get(tier) or {})
         shape = _shape(tspec.get("accelerator", "cpu"))
         labels = {LABEL_APPLICATION: dapp.name, LABEL_COMPONENT: tier}
+        unit_members += tspec.get("replicas", 1) * shape.hosts
         docs.extend(_render_gangs(
             f"arks-{dapp.name}-{tier}", dapp.namespace, labels,
             tspec.get("replicas", 1), shape, tspec, served, model_path, pvc,
-            port, extra_args=["--disaggregation-mode", tier]))
+            port, extra_args=["--disaggregation-mode", tier],
+            podgroup_unit=unit))
         svc = f"arks-{dapp.name}-{tier}"
         tiers[tier] = f"{svc}.{dapp.namespace}.svc:{port}"
         docs.append({
@@ -689,6 +736,13 @@ def render_disaggregated(dapp: DisaggregatedApplication,
     }
     rpod: dict = {"containers": [rcontainer]}
     ril, ria = apply_instance_spec(rpod, rcontainer, router.get("instanceSpec"))
+    if unit is not None:
+        # The scheduler/router role joins the unit PodGroup too (reference
+        # unified RBGS: scheduler is one of the three roles, :1316-1320).
+        rpl, rpa = apply_pod_group_policy(rpod, unit, spec.get("podGroupPolicy"))
+        ril = {**ril, **rpl}
+        ria = {**ria, **rpa}
+        unit_members += router.get("replicas", 1)
     docs.append({
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -703,6 +757,11 @@ def render_disaggregated(dapp: DisaggregatedApplication,
             },
         },
     })
+    if unit is not None and spec.get("podGroupPolicy"):
+        docs.append(render_podgroup(
+            unit, dapp.namespace, spec["podGroupPolicy"],
+            min_member=unit_members,
+            labels={LABEL_APPLICATION: dapp.name}))
     # Router front service — the disagg app's traffic entry, named like a
     # standalone app's front service so Endpoint routing treats both alike.
     docs.append({

@@ -161,33 +161,37 @@ class K8sGangDriver:
                                  name, desired)
 
     def _ensure_podgroup(self, gs, index: int, name: str) -> None:
-        """Converge both PodGroup flavors for one group: the rendered one is
-        created or replaced on drift; the other (policy removed or flavor
-        switched) is deleted — but only when it actually exists, so steady
-        state costs reads, not blind writes."""
+        """Converge both PodGroup flavors for one group: the rendered one
+        (per-group, or the shared unit PodGroup under a podGroupUnit) is
+        created or replaced on drift; stale ones — policy removed, flavor
+        switched, or a legacy->unified layout switch leaving per-group
+        objects behind — are deleted, but only when they actually exist, so
+        steady state costs reads, not blind writes."""
         from arks_tpu.control.k8s_export import render_podgroup_from_gangset
         pg = render_podgroup_from_gangset(gs, index)
+        target = pg["metadata"]["name"] if pg is not None else None
         for gv in PODGROUP_FLAVORS:
-            cur = self.api.get(gv, "podgroups", gs.namespace, name)
-            if pg is not None and gv == pg["apiVersion"]:
-                if cur is None:
-                    self.api.create(gv, "podgroups", gs.namespace, pg)
-                elif cur.get("spec") != pg["spec"]:
-                    # REPLACE, not merge-patch: a dropped optional key
-                    # (volcano queue/priorityClassName) must actually go
-                    # away, or the spec comparison never converges and the
-                    # stale key keeps steering the scheduler.  A stale
-                    # minMember above the real gang size would deadlock
-                    # scheduling forever.
-                    desired = dict(pg)
-                    desired["metadata"] = {
-                        **pg["metadata"],
-                        "resourceVersion": cur["metadata"].get(
-                            "resourceVersion", "")}
-                    self.api.replace(gv, "podgroups", gs.namespace, name,
-                                     desired)
-            elif cur is not None:
-                self.api.delete(gv, "podgroups", gs.namespace, name)
+            for nm in dict.fromkeys(n for n in (name, target) if n):
+                cur = self.api.get(gv, "podgroups", gs.namespace, nm)
+                if pg is not None and gv == pg["apiVersion"] and nm == target:
+                    if cur is None:
+                        self.api.create(gv, "podgroups", gs.namespace, pg)
+                    elif cur.get("spec") != pg["spec"]:
+                        # REPLACE, not merge-patch: a dropped optional key
+                        # (volcano queue/priorityClassName) must actually go
+                        # away, or the spec comparison never converges and
+                        # the stale key keeps steering the scheduler.  A
+                        # stale minMember above the real gang/unit size
+                        # would deadlock scheduling forever.
+                        desired = dict(pg)
+                        desired["metadata"] = {
+                            **pg["metadata"],
+                            "resourceVersion": cur["metadata"].get(
+                                "resourceVersion", "")}
+                        self.api.replace(gv, "podgroups", gs.namespace, nm,
+                                         desired)
+                elif cur is not None:
+                    self.api.delete(gv, "podgroups", gs.namespace, nm)
 
     def status(self, gs) -> dict:
         existing = self._existing(gs)
@@ -221,6 +225,12 @@ class K8sGangDriver:
             # PodGroups created under the old spec.
             for gv in PODGROUP_FLAVORS:
                 self.api.delete(gv, "podgroups", gs.namespace, name)
+        # The shared unit PodGroup (unified disaggregated layout) goes with
+        # the last tier torn down; deletes are idempotent across tiers.
+        unit = (gs.spec.get("podGroupUnit") or {}).get("name")
+        if unit:
+            for gv in PODGROUP_FLAVORS:
+                self.api.delete(gv, "podgroups", gs.namespace, unit)
 
 
 # ---------------------------------------------------------------------------

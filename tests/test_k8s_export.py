@@ -313,3 +313,66 @@ def test_disagg_tier_instance_spec_and_router_args():
     rc = router["spec"]["template"]["spec"]["containers"][0]
     assert {"name": "RUST_LOG", "value": "info"} in rc["env"]
     assert "cache_aware" in rc["args"]
+
+
+def test_unified_mode_renders_one_unit_podgroup():
+    """Unified layout (reference generateUnifiedRBGS :1265-1326): ONE
+    PodGroup spans scheduler + prefill + decode — the whole PD unit
+    schedules atomically."""
+    dapp = DisaggregatedApplication(name="updd", namespace="team-a", spec={
+        "runtime": "jax", "model": {"name": "qwen25"},
+        "servedModelName": "qwen2.5-7b", "modelConfig": "qwen2.5-7b",
+        "mode": "unified",
+        "podGroupPolicy": {"kubeScheduling": {}},
+        "prefill": {"replicas": 2, "accelerator": "tpu-v5e-16"},  # 4 hosts ea
+        "decode": {"replicas": 1, "accelerator": "tpu-v5e-8"},    # 1 host
+        "router": {"replicas": 1},
+    })
+    docs = render_disaggregated(dapp)
+    pgs = [d for d in docs if d["kind"] == "PodGroup"]
+    assert len(pgs) == 1
+    assert pgs[0]["metadata"]["name"] == "arks-updd"
+    # 2 prefill groups x 4 hosts + 1 decode group x 1 host + 1 router pod.
+    assert pgs[0]["spec"]["minMember"] == 10
+    # Every tier pod AND the router carry the unit marker.
+    for d in docs:
+        if d["kind"] in ("StatefulSet", "Deployment"):
+            labels = d["spec"]["template"]["metadata"]["labels"]
+            assert labels.get("scheduling.x-k8s.io/pod-group") == "arks-updd", \
+                d["metadata"]["name"]
+
+
+def test_legacy_mode_keeps_per_group_podgroups():
+    dapp = DisaggregatedApplication(name="lgdd", namespace="team-a", spec={
+        "runtime": "jax", "model": {"name": "qwen25"},
+        "servedModelName": "qwen2.5-7b", "modelConfig": "qwen2.5-7b",
+        "podGroupPolicy": {"kubeScheduling": {}},
+        "prefill": {"replicas": 2, "accelerator": "tpu-v5e-16"},
+        "decode": {"replicas": 1, "accelerator": "tpu-v5e-8"},
+    })
+    docs = render_disaggregated(dapp)
+    pgs = sorted(d["metadata"]["name"] for d in docs if d["kind"] == "PodGroup")
+    # One per tier group, none for the unit or the router.
+    assert pgs == ["arks-lgdd-decode-0", "arks-lgdd-prefill-0",
+                   "arks-lgdd-prefill-1"]
+
+
+def test_unified_mode_without_podgroup_policy():
+    dapp = DisaggregatedApplication(name="np", namespace="team-a", spec={
+        "runtime": "jax", "model": {"name": "qwen25"},
+        "servedModelName": "m", "modelConfig": "qwen2.5-7b",
+        "mode": "unified",
+        "prefill": {"replicas": 1}, "decode": {"replicas": 1},
+    })
+    docs = render_disaggregated(dapp)
+    assert not [d for d in docs if d["kind"] == "PodGroup"]
+
+
+def test_invalid_mode_rejected():
+    import pytest
+    dapp = DisaggregatedApplication(name="bad", namespace="team-a", spec={
+        "runtime": "jax", "model": {"name": "qwen25"},
+        "servedModelName": "m", "mode": "sideways",
+    })
+    with pytest.raises(ValueError, match="legacy|unified"):
+        render_disaggregated(dapp)

@@ -258,3 +258,34 @@ def test_live_invalid_instance_spec_fails_precheck(live):
     conds = api.get(GV, "arksapplications", "default", "bad")["status"]["conditions"]
     pre = [c for c in conds if c["type"] == "Precheck"][0]
     assert pre["status"] == "False" and "reserved" in pre["message"]
+
+
+def test_live_unified_disagg_unit_podgroup(live):
+    """Unified layout in LIVE mode: every tier's pods join ONE unit-wide
+    PodGroup whose minMember spans router + prefill + decode — not
+    per-group PodGroups (reference generateUnifiedRBGS :1265-1326)."""
+    api, op = live
+    api.create(GV, "arksmodels", "default",
+               _cr("ArksModel", "m1", {"model": "org/m"}))
+    api.create(GV, "arksdisaggregatedapplications", "default", _cr(
+        "ArksDisaggregatedApplication", "updd", {
+            "runtime": "jax", "model": {"name": "m1"},
+            "servedModelName": "u-served", "modelConfig": "tiny",
+            "mode": "unified",
+            "podGroupPolicy": {"kubeScheduling": {}},
+            "prefill": {"replicas": 1, "accelerator": "tpu-v5p-16"},  # 2 hosts
+            "decode": {"replicas": 1},
+            "router": {"replicas": 1},
+        }))
+    pg = wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                                  "default", "arks-updd"))
+    # 1 router + 1x2 prefill hosts + 1x1 decode host.
+    assert pg["spec"]["minMember"] == 4
+    # Tier pods carry the UNIT marker, and no per-group PodGroups exist.
+    sts = api.get("apps/v1", "statefulsets", "default", "arks-updd-prefill-0")
+    labels = sts["spec"]["template"]["metadata"]["labels"]
+    assert labels["scheduling.x-k8s.io/pod-group"] == "arks-updd"
+    for s in api.list("apps/v1", "statefulsets"):
+        nm = s["metadata"]["name"]
+        assert api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                       "default", nm) is None
