@@ -383,6 +383,8 @@ class FakeApiServer:
         if not parts:
             raise ValueError("empty path")
         if parts[0] == "api":
+            if len(parts) < 2:
+                raise ValueError(f"bad path {path}")
             gv, rest = parts[1], parts[2:]
         elif parts[0] == "apis":
             if len(parts) < 3:

@@ -126,7 +126,9 @@ class K8sGangDriver:
             name = sts["metadata"]["name"]
             if self.api.get("v1", "services", gs.namespace, name) is None:
                 self.api.create("v1", "services", gs.namespace, svc)
-            self._ensure_podgroup(gs, i, name)
+            # Unified unit PodGroups are one shared object: converge it on
+            # group 0 only (per-group stale names still probed every group).
+            self._ensure_podgroup(gs, i, name, converge_target=(i == 0))
             if i not in existing:
                 self.api.create("apps/v1", "statefulsets", gs.namespace, sts)
         # Scale down (the group's PodGroups go with it, whatever flavor).
@@ -160,18 +162,35 @@ class K8sGangDriver:
                 self.api.replace("apps/v1", "statefulsets", gs.namespace,
                                  name, desired)
 
-    def _ensure_podgroup(self, gs, index: int, name: str) -> None:
+    @staticmethod
+    def _unit_name(gs) -> str | None:
+        """The deterministic unit-PodGroup name this gangset WOULD use in
+        unified mode — needed for cleanup even when the current spec no
+        longer carries a podGroupUnit (unified -> legacy switch)."""
+        unit = (gs.spec.get("podGroupUnit") or {}).get("name")
+        if unit:
+            return unit
+        role = gs.spec.get("role")
+        if role and gs.name.endswith(f"-{role}"):
+            return f"arks-{gs.name[: -len(role) - 1]}"
+        return None
+
+    def _ensure_podgroup(self, gs, index: int, name: str,
+                         converge_target: bool = True) -> None:
         """Converge both PodGroup flavors for one group: the rendered one
         (per-group, or the shared unit PodGroup under a podGroupUnit) is
         created or replaced on drift; stale ones — policy removed, flavor
-        switched, or a legacy->unified layout switch leaving per-group
-        objects behind — are deleted, but only when they actually exist, so
-        steady state costs reads, not blind writes."""
+        or LAYOUT switched (incl. unified -> legacy, probed via the
+        deterministic unit name) — are deleted, but only when they actually
+        exist, so steady state costs reads, not blind writes."""
         from arks_tpu.control.k8s_export import render_podgroup_from_gangset
         pg = render_podgroup_from_gangset(gs, index)
         target = pg["metadata"]["name"] if pg is not None else None
+        names = [name, target] if converge_target else [name]
+        if converge_target:
+            names.append(self._unit_name(gs))
         for gv in PODGROUP_FLAVORS:
-            for nm in dict.fromkeys(n for n in (name, target) if n):
+            for nm in dict.fromkeys(n for n in names if n):
                 cur = self.api.get(gv, "podgroups", gs.namespace, nm)
                 if pg is not None and gv == pg["apiVersion"] and nm == target:
                     if cur is None:
@@ -226,8 +245,9 @@ class K8sGangDriver:
             for gv in PODGROUP_FLAVORS:
                 self.api.delete(gv, "podgroups", gs.namespace, name)
         # The shared unit PodGroup (unified disaggregated layout) goes with
-        # the last tier torn down; deletes are idempotent across tiers.
-        unit = (gs.spec.get("podGroupUnit") or {}).get("name")
+        # the last tier torn down; deletes are idempotent across tiers, and
+        # the deterministic name covers specs that already switched layouts.
+        unit = self._unit_name(gs)
         if unit:
             for gv in PODGROUP_FLAVORS:
                 self.api.delete(gv, "podgroups", gs.namespace, unit)

@@ -74,6 +74,15 @@ class EngineConfig:
     tensor_parallel: int | None = None
     data_parallel: int = 1
     context_parallel: int = 1
+    # pipeline_parallel > 1 shards LAYERS (and each layer's KV) over the
+    # 'stage' axis: HBM capacity scales with stages, for models whose
+    # weights+KV exceed one chip.  Decode pipelines microbatches of slots
+    # across stages (parallel.pipeline.pp_decode_step); prefill runs
+    # one-shot through the stages.  Mutually exclusive with tp/dp/cp in the
+    # engine (compose via multi-group replicas instead); chunked prefill
+    # and the prefix cache are disabled under pp (their dynamic layer
+    # indexing would gather the stage-sharded cache).
+    pipeline_parallel: int = 1
     dtype: str | None = None   # default: model config dtype
     # "auto"|"bf16"|"int8": int8 halves KV HBM traffic and doubles cache
     # capacity (per-token scales, dequantized inside the attention kernel).
@@ -228,22 +237,41 @@ class InferenceEngine:
         self.cfg = cfg
         self.ecfg = engine_cfg
         self.tokenizer = tokenizer
+        if engine_cfg.pipeline_parallel > 1 and (
+                (engine_cfg.tensor_parallel or 1) * engine_cfg.data_parallel
+                * engine_cfg.context_parallel > 1):
+            raise ValueError(
+                "pipeline_parallel cannot combine with tp/dp/cp in one "
+                "engine; scale those via replica groups")
         if mesh is None and ((engine_cfg.tensor_parallel or 1)
                              * engine_cfg.data_parallel
-                             * engine_cfg.context_parallel > 1):
+                             * engine_cfg.context_parallel
+                             * engine_cfg.pipeline_parallel > 1):
             from arks_tpu.parallel.mesh import make_mesh
             mesh = make_mesh(tensor_parallel=engine_cfg.tensor_parallel,
                              data_parallel=engine_cfg.data_parallel,
-                             context_parallel=engine_cfg.context_parallel)
+                             context_parallel=engine_cfg.context_parallel,
+                             pipeline_parallel=engine_cfg.pipeline_parallel)
         self.mesh = mesh
         self.metrics = EngineMetrics(registry)
+        # Effective parallelism comes from the MESH's axes (an explicitly
+        # passed mesh wins over the config — keying off the config here
+        # while _build_programs keys off the mesh would let them disagree).
+        self._cp = mesh.shape.get("seq", 1) if mesh is not None else 1
+        self._pp = mesh.shape.get("stage", 1) if mesh is not None else 1
+        # Under pp, chunked prefill (and with it the prefix cache) is off:
+        # its dynamic layer indexing would gather the stage-sharded cache.
+        # Derived locally — the caller's EngineConfig is not mutated.
+        self._chunk_cfg = engine_cfg.prefill_chunk if self._pp == 1 else None
+        if self._pp > 1 and engine_cfg.prefill_chunk:
+            log.info("pipeline parallelism: chunked prefill and the prefix "
+                     "cache are disabled for this engine")
         engine_cfg.align_cache_len()
         self._buckets = engine_cfg.resolve_buckets()
-        # Effective context parallelism comes from the MESH's seq axis (an
-        # explicitly passed mesh wins over engine_cfg.context_parallel —
-        # keying off the config here while _build_programs keys off the mesh
-        # would let them disagree).
-        self._cp = mesh.shape.get("seq", 1) if mesh is not None else 1
+        if self._pp > 1 and self._buckets[-1] < engine_cfg.max_cache_len:
+            # No chunked path under pp: one-shot buckets must cover the
+            # window (mirrors resolve_buckets' no-chunk behavior).
+            self._buckets.append(engine_cfg.max_cache_len)
         if self._cp > 1:
             # Ring prefill shards T over 'seq': buckets must divide evenly.
             kept = [b for b in self._buckets if b % self._cp == 0]
@@ -281,7 +309,11 @@ class InferenceEngine:
             if not quant.is_quantized(params["layers"].get("wq")):
                 params = quant.quantize_params(params)
         if mesh is not None:
-            params = tf.shard_params(params, cfg, mesh)
+            if self._pp > 1:
+                from arks_tpu.parallel.pipeline import shard_params_pp
+                params = shard_params_pp(params, mesh)
+            else:
+                params = tf.shard_params(params, cfg, mesh)
         self.params = params
 
         self._cache = tf.init_cache(cfg, engine_cfg.num_slots,
@@ -289,7 +321,7 @@ class InferenceEngine:
                                     self._cache_dtype(dtype),
                                     quantized=engine_cfg.kv_quantized)
         if mesh is not None:
-            self._cache = tf.shard_cache(self._cache, cfg, mesh)
+            self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
             engine_cfg.num_slots, engine_cfg.seed)
 
@@ -308,8 +340,8 @@ class InferenceEngine:
         # [start, start+C) stays inside the cache (dynamic_update_slice
         # would otherwise clamp the start and corrupt earlier rows).
         self._chunk = 0
-        if engine_cfg.prefill_chunk:
-            c = min(engine_cfg.prefill_chunk, engine_cfg.max_cache_len)
+        if self._chunk_cfg:
+            c = min(self._chunk_cfg, engine_cfg.max_cache_len)
             while engine_cfg.max_cache_len % c:
                 c -= 1
             self._chunk = c
@@ -350,10 +382,30 @@ class InferenceEngine:
         # long-context path the trainer and dryrun exercise.
         seq_axis = "seq" if self._cp > 1 else None
         K = self.ecfg.steps_per_dispatch
+        # Pipeline parallelism: stage-sharded prefill/decode programs with
+        # microbatch overlap when slots divide evenly (else M=1, a plain
+        # sequential pipeline — still correct, no overlap).
+        if self._pp > 1:
+            from arks_tpu.parallel import pipeline as pp_mod
+            num_mb = self._pp if self.ecfg.num_slots % self._pp == 0 else 1
+
+            def model_prefill(params, tokens, length):
+                return pp_mod.pp_prefill(params, cfg, tokens, length, mesh)
+
+            def model_decode(params, cache, tokens, lengths):
+                return pp_mod.pp_decode_step(params, cfg, cache, tokens,
+                                             lengths, mesh, num_mb)
+        else:
+            def model_prefill(params, tokens, length):
+                return tf.prefill(params, cfg, tokens, length, mesh,
+                                  seq_axis=seq_axis)
+
+            def model_decode(params, cache, tokens, lengths):
+                return tf.decode_step(params, cfg, cache, tokens, lengths,
+                                      mesh, batch_axis)
 
         def prefill_and_sample(params, tokens, length, temperature, top_p, top_k, key):
-            logits, ks, vs = tf.prefill(params, cfg, tokens, length, mesh,
-                                        seq_axis=seq_axis)
+            logits, ks, vs = model_prefill(params, tokens, length)
             state = sampler_mod.SamplingState(
                 temperature=temperature[None], top_p=top_p[None],
                 top_k=top_k[None], key=key[None])
@@ -385,8 +437,7 @@ class InferenceEngine:
         def decode_loop(params, cache, tokens, lengths, sstate):
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
-                logits, cache = tf.decode_step(
-                    params, cfg, cache, tokens, lengths, mesh, batch_axis)
+                logits, cache = model_decode(params, cache, tokens, lengths)
                 nxt, sstate = sampler_mod.sample(logits, sstate)
                 return (cache, nxt, lengths + 1, sstate), nxt
 
@@ -433,6 +484,12 @@ class InferenceEngine:
     def _cache_dtype(self, engine_dtype):
         kvd = self.ecfg.resolve_kv_cache_dtype()
         return jnp.bfloat16 if kvd == "bf16" else engine_dtype
+
+    def _shard_cache(self, cache):
+        if self._pp > 1:
+            from arks_tpu.parallel.pipeline import shard_cache_pp
+            return shard_cache_pp(cache, self.mesh)
+        return tf.shard_cache(cache, self.cfg, self.mesh)
 
     def _emit(self, op: str, **payload) -> None:
         """Broadcast a device dispatch to follower processes (multi-host);
@@ -488,7 +545,7 @@ class InferenceEngine:
                                     self._cache_dtype(dtype),
                                     quantized=self.ecfg.kv_quantized)
         if self.mesh is not None:
-            self._cache = tf.shard_cache(self._cache, self.cfg, self.mesh)
+            self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
             self.ecfg.num_slots, self.ecfg.seed)
         self._lengths[:] = 0

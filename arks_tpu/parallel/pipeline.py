@@ -149,3 +149,228 @@ def pp_train_init(cfg, key, optimizer, mesh, dtype=jnp.float32):
     opt_state = optimizer.init(params)
     return TrainState(params=params, opt_state=opt_state,
                       step=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Serving: stage-sharded KV cache, pipelined decode, one-shot prefill
+# ---------------------------------------------------------------------------
+
+
+def shard_cache_pp(cache, mesh, stage_axis: str = AXIS_STAGE):
+    """KV cache sharded over the STAGE axis on its layer dim: each stage
+    holds only its own layers' KV — HBM capacity scales with stages, the
+    lever serving PP exists for (models whose weights+KV exceed one chip)."""
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    spec = P(stage_axis)
+    return tf.KVCache(
+        k=put(cache.k, spec), v=put(cache.v, spec),
+        k_scale=put(cache.k_scale, spec) if cache.quantized else None,
+        v_scale=put(cache.v_scale, spec) if cache.quantized else None)
+
+
+def pp_decode_step(
+    params,
+    cfg,
+    cache,
+    tokens: jnp.ndarray,   # [B] int32
+    lengths: jnp.ndarray,  # [B] int32
+    mesh,
+    num_microbatches: int,
+    stage_axis: str = AXIS_STAGE,
+):
+    """One decode token for every slot, layers pipelined over stages.
+
+    The batch splits into M microbatches of contiguous slots; for
+    M + S - 1 ticks each stage advances one microbatch through its local
+    layers (updating its local KV shard) and ``ppermute``s activations on.
+    Bubble ticks run a ``lax.cond`` no-op branch: unlike activations
+    (overwritten before read), a bubble CACHE write would corrupt a real
+    slot's rows, so bubbles must genuinely skip.  The final hidden states
+    are psum-collected from the last stage and unembedded OUTSIDE the
+    shard_map — once, replicated, instead of S redundant vocab matmuls.
+
+    The attention/update body runs the XLA path (impl="xla"): per-stage
+    microbatches are small and kernel batch-tiling constraints would bind;
+    PP's win is HBM capacity, not decode-kernel latency.
+    """
+    num_stages = mesh.shape[stage_axis]
+    if cfg.num_layers % num_stages != 0:
+        raise ValueError(f"{cfg.num_layers} layers not divisible into "
+                         f"{num_stages} stages")
+    b = tokens.shape[0]
+    m = num_microbatches
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    mbs = b // m
+    quantized = cache.quantized
+    compute_dtype = params["layers"]["attn_norm"].dtype
+    from arks_tpu.ops.attention import decode_update_and_attend
+
+    def local(layers_local, embed, kc, vc, ksc, vsc, tokens, lengths):
+        s_ax = jax.lax.axis_size(stage_axis)
+        s_id = jax.lax.axis_index(stage_axis)
+        perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
+        toks_mb = tokens.reshape(m, mbs)
+        lens_mb = lengths.reshape(m, mbs)
+        e = embed.shape[1]
+
+        def run_stage(h, kc_mb, vc_mb, ks_mb, vs_mb, lens):
+            write_idx = lens.astype(jnp.int32)
+
+            def body(carry, xs):
+                h, kc, vc, ks, vs = carry
+                lp, layer = xs
+                x = tf.rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+                q, k, v = tf._qkv(x, lp, cfg)
+                q = q.reshape(mbs, cfg.num_heads, cfg.head_dim)
+                k = k.reshape(mbs, cfg.num_kv_heads, cfg.head_dim)
+                v = v.reshape(mbs, cfg.num_kv_heads, cfg.head_dim)
+                q = tf.apply_rope(q, write_idx, cfg.rope_theta)
+                k = tf.apply_rope(k, write_idx, cfg.rope_theta)
+                attn, kc, vc, ks, vs = decode_update_and_attend(
+                    q, k, v, kc, vc, write_idx, layer, impl="xla",
+                    k_scale=ks, v_scale=vs)
+                attn = attn.reshape(mbs, cfg.q_dim)
+                h = h + tf.qeinsum("bq,qe->be", attn, lp["wo"])
+                h = h + tf._mlp(h, lp, cfg, None, None)
+                return (h, kc, vc, ks, vs), None
+
+            n_local = jax.tree.leaves(layers_local)[0].shape[0]
+            (h, kc_mb, vc_mb, ks_mb, vs_mb), _ = jax.lax.scan(
+                body, (h, kc_mb, vc_mb, ks_mb, vs_mb),
+                (layers_local, jnp.arange(n_local, dtype=jnp.int32)))
+            return h, kc_mb, vc_mb, ks_mb, vs_mb
+
+        buf = jnp.zeros((mbs, e), compute_dtype)
+        h_acc = jnp.zeros((m, mbs, e), compute_dtype)
+
+        def tick(carry, ti):
+            kc, vc, ksc, vsc, buf, h_acc = carry
+            mi = ti - s_id
+            valid = (mi >= 0) & (mi < m)
+            mi_c = jnp.clip(mi, 0, m - 1)
+            start = mi_c * mbs
+            toks = jax.lax.dynamic_index_in_dim(toks_mb, mi_c, 0, keepdims=False)
+            lens = jax.lax.dynamic_index_in_dim(lens_mb, mi_c, 0, keepdims=False)
+            h0 = tf.embed_lookup(embed, toks, compute_dtype)
+            h_in = jnp.where(s_id == 0, h0, buf)
+
+            kc_mb = jax.lax.dynamic_slice_in_dim(kc, start, mbs, axis=1)
+            vc_mb = jax.lax.dynamic_slice_in_dim(vc, start, mbs, axis=1)
+            ks_mb = (jax.lax.dynamic_slice_in_dim(ksc, start, mbs, axis=1)
+                     if quantized else None)
+            vs_mb = (jax.lax.dynamic_slice_in_dim(vsc, start, mbs, axis=1)
+                     if quantized else None)
+
+            def do(h_in, kc_mb, vc_mb, ks_mb, vs_mb, lens):
+                return run_stage(h_in, kc_mb, vc_mb, ks_mb, vs_mb, lens)
+
+            def skip(h_in, kc_mb, vc_mb, ks_mb, vs_mb, lens):
+                return jnp.zeros_like(h_in), kc_mb, vc_mb, ks_mb, vs_mb
+
+            h_out, kc_mb, vc_mb, ks_mb, vs_mb = jax.lax.cond(
+                valid, do, skip, h_in, kc_mb, vc_mb, ks_mb, vs_mb, lens)
+
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, kc_mb, start, 1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vc_mb, start, 1)
+            if quantized:
+                ksc = jax.lax.dynamic_update_slice_in_dim(ksc, ks_mb, start, 1)
+                vsc = jax.lax.dynamic_update_slice_in_dim(vsc, vs_mb, start, 1)
+            # Last stage's h_out lands at its microbatch row (bubble-tick
+            # garbage at clamped rows is overwritten before the psum reads
+            # it — same trick as pipeline_forward).
+            out_idx = jnp.clip(ti - (s_ax - 1), 0, m - 1)
+            h_acc = jax.lax.dynamic_update_slice(
+                h_acc, h_out[None].astype(h_acc.dtype), (out_idx, 0, 0))
+            buf = jax.lax.ppermute(h_out, stage_axis, perm)
+            return (kc, vc, ksc, vsc, buf, h_acc), None
+
+        (kc, vc, ksc, vsc, buf, h_acc), _ = jax.lax.scan(
+            tick, (kc, vc, ksc, vsc, buf, h_acc),
+            jnp.arange(m + s_ax - 1))
+        mask = (s_id == s_ax - 1).astype(h_acc.dtype)
+        h_final = jax.lax.psum(h_acc * mask, stage_axis)
+        return h_final, kc, vc, ksc, vsc
+
+    cspec = P(stage_axis)
+    sspec = cspec if quantized else None
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), P(), cspec, cspec, sspec, sspec, P(), P()),
+        out_specs=(P(), cspec, cspec, sspec, sspec),
+        check_vma=False,
+    )
+    h, kc, vc, ksc, vsc = fn(params["layers"], params["embed"],
+                             cache.k, cache.v, cache.k_scale, cache.v_scale,
+                             tokens, lengths)
+    logits = tf._unembed(h.reshape(b, -1), params, cfg, None, None)
+    return logits, tf.KVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
+def pp_prefill(
+    params,
+    cfg,
+    tokens: jnp.ndarray,   # [B, T] int32, bucket-padded
+    lengths: jnp.ndarray,  # [B] int32
+    mesh,
+    stage_axis: str = AXIS_STAGE,
+):
+    """One-shot serving prefill over stages.  Returns (last-token logits
+    [B, V] f32 replicated, ks, vs time-major [L, B, T, Hkv, D] sharded over
+    ``stage`` on L) — the same contract as transformer.prefill, so the
+    engine's insert into a stage-sharded cache stays a local write.
+
+    Single stream (serving prefills one prompt per dispatch), so no
+    microbatch overlap: stages run in sequence, each contributing its
+    layers; PP prefill trades bubbles for fitting the model at all.
+    """
+    num_stages = mesh.shape[stage_axis]
+    if cfg.num_layers % num_stages != 0:
+        raise ValueError(f"{cfg.num_layers} layers not divisible into "
+                         f"{num_stages} stages")
+    b, t = tokens.shape
+    compute_dtype = params["layers"]["attn_norm"].dtype
+
+    def local(layers_local, embed, tokens):
+        s_ax = jax.lax.axis_size(stage_axis)
+        s_id = jax.lax.axis_index(stage_axis)
+        perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+        def run_stage(h):
+            def body(h, lp):
+                h, k, v = tf.prefill_layer(h, lp, cfg, positions, None)
+                return h, (k, v)
+            return jax.lax.scan(body, h, layers_local)
+
+        h = tf.embed_lookup(embed, tokens, compute_dtype)
+        ks = vs = None
+        # S sequential hops: stage s computes on hop s (earlier hops carry
+        # zeros through it — cheap relative to fitting the model, and the
+        # KV it produces on non-final hops is discarded by the where).
+        for hop in range(num_stages):
+            h_out, (k_hop, v_hop) = run_stage(h)
+            keep = (s_id == hop)
+            ks = k_hop if ks is None else jnp.where(keep, k_hop, ks)
+            vs = v_hop if vs is None else jnp.where(keep, v_hop, vs)
+            h = jax.lax.ppermute(h_out, stage_axis, perm)
+        # After S hops the fully-processed h is back at stage 0; every
+        # stage's ks/vs hold ITS layers' KV (the shard_map out_spec stacks
+        # them into the global [L, ...]).
+        mask = (s_id == 0).astype(h.dtype)
+        h_final = jax.lax.psum(h * mask, stage_axis)
+        return h_final, ks, vs
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), P(), P()),
+        out_specs=(P(), P(stage_axis), P(stage_axis)),
+        check_vma=False,
+    )
+    h, ks, vs = fn(params["layers"], params["embed"], tokens)
+    h_last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = tf._unembed(h_last, params, cfg, None, None)
+    return logits, ks, vs

@@ -39,6 +39,12 @@ def main() -> None:
                         "attention (long-context prefill; best on the "
                         "disaggregated prefill tier — decode replicates "
                         "across this axis)")
+    p.add_argument("--pipeline-parallel-size", "--pp", type=int, default=1,
+                   dest="pp",
+                   help="shard layers (and their KV) over a 'stage' mesh "
+                        "axis with a microbatched decode pipeline — HBM "
+                        "capacity scaling for models beyond one chip; "
+                        "exclusive with tp/dp/cp in one engine")
     p.add_argument("--num-slots", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=1024)
     p.add_argument("--steps-per-dispatch", type=int, default=4)
@@ -90,15 +96,18 @@ def main() -> None:
         model_path = args.model_path
 
     n_dev = len(jax.devices())
-    if args.dp < 1 or args.cp < 1 or (args.tp is not None and args.tp < 1):
-        raise SystemExit("--tensor-parallel-size, --data-parallel-size and "
-                         "--context-parallel-size must be >= 1")
-    tp = args.tp or (n_dev // (args.dp * args.cp))
-    want = tp * args.dp * args.cp
-    if want > n_dev or (args.dp * args.cp > 1 and tp == 0):
+    if (args.dp < 1 or args.cp < 1 or args.pp < 1
+            or (args.tp is not None and args.tp < 1)):
+        raise SystemExit("parallel-size flags must be >= 1")
+    if args.pp > 1:
+        tp = args.tp or 1  # pp is exclusive with tp; don't auto-fill tp
+    else:
+        tp = args.tp or max(n_dev // (args.dp * args.cp), 1)
+    want = tp * args.dp * args.cp * args.pp
+    if want > n_dev:
         raise SystemExit(
-            f"requested tp={args.tp or tp} x dp={args.dp} x cp={args.cp} "
-            f"needs {max(want, args.dp * args.cp)} devices but only "
+            f"requested tp={tp} x dp={args.dp} x cp={args.cp} "
+            f"x pp={args.pp} needs {want} devices but only "
             f"{n_dev} are visible")
     nproc = int(os.environ.get("ARKS_NUM_PROCESSES", "1"))
     mesh = None
@@ -130,7 +139,8 @@ def main() -> None:
             # wants.
             devices = jax.devices()[:want]
         mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp,
-                         context_parallel=args.cp, devices=devices)
+                         context_parallel=args.cp,
+                         pipeline_parallel=args.pp, devices=devices)
 
     params = None
     if model_path:
@@ -144,7 +154,7 @@ def main() -> None:
                               if b <= args.max_model_len),
         steps_per_dispatch=args.steps_per_dispatch,
         tensor_parallel=args.tp, data_parallel=args.dp,
-        context_parallel=args.cp,
+        context_parallel=args.cp, pipeline_parallel=args.pp,
         dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype,
         weight_dtype=args.weight_dtype, seed=args.seed,
         prefix_cache_mb=args.prefix_cache_mb,

@@ -100,3 +100,29 @@ spec:
     finally:
         proc.send_signal(signal.SIGTERM)
         proc.wait(timeout=10)
+
+
+def test_per_kind_samples_parse_and_render():
+    """Every per-kind sample (examples/samples/, reference config/samples
+    parity) loads through the manifest path and the workload-bearing ones
+    render to valid K8s docs."""
+    import glob
+
+    from arks_tpu.control.__main__ import apply_manifests
+    from arks_tpu.control.k8s_export import render_store
+    from arks_tpu.control.store import Store
+
+    store = Store()
+    files = sorted(glob.glob("examples/samples/*.yaml"))
+    assert len(files) == 6
+    for f in files:
+        apply_manifests(store, f)
+    docs = render_store(store)
+    kinds = {d["kind"] for d in docs}
+    assert {"PersistentVolumeClaim", "Job", "StatefulSet", "Service",
+            "Deployment", "HTTPRoute", "PodGroup"} <= kinds
+    # The unified disagg sample yields exactly one unit PodGroup + the
+    # standalone app's per-group PodGroups (2 replicas).
+    pgs = [d["metadata"]["name"] for d in docs if d["kind"] == "PodGroup"]
+    assert sorted(pgs) == ["arks-qwen-pd", "arks-qwen2.5-7b-0",
+                           "arks-qwen2.5-7b-1"]

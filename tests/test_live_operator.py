@@ -289,3 +289,29 @@ def test_live_unified_disagg_unit_podgroup(live):
         nm = s["metadata"]["name"]
         assert api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
                        "default", nm) is None
+
+
+def test_live_unified_to_legacy_cleans_unit_podgroup(live):
+    """Switching a live disaggregated app from unified back to legacy must
+    delete the unit-wide PodGroup (its large minMember would otherwise
+    haunt the scheduler forever)."""
+    api, op = live
+    api.create(GV, "arksmodels", "default",
+               _cr("ArksModel", "m1", {"model": "org/m"}))
+    api.create(GV, "arksdisaggregatedapplications", "default", _cr(
+        "ArksDisaggregatedApplication", "sw", {
+            "runtime": "jax", "model": {"name": "m1"},
+            "servedModelName": "sw-served", "modelConfig": "tiny",
+            "mode": "unified", "podGroupPolicy": {"kubeScheduling": {}},
+            "prefill": {"replicas": 1}, "decode": {"replicas": 1},
+            "router": {"replicas": 1},
+        }))
+    wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                             "default", "arks-sw"))
+    api.patch(GV, "arksdisaggregatedapplications", "default", "sw",
+              {"spec": {"mode": "legacy"}})
+    wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                             "default", "arks-sw") is None)
+    # Legacy per-group PodGroups take its place.
+    wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
+                             "default", "arks-sw-prefill-0"))
