@@ -62,14 +62,19 @@ def main() -> None:
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     from arks_tpu.control.manager import build_manager
+    from arks_tpu.control.store import Store
     from arks_tpu.gateway.server import Gateway
 
-    mgr = build_manager(models_root=args.models_root,
-                        local_platform=args.local_platform)
+    store = Store()
+    gateway = None if args.no_gateway else Gateway(store, port=args.gateway_port)
+    # The embedded gateway's admitted-request rates drive the native
+    # autoscaler (Application.spec.autoscale) — K8s deployments use
+    # deploy/hpa.yaml over the same metric instead.
+    mgr = build_manager(models_root=args.models_root, store=store,
+                        local_platform=args.local_platform,
+                        rate_source=gateway.rate.rpm if gateway else None)
     mgr.start()
-    gateway = None
-    if not args.no_gateway:
-        gateway = Gateway(mgr.store, port=args.gateway_port)
+    if gateway is not None:
         gateway.start(background=True)
         log.info("gateway on :%d", gateway.port)
     for path in args.manifests:

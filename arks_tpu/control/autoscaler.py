@@ -1,0 +1,153 @@
+"""Autoscaler: replica scaling driven by gateway request rates.
+
+The reference defers autoscaling entirely to Kubernetes HPA over the
+gateway/runtime Prometheus metrics (SURVEY.md §7 step 7); it ships no
+autoscaling code.  The TPU build covers both deployment shapes:
+
+- **K8s / live-operator**: ``deploy/hpa.yaml`` — a standard HPA over the
+  gateway's ``gateway_requests_total`` rate via prometheus-adapter, scaling
+  ``Application.spec.replicas`` through the CRD's scale-like semantics.
+- **Local single-binary** (this module): the operator closes the loop
+  natively.  ``Application.spec.autoscale``:
+
+  .. code-block:: yaml
+
+      autoscale:
+        minReplicas: 1
+        maxReplicas: 4
+        targetRPMPerReplica: 120          # admitted requests/min/replica
+        scaleDownStabilizationSeconds: 60 # damping, HPA-style
+
+  Each tick reads the embedded gateway's per-endpoint admitted-request
+  rate, computes ``ceil(rpm / target)`` clamped to [min, max], scales UP
+  immediately and DOWN only after the demand has stayed low for the
+  stabilization window (flap damping — the same asymmetry HPA defaults
+  to, since a cold replica group pays model-load time).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Callable
+
+from arks_tpu.control.reconciler import Controller
+from arks_tpu.control.resources import Application
+
+log = logging.getLogger("arks_tpu.control.autoscaler")
+
+# rate_source(namespace, served_model_name) -> requests per minute.
+RateSource = Callable[[str, str], float]
+
+
+class AutoscalerController(Controller):
+    KIND = Application
+
+    def __init__(self, store, rate_source: RateSource,
+                 interval_s: float = 10.0):
+        super().__init__(store, workers=1)
+        self.rate_source = rate_source
+        self.interval_s = interval_s
+        # (ns, name) -> monotonic time the demand first dropped below the
+        # current replica count (scale-down stabilization clock).
+        self._below_since: dict[tuple[str, str], float] = {}
+        # (ns, name) -> last status written (suppress no-op status churn:
+        # each write fires a watch event that wakes every Application
+        # watcher, so continuous observedRPM jitter must not write).
+        self._last_status: dict[tuple[str, str], dict] = {}
+        self._ticker: threading.Thread | None = None
+
+    # Periodic evaluation runs off a DEDICATED ticker, not Result requeues:
+    # a self-requeue per reconcile compounds with watch-triggered reconciles
+    # (our own status writes included) into an ever-growing stream of
+    # delayed queue entries — measured 13x the configured rate before this
+    # design.  The ticker enqueues each autoscaled app once per interval;
+    # watch events still give immediate reaction to spec edits.
+    def start(self) -> None:
+        super().start()
+
+        def tick() -> None:
+            while self._running:
+                time.sleep(self.interval_s)
+                try:
+                    for app in self.store.list(Application):
+                        if app.spec.get("autoscale"):
+                            self.queue.add(app.key)
+                except Exception:
+                    log.exception("autoscaler tick failed")
+
+        self._ticker = threading.Thread(target=tick, name="autoscaler-tick",
+                                        daemon=True)
+        self._ticker.start()
+        self._threads.append(self._ticker)
+
+    def finalize(self, app: Application) -> None:
+        self._below_since.pop(app.key, None)
+        self._last_status.pop(app.key, None)
+
+    def _demand_share(self, app: Application) -> float:
+        """This app's share of the endpoint's demand.  The endpoint
+        controller routes one served name across EVERY matching app with
+        equal default weights (endpoint_controller), so each app sees
+        total/N — scaling each app to the full total would over-provision
+        N-fold."""
+        served = app.served_model_name
+        total = float(self.rate_source(app.namespace, served))
+        peers = sum(1 for a in self.store.list(Application,
+                                               namespace=app.namespace)
+                    if a.served_model_name == served)
+        return total / max(peers, 1)
+
+    def reconcile(self, app: Application) -> Result | None:
+        au = app.spec.get("autoscale")
+        if not au:
+            self._below_since.pop(app.key, None)
+            self._last_status.pop(app.key, None)
+            return None
+        lo = max(au.get("minReplicas", 1), 0)
+        hi = max(au.get("maxReplicas", lo), lo)
+        target = max(au.get("targetRPMPerReplica", 60), 1)
+        rpm = self._demand_share(app)
+        cur = app.spec.get("replicas", 1)
+        desired = min(hi, max(lo, math.ceil(rpm / target)))
+
+        now = time.monotonic()
+        if desired > cur:
+            # Scale up immediately: under-provisioning is user-visible.
+            self._below_since.pop(app.key, None)
+            self._scale(app, desired, rpm)
+            return None
+        if desired < cur:
+            stab = au.get("scaleDownStabilizationSeconds", 60)
+            since = self._below_since.setdefault(app.key, now)
+            if now - since >= stab:
+                self._scale(app, desired, rpm)
+                self._below_since.pop(app.key, None)
+            return None
+        self._below_since.pop(app.key, None)
+        status = {"observedRPM": round(rpm, 1), "desiredReplicas": desired}
+        last = self._last_status.get(app.key)
+        # Write only on a meaningful change (desired flip, or rpm moved by
+        # >10% or >1): jitter-driven writes would storm every watcher.
+        if last is None or last["desiredReplicas"] != desired or (
+                abs(last["observedRPM"] - status["observedRPM"])
+                > max(1.0, 0.1 * max(last["observedRPM"], 1.0))):
+            app.status["autoscale"] = status
+            self.store.update_status(app)
+            self._last_status[app.key] = status
+        return None
+
+    def _scale(self, app: Application, desired: int, rpm: float) -> None:
+        log.info("autoscale %s/%s: rpm=%.1f replicas %d -> %d",
+                 app.namespace, app.name, rpm,
+                 app.spec.get("replicas", 1), desired)
+        app.spec["replicas"] = desired
+        status = {"observedRPM": round(rpm, 1), "desiredReplicas": desired}
+        app.status["autoscale"] = status
+        self._last_status[app.key] = status
+        # Spec write wakes the ApplicationController, which resizes the
+        # GangSet; a Conflict (someone else wrote first) retries via the
+        # workqueue's error backoff against the fresh object.
+        self.store.update(app)

@@ -20,12 +20,18 @@ def build_manager(
     store: Store | None = None,
     fetcher=default_fetcher,
     local_platform: str | None = None,
+    rate_source=None,
+    autoscale_interval_s: float = 10.0,
 ) -> Manager:
     """Wire the controller set over one store.
 
     Token/Quota have no controllers — by design, matching the reference where
     both reconcilers are unregistered no-ops (cmd/main.go:264-277); the
     gateway consumes those resources read-only.
+
+    ``rate_source(namespace, served_model_name) -> rpm`` (typically the
+    embedded gateway's RequestRateTracker.rpm) enables the native
+    autoscaler over ``Application.spec.autoscale``.
     """
     mgr = Manager(store)
     driver = driver or LocalProcessDriver()
@@ -35,4 +41,8 @@ def build_manager(
     mgr.add(DisaggregatedApplicationController(
         mgr.store, local_platform=local_platform))
     mgr.add(EndpointController(mgr.store))
+    if rate_source is not None:
+        from arks_tpu.control.autoscaler import AutoscalerController
+        mgr.add(AutoscalerController(mgr.store, rate_source,
+                                     interval_s=autoscale_interval_s))
     return mgr

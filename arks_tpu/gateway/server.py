@@ -125,6 +125,33 @@ def make_usage_scanner():
     return PyUsageScanner()
 
 
+class RequestRateTracker:
+    """Per-endpoint admitted-request rate (rpm), two-minute-window sliding
+    estimate: prev-window count weighted by the un-elapsed fraction + the
+    current window — cheap, lock-bounded, and smooth enough for the
+    autoscaler (arks_tpu.control.autoscaler) to damp on."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, str], dict[int, int]] = {}
+
+    def record(self, namespace: str, endpoint: str) -> None:
+        m = int(time.time() // 60)
+        with self._lock:
+            w = self._counts.setdefault((namespace, endpoint), {})
+            w[m] = w.get(m, 0) + 1
+            for k in [k for k in w if k < m - 1]:
+                del w[k]
+
+    def rpm(self, namespace: str, endpoint: str) -> float:
+        now = time.time()
+        m = int(now // 60)
+        frac = (now % 60) / 60
+        with self._lock:
+            w = self._counts.get((namespace, endpoint), {})
+            return w.get(m - 1, 0) * (1 - frac) + w.get(m, 0)
+
+
 class _Ejector:
     """Passive outlier detection per backend address."""
 
@@ -168,6 +195,7 @@ class Gateway:
         self.syncer = QuotaStatusSyncer(store, self.quota, sync_s=quota_sync_s)
         self.metrics = GatewayMetrics()
         self.ejector = _Ejector()
+        self.rate = RequestRateTracker()
         self.max_body_bytes = max_body_bytes
         self.process_timeout_s = process_timeout_s
         self._httpd: ThreadingHTTPServer | None = None
@@ -386,6 +414,8 @@ class Gateway:
         status = 500
         try:
             qos, body, limits = self._admit(handler)
+            # Admitted demand feeds the autoscaler's per-endpoint rate.
+            self.rate.record(qos.namespace, qos.endpoint)
             status = self._proxy(handler, qos, body, limits)
         except _ApiError as e:
             status = e.code

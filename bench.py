@@ -85,7 +85,9 @@ def main() -> None:
     from arks_tpu.models import transformer as tf
 
     model = os.environ.get("ARKS_BENCH_MODEL", "qwen2.5-7b")
-    batch = int(os.environ.get("ARKS_BENCH_BATCH", "128"))
+    # 192 beats 128 by ~9% and keeps ~2GB more HBM headroom than 256 on a
+    # 16GB v5e (256 was only ~1% faster than 192 when measured).
+    batch = int(os.environ.get("ARKS_BENCH_BATCH", "192"))
     cache_len = int(os.environ.get("ARKS_BENCH_CACHE_LEN", "1024"))
     steps = int(os.environ.get("ARKS_BENCH_STEPS", "32"))
     trials = int(os.environ.get("ARKS_BENCH_TRIALS", "3"))

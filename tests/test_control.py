@@ -299,3 +299,112 @@ def test_pick_rolling_restart_semantics():
                                 {0: False, 1: False}) == 0
     assert pick_rolling_restart({0: "old", 1: "old"}, "new",
                                 {0: True, 1: False}) == 1
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler (native HPA analogue over gateway request rates)
+# ---------------------------------------------------------------------------
+
+
+def test_request_rate_tracker(monkeypatch):
+    from arks_tpu.gateway import server as gws
+
+    t = [960.0]  # exactly a minute boundary (minute 16)
+    monkeypatch.setattr(gws.time, "time", lambda: t[0])
+    tr = gws.RequestRateTracker()
+    for _ in range(30):
+        tr.record("ns", "m")
+    # Same window: the 30 fresh requests count in full.
+    assert tr.rpm("ns", "m") == 30
+    # One window later at its midpoint: prev 30 weighted by the un-elapsed
+    # half + 12 current.
+    t[0] = 1050.0  # minute 17 + 30s
+    for _ in range(12):
+        tr.record("ns", "m")
+    assert abs(tr.rpm("ns", "m") - (30 * 0.5 + 12)) < 1e-6
+    # Two windows later: the old minutes have aged out entirely.
+    t[0] = 1140.0  # minute 19
+    assert tr.rpm("ns", "m") == 0
+    assert tr.rpm("other", "m") == 0
+
+
+def test_autoscaler_scales_up_then_down(tmp_path):
+    import time as _time
+
+    rpm = {"v": 500.0}
+    driver = FakeGangDriver()
+    mgr = build_manager(models_root=str(tmp_path / "models"), driver=driver,
+                        rate_source=lambda ns, model: rpm["v"],
+                        autoscale_interval_s=0.1)
+    mgr.start()
+    try:
+        store = mgr.store
+        store.create(res.Model(name="m1", spec={"model": "org/m"}))
+        store.create(res.Application(name="auto", spec={
+            "replicas": 1, "runtime": "jax", "model": {"name": "m1"},
+            "servedModelName": "auto-m", "modelConfig": "tiny",
+            "autoscale": {"minReplicas": 1, "maxReplicas": 3,
+                          "targetRPMPerReplica": 100,
+                          "scaleDownStabilizationSeconds": 1},
+        }))
+        deadline = _time.monotonic() + 20
+        # 500 rpm / 100 target -> 5, clamped to max 3; scale-up immediate.
+        while _time.monotonic() < deadline:
+            app = store.get(res.Application, "auto")
+            if app.spec.get("replicas") == 3:
+                break
+            _time.sleep(0.05)
+        assert store.get(res.Application, "auto").spec["replicas"] == 3
+        # Gang followed.
+        gs = store.get(res.GangSet, "auto")
+        assert gs.spec["replicas"] == 3
+
+        # Demand drops; scale-down waits the stabilization window then lands
+        # on the clamped minimum.
+        rpm["v"] = 0.0
+        t0 = _time.monotonic()
+        while _time.monotonic() < deadline:
+            app = store.get(res.Application, "auto")
+            if app.spec.get("replicas") == 1:
+                break
+            _time.sleep(0.05)
+        app = store.get(res.Application, "auto")
+        assert app.spec["replicas"] == 1
+        assert _time.monotonic() - t0 >= 0.9  # damped, not instant
+        assert app.status["autoscale"]["desiredReplicas"] == 1
+    finally:
+        mgr.stop()
+
+
+def test_autoscaler_splits_demand_across_peer_apps(tmp_path):
+    """Multiple Applications behind one served name split the endpoint's
+    demand — each must scale to its SHARE, not the full total."""
+    import time as _time
+
+    driver = FakeGangDriver()
+    mgr = build_manager(models_root=str(tmp_path / "models"), driver=driver,
+                        rate_source=lambda ns, model: 400.0,
+                        autoscale_interval_s=0.1)
+    mgr.start()
+    try:
+        store = mgr.store
+        store.create(res.Model(name="m1", spec={"model": "org/m"}))
+        for name in ("peer-a", "peer-b"):
+            store.create(res.Application(name=name, spec={
+                "replicas": 1, "runtime": "jax", "model": {"name": "m1"},
+                "servedModelName": "shared-m", "modelConfig": "tiny",
+                "autoscale": {"minReplicas": 1, "maxReplicas": 8,
+                              "targetRPMPerReplica": 100},
+            }))
+        deadline = _time.monotonic() + 20
+        while _time.monotonic() < deadline:
+            reps = [store.get(res.Application, n).spec.get("replicas")
+                    for n in ("peer-a", "peer-b")]
+            if reps == [2, 2]:
+                break
+            _time.sleep(0.05)
+        # 400 rpm / 2 peers = 200 each -> 2 replicas each (not 4).
+        assert [store.get(res.Application, n).spec["replicas"]
+                for n in ("peer-a", "peer-b")] == [2, 2]
+    finally:
+        mgr.stop()
