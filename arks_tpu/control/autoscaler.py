@@ -89,15 +89,21 @@ class AutoscalerController(Controller):
 
     def _demand_share(self, app: Application) -> float:
         """This app's share of the endpoint's demand.  The endpoint
-        controller routes one served name across EVERY matching app with
-        equal default weights (endpoint_controller), so each app sees
-        total/N — scaling each app to the full total would over-provision
-        N-fold."""
+        controller routes one served name across every SERVING backend —
+        standalone or disaggregated — with equal default weights
+        (endpoint_controller), so each backend sees total/N.  Peers are
+        counted by the same serving() rule the router uses: a crash-looping
+        peer takes no traffic and must not dilute this app's share."""
+        from arks_tpu.control.resources import DisaggregatedApplication
         served = app.served_model_name
         total = float(self.rate_source(app.namespace, served))
-        peers = sum(1 for a in self.store.list(Application,
-                                               namespace=app.namespace)
-                    if a.served_model_name == served)
+        peers = 0
+        for kind in (Application, DisaggregatedApplication):
+            for a in self.store.list(kind, namespace=app.namespace):
+                if a.served_model_name == served and a.serving():
+                    peers += 1
+        # A not-yet-serving self still counts itself once: it is about to
+        # join the rotation the moment it comes up.
         return total / max(peers, 1)
 
     def reconcile(self, app: Application) -> Result | None:

@@ -83,6 +83,16 @@ class EngineConfig:
     # and the prefix cache are disabled under pp (their dynamic layer
     # indexing would gather the stage-sharded cache).
     pipeline_parallel: int = 1
+    # Speculative decoding: a small draft model proposes draft_len-1 tokens
+    # per dispatch, the target verifies ALL of them in ONE multi-token pass
+    # (transformer.verify_step) and keeps the longest matching prefix plus
+    # one bonus token.  Greedy-exact: emitted tokens are IDENTICAL to
+    # target-only greedy decoding — the draft only changes how many land
+    # per dispatch.  Applied to all-greedy dispatches; sampled slots fall
+    # back to the normal fused loop.  Single-host (no dispatcher op),
+    # dp/pp-exclusive.
+    draft_model: str | None = None
+    draft_len: int = 4
     dtype: str | None = None   # default: model config dtype
     # "auto"|"bf16"|"int8": int8 halves KV HBM traffic and doubles cache
     # capacity (per-token scales, dequantized inside the attention kernel).
@@ -170,6 +180,11 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     num_emitted: int = 0  # tokens already streamed to the request queue
     first_token_time: float | None = None
+    # Speculative decoding: the draft cache mirrors this slot's rows.  A
+    # fused-loop dispatch advances the target cache only, so the mirror
+    # goes stale and the slot must ride the fused loop for the rest of its
+    # life (correct either way; the spec path would just mispredict).
+    draft_synced: bool = False
 
 
 @dataclasses.dataclass
@@ -222,6 +237,15 @@ class EngineMetrics:
             "prefix_cache_usage_bytes", "Host bytes held by the prefix cache")
         self.prefix_cache_hit_rate = r.gauge(
             "prefix_cache_hit_rate", "Lifetime prefix-cache token hit rate")
+        self.spec_decode_proposed_tokens_total = r.counter(
+            "spec_decode_proposed_tokens_total",
+            "Draft tokens proposed to the verifier")
+        self.spec_decode_accepted_tokens_total = r.counter(
+            "spec_decode_accepted_tokens_total",
+            "Draft tokens accepted by the verifier")
+        self.spec_decode_acceptance_rate = r.gauge(
+            "spec_decode_acceptance_rate",
+            "Lifetime draft-token acceptance rate")
 
 
 class InferenceEngine:
@@ -233,6 +257,8 @@ class InferenceEngine:
         params: tf.Params | None = None,
         mesh=None,
         registry: prom.Registry | None = None,
+        draft_params: tf.Params | None = None,
+        draft_cfg: ModelConfig | None = None,
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg
@@ -354,6 +380,41 @@ class InferenceEngine:
             self._prefix = PrefixKVCache(
                 self._chunk, engine_cfg.prefix_cache_mb * 2**20)
 
+        # Speculative decoding: draft model params + its own slot cache.
+        self._draft_cfg = None
+        self._draft_params = None
+        self._draft_cache = None
+        if engine_cfg.draft_model:
+            if self._pp > 1:
+                raise ValueError(
+                    "speculative decoding is incompatible with pipeline_parallel")
+            if mesh is not None and mesh.shape.get(tf.AXIS_DATA, 1) > 1:
+                raise ValueError(
+                    "speculative decoding requires data_parallel == 1")
+            if engine_cfg.draft_len < 2:
+                raise ValueError("draft_len must be >= 2")
+            from arks_tpu.models import get_config
+            dcfg = draft_cfg or get_config(engine_cfg.draft_model)
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target {cfg.vocab_size}"
+                    " — the draft must share the target's tokenizer")
+            self._draft_cfg = dcfg
+            dparams = draft_params
+            if dparams is None:
+                dparams = tf.init_params(
+                    dcfg, jax.random.PRNGKey(engine_cfg.seed + 1), dtype)
+            if mesh is not None:
+                dparams = tf.shard_params(dparams, dcfg, mesh)
+            self._draft_params = dparams
+            self._draft_cache = tf.init_cache(
+                dcfg, engine_cfg.num_slots, engine_cfg.max_cache_len,
+                self._cache_dtype(dtype), quantized=engine_cfg.kv_quantized)
+            if mesh is not None:
+                self._draft_cache = tf.shard_cache(self._draft_cache, dcfg, mesh)
+
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._queued_rids: set[str] = set()
         self._aborted: set[str] = set()
@@ -446,6 +507,47 @@ class InferenceEngine:
             return cache, sstate, toks  # toks [K, B]
 
         self._decode_fn = jax.jit(decode_loop, donate_argnums=(1, 4))
+
+        if self._draft_cfg is not None:
+            dcfg = self._draft_cfg
+            DK = self.ecfg.draft_len
+
+            def draft_prefill_insert(dparams, dcache, tokens, length, slot):
+                _, ks, vs = tf.prefill(dparams, dcfg, tokens, length, mesh)
+                return tf.insert(dcache, ks, vs, slot)
+
+            self._draft_prefill_fn = jax.jit(draft_prefill_insert,
+                                             donate_argnums=(1,))
+
+            def spec_loop(params, dparams, cache, dcache, tokens, lengths):
+                # Draft DK-1 greedy continuations...
+                def body(carry, _):
+                    dcache, tok, ln = carry
+                    logits, dcache = tf.decode_step(dparams, dcfg, dcache,
+                                                    tok, ln, mesh)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (dcache, nxt, ln + 1), nxt
+
+                # DK steps, not DK-1: the extra step writes the LAST draft
+                # token's KV row, so after a fully-accepted block the next
+                # dispatch's draft attends a complete prefix (without it,
+                # row L+DK-1 is garbage and the draft mispredicts every
+                # DK-th token even when draft == target).
+                (dcache, _, _), outs = jax.lax.scan(
+                    body, (dcache, tokens, lengths), None, length=DK)
+                drafts = jnp.swapaxes(outs, 0, 1)[:, : DK - 1]  # [B, DK-1]
+                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                # ...then verify the whole block in ONE target pass.
+                vlogits, cache = tf.verify_step(params, cfg, cache, block,
+                                                lengths, mesh)
+                a = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, DK]
+                # Greedy acceptance: keep the matching prefix + the target's
+                # token at the first mismatch (always >= 1 token/slot).
+                match = (a[:, :-1] == drafts).astype(jnp.int32)
+                counts = 1 + jnp.cumprod(match, axis=1).sum(axis=1)
+                return cache, dcache, a, counts
+
+            self._spec_fn = jax.jit(spec_loop, donate_argnums=(2, 3))
 
     # ------------------------------------------------------------------
     # Public API
@@ -548,6 +650,13 @@ class InferenceEngine:
             self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
             self.ecfg.num_slots, self.ecfg.seed)
+        if self._draft_cfg is not None:
+            self._draft_cache = tf.init_cache(
+                self._draft_cfg, self.ecfg.num_slots, self.ecfg.max_cache_len,
+                self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized)
+            if self.mesh is not None:
+                self._draft_cache = tf.shard_cache(
+                    self._draft_cache, self._draft_cfg, self.mesh)
         self._lengths[:] = 0
         self._last_token[:] = 0
         # A fault between _free.pop() and slot registration would otherwise
@@ -700,8 +809,26 @@ class InferenceEngine:
 
     def _register_slot(self, req: Request, slot: int, first: int,
                        num_prompt: int) -> None:
+        # Draft-cache prompt prefill (speculative decoding).  Skipped when
+        # the prompt tokens aren't available (disagg-transferred KV) or the
+        # prompt exceeds the one-shot buckets (a monolithic draft prefill
+        # would reintroduce the head-of-line stall chunking exists to
+        # prevent): the slot then rides the fused loop — still CORRECT, the
+        # verifier is exact; only the draft speedup is forfeited.
+        draft_synced = False
+        if (self._draft_cfg is not None and req.prompt_ids
+                and len(req.prompt_ids) <= self._buckets[-1]):
+            ids = list(req.prompt_ids)
+            bucket = next(b for b in self._buckets if b >= len(ids))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(ids)] = ids
+            self._draft_cache = self._draft_prefill_fn(
+                self._draft_params, self._draft_cache, jnp.asarray(padded),
+                jnp.asarray([len(ids)], jnp.int32), jnp.asarray(slot))
+            draft_synced = True
         now = time.monotonic()
-        st = _Slot(request=req, num_prompt=num_prompt)
+        st = _Slot(request=req, num_prompt=num_prompt,
+                   draft_synced=draft_synced)
         st.generated.append(first)
         st.first_token_time = now
         self._slots[slot] = st
@@ -928,12 +1055,27 @@ class InferenceEngine:
         with self._abort_lock:
             self._aborted -= consumed
             self._aborted &= active | self._queued_rids
-        # Retire any slot that would overflow its cache this dispatch.
+        # Retire any slot that would overflow its cache this dispatch (the
+        # spec path writes draft_len rows, the fused loop K).
+        margin = max(K, self.ecfg.draft_len if self._draft_cfg else 0)
         for slot in list(self._slots):
-            if int(self._lengths[slot]) + 1 + K > self.ecfg.max_cache_len:
+            if int(self._lengths[slot]) + 1 + margin > self.ecfg.max_cache_len:
                 self._finish(slot, "length")
         if not self._slots:
             return
+
+        # Speculative path: all slots greedy AND draft-synced, no follower
+        # processes to mirror (single-host).
+        if (self._draft_cfg is not None and self.dispatcher is None
+                and all(st.request.params.temperature == 0
+                        and st.draft_synced
+                        for st in self._slots.values())):
+            return self._spec_dispatch()
+        if self._draft_cfg is not None:
+            # The fused loop advances the target cache only — every live
+            # slot's draft mirror is stale from here on.
+            for st in self._slots.values():
+                st.draft_synced = False
 
         t0 = time.monotonic()
         self._emit("decode", tokens=np.array(self._last_token),
@@ -959,6 +1101,56 @@ class InferenceEngine:
             self._last_token[slot] = int(toks[K - 1, slot])
             self.metrics.generation_tokens_total.inc(new_tokens)
             self.metrics.time_per_output_token_seconds.observe(dt / K)
+            if finished:
+                self._finish(slot, self._finish_reason(st))
+            else:
+                delta = st.generated[st.num_emitted:]
+                st.num_emitted = len(st.generated)
+                st.request.outputs.put(RequestOutput(
+                    request_id=st.request.request_id, token_ids=delta,
+                    num_prompt_tokens=st.num_prompt))
+
+    def _spec_dispatch(self) -> None:
+        """One speculative step: draft proposes, target verifies, each slot
+        advances 1..draft_len tokens.  Greedy-exact — emitted tokens equal
+        target-only greedy decoding."""
+        DK = self.ecfg.draft_len
+        t0 = time.monotonic()
+        self._cache, self._draft_cache, a, counts = self._spec_fn(
+            self.params, self._draft_params, self._cache, self._draft_cache,
+            jnp.asarray(self._last_token), jnp.asarray(self._lengths))
+        a = np.asarray(a)            # [B, DK] — host sync point
+        counts = np.asarray(counts)
+        dt = time.monotonic() - t0
+
+        n_slots = len(self._slots)
+        accepted = sum(int(counts[s]) - 1 for s in self._slots)
+        self.metrics.spec_decode_proposed_tokens_total.inc((DK - 1) * n_slots)
+        self.metrics.spec_decode_accepted_tokens_total.inc(accepted)
+        self._spec_proposed += (DK - 1) * n_slots
+        self._spec_accepted += accepted
+        self.metrics.spec_decode_acceptance_rate.set(
+            self._spec_accepted / max(self._spec_proposed, 1))
+
+        for slot in list(self._slots):
+            st = self._slots[slot]
+            c = int(counts[slot])
+            finished = False
+            new_tokens = 0
+            for i in range(c):
+                tok = int(a[slot, i])
+                st.generated.append(tok)
+                new_tokens += 1
+                if (self._is_stop(st, tok)
+                        or len(st.generated) >= st.request.params.max_tokens):
+                    finished = True
+                    break
+            # Cache rows valid through the accepted prefix (t0 + c-1 drafts).
+            self._lengths[slot] += c
+            self._last_token[slot] = int(a[slot, c - 1])
+            self.metrics.generation_tokens_total.inc(new_tokens)
+            self.metrics.time_per_output_token_seconds.observe(
+                dt / max(new_tokens, 1))
             if finished:
                 self._finish(slot, self._finish_reason(st))
             else:

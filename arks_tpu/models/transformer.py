@@ -543,6 +543,54 @@ def decode_step(
     return logits, KVCache(k=ks, v=vs, k_scale=kss, v_scale=vss)
 
 
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: KVCache,
+    tokens: jnp.ndarray,   # [B, K] int32 — K tokens per slot (t0 + drafts)
+    lengths: jnp.ndarray,  # [B] int32 — tokens already in cache per slot
+    mesh: Mesh | None = None,
+    batch_axis: str | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Multi-token decode: advance every slot K tokens in ONE pass.
+
+    The speculative-decoding verifier (and a general batched multi-token
+    scorer): token k of slot b sits at position lengths[b]+k, its KV is
+    written there, and it attends the cache prefix plus the earlier tokens
+    of its own block (causal).  Returns (logits [B, K, V] f32, cache).
+    Rows written for later-rejected draft tokens become garbage beyond the
+    accepted length — every read path masks by position, and the next
+    dispatch overwrites them (the same invariant as decode_step's padding
+    writes)."""
+    b, kk = tokens.shape
+    h = embed_lookup(params["embed"], tokens,
+                     params["layers"]["attn_norm"].dtype)      # [B, K, E]
+    h = _constrain(h, mesh, batch_axis, None, None)
+    positions = lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)  # [B, K]
+    kv_sharded = mesh is not None and shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1))
+    from arks_tpu.ops.attention import verify_update_and_attend
+
+    def body(carry, xs):
+        h, kc, vc, ksc, vsc = carry
+        lp, layer = xs
+        q, k, v = _block_qkv(h, lp, cfg, positions)  # [B, K, H(.kv), D]
+        attn, kc, vc, ksc, vsc = verify_update_and_attend(
+            q, k, v, kc, vc, positions, lengths, layer, mesh, batch_axis,
+            kv_sharded, model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
+        attn = attn.reshape(b, kk, cfg.q_dim)
+        attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
+        h = _block_tail(h, attn, lp, cfg, mesh, batch_axis)
+        return (h, kc, vc, ksc, vsc), None
+
+    (h, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    # unembed_logits is 2D-shaped; fold K into the batch for the vocab dot.
+    logits = _unembed(h.reshape(b * kk, -1), params, cfg, mesh,
+                      batch_axis).reshape(b, kk, -1)
+    return logits, KVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
 # ---------------------------------------------------------------------------
 # Jit wrappers
 # ---------------------------------------------------------------------------

@@ -162,6 +162,84 @@ def chunk_attention_xla(
     return out.astype(q.dtype)
 
 
+def verify_update_and_attend(
+    q: jnp.ndarray,        # [B, K, H, D] — K tokens per slot
+    k_new: jnp.ndarray,    # [B, K, Hkv, D]
+    v_new: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L, B, Hkv, S, D] — FULL stacked cache
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, K] int32 — write positions per token
+    lengths: jnp.ndarray,    # [B] int32 — valid prefix before this block
+    layer,                   # int32
+    mesh=None,
+    batch_axis: str | None = None,
+    kv_sharded: bool = False,
+    model_axis: str = "model",
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray | None, jnp.ndarray | None]:
+    """Speculative-verify attention: write K rows per slot at ``positions``,
+    then attend each query over the cache prefix plus the causal part of its
+    own block (index s valid iff s <= positions[b, k], which equals
+    lengths[b]+k).  Returns ([B, K, H, D], kc, vc, k_scale, v_scale).
+
+    XLA path only: K is small (draft lengths 2-8) and the scores tensor
+    [B, Hkv, G, K, S] stays modest; under a mesh the partitioner reshards
+    exactly as the non-pallas decode branch does."""
+    del mesh, batch_axis, kv_sharded, model_axis, lengths
+    b, kk, h, d = q.shape
+    hkv = k_cache.shape[2]
+    g = h // hkv
+    quantized = k_scale is not None
+
+    kc_l = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
+    vc_l = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
+    b_idx = jnp.arange(b)[:, None, None]
+    h_idx = jnp.arange(hkv)[None, :, None]
+    pos = positions[:, None, :]                       # [B, 1, K]
+    kt = jnp.transpose(k_new, (0, 2, 1, 3))           # [B, Hkv, K, D]
+    vt = jnp.transpose(v_new, (0, 2, 1, 3))
+    if quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        ktq, ktn = quantize_kv(kt)
+        vtq, vtn = quantize_kv(vt)
+        kc_l = kc_l.at[b_idx, h_idx, pos].set(ktq)
+        vc_l = vc_l.at[b_idx, h_idx, pos].set(vtq)
+        ks_l = jax.lax.dynamic_index_in_dim(k_scale, layer, 0, keepdims=False)
+        vs_l = jax.lax.dynamic_index_in_dim(v_scale, layer, 0, keepdims=False)
+        ks_l = ks_l.at[b_idx, h_idx, pos].set(ktn)
+        vs_l = vs_l.at[b_idx, h_idx, pos].set(vtn)
+    else:
+        kc_l = kc_l.at[b_idx, h_idx, pos].set(kt.astype(kc_l.dtype))
+        vc_l = vc_l.at[b_idx, h_idx, pos].set(vt.astype(vc_l.dtype))
+
+    s = kc_l.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    qg = jnp.transpose(q.reshape(b, kk, hkv, g, d), (0, 2, 3, 1, 4))  # [B,Hkv,G,K,D]
+    scores = jnp.einsum("bkgqd,bksd->bkgqs", qg, kc_l.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    if quantized:
+        scores = scores * ks_l[:, :, None, None, :]
+    valid = jnp.arange(s)[None, None] <= positions[:, :, None]  # [B, K, S]
+    scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+    probs = _softmax(scores, axis=-1)
+    if quantized:
+        probs = probs * vs_l[:, :, None, None, :]
+    out = jnp.einsum("bkgqs,bksd->bkgqd", probs.astype(q.dtype),
+                     vc_l.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, kk, h, d).astype(q.dtype)
+
+    kc = jax.lax.dynamic_update_index_in_dim(k_cache, kc_l, layer, 0)
+    vc = jax.lax.dynamic_update_index_in_dim(v_cache, vc_l, layer, 0)
+    if quantized:
+        ks = jax.lax.dynamic_update_index_in_dim(k_scale, ks_l, layer, 0)
+        vs = jax.lax.dynamic_update_index_in_dim(v_scale, vs_l, layer, 0)
+        return out, kc, vc, ks, vs
+    return out, kc, vc, k_scale, v_scale
+
+
 def decode_update_and_attend(
     q: jnp.ndarray,        # [B, H, D] — this step's query per slot
     k_new: jnp.ndarray,    # [B, Hkv, D] — this step's KV per slot

@@ -59,6 +59,16 @@ def main() -> None:
                         "weight reads")
     p.add_argument("--prefix-cache-mb", type=int, default=256,
                    help="host-RAM budget for prefix KV reuse (0 disables)")
+    p.add_argument("--draft-model", default=None,
+                   help="speculative decoding: draft model config name or "
+                        "dir (must share the target tokenizer); greedy "
+                        "requests emit identical tokens, several per "
+                        "dispatch")
+    p.add_argument("--draft-model-path", default=None,
+                   help="draft weights dir (random init without it)")
+    p.add_argument("--draft-len", type=int, default=4,
+                   help="tokens per speculative dispatch (draft proposes "
+                        "draft-len - 1, target verifies all in one pass)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
@@ -158,13 +168,31 @@ def main() -> None:
         dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype,
         weight_dtype=args.weight_dtype, seed=args.seed,
         prefix_cache_mb=args.prefix_cache_mb,
+        draft_model=args.draft_model, draft_len=args.draft_len,
     )
+    draft_cfg = draft_params = None
+    if args.draft_model:
+        if os.path.isdir(args.draft_model):
+            draft_cfg = ModelConfig.from_hf_config(
+                args.draft_model, name=os.path.basename(args.draft_model))
+            # A weights DIR as --draft-model loads from that dir, mirroring
+            # --model's behavior (random-initializing silently would make
+            # the draft useless — ~0 acceptance — with no error).
+            draft_path = args.draft_model_path or args.draft_model
+        else:
+            draft_cfg = get_config(args.draft_model)
+            draft_path = args.draft_model_path
+        if draft_path:
+            from arks_tpu.models.weights import load_params
+            draft_params = load_params(draft_cfg, draft_path,
+                                       mesh=mesh, dtype=args.dtype)
     # Real weights without tokenizer assets = broken mount; fail fast then.
     from arks_tpu.models.weights import has_real_weights
     tokenizer = load_tokenizer(
         model_path if model_path and os.path.isdir(model_path) else None,
         strict=has_real_weights(model_path))
-    engine = InferenceEngine(cfg, ecfg, tokenizer, params=params, mesh=mesh)
+    engine = InferenceEngine(cfg, ecfg, tokenizer, params=params, mesh=mesh,
+                             draft_params=draft_params, draft_cfg=draft_cfg)
 
     served = args.served_model_name or cfg.name
 

@@ -394,7 +394,12 @@ def test_autoscaler_splits_demand_across_peer_apps(tmp_path):
                 "replicas": 1, "runtime": "jax", "model": {"name": "m1"},
                 "servedModelName": "shared-m", "modelConfig": "tiny",
                 "autoscale": {"minReplicas": 1, "maxReplicas": 8,
-                              "targetRPMPerReplica": 100},
+                              "targetRPMPerReplica": 100,
+                              # Short window: before both peers are
+                              # serving(), shares are transiently too big
+                              # and the test must not wait the 60s default
+                              # to correct down.
+                              "scaleDownStabilizationSeconds": 1},
             }))
         deadline = _time.monotonic() + 20
         while _time.monotonic() < deadline:
