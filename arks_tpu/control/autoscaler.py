@@ -102,8 +102,12 @@ class AutoscalerController(Controller):
             for a in self.store.list(kind, namespace=app.namespace):
                 if a.served_model_name == served and a.serving():
                     peers += 1
-        # A not-yet-serving self still counts itself once: it is about to
-        # join the rotation the moment it comes up.
+        # A not-yet-serving SELF joins the rotation the moment it comes up,
+        # so it counts toward its own divisor — otherwise a freshly created
+        # peer briefly sees the whole endpoint's demand and over-scales
+        # until the scale-down window corrects it.
+        if not app.serving():
+            peers += 1
         return total / max(peers, 1)
 
     def reconcile(self, app: Application) -> Result | None:

@@ -85,12 +85,12 @@ class EngineConfig:
     pipeline_parallel: int = 1
     # Speculative decoding: a small draft model proposes draft_len-1 tokens
     # per dispatch, the target verifies ALL of them in ONE multi-token pass
-    # (transformer.verify_step) and keeps the longest matching prefix plus
-    # one bonus token.  Greedy-exact: emitted tokens are IDENTICAL to
-    # target-only greedy decoding — the draft only changes how many land
-    # per dispatch.  Applied to all-greedy dispatches; sampled slots fall
-    # back to the normal fused loop.  Single-host (no dispatcher op),
-    # dp/pp-exclusive.
+    # (transformer.verify_step).  Greedy slots keep the longest argmax-
+    # matching prefix plus one bonus token — emitted tokens IDENTICAL to
+    # target-only greedy decoding.  Sampled slots use rejection sampling
+    # (sampler.speculative_accept) — exact in DISTRIBUTION against the
+    # engine's own effective sampling dist.  Single-host (no dispatcher
+    # op), dp/pp-exclusive.
     draft_model: str | None = None
     draft_len: int = 4
     dtype: str | None = None   # default: model config dtype
@@ -519,35 +519,41 @@ class InferenceEngine:
             self._draft_prefill_fn = jax.jit(draft_prefill_insert,
                                              donate_argnums=(1,))
 
-            def spec_loop(params, dparams, cache, dcache, tokens, lengths):
-                # Draft DK-1 greedy continuations...
+            def spec_loop(params, dparams, cache, dcache, tokens, lengths,
+                          sstate):
+                # Draft DK-1 proposals (greedy slots argmax, sampled slots
+                # draw from their effective filtered distribution)...
                 def body(carry, _):
-                    dcache, tok, ln = carry
+                    dcache, tok, ln, keys = carry
                     logits, dcache = tf.decode_step(dparams, dcfg, dcache,
                                                     tok, ln, mesh)
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    return (dcache, nxt, ln + 1), nxt
+                    tok, q, qp, qi, keys = sampler_mod.draft_sample(
+                        logits, sstate, keys)
+                    return (dcache, tok, ln + 1, keys), (tok, q, qp, qi)
 
                 # DK steps, not DK-1: the extra step writes the LAST draft
                 # token's KV row, so after a fully-accepted block the next
                 # dispatch's draft attends a complete prefix (without it,
                 # row L+DK-1 is garbage and the draft mispredicts every
                 # DK-th token even when draft == target).
-                (dcache, _, _), outs = jax.lax.scan(
-                    body, (dcache, tokens, lengths), None, length=DK)
-                drafts = jnp.swapaxes(outs, 0, 1)[:, : DK - 1]  # [B, DK-1]
+                (dcache, _, _, keys), (toks, qs, qps, qis) = jax.lax.scan(
+                    body, (dcache, tokens, lengths, sstate.key), None,
+                    length=DK)
+                drafts = jnp.swapaxes(toks, 0, 1)[:, : DK - 1]   # [B, DK-1]
+                q_sel = jnp.swapaxes(qs, 0, 1)[:, : DK - 1]
+                q_probs = jnp.swapaxes(qps, 0, 1)[:, : DK - 1]   # [B,DK-1,W]
+                q_idx = jnp.swapaxes(qis, 0, 1)[:, : DK - 1]
                 block = jnp.concatenate([tokens[:, None], drafts], axis=1)
-                # ...then verify the whole block in ONE target pass.
+                # ...then verify the whole block in ONE target pass and
+                # accept by rejection sampling (exact in distribution;
+                # greedy slots reduce to argmax prefix matching).
                 vlogits, cache = tf.verify_step(params, cfg, cache, block,
                                                 lengths, mesh)
-                a = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, DK]
-                # Greedy acceptance: keep the matching prefix + the target's
-                # token at the first mismatch (always >= 1 token/slot).
-                match = (a[:, :-1] == drafts).astype(jnp.int32)
-                counts = 1 + jnp.cumprod(match, axis=1).sum(axis=1)
-                return cache, dcache, a, counts
+                out, counts, keys = sampler_mod.speculative_accept(
+                    drafts, q_sel, q_probs, q_idx, vlogits, sstate, keys)
+                return cache, dcache, out, counts, sstate._replace(key=keys)
 
-            self._spec_fn = jax.jit(spec_loop, donate_argnums=(2, 3))
+            self._spec_fn = jax.jit(spec_loop, donate_argnums=(2, 3, 6))
 
     # ------------------------------------------------------------------
     # Public API
@@ -810,21 +816,33 @@ class InferenceEngine:
     def _register_slot(self, req: Request, slot: int, first: int,
                        num_prompt: int) -> None:
         # Draft-cache prompt prefill (speculative decoding).  Skipped when
-        # the prompt tokens aren't available (disagg-transferred KV) or the
+        # the prompt tokens aren't available (disagg-transferred KV), the
         # prompt exceeds the one-shot buckets (a monolithic draft prefill
         # would reintroduce the head-of-line stall chunking exists to
-        # prevent): the slot then rides the fused loop — still CORRECT, the
-        # verifier is exact; only the draft speedup is forfeited.
+        # prevent), or a multi-host dispatcher is wired (followers have no
+        # replay op for this dispatch — an unmirrored jit would wedge the
+        # gang's collectives): the slot then rides the fused loop — still
+        # CORRECT, the verifier is exact; only the draft speedup is
+        # forfeited.
         draft_synced = False
-        if (self._draft_cfg is not None and req.prompt_ids
+        if (self._draft_cfg is not None and self.dispatcher is None
+                and req.prompt_ids
                 and len(req.prompt_ids) <= self._buckets[-1]):
             ids = list(req.prompt_ids)
-            bucket = next(b for b in self._buckets if b >= len(ids))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(ids)] = ids
-            self._draft_cache = self._draft_prefill_fn(
-                self._draft_params, self._draft_cache, jnp.asarray(padded),
-                jnp.asarray([len(ids)], jnp.int32), jnp.asarray(slot))
+            try:
+                self._draft_cache = self._draft_prefill_fn(
+                    self._draft_params, self._draft_cache,
+                    jnp.asarray(self._pad_to_bucket(ids)),
+                    jnp.asarray([len(ids)], jnp.int32), jnp.asarray(slot))
+            except Exception:
+                # Not registered yet: _run's recovery can't see this
+                # request — fail it here or its client blocks forever
+                # (same contract as the pre-registration dispatches).
+                self._free.append(slot)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=num_prompt))
+                raise
             draft_synced = True
         now = time.monotonic()
         st = _Slot(request=req, num_prompt=num_prompt,
@@ -875,6 +893,15 @@ class InferenceEngine:
         last = self._buckets[-1]
         return min(-(-plen // last) * last, self.ecfg.max_cache_len)
 
+    def _pad_to_bucket(self, ids: list[int]) -> np.ndarray:
+        """[1, bucket] zero-padded prompt at the smallest covering bucket —
+        the ONE padding implementation (one-shot prefill, draft prefill);
+        shape agreement between them rides on this."""
+        bucket = next(b for b in self._buckets if b >= len(ids))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(ids)] = ids
+        return padded
+
     def _prepare_prompt(self, prompt_ids: list[int]) -> tuple[list[int], np.ndarray | None]:
         """Pad the prompt to the smallest prefill bucket.  Shared by the
         unified and disaggregated paths — the bit-identity guarantee between
@@ -891,10 +918,7 @@ class InferenceEngine:
                 f"length is {self.max_prompt_len}")
         if len(ids) > self._one_shot_limit():
             return ids, None  # chunked path
-        bucket = next(b for b in self._buckets if b >= len(ids))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(ids)] = ids
-        return ids, padded
+        return ids, self._pad_to_bucket(ids)
 
     # ------------------------------------------------------------------
     # Chunked prefill
@@ -1064,12 +1088,11 @@ class InferenceEngine:
         if not self._slots:
             return
 
-        # Speculative path: all slots greedy AND draft-synced, no follower
-        # processes to mirror (single-host).
+        # Speculative path: all slots draft-synced (greedy OR sampled — the
+        # rejection-sampled kernel is exact in distribution either way), no
+        # follower processes to mirror (single-host).
         if (self._draft_cfg is not None and self.dispatcher is None
-                and all(st.request.params.temperature == 0
-                        and st.draft_synced
-                        for st in self._slots.values())):
+                and all(st.draft_synced for st in self._slots.values())):
             return self._spec_dispatch()
         if self._draft_cfg is not None:
             # The fused loop advances the target cache only — every live
@@ -1112,13 +1135,16 @@ class InferenceEngine:
 
     def _spec_dispatch(self) -> None:
         """One speculative step: draft proposes, target verifies, each slot
-        advances 1..draft_len tokens.  Greedy-exact — emitted tokens equal
-        target-only greedy decoding."""
+        advances 1..draft_len tokens.  Greedy slots are byte-exact vs the
+        target-only path; sampled slots are exact in distribution (the
+        rejection kernel's guarantee)."""
         DK = self.ecfg.draft_len
         t0 = time.monotonic()
-        self._cache, self._draft_cache, a, counts = self._spec_fn(
+        (self._cache, self._draft_cache, a, counts,
+         self._sampling) = self._spec_fn(
             self.params, self._draft_params, self._cache, self._draft_cache,
-            jnp.asarray(self._last_token), jnp.asarray(self._lengths))
+            jnp.asarray(self._last_token), jnp.asarray(self._lengths),
+            self._sampling)
         a = np.asarray(a)            # [B, DK] — host sync point
         counts = np.asarray(counts)
         dt = time.monotonic() - t0

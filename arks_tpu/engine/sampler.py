@@ -49,17 +49,14 @@ def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
     )
 
 
-def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, SamplingState]:
-    """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
-
-    Greedy where temperature <= 0; otherwise temperature + top-k + top-p over
-    the TOP_K_MAX highest-logit candidates.
-    """
+def _filtered_scaled(logits: jnp.ndarray, state: SamplingState
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The effective per-slot sampling distribution in window form:
+    (scaled logits [B, W] with filtered entries at -inf, vocab ids
+    [B, W]) after temperature + top-k + top-p over the TOP_K_MAX window."""
     b, v = logits.shape
     window = min(TOP_K_MAX, v)
-    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    top_logits, top_idx = jax.lax.top_k(logits, window)  # [B, K], descending
+    top_logits, top_idx = jax.lax.top_k(logits, window)  # [B, W], descending
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
     scaled = top_logits / temp
 
@@ -73,7 +70,26 @@ def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, Samp
     probs = jax.nn.softmax(scaled, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = (cum - probs) < state.top_p[:, None]  # first candidate always kept
-    scaled = jnp.where(keep, scaled, -jnp.inf)
+    return jnp.where(keep, scaled, -jnp.inf), top_idx
+
+
+def filtered_probs(logits: jnp.ndarray, state: SamplingState
+                   ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(probs [B, W], vocab ids [B, W], scaled logits [B, W]) — the exact
+    distribution ``sample`` draws from, exposed for speculative decoding's
+    acceptance ratios and residual distributions."""
+    scaled, idx = _filtered_scaled(logits, state)
+    return jax.nn.softmax(scaled, axis=-1), idx, scaled
+
+
+def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, SamplingState]:
+    """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
+
+    Greedy where temperature <= 0; otherwise temperature + top-k + top-p over
+    the TOP_K_MAX highest-logit candidates.
+    """
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled, top_idx = _filtered_scaled(logits, state)
 
     new_keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)
     step_keys, carry_keys = new_keys[:, 0], new_keys[:, 1]
@@ -82,3 +98,91 @@ def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, Samp
 
     ids = jnp.where(state.temperature <= 0.0, greedy_ids, sampled_ids)
     return ids, state._replace(key=carry_keys)
+
+
+def draft_sample(logits: jnp.ndarray, state: SamplingState, keys: jnp.ndarray
+                 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                            jnp.ndarray, jnp.ndarray]:
+    """One draft proposal per slot for speculative decoding.
+
+    Returns (token [B], q(token) [B], q probs [B, W], window ids [B, W],
+    advanced keys [B, 2]).  Greedy slots propose argmax with q=1 (the
+    temperature->0 limit of the acceptance rule reduces to exact-match)."""
+    probs, idx, scaled = filtered_probs(logits, state)
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    step_keys, carry_keys = new_keys[:, 0], new_keys[:, 1]
+    choice = jax.vmap(lambda key, s: jax.random.categorical(key, s))(step_keys, scaled)
+    samp_tok = jnp.take_along_axis(idx, choice[:, None], -1)[:, 0].astype(jnp.int32)
+    samp_q = jnp.take_along_axis(probs, choice[:, None], -1)[:, 0]
+    greedy = state.temperature <= 0.0
+    tok = jnp.where(greedy, jnp.argmax(logits, -1).astype(jnp.int32), samp_tok)
+    q = jnp.where(greedy, 1.0, samp_q)
+    return tok, q, probs, idx, carry_keys
+
+
+def speculative_accept(
+    drafts: jnp.ndarray,        # [B, K-1] draft proposals
+    q_sel: jnp.ndarray,         # [B, K-1] q(draft) under the draft dist
+    q_probs: jnp.ndarray,       # [B, K-1, W] draft window probs
+    q_idx: jnp.ndarray,         # [B, K-1, W] draft window vocab ids
+    target_logits: jnp.ndarray,  # [B, K, V] verifier logits per position
+    state: SamplingState,
+    keys: jnp.ndarray,          # [B, 2]
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Rejection-sampled acceptance (Leviathan et al.): accept draft i with
+    prob min(1, p_i(d_i)/q_i(d_i)); at the first rejection sample from the
+    residual norm(max(p - q, 0)); after a fully-accepted block sample the
+    bonus token from p_{K-1}.  The emitted tokens are distributed EXACTLY
+    as the engine's own effective sampling distribution (the windowed
+    temperature/top-k/top-p dist ``sample`` uses) — the draft only changes
+    how many land per dispatch.  Greedy slots reduce to exact argmax
+    matching + the argmax bonus token.
+
+    Returns (tokens [B, K] — first counts[b] are valid, counts [B] in
+    1..K, advanced keys)."""
+    b, km1 = drafts.shape
+    kk = km1 + 1
+    greedy = state.temperature <= 0.0
+
+    # Target filtered dist per position: [B, K, W].
+    def per_pos(logits_i):
+        return filtered_probs(logits_i, state)
+
+    p_probs, p_idx, _ = jax.vmap(per_pos, in_axes=1, out_axes=1)(target_logits)
+    g_t = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # [B, K]
+
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+    u_keys, r_keys, carry_keys = new_keys[:, 0], new_keys[:, 1], new_keys[:, 2]
+    u = jax.vmap(lambda key: jax.random.uniform(key, (km1,)))(u_keys)
+
+    # p_i(d_i): the draft token's prob under the target window (0 when the
+    # token fell outside the target's filtered support).
+    p_at_d = jnp.sum(p_probs[:, :km1]
+                     * (p_idx[:, :km1] == drafts[..., None]), axis=-1)
+    accept_samp = u < p_at_d / jnp.maximum(q_sel, 1e-20)
+    accept_greedy = g_t[:, :km1] == drafts
+    accept = jnp.where(greedy[:, None], accept_greedy, accept_samp)
+    j = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)  # [B] 0..K-1
+    counts = 1 + j
+
+    # Residual/bonus token at position j.
+    pj = jnp.take_along_axis(p_probs, j[:, None, None], axis=1)[:, 0]   # [B, W]
+    pidxj = jnp.take_along_axis(p_idx, j[:, None, None], axis=1)[:, 0]
+    jq = jnp.minimum(j, km1 - 1)
+    qj = jnp.take_along_axis(q_probs, jq[:, None, None], axis=1)[:, 0]
+    qidxj = jnp.take_along_axis(q_idx, jq[:, None, None], axis=1)[:, 0]
+    # Map q onto the target window's index set.
+    q_on_p = jnp.sum(qj[:, None, :] * (qidxj[:, None, :] == pidxj[:, :, None]),
+                     axis=-1)                                           # [B, W]
+    rejected = (j < km1)[:, None]
+    res = jnp.maximum(pj - jnp.where(rejected, q_on_p, 0.0), 0.0)
+    norm = res.sum(-1, keepdims=True)
+    res = jnp.where(norm > 1e-20, res / jnp.maximum(norm, 1e-20), pj)
+    rchoice = jax.vmap(lambda key, pr: jax.random.categorical(
+        key, jnp.log(pr + 1e-30)))(r_keys, res)
+    y_samp = jnp.take_along_axis(pidxj, rchoice[:, None], -1)[:, 0].astype(jnp.int32)
+    y = jnp.where(greedy, jnp.take_along_axis(g_t, j[:, None], 1)[:, 0], y_samp)
+
+    out = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    out = out.at[jnp.arange(b), j].set(y)
+    return out, counts, carry_keys
