@@ -89,8 +89,8 @@ class EngineConfig:
     # matching prefix plus one bonus token — emitted tokens IDENTICAL to
     # target-only greedy decoding.  Sampled slots use rejection sampling
     # (sampler.speculative_accept) — exact in DISTRIBUTION against the
-    # engine's own effective sampling dist.  Single-host (no dispatcher
-    # op), dp/pp-exclusive.
+    # engine's own effective sampling dist.  Multi-host gangs mirror the
+    # draft-prefill and spec dispatches like any other op; dp/pp-exclusive.
     draft_model: str | None = None
     draft_len: int = 4
     dtype: str | None = None   # default: model config dtype
@@ -816,23 +816,22 @@ class InferenceEngine:
     def _register_slot(self, req: Request, slot: int, first: int,
                        num_prompt: int) -> None:
         # Draft-cache prompt prefill (speculative decoding).  Skipped when
-        # the prompt tokens aren't available (disagg-transferred KV), the
+        # the prompt tokens aren't available (disagg-transferred KV) or the
         # prompt exceeds the one-shot buckets (a monolithic draft prefill
         # would reintroduce the head-of-line stall chunking exists to
-        # prevent), or a multi-host dispatcher is wired (followers have no
-        # replay op for this dispatch — an unmirrored jit would wedge the
-        # gang's collectives): the slot then rides the fused loop — still
-        # CORRECT, the verifier is exact; only the draft speedup is
-        # forfeited.
+        # prevent): the slot then rides the fused loop — still CORRECT, the
+        # verifier is exact; only the draft speedup is forfeited.
         draft_synced = False
-        if (self._draft_cfg is not None and self.dispatcher is None
-                and req.prompt_ids
+        if (self._draft_cfg is not None and req.prompt_ids
                 and len(req.prompt_ids) <= self._buckets[-1]):
             ids = list(req.prompt_ids)
+            padded = self._pad_to_bucket(ids)
             try:
+                self._emit("draft_prefill", tokens=padded, length=len(ids),
+                           slot=slot)
                 self._draft_cache = self._draft_prefill_fn(
                     self._draft_params, self._draft_cache,
-                    jnp.asarray(self._pad_to_bucket(ids)),
+                    jnp.asarray(padded),
                     jnp.asarray([len(ids)], jnp.int32), jnp.asarray(slot))
             except Exception:
                 # Not registered yet: _run's recovery can't see this
@@ -1089,9 +1088,9 @@ class InferenceEngine:
             return
 
         # Speculative path: all slots draft-synced (greedy OR sampled — the
-        # rejection-sampled kernel is exact in distribution either way), no
-        # follower processes to mirror (single-host).
-        if (self._draft_cfg is not None and self.dispatcher is None
+        # rejection-sampled kernel is exact in distribution either way).
+        # Multi-host gangs mirror it like any other dispatch ("spec" op).
+        if (self._draft_cfg is not None
                 and all(st.draft_synced for st in self._slots.values())):
             return self._spec_dispatch()
         if self._draft_cfg is not None:
@@ -1140,6 +1139,8 @@ class InferenceEngine:
         rejection kernel's guarantee)."""
         DK = self.ecfg.draft_len
         t0 = time.monotonic()
+        self._emit("spec", tokens=np.array(self._last_token),
+                   lengths=np.array(self._lengths))
         (self._cache, self._draft_cache, a, counts,
          self._sampling) = self._spec_fn(
             self.params, self._draft_params, self._cache, self._draft_cache,

@@ -262,6 +262,23 @@ class DispatchFollower:
             # Host-sync like the leader, but via block_until_ready —
             # a follower may not address every shard of toks.
             jax.block_until_ready(toks)
+        elif op == "draft_prefill":
+            # Speculative decoding: the draft cache mirrors the leader's
+            # (identical draft params: same spec + same seed/shards).
+            eng._draft_cache = eng._draft_prefill_fn(
+                eng._draft_params, eng._draft_cache,
+                jnp.asarray(p["tokens"]),
+                jnp.asarray([p["length"]], jnp.int32),
+                jnp.asarray(p["slot"]))
+        elif op == "spec":
+            # Key lockstep rides the shared _sampling state: both sides
+            # evolve it with the kernel's deterministic splits.
+            (eng._cache, eng._draft_cache, a, counts,
+             eng._sampling) = eng._spec_fn(
+                eng.params, eng._draft_params, eng._cache, eng._draft_cache,
+                jnp.asarray(p["tokens"]), jnp.asarray(p["lengths"]),
+                eng._sampling)
+            jax.block_until_ready(counts)
         elif op == "reset":
             eng._reset_device_state()
         else:

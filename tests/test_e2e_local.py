@@ -116,6 +116,50 @@ def test_quickstart_end_to_end(stack):
              timeout=10)
 
 
+def _launch_gang(store, name, served, extra_args=()):
+    """Shared size-2 gang scaffolding: create the app + endpoint, wait for
+    Running, return the leader address."""
+    if store.try_get(res.Model, "gang-model") is None:
+        store.create(res.Model(name="gang-model", spec={"model": "test/tiny"}))
+    store.create(res.Application(name=name, spec={
+        "replicas": 1, "size": 2, "runtime": "jax",
+        "model": {"name": "gang-model"},
+        "servedModelName": served,
+        "tensorParallel": 2,
+        "modelConfig": "tiny",
+        "runtimeCommonArgs": ["--num-slots", "2", "--max-model-len", "64",
+                              *extra_args],
+    }))
+    store.create(res.Endpoint(name=served, spec={"defaultWeight": 1}))
+    # Two engine processes boot + distributed rendezvous + compile.
+    wait_for(lambda: store.get(res.Application, name).status.get("phase")
+             == res.PHASE_RUNNING, timeout=240)
+    ep = wait_for(lambda: (store.get(res.Endpoint, served).status.get("routes")
+                           or None), timeout=30)
+    return ep[0]["backend"]["addresses"][0]
+
+
+def _complete(addr, served, prompt, max_tokens):
+    req = urllib.request.Request(
+        f"http://{addr}/v1/completions",
+        data=json.dumps({
+            "model": served, "prompt": prompt,
+            "max_tokens": max_tokens, "temperature": 0, "ignore_eos": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.load(r)
+
+
+def _assert_gang_alive(store, driver, name, members=2):
+    time.sleep(2)
+    gs = store.get(res.GangSet, name)
+    group = driver._groups[gs.key][0]
+    assert len(group.procs) == members
+    assert all(p.poll() is None for p in group.procs)
+    assert gs.status["readyReplicas"] == 1
+
+
 def test_multiprocess_gang_serves(stack):
     """VERDICT acceptance: a size-2 gang launches BOTH members as real
     processes, they rendezvous via jax.distributed (gloo collectives over
@@ -124,56 +168,42 @@ def test_multiprocess_gang_serves(stack):
     spanning both processes."""
     mgr, gw, driver = stack
     store = mgr.store
+    addr = _launch_gang(store, "gang-app", "gang-served")
 
-    if store.try_get(res.Model, "gang-model") is None:
-        store.create(res.Model(name="gang-model", spec={"model": "test/tiny"}))
-    store.create(res.Application(name="gang-app", spec={
-        "replicas": 1, "size": 2, "runtime": "jax",
-        "model": {"name": "gang-model"},
-        "servedModelName": "gang-served",
-        "tensorParallel": 2,
-        "modelConfig": "tiny",
-        "runtimeCommonArgs": ["--num-slots", "2", "--max-model-len", "64"],
-    }))
-    store.create(res.Endpoint(name="gang-served", spec={"defaultWeight": 1}))
-
-    # Two engine processes boot + distributed rendezvous + compile.
-    wait_for(lambda: store.get(res.Application, "gang-app").status.get("phase")
-             == res.PHASE_RUNNING, timeout=240)
-    ep = wait_for(lambda: (store.get(res.Endpoint, "gang-served").status.get("routes")
-                           or None), timeout=30)
-    addr = ep[0]["backend"]["addresses"][0]
-
-    req = urllib.request.Request(
-        f"http://{addr}/v1/completions",
-        data=json.dumps({
-            "model": "gang-served", "prompt": "multi host",
-            "max_tokens": 6, "temperature": 0, "ignore_eos": True,
-        }).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=120) as r:
-        data = json.load(r)
+    data = _complete(addr, "gang-served", "multi host", 6)
     assert data["usage"]["completion_tokens"] == 6
     assert data["choices"][0]["finish_reason"] == "length"
 
     # A second request exercises steady-state decode through the follower.
-    req2 = urllib.request.Request(
-        f"http://{addr}/v1/completions",
-        data=json.dumps({
-            "model": "gang-served", "prompt": "again please",
-            "max_tokens": 4, "temperature": 0, "ignore_eos": True,
-        }).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req2, timeout=120) as r:
-        data2 = json.load(r)
+    data2 = _complete(addr, "gang-served", "again please", 4)
     assert data2["usage"]["completion_tokens"] == 4
 
     # The gang is really 2 live processes (leader + follower) and the
     # follower SURVIVES serving (a desync/crash there would show up as a
     # dead member and a group restart).
-    time.sleep(2)
-    gs = store.get(res.GangSet, "gang-app")
-    group = driver._groups[gs.key][0]
-    assert len(group.procs) == 2
-    assert all(p.poll() is None for p in group.procs)
-    assert gs.status["readyReplicas"] == 1
+    _assert_gang_alive(store, driver, "gang-app")
+
+
+def test_multiprocess_gang_with_spec_decode(stack):
+    """A size-2 gang serving WITH speculative decoding: the leader
+    broadcasts draft-prefill and spec dispatches, the follower mirrors
+    them, and greedy output stays correct across the gang."""
+    mgr, gw, driver = stack
+    store = mgr.store
+    addr = _launch_gang(store, "spec-gang", "spec-gang-served",
+                        extra_args=["--draft-model", "tiny-gqa",
+                                    "--draft-len", "4",
+                                    "--prefix-cache-mb", "0"])
+
+    data = _complete(addr, "spec-gang-served", "multi host spec", 6)
+    assert data["usage"]["completion_tokens"] == 6
+
+    # The spec path really fired on the gang (not a silent fused fallback).
+    metrics = urllib.request.urlopen(f"http://{addr}/metrics",
+                                     timeout=10).read().decode()
+    prop = [l for l in metrics.splitlines()
+            if l.startswith("spec_decode_proposed_tokens_total")]
+    assert prop and float(prop[0].split()[-1]) > 0
+
+    # Both processes alive after speculative serving.
+    _assert_gang_alive(store, driver, "spec-gang")
