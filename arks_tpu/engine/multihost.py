@@ -273,7 +273,7 @@ class DispatchFollower:
         elif op == "spec":
             # Key lockstep rides the shared _sampling state: both sides
             # evolve it with the kernel's deterministic splits.
-            (eng._cache, eng._draft_cache, a, counts,
+            (eng._cache, eng._draft_cache, _, counts,
              eng._sampling) = eng._spec_fn(
                 eng.params, eng._draft_params, eng._cache, eng._draft_cache,
                 jnp.asarray(p["tokens"]), jnp.asarray(p["lengths"]),

@@ -24,12 +24,16 @@ Conventions:
 
 from __future__ import annotations
 
+import logging
 import os
 
 import jax
 import jax.numpy as jnp
 
+log = logging.getLogger("arks_tpu.ops.attention")
+
 _NEG_INF = -1e30
+_lane_warned: set[int] = set()
 
 
 def default_decode_impl() -> str:
@@ -284,7 +288,20 @@ def decode_update_and_attend(
     # embarrassingly parallel over batch.  Only the replicated-KV TP regime
     # (tp > 1 not dividing Hkv) needs the XLA partitioner.
     tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
-    use_pallas = impl == "pallas" and (kv_sharded or tp_trivial)
+    # Mosaic tiles the last (lane) dim at 128: compiled-TPU kernels require
+    # head_dim % 128 == 0.  That covers the 1.5B+ model registry (d=128);
+    # d=64 models (qwen2.5-0.5b) and tiny test configs fall back to the
+    # XLA path — slower per step but correct (the kernel would fail to
+    # compile; lane-padding the kernels is the future fix).  Interpret mode
+    # has no such constraint, so CPU kernel tests still exercise the Pallas
+    # path at small D.
+    lane_ok = d % 128 == 0 or jax.default_backend() != "tpu"
+    if impl == "pallas" and not lane_ok and d not in _lane_warned:
+        _lane_warned.add(d)
+        log.warning(
+            "head_dim=%d is not 128-lane aligned: decode falls back to the "
+            "XLA attention path on TPU (slower per step, same results)", d)
+    use_pallas = impl == "pallas" and (kv_sharded or tp_trivial) and lane_ok
 
     if not use_pallas:
         from arks_tpu.ops.pallas_attention import quantize_kv

@@ -41,7 +41,12 @@ def test_crds_cover_six_kinds_with_status_subresource():
         assert d["spec"]["names"]["plural"] in plurals
         v = d["spec"]["versions"][0]
         assert v["name"] == "v1" and v["served"] and v["storage"]
-        assert v["subresources"] == {"status": {}}, kind
+        assert v["subresources"].get("status") == {}, kind
+        if kind == "ArksApplication":
+            # Scale subresource: HPA / kubectl scale drive replicas.
+            scale = v["subresources"]["scale"]
+            assert scale["specReplicasPath"] == ".spec.replicas"
+            assert scale["statusReplicasPath"] == ".status.replicas"
         assert v["schema"]["openAPIV3Schema"]["type"] == "object"
         # metadata.name = <plural>.<group>
         assert d["metadata"]["name"] == f"{d['spec']['names']['plural']}.arks.ai"
