@@ -44,9 +44,14 @@ def test_crds_cover_six_kinds_with_status_subresource():
         assert v["subresources"].get("status") == {}, kind
         if kind == "ArksApplication":
             # Scale subresource: HPA / kubectl scale drive replicas.
+            assert set(v["subresources"]) == {"status", "scale"}
             scale = v["subresources"]["scale"]
             assert scale["specReplicasPath"] == ".spec.replicas"
             assert scale["statusReplicasPath"] == ".status.replicas"
+        else:
+            # No stray subresources on the other kinds (a copy-pasted
+            # scale block would carry wrong paths).
+            assert set(v["subresources"]) == {"status"}, kind
         assert v["schema"]["openAPIV3Schema"]["type"] == "object"
         # metadata.name = <plural>.<group>
         assert d["metadata"]["name"] == f"{d['spec']['names']['plural']}.arks.ai"

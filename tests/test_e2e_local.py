@@ -207,3 +207,92 @@ def test_multiprocess_gang_with_spec_decode(stack):
 
     # Both processes alive after speculative serving.
     _assert_gang_alive(store, driver, "spec-gang")
+
+
+def test_gang_member_death_restarts_group_and_serving_recovers(stack):
+    """Failure detection e2e: killing a gang FOLLOWER mid-serving must take
+    the whole group down (shared fate — the leader exits when its dispatch
+    channel breaks rather than silently diverging), the driver restarts the
+    gang, and serving recovers on the fresh processes.
+
+    Reuses the gang from test_multiprocess_gang_serves (same module-scoped
+    stack, runs after it in file order)."""
+    mgr, gw, driver = stack
+    store = mgr.store
+    gs = store.get(res.GangSet, "gang-app")
+    group = driver._groups[gs.key][0]
+    old_procs = list(group.procs)
+    assert all(p.poll() is None for p in old_procs)
+
+    old_procs[1].kill()  # the follower
+
+    # Shared fate + restart: eventually a NEW set of live processes.
+    def regrouped():
+        g = driver._groups.get(gs.key, [None])[0]
+        if g is None or g.procs is old_procs:
+            return False
+        return (len(g.procs) == 2
+                and all(p.poll() is None for p in g.procs)
+                and all(p.pid != q.pid for p, q in zip(g.procs, old_procs)))
+    wait_for(regrouped, timeout=60)
+
+    # Readiness dips then recovers; the fresh gang serves.  The status and
+    # route lag the restart (and the relaunch may bind a new port), so poll
+    # the completion against the CURRENT route until it lands.
+    def served_again():
+        try:
+            routes = store.get(res.Endpoint, "gang-served").status["routes"]
+            if not routes or not routes[0]["backend"]["addresses"]:
+                return False
+            addr = routes[0]["backend"]["addresses"][0]
+            data = _complete(addr, "gang-served", "after the restart", 4)
+            return data["usage"]["completion_tokens"] == 4
+        except Exception:
+            return False
+
+    wait_for(served_again, timeout=240, interval=2.0)
+
+
+def test_counter_store_outage_fails_cleanly():
+    """A dead shared counter store (Redis down) must fail requests quickly
+    and cleanly — bounded by the client's socket timeout — not hang the
+    gateway's handler threads."""
+    import urllib.error
+
+    from arks_tpu.control.store import Store
+    from arks_tpu.gateway.ratelimiter import RateLimiter
+    from arks_tpu.gateway.rediskv import (
+        RedisCounterBackend, RespClient, RespServer)
+    from arks_tpu.gateway.server import Gateway
+
+    # A live counter store at startup (RespClient fails fast on a bad
+    # address by design) that dies mid-flight.
+    resp = RespServer(host="127.0.0.1", port=0)
+    resp.start(background=True)
+
+    store = Store()
+    store.create(res.Endpoint(name="m1", namespace="default", spec={},
+                              status={"routes": []}))
+    store.create(res.Token(name="t", namespace="default", spec={
+        "token": "sk-t", "qos": [{"endpoint": {"name": "m1"}}]}))
+    gw = Gateway(store, host="127.0.0.1", port=0,
+                 rate_limiter=RateLimiter(RedisCounterBackend(
+                     RespClient("127.0.0.1", resp.port, timeout_s=0.5))))
+    gw.start(background=True)
+    resp.stop()  # the outage
+    try:
+        wait_for(lambda: gw.qos.token_known("sk-t"), timeout=10)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+            data=json.dumps({"model": "m1", "messages": []}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer sk-t"})
+        t0 = time.monotonic()
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected an error response")
+        except urllib.error.HTTPError as e:
+            assert e.code >= 500  # clean server error, not a hang
+        assert time.monotonic() - t0 < 10  # bounded by the socket timeout
+    finally:
+        gw.stop()
