@@ -384,7 +384,11 @@ def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
            "--model", model_arg,
            "--served-model-name", served_model_name,
            "--port", port_token,
-           "--tensor-parallel-size", str(tensor_parallel)]
+           "--tensor-parallel-size", str(tensor_parallel),
+           # Fit the graceful drain inside the local driver's 10s
+           # SIGTERM->SIGKILL escalation window (argparse last-wins, so
+           # runtimeCommonArgs can still override).
+           "--drain-timeout", "8"]
     if context_parallel > 1:
         cmd += ["--context-parallel-size", str(context_parallel)]
     if model_path:

@@ -585,6 +585,13 @@ class InferenceEngine:
     def num_running(self) -> int:
         return len(self._slots)
 
+    @property
+    def idle(self) -> bool:
+        """No decoding slots, no queued admissions, no chunked prefills in
+        flight — the drain gate (servers must not poke at privates)."""
+        return (not self._slots and self._queue.empty()
+                and not self._prefilling)
+
     # ------------------------------------------------------------------
     # Scheduler loop
     # ------------------------------------------------------------------

@@ -69,6 +69,11 @@ def main() -> None:
     p.add_argument("--draft-len", type=int, default=4,
                    help="tokens per speculative dispatch (draft proposes "
                         "draft-len - 1, target verifies all in one pass)")
+    p.add_argument("--drain-timeout", type=float, default=20.0,
+                   help="SIGTERM grace: finish in-flight requests up to "
+                        "this many seconds before exiting (rolling updates "
+                        "become request-lossless when it covers the longest "
+                        "request)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
@@ -200,11 +205,18 @@ def main() -> None:
     # dispatch; the other processes mirror them so the gang's collectives
     # stay in lockstep (arks_tpu.engine.multihost).
     if coord and nproc > 1:
+        import signal as _signal
+
         from arks_tpu.engine.multihost import (
             DispatchFollower, DispatchLeader, dispatch_address)
         dhost, dport = dispatch_address(coord)
         pid = int(os.environ.get("ARKS_PROCESS_ID", "0"))
         if pid != 0:
+            # The gang driver SIGTERMs every member at once; a follower
+            # dying instantly would strand the leader's drain mid-
+            # collective.  Followers ignore SIGTERM and exit when the
+            # leader (who coordinates the drain) closes the channel.
+            _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
             log.info("follower %d/%d: mirroring leader dispatches", pid, nproc)
             DispatchFollower(engine, dhost, dport).run()
             return
@@ -221,9 +233,27 @@ def main() -> None:
     else:
         engine.start()
         server = OpenAIServer(engine, served, host=args.host, port=args.port)
+    # Graceful drain: SIGTERM (rolling update, scale-down, kubelet stop)
+    # flips readiness off, 503s new work, and lets in-flight requests
+    # finish before serve_forever returns.
+    import signal
+    import threading
+
+    def _on_term(signum, frame):
+        log.info("SIGTERM: draining in-flight requests (up to %.0fs)",
+                 args.drain_timeout)
+        threading.Thread(target=server.drain, args=(args.drain_timeout,),
+                         name="drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     log.info("serving %s on %s:%d (devices=%d, mode=%s)",
              served, args.host, args.port, n_dev, args.disagg or "unified")
     server.start(background=False)
+    engine.stop()
+    if engine.dispatcher is not None:
+        engine.dispatcher.close()  # releases followers (they exit on close)
+    log.info("drained; exiting")
 
 
 if __name__ == "__main__":

@@ -78,6 +78,15 @@ class OpenAIServer:
         self.host, self.port = host, port
         self._httpd: ThreadingHTTPServer | None = None
         self._ready = threading.Event()
+        # Graceful drain (SIGTERM): readiness drops (Services/routes pull
+        # this backend), new completions get 503, in-flight ones finish.
+        # _active counts POST handlers between their admission check and
+        # their last byte — the drain gate that closes the accept-vs-drain
+        # race (engine queues alone can read idle while a handler is still
+        # tokenizing, streaming tail frames, or running a detached prefill).
+        self.draining = False
+        self._active = 0
+        self._active_lock = threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -120,6 +129,8 @@ class OpenAIServer:
                     # selects the whole gang and relies on this gate).
                     if os.environ.get("ARKS_PROCESS_ID", "0") not in ("", "0"):
                         self._error(503, "worker process (leader serves)")
+                    elif server.draining:
+                        self._error(503, "draining")
                     elif server._ready.is_set():
                         self._json(200, {"status": "ready"})
                     else:
@@ -133,6 +144,13 @@ class OpenAIServer:
                     body = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError):
                     return self._error(400, "invalid JSON body")
+                # Admission check and active-count increment are ATOMIC:
+                # drain() waiting for _active == 0 is then guaranteed no
+                # handler slips in after its last look.
+                with server._active_lock:
+                    if server.draining:
+                        return self._error(503, "server is draining")
+                    server._active += 1
                 try:
                     if server.handle_post(self, body, self.path):
                         pass  # subclass route (disaggregated prefill/decode)
@@ -149,6 +167,9 @@ class OpenAIServer:
                         self._error(500, f"internal error: {e}")
                     except Exception:
                         pass
+                finally:
+                    with server._active_lock:
+                        server._active -= 1
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_port
@@ -162,6 +183,27 @@ class OpenAIServer:
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()
+
+    def drain(self, timeout_s: float = 20.0) -> None:
+        """Graceful shutdown: flip readiness off (routes pull this backend),
+        reject new completions with 503, wait for in-flight requests to
+        finish (bounded by ``timeout_s``), then stop the HTTP server.  The
+        local gang driver and K8s both SIGTERM before SIGKILL — this is
+        what makes rolling updates request-lossless when the grace period
+        covers the longest request."""
+        self.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            # Engine idle AND no live POST handler: the handler count
+            # covers the gaps the engine cannot see (tokenizing before
+            # add_request, streaming tail frames to a slow client,
+            # synchronous detached prefills on the prefill tier).
+            with self._active_lock:
+                active = self._active
+            if active == 0 and self.engine.idle:
+                break
+            time.sleep(0.1)
+        self.stop()
 
     def handle_post(self, h, body: dict, path: str) -> bool:
         """Subclass hook for extra POST routes; True = handled."""

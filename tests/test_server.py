@@ -247,3 +247,99 @@ def test_stream_batch_prompt_400(server):
         assert False
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_sigterm_drains_in_flight_requests():
+    """Graceful drain: SIGTERM mid-request flips readiness to 503, rejects
+    NEW completions, lets the in-flight streamed request finish, and the
+    process exits cleanly — what makes rolling updates request-lossless."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "arks_tpu.server",
+         "--model", "tiny", "--port", str(port), "--platform", "cpu",
+         "--num-slots", "2", "--max-model-len", "64",
+         "--steps-per-dispatch", "1", "--drain-timeout", "30"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for _ in range(120):
+            try:
+                urllib.request.urlopen(base + "/readiness", timeout=2)
+                break
+            except Exception:
+                _time.sleep(1)
+
+        # Long streamed request (40 tokens at 1 step/dispatch: plenty of
+        # wall time to SIGTERM in the middle).
+        frames: list[str] = []
+        err: list[Exception] = []
+
+        def stream():
+            req = urllib.request.Request(
+                base + "/v1/completions",
+                data=_json.dumps({"model": "tiny", "prompt": "drain me",
+                                  "max_tokens": 40, "temperature": 0,
+                                  "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    for raw in r:
+                        line = raw.decode().strip()
+                        if line.startswith("data: "):
+                            frames.append(line[6:])
+            except Exception as e:  # noqa: BLE001 — recorded for the assert
+                err.append(e)
+
+        t = threading.Thread(target=stream)
+        t.start()
+        # Wait until tokens are flowing, then SIGTERM.
+        deadline = _time.monotonic() + 60
+        while not frames and _time.monotonic() < deadline:
+            _time.sleep(0.1)
+        assert frames, "stream never started"
+        os.kill(proc.pid, signal.SIGTERM)
+
+        # While draining: readiness 503 and new completions 503.
+        _time.sleep(0.5)
+        try:
+            urllib.request.urlopen(base + "/readiness", timeout=5)
+            raise AssertionError("readiness should be 503 while draining")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/completions",
+                data=_json.dumps({"model": "tiny", "prompt": "new",
+                                  "max_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=10)
+            raise AssertionError("new work should be 503 while draining")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+
+        # The in-flight stream finishes completely and the process exits 0.
+        t.join(timeout=120)
+        assert not err, f"in-flight stream died during drain: {err}"
+        assert frames[-1] == "[DONE]"
+        payloads = [_json.loads(f) for f in frames[:-1]]
+        text = "".join(c["text"] for p in payloads
+                       for c in p.get("choices", []) if "text" in c)
+        assert len(text) > 0
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
