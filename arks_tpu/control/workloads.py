@@ -292,6 +292,11 @@ class LocalProcessDriver:
                 "ARKS_GANG_LEADER_ADDRESS": f"127.0.0.1:{leader_port}",
                 "ARKS_GANG_SIZE": str(size),
                 "ARKS_GANG_WORKER_INDEX": str(member),
+                # Fit the graceful drain inside THIS driver's 10s
+                # SIGTERM->SIGKILL window.  Env-default only: an explicit
+                # --drain-timeout flag wins, and K8s-rendered pods (30s
+                # grace) keep the server's own 20s default.
+                "ARKS_DRAIN_TIMEOUT": env.get("ARKS_DRAIN_TIMEOUT", "8"),
             })
             if size > 1:
                 # jax.distributed rendezvous (the LWS env contract
@@ -384,11 +389,7 @@ def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
            "--model", model_arg,
            "--served-model-name", served_model_name,
            "--port", port_token,
-           "--tensor-parallel-size", str(tensor_parallel),
-           # Fit the graceful drain inside the local driver's 10s
-           # SIGTERM->SIGKILL escalation window (argparse last-wins, so
-           # runtimeCommonArgs can still override).
-           "--drain-timeout", "8"]
+           "--tensor-parallel-size", str(tensor_parallel)]
     if context_parallel > 1:
         cmd += ["--context-parallel-size", str(context_parallel)]
     if model_path:

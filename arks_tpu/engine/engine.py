@@ -349,7 +349,8 @@ class InferenceEngine:
         if mesh is not None:
             self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
-            engine_cfg.num_slots, engine_cfg.seed)
+            engine_cfg.num_slots, engine_cfg.seed,
+            vocab_size=cfg.vocab_size)
 
         # Host-authoritative mirrors.
         self._lengths = np.zeros((engine_cfg.num_slots,), np.int32)
@@ -467,9 +468,8 @@ class InferenceEngine:
 
         def prefill_and_sample(params, tokens, length, temperature, top_p, top_k, key):
             logits, ks, vs = model_prefill(params, tokens, length)
-            state = sampler_mod.SamplingState(
-                temperature=temperature[None], top_p=top_p[None],
-                top_k=top_k[None], key=key[None])
+            state = sampler_mod.transient_state(temperature, top_p, top_k,
+                                                key, cfg.vocab_size)
             ids, _ = sampler_mod.sample(logits, state)
             return ids[0], ks, vs
 
@@ -483,9 +483,8 @@ class InferenceEngine:
         self._chunk_fn = jax.jit(chunk_step, donate_argnums=(1,))
 
         def sample_one(logits, temperature, top_p, top_k, key):
-            state = sampler_mod.SamplingState(
-                temperature=temperature[None], top_p=top_p[None],
-                top_k=top_k[None], key=key[None])
+            state = sampler_mod.transient_state(temperature, top_p, top_k,
+                                                key, cfg.vocab_size)
             ids, _ = sampler_mod.sample(logits, state)
             return ids[0]
 
@@ -495,9 +494,19 @@ class InferenceEngine:
         self._extract_fn = jax.jit(
             lambda cache, slot: tf.extract(cache, slot, dtype))
 
+        # Donated slot-state writes: eager .at[].set() would copy the whole
+        # [num_slots, vocab] penalty-counts buffer on EVERY admission
+        # (~117MB at 192 slots x 152k vocab); donation updates in place.
+        self._set_slot_fn = jax.jit(sampler_mod.set_slot,
+                                    donate_argnums=(0,))
+
         def decode_loop(params, cache, tokens, lengths, sstate):
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
+                # Feed-time counting: every generated token is fed exactly
+                # once, which keeps the presence/frequency counts right
+                # across the one-shot, chunked, and disagg admission paths.
+                sstate = sampler_mod.count_tokens(sstate, tokens)
                 logits, cache = model_decode(params, cache, tokens, lengths)
                 nxt, sstate = sampler_mod.sample(logits, sstate)
                 return (cache, nxt, lengths + 1, sstate), nxt
@@ -662,7 +671,8 @@ class InferenceEngine:
         if self.mesh is not None:
             self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
-            self.ecfg.num_slots, self.ecfg.seed)
+            self.ecfg.num_slots, self.ecfg.seed,
+            vocab_size=self.cfg.vocab_size)
         if self._draft_cfg is not None:
             self._draft_cache = tf.init_cache(
                 self._draft_cfg, self.ecfg.num_slots, self.ecfg.max_cache_len,
@@ -765,10 +775,9 @@ class InferenceEngine:
             self._emit("insert", slot=slot)
             self._cache = self._insert_fn(self._cache, ks, vs, jnp.asarray(slot))
             self._emit("set_slot", slot=slot, temperature=p.temperature,
-                       top_p=p.top_p, top_k=p.top_k, seed=seed)
-            self._sampling = sampler_mod.set_slot(
-                self._sampling, slot, p.temperature, p.top_p, p.top_k,
-                jax.random.fold_in(key, 1))
+                       top_p=p.top_p, top_k=p.top_k, seed=seed,
+                       presence=p.presence_penalty, frequency=p.frequency_penalty)
+            self._apply_set_slot(slot, p, jax.random.fold_in(key, 1))
         except Exception:
             # The request is in no slot yet, so _run's recovery path can't
             # see it — fail it here or its client blocks forever.
@@ -809,16 +818,27 @@ class InferenceEngine:
             self._emit("insert_kv", slot=slot, k=np.asarray(k), v=np.asarray(v))
             self._cache = self._insert_fn(self._cache, k, v, jnp.asarray(slot))
             self._emit("set_slot", slot=slot, temperature=p.temperature,
-                       top_p=p.top_p, top_k=p.top_k, seed=pf.seed)
-            self._sampling = sampler_mod.set_slot(
-                self._sampling, slot, p.temperature, p.top_p, p.top_k,
-                jax.random.fold_in(key, 1))
+                       top_p=p.top_p, top_k=p.top_k, seed=pf.seed,
+                       presence=p.presence_penalty, frequency=p.frequency_penalty)
+            self._apply_set_slot(slot, p, jax.random.fold_in(key, 1))
         except Exception:
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="abort", num_prompt_tokens=pf.num_prompt))
             raise
         self._register_slot(req, slot, pf.first_token, pf.num_prompt)
+
+    def _apply_set_slot(self, slot: int, p, key) -> None:
+        """Write one slot's sampling params through the donated jit (array
+        args keep one compiled program across requests; python floats would
+        retrace per distinct value)."""
+        self._sampling = self._set_slot_fn(
+            self._sampling, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(p.temperature, jnp.float32),
+            jnp.asarray(p.top_p, jnp.float32),
+            jnp.asarray(p.top_k, jnp.int32), key,
+            jnp.asarray(p.presence_penalty, jnp.float32),
+            jnp.asarray(p.frequency_penalty, jnp.float32))
 
     def _register_slot(self, req: Request, slot: int, first: int,
                        num_prompt: int) -> None:
@@ -1017,10 +1037,9 @@ class InferenceEngine:
             jnp.int32(p.top_k), st.key))
         del self._prefilling[slot]
         self._emit("set_slot", slot=slot, temperature=p.temperature,
-                   top_p=p.top_p, top_k=p.top_k, seed=st.seed)
-        self._sampling = sampler_mod.set_slot(
-            self._sampling, slot, p.temperature, p.top_p, p.top_k,
-            jax.random.fold_in(st.key, 1))
+                   top_p=p.top_p, top_k=p.top_k, seed=st.seed,
+                   presence=p.presence_penalty, frequency=p.frequency_penalty)
+        self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1))
         self._register_slot(st.request, slot, first, len(st.ids))
         # Harvest the chunk-prefilled prompt (its KV exists only inside the
         # slotted cache — read it back out before decode grows past it).
@@ -1095,10 +1114,16 @@ class InferenceEngine:
             return
 
         # Speculative path: all slots draft-synced (greedy OR sampled — the
-        # rejection-sampled kernel is exact in distribution either way).
-        # Multi-host gangs mirror it like any other dispatch ("spec" op).
+        # rejection-sampled kernel is exact in distribution either way) and
+        # penalty-free (the spec kernel's per-position dists don't evolve
+        # the penalty counts within a block; penalized slots ride the fused
+        # loop, which does).  Multi-host gangs mirror it like any other
+        # dispatch ("spec" op).
         if (self._draft_cfg is not None
-                and all(st.draft_synced for st in self._slots.values())):
+                and all(st.draft_synced
+                        and st.request.params.presence_penalty == 0
+                        and st.request.params.frequency_penalty == 0
+                        for st in self._slots.values())):
             return self._spec_dispatch()
         if self._draft_cfg is not None:
             # The fused loop advances the target cache only — every live

@@ -239,9 +239,16 @@ class DispatchFollower:
                 jnp.asarray(p["slot"]))
         elif op == "set_slot":
             key = self._jax.random.PRNGKey(p["seed"])
-            eng._sampling = sampler_mod.set_slot(
-                eng._sampling, p["slot"], p["temperature"], p["top_p"],
-                p["top_k"], self._jax.random.fold_in(key, 1))
+
+            class _P:  # shaped like SamplingParams for _apply_set_slot
+                temperature = p["temperature"]
+                top_p = p["top_p"]
+                top_k = p["top_k"]
+                presence_penalty = p.get("presence", 0.0)
+                frequency_penalty = p.get("frequency", 0.0)
+
+            eng._apply_set_slot(p["slot"], _P,
+                                self._jax.random.fold_in(key, 1))
         elif op == "chunk":
             _logits, eng._cache = eng._chunk_fn(
                 eng.params, eng._cache, jnp.asarray(p["slot"], jnp.int32),

@@ -27,26 +27,76 @@ class SamplingState(NamedTuple):
     top_p: jnp.ndarray        # f32 in (0, 1]
     top_k: jnp.ndarray        # i32; 0 = disabled (use TOP_K_MAX window)
     key: jnp.ndarray          # uint32 [B, 2] per-slot PRNG keys
+    # OpenAI presence/frequency penalties over OUTPUT tokens (vLLM
+    # semantics): logits -= presence*1[count>0] + frequency*count.
+    presence: jnp.ndarray     # f32 [B]
+    frequency: jnp.ndarray    # f32 [B]
+    counts: jnp.ndarray       # i32 [B, V] per-slot generated-token counts
 
 
-def init_sampling_state(batch: int, seed: int = 0) -> SamplingState:
+def init_sampling_state(batch: int, seed: int = 0,
+                        vocab_size: int = 1) -> SamplingState:
     keys = jax.random.split(jax.random.PRNGKey(seed), batch)
     return SamplingState(
         temperature=jnp.zeros((batch,), jnp.float32),
         top_p=jnp.ones((batch,), jnp.float32),
         top_k=jnp.zeros((batch,), jnp.int32),
         key=jnp.asarray(keys),
+        presence=jnp.zeros((batch,), jnp.float32),
+        frequency=jnp.zeros((batch,), jnp.float32),
+        counts=jnp.zeros((batch, vocab_size), jnp.int32),
     )
 
 
 def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
-             top_p: float, top_k: int, key: jnp.ndarray) -> SamplingState:
+             top_p: float, top_k: int, key: jnp.ndarray,
+             presence: float = 0.0, frequency: float = 0.0) -> SamplingState:
     return SamplingState(
         temperature=state.temperature.at[slot].set(temperature),
         top_p=state.top_p.at[slot].set(top_p),
         top_k=state.top_k.at[slot].set(top_k),
         key=state.key.at[slot].set(key),
+        presence=state.presence.at[slot].set(presence),
+        frequency=state.frequency.at[slot].set(frequency),
+        counts=state.counts.at[slot].set(0),
     )
+
+
+def transient_state(temperature, top_p, top_k, key,
+                    vocab_size: int) -> SamplingState:
+    """One-row state for first-token sampling (prefill paths): penalties
+    are identity there — the output is empty, so counts are all zero."""
+    return SamplingState(
+        temperature=temperature[None], top_p=top_p[None], top_k=top_k[None],
+        key=key[None],
+        presence=jnp.zeros((1,), jnp.float32),
+        frequency=jnp.zeros((1,), jnp.float32),
+        counts=jnp.zeros((1, vocab_size), jnp.int32),
+    )
+
+
+def count_tokens(state: SamplingState, tokens: jnp.ndarray) -> SamplingState:
+    """Record one emitted token per slot (called on the tokens FED to a
+    decode step — every generated token is fed exactly once, so feed-time
+    counting covers the one-shot, chunked, and disagg admission paths
+    uniformly; free slots' garbage rows are reset at set_slot)."""
+    b = tokens.shape[0]
+    return state._replace(
+        counts=state.counts.at[jnp.arange(b), tokens].add(1))
+
+
+def penalized(logits: jnp.ndarray, state: SamplingState) -> jnp.ndarray:
+    """Apply presence/frequency penalties (identity when both are 0).
+
+    Runtime-gated with ``lax.cond``: the un-penalized common case skips the
+    two [B, V] reads entirely instead of multiplying by zero."""
+    def apply(logits):
+        cnt = state.counts.astype(jnp.float32)
+        return (logits - state.presence[:, None] * (cnt > 0)
+                - state.frequency[:, None] * cnt)
+
+    active = jnp.any((state.presence != 0.0) | (state.frequency != 0.0))
+    return jax.lax.cond(active, apply, lambda x: x, logits)
 
 
 def _filtered_scaled(logits: jnp.ndarray, state: SamplingState
@@ -86,8 +136,10 @@ def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, Samp
     """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
 
     Greedy where temperature <= 0; otherwise temperature + top-k + top-p over
-    the TOP_K_MAX highest-logit candidates.
+    the TOP_K_MAX highest-logit candidates.  Presence/frequency penalties
+    apply BEFORE greedy/filtering (identity at the 0 defaults).
     """
+    logits = penalized(logits, state)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled, top_idx = _filtered_scaled(logits, state)
 

@@ -16,6 +16,10 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False
     seed: int | None = None
+    # OpenAI presence/frequency penalties over OUTPUT tokens (vLLM
+    # semantics): logits -= presence*1[seen] + frequency*count.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
 
 @dataclasses.dataclass

@@ -69,11 +69,13 @@ def main() -> None:
     p.add_argument("--draft-len", type=int, default=4,
                    help="tokens per speculative dispatch (draft proposes "
                         "draft-len - 1, target verifies all in one pass)")
-    p.add_argument("--drain-timeout", type=float, default=20.0,
+    p.add_argument("--drain-timeout", type=float,
+                   default=float(os.environ.get("ARKS_DRAIN_TIMEOUT", "20")),
                    help="SIGTERM grace: finish in-flight requests up to "
                         "this many seconds before exiting (rolling updates "
                         "become request-lossless when it covers the longest "
-                        "request)")
+                        "request; launchers set the ARKS_DRAIN_TIMEOUT env "
+                        "default to fit their own kill escalation windows)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),

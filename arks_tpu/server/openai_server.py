@@ -66,6 +66,8 @@ def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str
         seed=body.get("seed"),
         ignore_eos=bool(body.get("ignore_eos", False)),
         stop_token_ids=tuple(stop_ids),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
     )
     return params, stop_strings
 
@@ -87,6 +89,7 @@ class OpenAIServer:
         self.draining = False
         self._active = 0
         self._active_lock = threading.Lock()
+        self._stopped = False
 
     # ------------------------------------------------------------------
 
@@ -171,18 +174,30 @@ class OpenAIServer:
                     with server._active_lock:
                         server._active -= 1
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self.port = self._httpd.server_port
+        httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        with self._active_lock:
+            self._httpd = httpd
+            stopped = self._stopped
+        if stopped:
+            # stop()/drain() raced ahead of start() (e.g. SIGTERM between
+            # installing the handler and binding the socket): entering
+            # serve_forever now would hang the process unready forever.
+            httpd.server_close()
+            return
+        self.port = httpd.server_port
         self._ready.set()
         if background:
-            threading.Thread(target=self._httpd.serve_forever,
+            threading.Thread(target=httpd.serve_forever,
                              name="http", daemon=True).start()
         else:
-            self._httpd.serve_forever()
+            httpd.serve_forever()
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
+        with self._active_lock:
+            self._stopped = True
+            httpd = self._httpd
+        if httpd is not None:
+            httpd.shutdown()
 
     def drain(self, timeout_s: float = 20.0) -> None:
         """Graceful shutdown: flip readiness off (routes pull this backend),

@@ -7,13 +7,13 @@ import numpy as np
 from arks_tpu.engine import sampler as sm
 
 
-def _state(batch, temperature=1.0, top_p=1.0, top_k=0, seed=0):
-    st = sm.init_sampling_state(batch, seed)
-    return sm.SamplingState(
+def _state(batch, temperature=1.0, top_p=1.0, top_k=0, seed=0,
+           vocab_size=100):
+    st = sm.init_sampling_state(batch, seed, vocab_size=vocab_size)
+    return st._replace(
         temperature=jnp.full((batch,), temperature, jnp.float32),
         top_p=jnp.full((batch,), top_p, jnp.float32),
-        top_k=jnp.full((batch,), top_k, jnp.int32),
-        key=st.key)
+        top_k=jnp.full((batch,), top_k, jnp.int32))
 
 
 def test_greedy_is_argmax():
@@ -37,7 +37,7 @@ def test_tiny_top_p_is_argmax():
 def test_sampling_respects_top_k_support():
     # With top_k=3, only the 3 highest-logit ids may ever be sampled.
     logits = jnp.tile(jnp.arange(50.0)[None], (2, 1))  # argsorted: 49,48,47
-    state = _state(2, temperature=5.0, top_k=3, seed=7)
+    state = _state(2, temperature=5.0, top_k=3, seed=7, vocab_size=50)
     seen = set()
     for _ in range(50):
         ids, state = sm.sample(logits, state)
@@ -48,7 +48,7 @@ def test_sampling_respects_top_k_support():
 
 def test_keys_advance():
     logits = jnp.zeros((2, 64))  # uniform: successive draws should differ
-    state = _state(2, temperature=1.0)
+    state = _state(2, temperature=1.0, vocab_size=64)
     draws = []
     for _ in range(8):
         ids, state = sm.sample(logits, state)
@@ -62,3 +62,29 @@ def test_mixed_greedy_and_sampled_slots():
     st = st._replace(temperature=jnp.asarray([0.0, 1.0], jnp.float32))
     ids, _ = sm.sample(logits, st)
     assert int(ids[0]) == int(jnp.argmax(logits[0]))
+
+
+def test_presence_frequency_penalties_suppress_repeats():
+    """A strong frequency penalty makes a repeated token's adjusted logit
+    lose to the runner-up; counts drive the adjustment."""
+    logits = jnp.zeros((1, 10)).at[0, 3].set(5.0).at[0, 7].set(4.0)
+    st = _state(1, temperature=0.0, vocab_size=10)
+    st = st._replace(frequency=jnp.asarray([0.6]))
+    seen = []
+    for _ in range(4):
+        ids, st = sm.sample(logits, st)
+        tok = int(ids[0])
+        seen.append(tok)
+        st = sm.count_tokens(st, ids)
+    # Token 3 wins until its cumulative penalty (0.6/count) crosses the
+    # 1.0 logit gap: 3, 3, then 7 takes over.
+    assert seen[0] == 3 and seen[1] == 3
+    assert 7 in seen[2:]
+
+
+def test_penalties_are_identity_at_zero():
+    logits = jax.random.normal(jax.random.PRNGKey(5), (3, 100))
+    st = _state(3, temperature=0.0)
+    st = sm.count_tokens(st, jnp.asarray([1, 2, 3]))  # counts but no penalty
+    ids, _ = sm.sample(logits, st)
+    assert np.array_equal(np.asarray(ids), np.asarray(jnp.argmax(logits, -1)))

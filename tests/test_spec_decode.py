@@ -111,10 +111,8 @@ def test_speculative_accept_distribution_exact():
     rng = np.random.default_rng(0)
     t_logits = jnp.asarray(rng.standard_normal((1, K, V)), jnp.float32)
     d_logits = jnp.asarray(rng.standard_normal((1, V)), jnp.float32)
-    state = sm.SamplingState(
-        temperature=jnp.asarray([1.0]), top_p=jnp.asarray([1.0]),
-        top_k=jnp.asarray([0], jnp.int32),
-        key=jnp.asarray(jax.random.split(jax.random.PRNGKey(0), 1)))
+    state = sm.init_sampling_state(1, seed=0, vocab_size=V)._replace(
+        temperature=jnp.asarray([1.0]))
 
     @jax.jit
     def one_trial(key):
@@ -246,3 +244,22 @@ def test_long_prompt_skips_draft_prefill():
     ids, fin = _collect(r)
     assert fin.num_prompt_tokens == 42 and len(ids) == 4
     assert eng._spec_proposed == 0  # slot never draft-synced
+
+
+def test_penalized_requests_use_fused_path():
+    """Presence/frequency penalties evolve per-token counts, which the spec
+    kernel doesn't model within a block — penalized slots must ride the
+    fused loop (correct penalties beat the draft speedup)."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(16, 32), draft_model="tiny-gqa",
+                        draft_len=4, prefix_cache_mb=0)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    req = Request("pen", PROMPTS[0], SamplingParams(
+        max_tokens=10, temperature=0.0, ignore_eos=True,
+        frequency_penalty=1.0))
+    eng.add_request(req)
+    _drive(eng)
+    ids, _ = _collect(req)
+    assert len(ids) == 10
+    assert eng._spec_proposed == 0  # spec path never fired
