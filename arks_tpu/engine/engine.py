@@ -499,6 +499,8 @@ class InferenceEngine:
         # (~117MB at 192 slots x 152k vocab); donation updates in place.
         self._set_slot_fn = jax.jit(sampler_mod.set_slot,
                                     donate_argnums=(0,))
+        self._clear_pen_fn = jax.jit(sampler_mod.clear_slot_penalties,
+                                     donate_argnums=(0,))
 
         def decode_loop(params, cache, tokens, lengths, sstate):
             def body(carry, _):
@@ -1246,6 +1248,14 @@ class InferenceEngine:
     def _finish(self, slot: int, reason: str) -> None:
         st = self._slots.pop(slot)
         self._free.append(slot)
+        p = st.request.params
+        if p.presence_penalty or p.frequency_penalty:
+            # Re-arm penalized()'s lax.cond fast path: a stale penalized row
+            # on a FREE slot would keep every future dispatch paying the
+            # [B, V] penalty reads.
+            self._emit("clear_penalties", slot=slot)
+            self._sampling = self._clear_pen_fn(self._sampling,
+                                                jnp.asarray(slot, jnp.int32))
         gen = st.generated
         # The stop token itself is not part of the output text.
         if reason == "stop" and gen and self._is_stop(st, gen[-1]):

@@ -238,17 +238,19 @@ class DispatchFollower:
                 eng._cache, jnp.asarray(p["k"]), jnp.asarray(p["v"]),
                 jnp.asarray(p["slot"]))
         elif op == "set_slot":
+            from arks_tpu.engine.types import SamplingParams
+
             key = self._jax.random.PRNGKey(p["seed"])
-
-            class _P:  # shaped like SamplingParams for _apply_set_slot
-                temperature = p["temperature"]
-                top_p = p["top_p"]
-                top_k = p["top_k"]
-                presence_penalty = p.get("presence", 0.0)
-                frequency_penalty = p.get("frequency", 0.0)
-
-            eng._apply_set_slot(p["slot"], _P,
+            params = SamplingParams(
+                temperature=p["temperature"], top_p=p["top_p"],
+                top_k=p["top_k"],
+                presence_penalty=p.get("presence", 0.0),
+                frequency_penalty=p.get("frequency", 0.0))
+            eng._apply_set_slot(p["slot"], params,
                                 self._jax.random.fold_in(key, 1))
+        elif op == "clear_penalties":
+            eng._sampling = eng._clear_pen_fn(
+                eng._sampling, jnp.asarray(p["slot"], jnp.int32))
         elif op == "chunk":
             _logits, eng._cache = eng._chunk_fn(
                 eng.params, eng._cache, jnp.asarray(p["slot"], jnp.int32),

@@ -75,6 +75,15 @@ def transient_state(temperature, top_p, top_k, key,
     )
 
 
+def clear_slot_penalties(state: SamplingState,
+                         slot: jnp.ndarray) -> SamplingState:
+    """Zero a freed slot's penalties so the ``penalized`` fast-path gate
+    (jnp.any over ALL rows) re-arms once no live slot is penalized."""
+    return state._replace(
+        presence=state.presence.at[slot].set(0.0),
+        frequency=state.frequency.at[slot].set(0.0))
+
+
 def count_tokens(state: SamplingState, tokens: jnp.ndarray) -> SamplingState:
     """Record one emitted token per slot (called on the tokens FED to a
     decode step — every generated token is fed exactly once, so feed-time
