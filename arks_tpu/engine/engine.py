@@ -185,6 +185,9 @@ class _Slot:
     # goes stale and the slot must ride the fused loop for the rest of its
     # life (correct either way; the spec path would just mispredict).
     draft_synced: bool = False
+    # Per-token logprob entries parallel to ``generated`` (only populated
+    # when the request asked for logprobs): (chosen_lp, [(id, lp), ...]).
+    logprobs: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -474,6 +477,17 @@ class InferenceEngine:
             return ids[0], ks, vs
 
         self._prefill_fn = jax.jit(prefill_and_sample)
+
+        def prefill_and_sample_lp(params, tokens, length, temperature, top_p,
+                                  top_k, key):
+            logits, ks, vs = model_prefill(params, tokens, length)
+            state = sampler_mod.transient_state(temperature, top_p, top_k,
+                                                key, cfg.vocab_size)
+            ids, _ = sampler_mod.sample(logits, state)
+            clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
+            return ids[0], clp[0], vals[0], lids[0], ks, vs
+
+        self._prefill_lp_fn = jax.jit(prefill_and_sample_lp)
         self._insert_fn = jax.jit(tf.insert, donate_argnums=(0,))
 
         def chunk_step(params, cache, slot, tokens, start, valid):
@@ -489,6 +503,15 @@ class InferenceEngine:
             return ids[0]
 
         self._sample_one_fn = jax.jit(sample_one)
+
+        def sample_one_lp(logits, temperature, top_p, top_k, key):
+            state = sampler_mod.transient_state(temperature, top_p, top_k,
+                                                key, cfg.vocab_size)
+            ids, _ = sampler_mod.sample(logits, state)
+            clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
+            return ids[0], clp[0], vals[0], lids[0]
+
+        self._sample_one_lp_fn = jax.jit(sample_one_lp)
 
         dtype = jnp.dtype(self.ecfg.dtype or cfg.dtype)
         self._extract_fn = jax.jit(
@@ -518,6 +541,24 @@ class InferenceEngine:
             return cache, sstate, toks  # toks [K, B]
 
         self._decode_fn = jax.jit(decode_loop, donate_argnums=(1, 4))
+
+        def decode_loop_lp(params, cache, tokens, lengths, sstate):
+            # The logprob variant: selected per dispatch when any live slot
+            # asked for logprobs (separate compiled program — the common
+            # case never pays the full-vocab log-softmax).
+            def body(carry, _):
+                cache, tokens, lengths, sstate = carry
+                sstate = sampler_mod.count_tokens(sstate, tokens)
+                logits, cache = model_decode(params, cache, tokens, lengths)
+                nxt, sstate = sampler_mod.sample(logits, sstate)
+                clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
+                return (cache, nxt, lengths + 1, sstate), (nxt, clp, vals, lids)
+
+            (cache, tokens, lengths, sstate), outs = jax.lax.scan(
+                body, (cache, tokens, lengths, sstate), None, length=K)
+            return cache, sstate, outs  # ([K,B], [K,B], [K,B,L], [K,B,L])
+
+        self._decode_lp_fn = jax.jit(decode_loop_lp, donate_argnums=(1, 4))
 
         if self._draft_cfg is not None:
             dcfg = self._draft_cfg
@@ -764,14 +805,23 @@ class InferenceEngine:
         self._request_seed += 1
         seed = p.seed if p.seed is not None else self._request_seed
         key = jax.random.PRNGKey(seed)
+        first_lp = None
         try:
-            self._emit("prefill", tokens=padded, length=len(ids),
-                       temperature=p.temperature, top_p=p.top_p,
-                       top_k=p.top_k, seed=seed)
-            first_id, ks, vs = self._prefill_fn(
-                self.params, jnp.asarray(padded), jnp.asarray([len(ids)], jnp.int32),
-                jnp.float32(p.temperature), jnp.float32(p.top_p),
-                jnp.int32(p.top_k), key)
+            args = (self.params, jnp.asarray(padded),
+                    jnp.asarray([len(ids)], jnp.int32),
+                    jnp.float32(p.temperature), jnp.float32(p.top_p),
+                    jnp.int32(p.top_k), key)
+            if p.logprobs is not None:
+                self._emit("prefill_lp", tokens=padded, length=len(ids),
+                           temperature=p.temperature, top_p=p.top_p,
+                           top_k=p.top_k, seed=seed)
+                first_id, clp, vals, lids, ks, vs = self._prefill_lp_fn(*args)
+                first_lp = self._lp_entry(clp, vals, lids, p.logprobs)
+            else:
+                self._emit("prefill", tokens=padded, length=len(ids),
+                           temperature=p.temperature, top_p=p.top_p,
+                           top_k=p.top_k, seed=seed)
+                first_id, ks, vs = self._prefill_fn(*args)
 
             slot = self._free.pop()
             self._emit("insert", slot=slot)
@@ -788,7 +838,8 @@ class InferenceEngine:
                 finish_reason="abort", num_prompt_tokens=len(ids)))
             raise
 
-        self._register_slot(req, slot, int(first_id), len(ids))
+        self._register_slot(req, slot, int(first_id), len(ids),
+                            first_lp=first_lp)
         # Harvest full blocks into the prefix cache (device->host copy only
         # when at least one block is actually new).
         if self._prefix is not None and self.dispatcher is None:
@@ -803,6 +854,14 @@ class InferenceEngine:
         decode side): insert the transferred KV, reconstruct the sampling key
         stream, and continue decoding from the first token."""
         pf = req.prefilled
+        if req.params.logprobs is not None:
+            # The transferred state has no logits for the first token;
+            # serving a partial logprob stream would be silently wrong.
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="error", error="logprobs_unavailable",
+                num_prompt_tokens=pf.num_prompt))
+            return
         usable = self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1
         k, v = jnp.asarray(pf.k), jnp.asarray(pf.v)
         if pf.num_prompt > usable:
@@ -830,6 +889,16 @@ class InferenceEngine:
             raise
         self._register_slot(req, slot, pf.first_token, pf.num_prompt)
 
+    @staticmethod
+    def _lp_entry(clp, vals, lids, n: int):
+        """(chosen_logprob, [(token_id, logprob) x min(n, MAX)]) from the
+        device outputs of a top_logprobs call."""
+        n = min(n, sampler_mod.TOP_LOGPROBS_MAX)
+        vals = np.asarray(vals)
+        lids = np.asarray(lids)
+        return (float(clp),
+                [(int(lids[i]), float(vals[i])) for i in range(n)])
+
     def _apply_set_slot(self, slot: int, p, key) -> None:
         """Write one slot's sampling params through the donated jit (array
         args keep one compiled program across requests; python floats would
@@ -843,7 +912,7 @@ class InferenceEngine:
             jnp.asarray(p.frequency_penalty, jnp.float32))
 
     def _register_slot(self, req: Request, slot: int, first: int,
-                       num_prompt: int) -> None:
+                       num_prompt: int, first_lp=None) -> None:
         # Draft-cache prompt prefill (speculative decoding).  Skipped when
         # the prompt tokens aren't available (disagg-transferred KV) or the
         # prompt exceeds the one-shot buckets (a monolithic draft prefill
@@ -876,6 +945,8 @@ class InferenceEngine:
         st = _Slot(request=req, num_prompt=num_prompt,
                    draft_synced=draft_synced)
         st.generated.append(first)
+        if first_lp is not None:
+            st.logprobs.append(first_lp)
         st.first_token_time = now
         self._slots[slot] = st
         self._lengths[slot] = num_prompt
@@ -891,7 +962,8 @@ class InferenceEngine:
         st.num_emitted = 1
         req.outputs.put(RequestOutput(
             request_id=req.request_id, token_ids=[first],
-            num_prompt_tokens=num_prompt, ttft_s=ttft))
+            num_prompt_tokens=num_prompt, ttft_s=ttft,
+            logprobs=list(st.logprobs) if st.logprobs else None))
 
     # ------------------------------------------------------------------
     # Detached prefill (disaggregated prefill side)
@@ -1032,17 +1104,26 @@ class InferenceEngine:
         # Final chunk: sample the first token (same key semantics as the
         # one-shot prefill_and_sample) and promote the slot to decoding.
         p = st.request.params
-        self._emit("sample_one", temperature=p.temperature, top_p=p.top_p,
-                   top_k=p.top_k, seed=st.seed)
-        first = int(self._sample_one_fn(
-            logits, jnp.float32(p.temperature), jnp.float32(p.top_p),
-            jnp.int32(p.top_k), st.key))
+        args = (logits, jnp.float32(p.temperature), jnp.float32(p.top_p),
+                jnp.int32(p.top_k), st.key)
+        first_lp = None
+        if p.logprobs is not None:
+            self._emit("sample_one_lp", temperature=p.temperature,
+                       top_p=p.top_p, top_k=p.top_k, seed=st.seed)
+            fid, clp, vals, lids = self._sample_one_lp_fn(*args)
+            first = int(fid)
+            first_lp = self._lp_entry(clp, vals, lids, p.logprobs)
+        else:
+            self._emit("sample_one", temperature=p.temperature, top_p=p.top_p,
+                       top_k=p.top_k, seed=st.seed)
+            first = int(self._sample_one_fn(*args))
         del self._prefilling[slot]
         self._emit("set_slot", slot=slot, temperature=p.temperature,
                    top_p=p.top_p, top_k=p.top_k, seed=st.seed,
                    presence=p.presence_penalty, frequency=p.frequency_penalty)
         self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1))
-        self._register_slot(st.request, slot, first, len(st.ids))
+        self._register_slot(st.request, slot, first, len(st.ids),
+                            first_lp=first_lp)
         # Harvest the chunk-prefilled prompt (its KV exists only inside the
         # slotted cache — read it back out before decode grows past it).
         if self._prefix is not None and self.dispatcher is None:
@@ -1125,6 +1206,7 @@ class InferenceEngine:
                 and all(st.draft_synced
                         and st.request.params.presence_penalty == 0
                         and st.request.params.frequency_penalty == 0
+                        and st.request.params.logprobs is None
                         for st in self._slots.values())):
             return self._spec_dispatch()
         if self._draft_cfg is not None:
@@ -1134,21 +1216,38 @@ class InferenceEngine:
                 st.draft_synced = False
 
         t0 = time.monotonic()
+        # Logprob variant selected per dispatch: only dispatches containing
+        # a logprob-bearing slot pay the full-vocab log-softmax.
+        want_lp = any(st.request.params.logprobs is not None
+                      for st in self._slots.values())
         self._emit("decode", tokens=np.array(self._last_token),
-                   lengths=np.array(self._lengths))
-        self._cache, self._sampling, toks = self._decode_fn(
-            self.params, self._cache, jnp.asarray(self._last_token),
-            jnp.asarray(self._lengths), self._sampling)
+                   lengths=np.array(self._lengths), lp=want_lp)
+        if want_lp:
+            self._cache, self._sampling, (toks, clps, lvals, lids) = \
+                self._decode_lp_fn(
+                    self.params, self._cache, jnp.asarray(self._last_token),
+                    jnp.asarray(self._lengths), self._sampling)
+            clps = np.asarray(clps)     # [K, B]
+            lvals = np.asarray(lvals)   # [K, B, L]
+            lids = np.asarray(lids)
+        else:
+            self._cache, self._sampling, toks = self._decode_fn(
+                self.params, self._cache, jnp.asarray(self._last_token),
+                jnp.asarray(self._lengths), self._sampling)
         toks = np.asarray(toks)  # [K, B] — host sync point
         dt = time.monotonic() - t0
 
         for slot in list(self._slots):
             st = self._slots[slot]
+            n_lp = st.request.params.logprobs
             finished = False
             new_tokens = 0
             for k in range(K):
                 tok = int(toks[k, slot])
                 st.generated.append(tok)
+                if want_lp and n_lp is not None:
+                    st.logprobs.append(self._lp_entry(
+                        clps[k, slot], lvals[k, slot], lids[k, slot], n_lp))
                 new_tokens += 1
                 if self._is_stop(st, tok) or len(st.generated) >= st.request.params.max_tokens:
                     finished = True
@@ -1161,10 +1260,13 @@ class InferenceEngine:
                 self._finish(slot, self._finish_reason(st))
             else:
                 delta = st.generated[st.num_emitted:]
+                lp_delta = (st.logprobs[st.num_emitted:]
+                            if n_lp is not None else None)
                 st.num_emitted = len(st.generated)
                 st.request.outputs.put(RequestOutput(
                     request_id=st.request.request_id, token_ids=delta,
-                    num_prompt_tokens=st.num_prompt))
+                    num_prompt_tokens=st.num_prompt,
+                    logprobs=lp_delta))
 
     def _spec_dispatch(self) -> None:
         """One speculative step: draft proposes, target verifies, each slot
@@ -1263,9 +1365,13 @@ class InferenceEngine:
         else:
             final_ids = gen[: st.request.params.max_tokens]
         delta = final_ids[st.num_emitted:]
+        lp_delta = None
+        if p.logprobs is not None and st.logprobs:
+            lp_delta = st.logprobs[st.num_emitted: len(final_ids)]
         st.request.outputs.put(RequestOutput(
             request_id=st.request.request_id,
             token_ids=delta,
+            logprobs=lp_delta,
             finished=True, finish_reason=reason,
             num_prompt_tokens=st.num_prompt,
             num_generated_tokens=len(final_ids)))

@@ -218,13 +218,16 @@ class DispatchFollower:
     def _apply(self, eng, jax, jnp, op: str, p: dict) -> None:
         from arks_tpu.engine import sampler as sampler_mod
 
-        if op == "prefill":
+        if op in ("prefill", "prefill_lp"):
             key = self._jax.random.PRNGKey(p["seed"])
-            _first, ks, vs = eng._prefill_fn(
-                eng.params, jnp.asarray(p["tokens"]),
-                jnp.asarray([p["length"]], jnp.int32),
-                jnp.float32(p["temperature"]), jnp.float32(p["top_p"]),
-                jnp.int32(p["top_k"]), key)
+            args = (eng.params, jnp.asarray(p["tokens"]),
+                    jnp.asarray([p["length"]], jnp.int32),
+                    jnp.float32(p["temperature"]), jnp.float32(p["top_p"]),
+                    jnp.int32(p["top_k"]), key)
+            if op == "prefill_lp":
+                *_rest, ks, vs = eng._prefill_lp_fn(*args)
+            else:
+                _first, ks, vs = eng._prefill_fn(*args)
             self._last_kv = (ks, vs)
         elif op == "insert":
             ks, vs = self._last_kv
@@ -258,14 +261,17 @@ class DispatchFollower:
                 jnp.asarray(p["start"], jnp.int32),
                 jnp.asarray(p["valid"], jnp.int32))
             self._last_logits = _logits
-        elif op == "sample_one":
+        elif op in ("sample_one", "sample_one_lp"):
             key = self._jax.random.PRNGKey(p["seed"])
-            eng._sample_one_fn(self._last_logits,
-                               jnp.float32(p["temperature"]),
-                               jnp.float32(p["top_p"]),
-                               jnp.int32(p["top_k"]), key)
+            fn = (eng._sample_one_lp_fn if op == "sample_one_lp"
+                  else eng._sample_one_fn)
+            fn(self._last_logits,
+               jnp.float32(p["temperature"]),
+               jnp.float32(p["top_p"]),
+               jnp.int32(p["top_k"]), key)
         elif op == "decode":
-            eng._cache, eng._sampling, toks = eng._decode_fn(
+            fn = eng._decode_lp_fn if p.get("lp") else eng._decode_fn
+            eng._cache, eng._sampling, toks = fn(
                 eng.params, eng._cache, jnp.asarray(p["tokens"]),
                 jnp.asarray(p["lengths"]), eng._sampling)
             # Host-sync like the leader, but via block_until_ready —

@@ -18,6 +18,20 @@ import jax
 import jax.numpy as jnp
 
 TOP_K_MAX = 64
+TOP_LOGPROBS_MAX = 8
+
+
+def top_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray
+                 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Logprob data for OpenAI ``logprobs`` responses: (chosen token's
+    logprob [B], top-``TOP_LOGPROBS_MAX`` logprobs [B, L], their vocab ids
+    [B, L]).  Computed over the RAW model distribution (full-vocab
+    log-softmax) — the conventional reading of the API field, independent
+    of temperature/penalty shaping."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, ids = jax.lax.top_k(lp, min(TOP_LOGPROBS_MAX, lp.shape[-1]))
+    chosen_lp = jnp.take_along_axis(lp, chosen[:, None], -1)[:, 0]
+    return chosen_lp, vals, ids.astype(jnp.int32)
 
 
 class SamplingState(NamedTuple):

@@ -20,6 +20,10 @@ class SamplingParams:
     # semantics): logits -= presence*1[seen] + frequency*count.
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # None = no logprobs; 0 = chosen-token logprob only; N>0 = plus the
+    # top-N alternatives (clamped to sampler.TOP_LOGPROBS_MAX).
+    # Logprob-bearing slots ride the fused loop.
+    logprobs: int | None = None
 
 
 @dataclasses.dataclass
@@ -66,3 +70,7 @@ class RequestOutput:
     # Machine-readable rejection code when finish_reason == "error"
     # (e.g. "context_length_exceeded" -> HTTP 400 at the server).
     error: str | None = None
+    # Per-token logprob data aligned with token_ids (present only when the
+    # request asked for logprobs): each entry is
+    # (chosen_logprob, [(token_id, logprob), ...top-N...]).
+    logprobs: list | None = None

@@ -58,6 +58,17 @@ def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str
             stop_ids.append(ids[0])
         else:
             stop_strings.append(s)
+    # logprobs: completions take an int (top-N alternatives per token,
+    # 0 = chosen only); chat takes logprobs=true + top_logprobs=N.  The
+    # engine param is None (off) / 0 (chosen only) / N (plus top-N).
+    lp = body.get("logprobs")
+    if lp is True:
+        n_lp = int(body.get("top_logprobs") or 0)
+    elif lp is None or lp is False:
+        n_lp = None
+    else:
+        n_lp = int(lp)
+    from arks_tpu.engine.sampler import TOP_LOGPROBS_MAX
     params = SamplingParams(
         max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 256),
         temperature=float(body.get("temperature", 1.0)),
@@ -68,6 +79,7 @@ def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str
         stop_token_ids=tuple(stop_ids),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        logprobs=None if n_lp is None else min(max(n_lp, 0), TOP_LOGPROBS_MAX),
     )
     return params, stop_strings
 
@@ -315,12 +327,18 @@ class OpenAIServer:
     def _collect_text(self, req: Request, stop_strings: list[str]):
         """Drain a request to completion, applying stop-string truncation to
         every chunk — including the final one and flushed tail text.
-        Returns (text, finish_reason, final RequestOutput)."""
+        Returns (text, finish_reason, final RequestOutput, token_ids,
+        logprob entries)."""
         detok = IncrementalDetokenizer(self.engine.tokenizer)
         text = ""
+        tokens: list[int] = []
+        lps: list = []
         while True:
             out = req.outputs.get()
             text += detok.push(out.token_ids)
+            tokens.extend(out.token_ids)
+            if out.logprobs:
+                lps.extend(out.logprobs)
             if out.finished:
                 text += detok.flush()
             if stop_strings:
@@ -331,9 +349,61 @@ class OpenAIServer:
                         self.engine.abort(req.request_id)
                         while not out.finished:
                             out = req.outputs.get()
-                    return text, "stop", out
+                    # Trim token/logprob arrays to the visible text: entries
+                    # past the cut would make text_offset index out of the
+                    # returned string.
+                    tokens, lps = self._trim_to_text(tokens, lps, cut)
+                    return text, "stop", out, tokens, lps
             if out.finished:
-                return text, out.finish_reason, out
+                return text, out.finish_reason, out, tokens, lps
+
+    def _trim_to_text(self, tokens: list[int], lps: list, cut: int):
+        """Keep the longest token prefix whose rendered text fits in
+        ``cut`` characters (a token straddling the cut is dropped)."""
+        tok = self.engine.tokenizer
+        keep, acc = 0, 0
+        for tid in tokens:
+            n = len(tok.decode([tid]))
+            if acc + n > cut:
+                break
+            acc += n
+            keep += 1
+        return tokens[:keep], lps[:keep]
+
+    def _lp_completions_obj(self, token_ids: list[int], lps: list,
+                            top_n: int) -> dict:
+        """Legacy completions logprobs object (tokens / token_logprobs /
+        top_logprobs / text_offset)."""
+        tok = self.engine.tokenizer
+        tokens, token_lps, tops, offsets = [], [], [], []
+        off = 0
+        for tid, (clp, top) in zip(token_ids, lps):
+            s = tok.decode([tid])
+            tokens.append(s)
+            token_lps.append(clp)
+            tops.append({tok.decode([i]): v for i, v in top[:top_n]})
+            offsets.append(off)
+            off += len(s)
+        return {"tokens": tokens, "token_logprobs": token_lps,
+                "top_logprobs": tops, "text_offset": offsets}
+
+    def _lp_chat_content(self, token_ids: list[int], lps: list,
+                         top_n: int) -> list[dict]:
+        """Chat logprobs.content entries ({token, logprob, bytes,
+        top_logprobs})."""
+        tok = self.engine.tokenizer
+
+        def entry(tid_text: str, lp_val: float) -> dict:
+            return {"token": tid_text, "logprob": lp_val,
+                    "bytes": list(tid_text.encode("utf-8", "surrogatepass"))}
+
+        out = []
+        for tid, (clp, top) in zip(token_ids, lps):
+            e = entry(tok.decode([tid]), clp)
+            e["top_logprobs"] = [entry(tok.decode([i]), v)
+                                 for i, v in top[:top_n]]
+            out.append(e)
+        return out
 
     def _batch_response(self, h, reqs: list[Request], model: str,
                         stop_strings: list[str]) -> None:
@@ -341,9 +411,14 @@ class OpenAIServer:
         choices, usage = [], {"prompt_tokens": 0, "completion_tokens": 0,
                               "total_tokens": 0}
         for i, req in enumerate(reqs):
-            text, finish_reason, fin = self._collect_text(req, stop_strings)
-            choices.append({"index": i, "text": text,
-                            "finish_reason": finish_reason})
+            text, finish_reason, fin, toks, lps = self._collect_text(
+                req, stop_strings)
+            choice = {"index": i, "text": text,
+                      "finish_reason": finish_reason}
+            if req.params.logprobs is not None and lps:
+                choice["logprobs"] = self._lp_completions_obj(
+                    toks, lps, req.params.logprobs)
+            choices.append(choice)
             usage["prompt_tokens"] += fin.num_prompt_tokens
             usage["completion_tokens"] += fin.num_generated_tokens
         usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
@@ -355,7 +430,8 @@ class OpenAIServer:
 
     def _full_response(self, h, req: Request, chat: bool, model: str,
                        stop_strings: list[str]) -> None:
-        text, finish_reason, fin = self._collect_text(req, stop_strings)
+        text, finish_reason, fin, toks, lps = self._collect_text(
+            req, stop_strings)
         if finish_reason == "error":
             # Engine-level rejection (defense for direct add_request users;
             # the HTTP path normally pre-checks).
@@ -369,22 +445,26 @@ class OpenAIServer:
             "total_tokens": fin.num_prompt_tokens + fin.num_generated_tokens,
         }
         rid = req.request_id
+        n_lp = req.params.logprobs
         if chat:
+            choice = {"index": 0,
+                      "message": {"role": "assistant", "content": text},
+                      "finish_reason": finish_reason}
+            if n_lp is not None and lps:
+                choice["logprobs"] = {
+                    "content": self._lp_chat_content(toks, lps, n_lp)}
             payload = {
                 "id": rid, "object": "chat.completion", "created": int(time.time()),
-                "model": model,
-                "choices": [{"index": 0,
-                             "message": {"role": "assistant", "content": text},
-                             "finish_reason": finish_reason}],
-                "usage": usage,
+                "model": model, "choices": [choice], "usage": usage,
             }
         else:
+            choice = {"index": 0, "text": text,
+                      "finish_reason": finish_reason}
+            if n_lp is not None and lps:
+                choice["logprobs"] = self._lp_completions_obj(toks, lps, n_lp)
             payload = {
                 "id": rid, "object": "text_completion", "created": int(time.time()),
-                "model": model,
-                "choices": [{"index": 0, "text": text,
-                             "finish_reason": finish_reason}],
-                "usage": usage,
+                "model": model, "choices": [choice], "usage": usage,
             }
         h._json(200, payload)
 
@@ -405,6 +485,24 @@ class OpenAIServer:
         created = int(time.time())
         obj = "chat.completion.chunk" if chat else "text_completion"
 
+        n_lp = req.params.logprobs
+        # Logprob entries accumulate per engine output and flush with the
+        # next emitted frame: stop-string holdback decouples text deltas
+        # from token boundaries, so per-frame alignment is best-effort (the
+        # full set is exact; non-stream responses align exactly).
+        pend_lp_toks: list[int] = []
+        pend_lps: list = []
+
+        def take_lp():
+            if n_lp is None or not pend_lps:
+                return None
+            toks_, lps_ = list(pend_lp_toks), list(pend_lps)
+            pend_lp_toks.clear()
+            pend_lps.clear()
+            if chat:
+                return {"content": self._lp_chat_content(toks_, lps_, n_lp)}
+            return self._lp_completions_obj(toks_, lps_, n_lp)
+
         def chunk(delta_text: str | None, finish: str | None = None, role: str | None = None,
                   usage: dict | None = None, empty_choices: bool = False) -> dict:
             if empty_choices:
@@ -418,6 +516,10 @@ class OpenAIServer:
                 choices = [{"index": 0, "delta": delta, "finish_reason": finish}]
             else:
                 choices = [{"index": 0, "text": delta_text or "", "finish_reason": finish}]
+            if choices and (delta_text or finish):
+                lp_obj = take_lp()
+                if lp_obj is not None:
+                    choices[0]["logprobs"] = lp_obj
             payload = {"id": rid, "object": obj, "created": created,
                        "model": model, "choices": choices}
             if usage is not None:
@@ -436,6 +538,9 @@ class OpenAIServer:
             while True:
                 out = req.outputs.get()
                 pending += detok.push(out.token_ids)
+                if n_lp is not None and out.logprobs:
+                    pend_lp_toks.extend(out.token_ids)
+                    pend_lps.extend(out.logprobs)
                 if stop_strings:
                     cut = _find_stop(pending, stop_strings)
                     if cut is not None:
@@ -445,6 +550,10 @@ class OpenAIServer:
                         while not out.finished:
                             out = req.outputs.get()
                         fin = out
+                        # Entries past the stop cut describe tokens the
+                        # client never sees.
+                        pend_lp_toks.clear()
+                        pend_lps.clear()
                         send_frame(chunk(None, finish="stop"))
                         break
                 if out.finished:

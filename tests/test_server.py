@@ -343,3 +343,87 @@ def test_sigterm_drains_in_flight_requests():
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_logprobs_completions_and_chat(server):
+    """OpenAI logprobs: completions int form and chat logprobs/top_logprobs
+    form, with chosen-token logprobs matching a real log-softmax (negative,
+    and for greedy the chosen token is the max of its top list)."""
+    import math
+
+    with _post(server, "/v1/completions",
+               {"model": "tiny-serve", "prompt": "hello", "max_tokens": 6,
+                "temperature": 0, "ignore_eos": True, "logprobs": 3}) as r:
+        out = json.load(r)
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 6
+    assert len(lp["token_logprobs"]) == 6
+    assert all(v <= 0 for v in lp["token_logprobs"])
+    # Dict keyed by token TEXT (the legacy format): distinct ids that
+    # render identically (byte-tokenizer replacement chars) collapse.
+    assert all(1 <= len(d) <= 3 for d in lp["top_logprobs"])
+    for tok_lp, top in zip(lp["token_logprobs"], lp["top_logprobs"]):
+        # Greedy: the chosen token is the global argmax, so its logprob
+        # bounds every listed alternative (text-key collisions can hide
+        # the chosen entry itself from the dict).
+        assert tok_lp >= max(top.values()) - 1e-5
+    assert lp["text_offset"][0] == 0
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+
+    with _post(server, "/v1/chat/completions",
+               {"model": "tiny-serve", "max_tokens": 4, "temperature": 0,
+                "ignore_eos": True, "logprobs": True, "top_logprobs": 2,
+                "messages": [{"role": "user", "content": "hi"}]}) as r:
+        out = json.load(r)
+    content = out["choices"][0]["logprobs"]["content"]
+    assert len(content) == 4
+    for e in content:
+        assert e["logprob"] <= 0
+        assert isinstance(e["bytes"], list)
+        assert len(e["top_logprobs"]) == 2
+
+    with _post(server, "/v1/completions",
+               {"model": "tiny-serve", "prompt": "x", "max_tokens": 2,
+                "temperature": 0, "ignore_eos": True}) as r:
+        out = json.load(r)
+    assert "logprobs" not in out["choices"][0]
+
+
+def test_logprobs_streaming(server):
+    entries = []
+    with _post(server, "/v1/chat/completions",
+               {"model": "tiny-serve", "max_tokens": 6, "temperature": 0,
+                "ignore_eos": True, "logprobs": True, "top_logprobs": 1,
+                "stream": True,
+                "messages": [{"role": "user", "content": "go"}]}) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            for c in json.loads(line[6:]).get("choices", []):
+                lp = c.get("logprobs")
+                if lp:
+                    entries.extend(lp["content"])
+    assert len(entries) == 6  # one per generated token, across chunks
+    assert all(e["logprob"] <= 0 for e in entries)
+
+
+def test_logprobs_zero_means_chosen_only(server):
+    """completions logprobs=0 and chat top_logprobs=0: logprob data present,
+    alternatives lists empty (distinct from 'off')."""
+    with _post(server, "/v1/completions",
+               {"model": "tiny-serve", "prompt": "z", "max_tokens": 3,
+                "temperature": 0, "ignore_eos": True, "logprobs": 0}) as r:
+        out = json.load(r)
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert all(d == {} for d in lp["top_logprobs"])
+
+    with _post(server, "/v1/chat/completions",
+               {"model": "tiny-serve", "max_tokens": 3, "temperature": 0,
+                "ignore_eos": True, "logprobs": True, "top_logprobs": 0,
+                "messages": [{"role": "user", "content": "q"}]}) as r:
+        out = json.load(r)
+    content = out["choices"][0]["logprobs"]["content"]
+    assert len(content) == 3
+    assert all(e["top_logprobs"] == [] for e in content)
