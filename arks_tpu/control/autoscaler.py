@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Callable
 
-from arks_tpu.control.reconciler import Controller
+from arks_tpu.control.reconciler import Controller, Result
 from arks_tpu.control.resources import Application
 
 log = logging.getLogger("arks_tpu.control.autoscaler")

@@ -98,20 +98,35 @@ def _prefix_key(body: bytes) -> bytes | None:
         total = 0
         for m in obj["messages"]:
             c = m.get("content") if isinstance(m, dict) else None
-            if isinstance(c, str):
-                parts.append(c)
-                total += len(c)
-                if total >= _PREFIX_KEY_CHARS:
+            if isinstance(c, list):
+                # OpenAI content parts: serialize the text parts so
+                # part-based requests key on their REAL prefix instead of
+                # skipping ahead to a later turn's text (which would pin
+                # different prefixes to one backend).
+                c = "".join(t for p in c
+                            if isinstance(p, dict) and p.get("type") == "text"
+                            for t in (p.get("text"),) if isinstance(t, str))
+                if not c:
+                    # No usable text (image-only parts): same rule as any
+                    # other unknown shape — never key on later turns.
                     break
+            if not isinstance(c, str):
+                # Unknown content shape: stop scanning — keying on LATER
+                # turns would defeat the prefix-affinity intent.
+                break
+            parts.append(c)
+            total += len(c)
+            if total >= _PREFIX_KEY_CHARS:
+                break
         text = "\x00".join(parts)
     elif isinstance(obj.get("prompt"), str):
         text = obj["prompt"]
     else:
         return None
     if not text:
-        # Content-parts bodies (list-valued content) and empty prompts have
-        # no usable text key — round-robin, don't pin them all to one
-        # backend via a shared empty key.
+        # Prompts with no usable text (empty, or content parts carrying no
+        # text) get no key — round-robin, don't pin them all to one backend
+        # via a shared empty key.
         return None
     return text[:_PREFIX_KEY_CHARS].encode("utf-8", "surrogatepass")
 

@@ -328,19 +328,37 @@ class OpenAIServer:
         """Drain a request to completion, applying stop-string truncation to
         every chunk — including the final one and flushed tail text.
         Returns (text, finish_reason, final RequestOutput, token_ids,
-        logprob entries)."""
+        logprob entries, per-token text pieces)."""
         detok = IncrementalDetokenizer(self.engine.tokenizer)
+        # Per-token text pieces come from the SAME incremental stream as the
+        # response text, so stop-cut trimming and text_offset stay aligned
+        # even for multi-byte BPE pieces (an isolated tok.decode([tid])
+        # renders replacement chars of the wrong length).  Only paid when
+        # logprobs are on — that is the only consumer of the alignment.
+        track = req.params.logprobs is not None
         text = ""
         tokens: list[int] = []
         lps: list = []
+        pieces: list[str] = []
         while True:
             out = req.outputs.get()
-            text += detok.push(out.token_ids)
+            if track:
+                for t in out.token_ids:
+                    piece = detok.push([t])
+                    text += piece
+                    pieces.append(piece)
+            else:
+                text += detok.push(out.token_ids)
             tokens.extend(out.token_ids)
             if out.logprobs:
                 lps.extend(out.logprobs)
             if out.finished:
-                text += detok.flush()
+                tail = detok.flush()
+                text += tail
+                if track and pieces and tail:
+                    # Window residue resolves after the last token; for
+                    # offset/trim purposes it belongs to that token.
+                    pieces[-1] += tail
             if stop_strings:
                 cut = _find_stop(text, stop_strings)
                 if cut is not None:
@@ -352,43 +370,55 @@ class OpenAIServer:
                     # Trim token/logprob arrays to the visible text: entries
                     # past the cut would make text_offset index out of the
                     # returned string.
-                    tokens, lps = self._trim_to_text(tokens, lps, cut)
-                    return text, "stop", out, tokens, lps
+                    tokens, lps, pieces = self._trim_to_text(
+                        tokens, lps, pieces, cut)
+                    return text, "stop", out, tokens, lps, pieces
             if out.finished:
-                return text, out.finish_reason, out, tokens, lps
+                return text, out.finish_reason, out, tokens, lps, pieces
 
-    def _trim_to_text(self, tokens: list[int], lps: list, cut: int):
-        """Keep the longest token prefix whose rendered text fits in
+    def _trim_to_text(self, tokens: list[int], lps: list, pieces: list[str],
+                      cut: int):
+        """Keep the longest token prefix whose streamed text fits in
         ``cut`` characters (a token straddling the cut is dropped)."""
-        tok = self.engine.tokenizer
-        keep, acc = 0, 0
-        for tid in tokens:
-            n = len(tok.decode([tid]))
-            if acc + n > cut:
+        if not pieces and tokens:
+            # The logprobs-off path records no stream pieces; isolated
+            # per-token decode is the best-effort fallback (lazy, stops at
+            # the cut; nothing downstream consumes offsets then).
+            tok = self.engine.tokenizer
+            pieces = (tok.decode([t]) for t in tokens)
+        keep, acc, kept = 0, 0, []
+        for piece in pieces:
+            if acc + len(piece) > cut:
                 break
-            acc += n
+            acc += len(piece)
             keep += 1
-        return tokens[:keep], lps[:keep]
+            kept.append(piece)
+        return tokens[:keep], lps[:keep], kept
 
     def _lp_completions_obj(self, token_ids: list[int], lps: list,
-                            top_n: int) -> dict:
+                            top_n: int, pieces: list[str] | None = None) -> dict:
         """Legacy completions logprobs object (tokens / token_logprobs /
-        top_logprobs / text_offset)."""
+        top_logprobs / text_offset).  ``pieces`` (per-token text from the
+        response's own incremental stream) keeps text_offset aligned with
+        the returned text; alternatives in top_logprobs are hypothetical
+        tokens with no stream context, so they decode in isolation."""
         tok = self.engine.tokenizer
         tokens, token_lps, tops, offsets = [], [], [], []
         off = 0
-        for tid, (clp, top) in zip(token_ids, lps):
-            s = tok.decode([tid])
+        for i, (tid, (clp, top)) in enumerate(zip(token_ids, lps)):
+            s = pieces[i] if pieces is not None and i < len(pieces) \
+                else tok.decode([tid])
             tokens.append(s)
             token_lps.append(clp)
-            tops.append({tok.decode([i]): v for i, v in top[:top_n]})
+            tops.append({tok.decode([j]): v for j, v in top[:top_n]})
             offsets.append(off)
             off += len(s)
         return {"tokens": tokens, "token_logprobs": token_lps,
                 "top_logprobs": tops, "text_offset": offsets}
 
     def _lp_chat_content(self, token_ids: list[int], lps: list,
-                         top_n: int) -> list[dict]:
+                         top_n: int, pieces: list[str] | None = None
+                         ) -> list[dict]:
         """Chat logprobs.content entries ({token, logprob, bytes,
         top_logprobs})."""
         tok = self.engine.tokenizer
@@ -398,10 +428,12 @@ class OpenAIServer:
                     "bytes": list(tid_text.encode("utf-8", "surrogatepass"))}
 
         out = []
-        for tid, (clp, top) in zip(token_ids, lps):
-            e = entry(tok.decode([tid]), clp)
-            e["top_logprobs"] = [entry(tok.decode([i]), v)
-                                 for i, v in top[:top_n]]
+        for i, (tid, (clp, top)) in enumerate(zip(token_ids, lps)):
+            s = pieces[i] if pieces is not None and i < len(pieces) \
+                else tok.decode([tid])
+            e = entry(s, clp)
+            e["top_logprobs"] = [entry(tok.decode([j]), v)
+                                 for j, v in top[:top_n]]
             out.append(e)
         return out
 
@@ -411,13 +443,13 @@ class OpenAIServer:
         choices, usage = [], {"prompt_tokens": 0, "completion_tokens": 0,
                               "total_tokens": 0}
         for i, req in enumerate(reqs):
-            text, finish_reason, fin, toks, lps = self._collect_text(
+            text, finish_reason, fin, toks, lps, pieces = self._collect_text(
                 req, stop_strings)
             choice = {"index": i, "text": text,
                       "finish_reason": finish_reason}
             if req.params.logprobs is not None and lps:
                 choice["logprobs"] = self._lp_completions_obj(
-                    toks, lps, req.params.logprobs)
+                    toks, lps, req.params.logprobs, pieces)
             choices.append(choice)
             usage["prompt_tokens"] += fin.num_prompt_tokens
             usage["completion_tokens"] += fin.num_generated_tokens
@@ -430,7 +462,7 @@ class OpenAIServer:
 
     def _full_response(self, h, req: Request, chat: bool, model: str,
                        stop_strings: list[str]) -> None:
-        text, finish_reason, fin, toks, lps = self._collect_text(
+        text, finish_reason, fin, toks, lps, pieces = self._collect_text(
             req, stop_strings)
         if finish_reason == "error":
             # Engine-level rejection (defense for direct add_request users;
@@ -452,7 +484,7 @@ class OpenAIServer:
                       "finish_reason": finish_reason}
             if n_lp is not None and lps:
                 choice["logprobs"] = {
-                    "content": self._lp_chat_content(toks, lps, n_lp)}
+                    "content": self._lp_chat_content(toks, lps, n_lp, pieces)}
             payload = {
                 "id": rid, "object": "chat.completion", "created": int(time.time()),
                 "model": model, "choices": [choice], "usage": usage,
@@ -461,7 +493,8 @@ class OpenAIServer:
             choice = {"index": 0, "text": text,
                       "finish_reason": finish_reason}
             if n_lp is not None and lps:
-                choice["logprobs"] = self._lp_completions_obj(toks, lps, n_lp)
+                choice["logprobs"] = self._lp_completions_obj(
+                    toks, lps, n_lp, pieces)
             payload = {
                 "id": rid, "object": "text_completion", "created": int(time.time()),
                 "model": model, "choices": [choice], "usage": usage,
@@ -486,22 +519,46 @@ class OpenAIServer:
         obj = "chat.completion.chunk" if chat else "text_completion"
 
         n_lp = req.params.logprobs
-        # Logprob entries accumulate per engine output and flush with the
-        # next emitted frame: stop-string holdback decouples text deltas
-        # from token boundaries, so per-frame alignment is best-effort (the
-        # full set is exact; non-stream responses align exactly).
+        # Logprob entries accumulate per engine output and flush with
+        # emitted frames — but never ahead of their text: entries whose
+        # pieces sit in the stop-string hold-back tail stay pending (a
+        # later cut may drop them), so the streamed entry set matches the
+        # non-stream response exactly.
         pend_lp_toks: list[int] = []
         pend_lps: list = []
+        pend_pieces: list[str] = []
+        lp_flush_n: list[int | None] = [None]  # entries next frame may flush
+
+        def lp_within(pending_text: str, boundary: int) -> int:
+            """How many pending entries' text ends within the first
+            ``boundary`` chars of ``pending_text``.  Pending tokens' text is
+            the trailing sum(pend_pieces) chars of emitted+pending text, so
+            walk from that (possibly negative) offset."""
+            acc = len(pending_text) - sum(len(p) for p in pend_pieces)
+            keep = 0
+            for p in pend_pieces:
+                if acc + len(p) > boundary:
+                    break
+                acc += len(p)
+                keep += 1
+            return keep
 
         def take_lp():
             if n_lp is None or not pend_lps:
                 return None
-            toks_, lps_ = list(pend_lp_toks), list(pend_lps)
-            pend_lp_toks.clear()
-            pend_lps.clear()
+            n = lp_flush_n[0]
+            n = len(pend_lps) if n is None else min(n, len(pend_lps))
+            if n <= 0:
+                return None
+            toks_, lps_, pieces_ = (pend_lp_toks[:n], pend_lps[:n],
+                                    pend_pieces[:n])
+            del pend_lp_toks[:n]
+            del pend_lps[:n]
+            del pend_pieces[:n]
             if chat:
-                return {"content": self._lp_chat_content(toks_, lps_, n_lp)}
-            return self._lp_completions_obj(toks_, lps_, n_lp)
+                return {"content": self._lp_chat_content(
+                    toks_, lps_, n_lp, pieces_)}
+            return self._lp_completions_obj(toks_, lps_, n_lp, pieces_)
 
         def chunk(delta_text: str | None, finish: str | None = None, role: str | None = None,
                   usage: dict | None = None, empty_choices: bool = False) -> dict:
@@ -537,27 +594,49 @@ class OpenAIServer:
                 send_frame(chunk(None, role="assistant"))
             while True:
                 out = req.outputs.get()
-                pending += detok.push(out.token_ids)
-                if n_lp is not None and out.logprobs:
-                    pend_lp_toks.extend(out.token_ids)
-                    pend_lps.extend(out.logprobs)
+                if n_lp is not None:
+                    # Per-token pushes through the same stream keep logprob
+                    # entries aligned with real text boundaries (see
+                    # _collect_text); chunk-wise push stays the no-logprobs
+                    # hot path.
+                    for t in out.token_ids:
+                        piece = detok.push([t])
+                        pending += piece
+                        if out.logprobs:
+                            pend_pieces.append(piece)
+                    if out.logprobs:
+                        pend_lp_toks.extend(out.token_ids)
+                        pend_lps.extend(out.logprobs)
+                else:
+                    pending += detok.push(out.token_ids)
+                if out.finished:
+                    # Flush window residue BEFORE the stop check: the tail
+                    # can complete a stop string, and the non-stream path
+                    # (_collect_text) cuts it — paths must agree.
+                    tail = detok.flush()
+                    pending += tail
+                    if pend_pieces and tail:
+                        pend_pieces[-1] += tail
                 if stop_strings:
                     cut = _find_stop(pending, stop_strings)
                     if cut is not None:
+                        # Drop only the logprob entries whose text falls
+                        # PAST the cut; kept entries flush with the cut
+                        # frame (or the stop frame when the cut text is
+                        # empty).
+                        keep = lp_within(pending, cut)
+                        del pend_lp_toks[keep:]
+                        del pend_lps[keep:]
+                        del pend_pieces[keep:]
                         if pending[:cut]:
                             send_frame(chunk(pending[:cut]))
                         self.engine.abort(req.request_id)
                         while not out.finished:
                             out = req.outputs.get()
                         fin = out
-                        # Entries past the stop cut describe tokens the
-                        # client never sees.
-                        pend_lp_toks.clear()
-                        pend_lps.clear()
                         send_frame(chunk(None, finish="stop"))
                         break
                 if out.finished:
-                    pending += detok.flush()
                     if pending:
                         send_frame(chunk(pending))
                     send_frame(chunk(None, finish=out.finish_reason))
@@ -566,7 +645,12 @@ class OpenAIServer:
                 # Hold back enough tail to catch a straddling stop string.
                 safe = len(pending) - hold
                 if safe > 0:
+                    # Flush only logprob entries whose text is fully inside
+                    # the emitted prefix; entries in the hold-back tail wait
+                    # (a later stop cut may drop them).
+                    lp_flush_n[0] = lp_within(pending, safe)
                     send_frame(chunk(pending[:safe]))
+                    lp_flush_n[0] = None
                     pending = pending[safe:]
             if include_usage and fin is not None:
                 usage = {

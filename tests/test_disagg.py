@@ -366,3 +366,49 @@ def test_prefix_key_robust_to_garbage():
     assert _prefix_key(b"{}") is None
     assert _prefix_key(b'{"messages": "nope"}') is None
     assert _prefix_key(b'{"prompt": "hi"}') is not None
+
+
+def test_prefix_key_content_parts():
+    """Content-part messages key on their serialized text parts; unknown
+    content shapes stop the scan instead of skipping to a later turn."""
+    import json as _json
+
+    from arks_tpu.router import _prefix_key
+
+    def body(messages):
+        return _json.dumps({"model": "m", "messages": messages}).encode()
+
+    tail = [{"role": "user", "content": "same tail question"}]
+    parts_a = [{"role": "system", "content": [
+        {"type": "text", "text": "persona A instructions"}]}] + tail
+    parts_b = [{"role": "system", "content": [
+        {"type": "text", "text": "persona B instructions"}]}] + tail
+    ka, kb = _prefix_key(body(parts_a)), _prefix_key(body(parts_b))
+    assert ka is not None and kb is not None and ka != kb
+    # Same as the equivalent plain-string message.
+    plain = [{"role": "system", "content": "persona A instructions"}] + tail
+    assert _prefix_key(body(plain)) == ka
+
+    # Unknown content shape in the FIRST message: never key on later turns.
+    weird = [{"role": "system", "content": {"mystery": 1}}] + tail
+    assert _prefix_key(body(weird)) is None
+
+
+def test_prefix_key_content_parts_edge_shapes():
+    """Null text values don't raise; image-only first messages don't key
+    on later turns."""
+    import json as _json
+
+    from arks_tpu.router import _prefix_key
+
+    def body(messages):
+        return _json.dumps({"model": "m", "messages": messages}).encode()
+
+    tail = [{"role": "user", "content": "tail"}]
+    assert _prefix_key(body(
+        [{"role": "u", "content": [{"type": "text", "text": None}]}] + tail
+    )) is None
+    assert _prefix_key(body(
+        [{"role": "u", "content": [{"type": "image_url",
+                                    "image_url": {"url": "x"}}]}] + tail
+    )) is None

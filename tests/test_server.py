@@ -427,3 +427,41 @@ def test_logprobs_zero_means_chosen_only(server):
     content = out["choices"][0]["logprobs"]["content"]
     assert len(content) == 3
     assert all(e["top_logprobs"] == [] for e in content)
+
+
+def test_logprobs_streaming_stop_cut_parity(server):
+    """On a streamed stop-string cut, logprob entries for visible tokens
+    still flush (only past-the-cut entries drop) — entry count and text
+    match the non-stream path for the same request."""
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "zq", "max_tokens": 8,
+        "temperature": 0, "ignore_eos": True,
+    }) as r:
+        full = json.load(r)["choices"][0]["text"]
+    assert len(full) >= 5
+    # full[3:5] straddles the 4-token dispatch boundary: the first frame is
+    # emitted (with its hold-back) before the cut is even detectable —
+    # entries in the hold-back tail must NOT flush early.
+    for stop in (full[1:3], full[3:5]):
+        body = {"model": "tiny-serve", "prompt": "zq", "max_tokens": 8,
+                "temperature": 0, "ignore_eos": True, "stop": [stop],
+                "logprobs": 1}
+        with _post(server, "/v1/completions", body) as r:
+            ref = json.load(r)["choices"][0]
+        assert ref["finish_reason"] == "stop"
+
+        text, n_entries = "", 0
+        with _post(server, "/v1/completions", dict(body, stream=True)) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                for c in json.loads(line[6:]).get("choices", []):
+                    text += c.get("text") or ""
+                    lp = c.get("logprobs")
+                    if lp:
+                        n_entries += len(lp["tokens"])
+        assert text == ref["text"]
+        assert n_entries == len(ref["logprobs"]["tokens"])
+        # The cut kept the visible-prefix tokens and dropped the rest.
+        assert 0 < n_entries < 8
