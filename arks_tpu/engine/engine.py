@@ -103,6 +103,15 @@ class EngineConfig:
     # channel scales, dequant fused into the matmuls — models.quant). How
     # 7B-class models fit a 16GB v5e chip; also halves decode weight reads.
     weight_dtype: str = "bf16"
+    # "auto"|"slot"|"paged": device KV layout.  "paged" = block-table pool
+    # (ops.paged_attention) with zero-copy on-device prefix sharing —
+    # measured FASTER than the slot cache at production shapes
+    # (tools/bench_kernels.py: 0.96x int8 b192, 0.78x bf16 b96) and it
+    # works on multi-host gangs.  "auto" = paged on TPU whenever the
+    # engine shape allows (no draft model / pp / cp / dp, lane-aligned
+    # head_dim, chunk == page alignment); slot elsewhere — the slot layout
+    # remains the fallback for those paths.
+    kv_layout: str = "auto"
     # Host-RAM budget for the prefix KV cache (0 disables).  Shared prompt
     # prefixes (system prompts, few-shot preambles, multi-turn history)
     # skip recomputation: cached blocks are inserted and only the tail is
@@ -199,6 +208,9 @@ class _ChunkState:
     pos: int      # tokens already prefilled
     seed: int     # sampling seed (key = PRNGKey(seed))
     key: jax.Array  # base sampling key (PRNGKey(seed))
+    # Paged layout: the prompt's chained page digests (computed at match
+    # time), registered into the allocator's prefix index at promote.
+    digests: list | None = None
 
 
 class EngineMetrics:
@@ -249,6 +261,12 @@ class EngineMetrics:
         self.spec_decode_acceptance_rate = r.gauge(
             "spec_decode_acceptance_rate",
             "Lifetime draft-token acceptance rate")
+        # Scheduler phase breakdown (seconds of engine-thread wall time):
+        # where a serving cycle actually goes — the counters bench_serving
+        # scrapes to attribute throughput loss (admit vs chunk vs decode).
+        self.scheduler_seconds_total = r.counter(
+            "scheduler_seconds_total",
+            "Engine-thread wall seconds by scheduler phase")
 
 
 class InferenceEngine:
@@ -345,12 +363,8 @@ class InferenceEngine:
                 params = tf.shard_params(params, cfg, mesh)
         self.params = params
 
-        self._cache = tf.init_cache(cfg, engine_cfg.num_slots,
-                                    engine_cfg.max_cache_len,
-                                    self._cache_dtype(dtype),
-                                    quantized=engine_cfg.kv_quantized)
-        if mesh is not None:
-            self._cache = self._shard_cache(self._cache)
+        # KV cache built below, once the chunk size (= page size for the
+        # paged layout) is known.
         self._sampling = sampler_mod.init_sampling_state(
             engine_cfg.num_slots, engine_cfg.seed,
             vocab_size=cfg.vocab_size)
@@ -376,10 +390,60 @@ class InferenceEngine:
                 c -= 1
             self._chunk = c
 
-        # Prefix KV cache: block size = chunk size, so a reused prefix ends
-        # exactly where the chunked tail prefill starts.
+        # ---- KV layout: paged pool or slot-contiguous cache ------------
+        self._paged = self._resolve_kv_layout()
+        self._alloc = None
+        self._tables = None
+        self._slot_pages: dict[int, list[int]] = {}
+        if self._paged:
+            from arks_tpu.engine.paged import PageAllocator
+            page = self._page_size()
+            max_pages = engine_cfg.max_cache_len // page
+            self._max_pages = max_pages
+            # Worst case (every slot full) always fits; the prefix budget
+            # adds retention headroom on top.
+            kv_bytes = 1 if engine_cfg.kv_quantized else jnp.dtype(
+                self._cache_dtype(dtype)).itemsize
+            page_bytes = (cfg.num_layers * cfg.num_kv_heads * page
+                          * cfg.head_dim * kv_bytes * 2)
+            if engine_cfg.kv_quantized:
+                page_bytes += cfg.num_layers * cfg.num_kv_heads * page * 4 * 2
+            extra = 0
+            if engine_cfg.prefix_cache_mb:
+                extra = max(engine_cfg.prefix_cache_mb * 2**20 // page_bytes, 0)
+                # The byte budget is tuned for 7B-class pools; cap by
+                # proportion so tiny test models don't allocate huge pools.
+                extra = min(extra, engine_cfg.num_slots * max_pages * 4)
+            num_pages = engine_cfg.num_slots * max_pages + extra
+            self._page_bytes = page_bytes
+            self._cache = tf.init_paged_cache(
+                cfg, num_pages, page, self._cache_dtype(dtype),
+                quantized=engine_cfg.kv_quantized)
+            if mesh is not None:
+                self._cache = tf.shard_paged_cache(self._cache, cfg, mesh)
+            self._alloc = PageAllocator(num_pages, page)
+            self._tables = np.zeros((engine_cfg.num_slots, max_pages),
+                                    np.int32)
+            # Free slots park at the coverage sentinel: their garbage
+            # dispatch rows are dropped by the kernels instead of landing
+            # in (possibly shared) pages.
+            self._lengths[:] = max_pages * page
+            log.info("paged KV: %d pages x %d tokens (%d retention extra)",
+                     num_pages, page, extra)
+        else:
+            self._max_pages = 0
+            self._page_bytes = 0
+            self._cache = tf.init_cache(cfg, engine_cfg.num_slots,
+                                        engine_cfg.max_cache_len,
+                                        self._cache_dtype(dtype),
+                                        quantized=engine_cfg.kv_quantized)
+            if mesh is not None:
+                self._cache = self._shard_cache(self._cache)
+
+        # Host-resident prefix KV cache (slot layout only — the paged pool
+        # shares pages ON DEVICE through the allocator's index instead).
         self._prefix = None
-        if engine_cfg.prefix_cache_mb and self._chunk:
+        if engine_cfg.prefix_cache_mb and self._chunk and not self._paged:
             from arks_tpu.engine.prefix_cache import PrefixKVCache
             self._prefix = PrefixKVCache(
                 self._chunk, engine_cfg.prefix_cache_mb * 2**20)
@@ -457,7 +521,7 @@ class InferenceEngine:
             def model_prefill(params, tokens, length):
                 return pp_mod.pp_prefill(params, cfg, tokens, length, mesh)
 
-            def model_decode(params, cache, tokens, lengths):
+            def model_decode(params, cache, tokens, lengths, tables=None):
                 return pp_mod.pp_decode_step(params, cfg, cache, tokens,
                                              lengths, mesh, num_mb)
         else:
@@ -465,9 +529,9 @@ class InferenceEngine:
                 return tf.prefill(params, cfg, tokens, length, mesh,
                                   seq_axis=seq_axis)
 
-            def model_decode(params, cache, tokens, lengths):
+            def model_decode(params, cache, tokens, lengths, tables=None):
                 return tf.decode_step(params, cfg, cache, tokens, lengths,
-                                      mesh, batch_axis)
+                                      mesh, batch_axis, tables=tables)
 
         def prefill_and_sample(params, tokens, length, temperature, top_p, top_k, key):
             logits, ks, vs = model_prefill(params, tokens, length)
@@ -490,11 +554,60 @@ class InferenceEngine:
         self._prefill_lp_fn = jax.jit(prefill_and_sample_lp)
         self._insert_fn = jax.jit(tf.insert, donate_argnums=(0,))
 
-        def chunk_step(params, cache, slot, tokens, start, valid):
-            return tf.prefill_chunk(params, cfg, cache, slot, tokens, start,
-                                    valid, mesh)
+        # Fused BATCHED admission: M queued prompts prefill + sample +
+        # insert + set_slot in ONE dispatch.  Under churn admissions were
+        # 71% of engine wall time as single dispatches (bench_serving.py's
+        # scheduler_seconds_total breakdown); batching amortizes the
+        # per-dispatch round-trip AND raises prefill MXU utilization.  One
+        # compiled program per (bucket, M, lp) combination — M is drawn
+        # from _ADMIT_BATCH_SIZES so the variant count stays bounded.
+        def admit_batch(params, cache, sampling, tokens, lengths, slots,
+                        pages, n_pages, temps, top_ps, top_ks, keys, pres,
+                        freqs, want_lp: bool):
+            logits, ks, vs = model_prefill(params, tokens, lengths)
+            tstate = sampler_mod.transient_state_batch(
+                temps, top_ps, top_ks, keys, cfg.vocab_size)
+            ids, _ = sampler_mod.sample(logits, tstate)
+            if self._paged:
+                # Buckets smaller than a page: pad T up so the page-insert
+                # loop can slice whole pages (tail rows masked by length).
+                pad = (-ks.shape[2]) % self._page_size()
+                if pad:
+                    width = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+                    ks_in = jnp.pad(ks, width)
+                    vs_in = jnp.pad(vs, width)
+                else:
+                    ks_in, vs_in = ks, vs
+                cache = tf.insert_pages_batch(cache, ks_in, vs_in, pages,
+                                              n_pages)
+            else:
+                cache = tf.insert_batch(cache, ks, vs, slots)
+            fold = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+            sampling = sampler_mod.set_slots(
+                sampling, slots, temps, top_ps, top_ks, fold, pres, freqs)
+            if want_lp:
+                clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
+                return ids, clp, vals, lids, cache, sampling, ks, vs
+            return ids, cache, sampling, ks, vs
+
+        self._admit_fn = jax.jit(functools.partial(admit_batch, want_lp=False),
+                                 donate_argnums=(1, 2))
+        self._admit_lp_fn = jax.jit(functools.partial(admit_batch, want_lp=True),
+                                    donate_argnums=(1, 2))
+
+        if self._paged:
+            def chunk_step(params, cache, tables_row, tokens, start, valid):
+                return tf.prefill_chunk_paged(params, cfg, cache, tables_row,
+                                              tokens, start, valid, mesh)
+        else:
+            def chunk_step(params, cache, slot, tokens, start, valid):
+                return tf.prefill_chunk(params, cfg, cache, slot, tokens,
+                                        start, valid, mesh)
 
         self._chunk_fn = jax.jit(chunk_step, donate_argnums=(1,))
+        if self._paged:
+            self._insert_pages_fn = jax.jit(tf.insert_pages,
+                                            donate_argnums=(0,))
 
         def sample_one(logits, temperature, top_p, top_k, key):
             state = sampler_mod.transient_state(temperature, top_p, top_k,
@@ -525,14 +638,15 @@ class InferenceEngine:
         self._clear_pen_fn = jax.jit(sampler_mod.clear_slot_penalties,
                                      donate_argnums=(0,))
 
-        def decode_loop(params, cache, tokens, lengths, sstate):
+        def decode_loop(params, cache, tokens, lengths, sstate, tables):
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
                 # Feed-time counting: every generated token is fed exactly
                 # once, which keeps the presence/frequency counts right
                 # across the one-shot, chunked, and disagg admission paths.
                 sstate = sampler_mod.count_tokens(sstate, tokens)
-                logits, cache = model_decode(params, cache, tokens, lengths)
+                logits, cache = model_decode(params, cache, tokens, lengths,
+                                             tables)
                 nxt, sstate = sampler_mod.sample(logits, sstate)
                 return (cache, nxt, lengths + 1, sstate), nxt
 
@@ -542,14 +656,15 @@ class InferenceEngine:
 
         self._decode_fn = jax.jit(decode_loop, donate_argnums=(1, 4))
 
-        def decode_loop_lp(params, cache, tokens, lengths, sstate):
+        def decode_loop_lp(params, cache, tokens, lengths, sstate, tables):
             # The logprob variant: selected per dispatch when any live slot
             # asked for logprobs (separate compiled program — the common
             # case never pays the full-vocab log-softmax).
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
                 sstate = sampler_mod.count_tokens(sstate, tokens)
-                logits, cache = model_decode(params, cache, tokens, lengths)
+                logits, cache = model_decode(params, cache, tokens, lengths,
+                                             tables)
                 nxt, sstate = sampler_mod.sample(logits, sstate)
                 clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
                 return (cache, nxt, lengths + 1, sstate), (nxt, clp, vals, lids)
@@ -652,6 +767,56 @@ class InferenceEngine:
         kvd = self.ecfg.resolve_kv_cache_dtype()
         return jnp.bfloat16 if kvd == "bf16" else engine_dtype
 
+    def _page_size(self) -> int:
+        """Page size = chunk size (a reused prefix then ends exactly where
+        the tail chunk prefill starts), or 256 when chunking is off."""
+        return self._chunk or 256
+
+    def _page_align(self) -> int:
+        """Kernel alignment for the page size (compiled TPU only): int8
+        scale RMW chunks are 128-wide, bf16 row chunks 16-wide."""
+        if jax.default_backend() != "tpu":
+            return 1
+        return 128 if self.ecfg.kv_quantized else 16
+
+    def _resolve_kv_layout(self) -> bool:
+        layout = self.ecfg.kv_layout
+        if layout not in ("auto", "slot", "paged"):
+            raise ValueError(f"kv_layout={layout!r}")
+        if layout == "slot":
+            return False
+        dp = self.mesh.shape.get(tf.AXIS_DATA, 1) if self.mesh is not None else 1
+        blockers = []
+        if self.ecfg.draft_model:
+            blockers.append("speculative decoding")
+        if self._pp > 1:
+            blockers.append("pipeline parallelism")
+        if self._cp > 1:
+            blockers.append("context parallelism")
+        if dp > 1:
+            blockers.append("data parallelism")
+        if (jax.default_backend() == "tpu"
+                and self.cfg.head_dim % 128 != 0):
+            blockers.append("head_dim not 128-lane aligned")
+        page = self._page_size()
+        if page % self._page_align() != 0:
+            blockers.append(f"page size {page} not {self._page_align()}-aligned")
+        if self.ecfg.max_cache_len % page != 0:
+            blockers.append(f"max_cache_len not a multiple of page {page}")
+        if layout == "paged":
+            if blockers:
+                raise ValueError(
+                    "kv_layout=paged is incompatible with: "
+                    + ", ".join(blockers))
+            return True
+        # auto: paged wherever supported — it measured faster than the
+        # slot layout at production shapes and adds on-device prefix
+        # sharing (tools/bench_kernels.py).  CPU stays on the slot layout
+        # (interpret-mode kernels are test-only).
+        if blockers or jax.default_backend() != "tpu":
+            return False
+        return True
+
     def _shard_cache(self, cache):
         if self._pp > 1:
             from arks_tpu.parallel.pipeline import shard_cache_pp
@@ -707,12 +872,25 @@ class InferenceEngine:
         if self.dispatcher is not None:
             self._emit("reset")
         dtype = jnp.dtype(self.ecfg.dtype or self.cfg.dtype)
-        self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
-                                    self.ecfg.max_cache_len,
-                                    self._cache_dtype(dtype),
-                                    quantized=self.ecfg.kv_quantized)
-        if self.mesh is not None:
-            self._cache = self._shard_cache(self._cache)
+        if self._paged:
+            from arks_tpu.engine.paged import PageAllocator
+            page = self._page_size()
+            self._cache = tf.init_paged_cache(
+                self.cfg, self._alloc.num_pages, page,
+                self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized)
+            if self.mesh is not None:
+                self._cache = tf.shard_paged_cache(self._cache, self.cfg,
+                                                   self.mesh)
+            self._alloc = PageAllocator(self._alloc.num_pages, page)
+            self._tables[:] = 0
+            self._slot_pages.clear()
+        else:
+            self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
+                                        self.ecfg.max_cache_len,
+                                        self._cache_dtype(dtype),
+                                        quantized=self.ecfg.kv_quantized)
+            if self.mesh is not None:
+                self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
             self.ecfg.num_slots, self.ecfg.seed,
             vocab_size=self.cfg.vocab_size)
@@ -723,7 +901,8 @@ class InferenceEngine:
             if self.mesh is not None:
                 self._draft_cache = tf.shard_cache(
                     self._draft_cache, self._draft_cfg, self.mesh)
-        self._lengths[:] = 0
+        self._lengths[:] = (self._max_pages * self._page_size()
+                            if self._paged else 0)
         self._last_token[:] = 0
         # A fault between _free.pop() and slot registration would otherwise
         # leak the slot index permanently.
@@ -736,12 +915,21 @@ class InferenceEngine:
         interleave bounds how long a long-prompt burst can stall decoding
         slots: one chunk dispatch, not one whole prefill.  Returns True if
         any work was done."""
+        t0 = time.monotonic()
         worked = self._admit()
+        t1 = time.monotonic()
+        if t1 - t0 > 1e-4:
+            self.metrics.scheduler_seconds_total.inc(t1 - t0, phase="admit")
         if self._prefilling:
             self._process_chunk()
+            t2 = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(t2 - t1, phase="chunk")
+            t1 = t2
             worked = True
         if self._slots:
             self._decode_dispatch()
+            self.metrics.scheduler_seconds_total.inc(
+                time.monotonic() - t1, phase="decode")
             worked = True
         if not worked:
             # Idle: wait briefly for a request, then try admission again.
@@ -749,21 +937,80 @@ class InferenceEngine:
                 req = self._queue.get(timeout=block_s)
             except queue.Empty:
                 return False
-            self._admit_one(req)
+            pre = self._preadmit(req)
+            if pre is not None:
+                self._resolve_admit_batch(
+                    self._issue_admit_batch([pre], pre[0].params.logprobs
+                                            is not None))
         return True
 
+    # Admission batch sizes (largest-first greedy fill).  Each size is one
+    # compiled program per (bucket, lp); the cap keeps variants bounded.
+    _ADMIT_BATCH_SIZES = (8, 4, 2, 1)
+
     def _admit(self) -> bool:
+        """Admit waiting requests.  One-shot prompts are GROUPED by
+        (prefill bucket, logprobs) and issued as fused batch dispatches —
+        all batches go out back-to-back (async), THEN first tokens are
+        fetched (issue-then-resolve; a blocking fetch between issues would
+        serialize every admission on the full device round-trip)."""
         admitted = False
-        while self._free:
+        groups: dict[tuple[int, bool], list] = {}
+        while self._free and self._queue.qsize() > 0:
+            n_grouped = sum(len(v) for v in groups.values())
+            if n_grouped >= len(self._free):
+                break
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            self._admit_one(req)
             admitted = True
+            pre = self._preadmit(req)
+            if pre is not None:
+                req, ids, padded = pre
+                key = (padded.shape[1], req.params.logprobs is not None)
+                groups.setdefault(key, []).append(pre)
+        recs = []
+        try:
+            for (bucket, want_lp), items in groups.items():
+                while items:
+                    m = next(s for s in self._ADMIT_BATCH_SIZES
+                             if s <= len(items))
+                    # Detach BEFORE issuing: _issue_admit_batch fails its
+                    # own items on error, and the handler below must not
+                    # abort them a second time.
+                    batch = items[:m]
+                    del items[:m]
+                    recs.append(self._issue_admit_batch(batch, want_lp))
+            while recs:
+                self._resolve_admit_batch(recs.pop(0))
+        except Exception:
+            # A failing batch must not strand its SIBLINGS: un-issued items
+            # and unresolved already-issued batches hold no registered slot
+            # (invisible to _run's recovery) — fail them here or their
+            # clients block forever.  (The failing batch's own requests
+            # were already failed by its issue/resolve handler.)
+            for items in groups.values():
+                for req, ids, _ in items:
+                    req.outputs.put(RequestOutput(
+                        request_id=req.request_id, token_ids=[],
+                        finished=True, finish_reason="abort",
+                        num_prompt_tokens=len(ids)))
+            for rec in recs:
+                for (req, ids, _), slot in zip(rec[0], rec[1]):
+                    if slot not in self._slots:
+                        self._free.append(slot)
+                    req.outputs.put(RequestOutput(
+                        request_id=req.request_id, token_ids=[],
+                        finished=True, finish_reason="abort",
+                        num_prompt_tokens=len(ids)))
+            raise
         return admitted
 
-    def _admit_one(self, req: Request) -> None:
+    def _preadmit(self, req: Request):
+        """Admission front half: aborts, disagg-transferred KV, rejects,
+        and the chunked/prefix paths are handled HERE (individually);
+        one-shot prompts return (req, ids, padded) for batch grouping."""
         self.metrics.num_requests_waiting.inc(-1)
         with self._abort_lock:
             self._queued_rids.discard(req.request_id)
@@ -785,10 +1032,29 @@ class InferenceEngine:
             log.info("rejected %s: %s", req.request_id, e)
             return
 
-        # Prefix reuse: insert the cached blocks, chunk-prefill only the
-        # tail (at least one tail token is always computed — its logits
-        # feed first-token sampling).
-        if self._prefix is not None and self.dispatcher is None:
+        # Prefix reuse.  Paged layout: the allocator's digest index maps
+        # shared prefixes to pages already ON DEVICE — the new slot's table
+        # points at them (zero copies, works on multi-host gangs since the
+        # pages travel as dispatch args) and only the tail is chunk-
+        # prefilled.  Slot layout: host-resident blocks are re-uploaded
+        # (single-host only).  At least one tail token is always computed —
+        # its logits feed first-token sampling.
+        if self._paged and self._chunk:
+            from arks_tpu.engine.paged import chain_digests
+            page = self._page_size()
+            nfull = (len(ids) - 1) // page
+            digests = chain_digests(ids, page, nfull) if nfull else []
+            shared = self._alloc.match(digests)
+            plen = len(shared) * page
+            self._alloc.record_query(len(ids), plen)
+            self.metrics.prefix_cache_query_tokens_total.inc(len(ids))
+            self.metrics.prefix_cache_hit_tokens_total.inc(plen)
+            self.metrics.prefix_cache_hit_rate.set(self._alloc.hit_rate)
+            if plen:
+                return self._start_chunked(req, ids, prefix_len=plen,
+                                           prefix_pages=shared,
+                                           digests=digests)
+        elif self._prefix is not None and self.dispatcher is None:
             plen = min(self._prefix.match(ids),
                        (len(ids) - 1) // self._chunk * self._chunk)
             self._prefix.record_query(len(ids), plen)
@@ -801,53 +1067,151 @@ class InferenceEngine:
         if padded is None:
             return self._start_chunked(req, ids)
 
-        p = req.params
-        self._request_seed += 1
-        seed = p.seed if p.seed is not None else self._request_seed
-        key = jax.random.PRNGKey(seed)
-        first_lp = None
+        return (req, ids, padded)
+
+    def _issue_admit_batch(self, items: list, want_lp: bool):
+        """Issue ONE fused dispatch admitting ``len(items)`` one-shot
+        prompts (same bucket).  Returns the pending record for
+        _resolve_admit_batch."""
+        m = len(items)
+        page = self._page_size() if self._paged else 0
+        tokens = np.concatenate([padded for _, _, padded in items], axis=0)
+        lengths = np.asarray([len(ids) for _, ids, _ in items], np.int32)
+        slots_l, seeds, keys = [], [], []
+        pages_rows = np.zeros((m, self._max_pages or 1), np.int32)
+        n_pages = np.zeros((m,), np.int32)
+        params_cols = {f: np.zeros((m,), np.float32)
+                       for f in ("temperature", "top_p", "presence", "frequency")}
+        top_ks = np.zeros((m,), np.int32)
         try:
-            args = (self.params, jnp.asarray(padded),
-                    jnp.asarray([len(ids)], jnp.int32),
-                    jnp.float32(p.temperature), jnp.float32(p.top_p),
-                    jnp.int32(p.top_k), key)
-            if p.logprobs is not None:
-                self._emit("prefill_lp", tokens=padded, length=len(ids),
-                           temperature=p.temperature, top_p=p.top_p,
-                           top_k=p.top_k, seed=seed)
-                first_id, clp, vals, lids, ks, vs = self._prefill_lp_fn(*args)
-                first_lp = self._lp_entry(clp, vals, lids, p.logprobs)
+            for i, (req, ids, _) in enumerate(items):
+                p = req.params
+                self._request_seed += 1
+                seed = p.seed if p.seed is not None else self._request_seed
+                seeds.append(seed)
+                keys.append(np.asarray(jax.random.PRNGKey(seed)))
+                slot = self._free.pop()
+                slots_l.append(slot)
+                if self._paged:
+                    n_alloc = -(-len(ids) // page)
+                    pages_rows[i] = self._assign_slot_pages(slot, n_alloc)
+                    n_pages[i] = n_alloc
+                params_cols["temperature"][i] = p.temperature
+                params_cols["top_p"][i] = p.top_p
+                params_cols["presence"][i] = p.presence_penalty
+                params_cols["frequency"][i] = p.frequency_penalty
+                top_ks[i] = p.top_k
+            slots = np.asarray(slots_l, np.int32)
+            self._emit("admit_batch_lp" if want_lp else "admit_batch",
+                       tokens=tokens, lengths=lengths, slots=slots,
+                       pages=pages_rows if self._paged else None,
+                       n_pages=n_pages if self._paged else None,
+                       seeds=list(seeds),
+                       temperature=params_cols["temperature"],
+                       top_p=params_cols["top_p"], top_k=top_ks,
+                       presence=params_cols["presence"],
+                       frequency=params_cols["frequency"])
+            args = (self.params, self._cache, self._sampling,
+                    jnp.asarray(tokens), jnp.asarray(lengths),
+                    jnp.asarray(slots),
+                    jnp.asarray(pages_rows) if self._paged else None,
+                    jnp.asarray(n_pages) if self._paged else None,
+                    jnp.asarray(params_cols["temperature"]),
+                    jnp.asarray(params_cols["top_p"]),
+                    jnp.asarray(top_ks),
+                    jnp.asarray(np.stack(keys)),
+                    jnp.asarray(params_cols["presence"]),
+                    jnp.asarray(params_cols["frequency"]))
+            if want_lp:
+                (first_ids, clps, valss, lidss, self._cache, self._sampling,
+                 ks, vs) = self._admit_lp_fn(*args)
+                lp_out = (clps, valss, lidss)
             else:
-                self._emit("prefill", tokens=padded, length=len(ids),
-                           temperature=p.temperature, top_p=p.top_p,
-                           top_k=p.top_k, seed=seed)
-                first_id, ks, vs = self._prefill_fn(*args)
-
-            slot = self._free.pop()
-            self._emit("insert", slot=slot)
-            self._cache = self._insert_fn(self._cache, ks, vs, jnp.asarray(slot))
-            self._emit("set_slot", slot=slot, temperature=p.temperature,
-                       top_p=p.top_p, top_k=p.top_k, seed=seed,
-                       presence=p.presence_penalty, frequency=p.frequency_penalty)
-            self._apply_set_slot(slot, p, jax.random.fold_in(key, 1))
+                first_ids, self._cache, self._sampling, ks, vs = \
+                    self._admit_fn(*args)
+                lp_out = None
         except Exception:
-            # The request is in no slot yet, so _run's recovery path can't
-            # see it — fail it here or its client blocks forever.
-            req.outputs.put(RequestOutput(
-                request_id=req.request_id, token_ids=[], finished=True,
-                finish_reason="abort", num_prompt_tokens=len(ids)))
+            # None of the requests holds a REGISTERED slot yet, so _run's
+            # recovery path can't see them — fail them here or their
+            # clients block forever.  (Slot and page bookkeeping are
+            # rebuilt by _run's reset.)
+            for req, ids, _ in items:
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(ids)))
             raise
+        return (items, slots_l, first_ids, lp_out, ks, vs)
 
-        self._register_slot(req, slot, int(first_id), len(ids),
-                            first_lp=first_lp)
-        # Harvest full blocks into the prefix cache (device->host copy only
-        # when at least one block is actually new).
-        if self._prefix is not None and self.dispatcher is None:
-            nfull = len(ids) // self._chunk * self._chunk
-            if nfull and self._prefix.missing_blocks(ids, nfull):
-                self._prefix.put(ids, np.asarray(ks[:, :, :nfull]),
-                                 np.asarray(vs[:, :, :nfull]), nfull)
-                self.metrics.prefix_cache_usage_bytes.set(self._prefix.bytes_used)
+    def _resolve_admit_batch(self, rec) -> None:
+        """Host-sync tail of a fused admission batch: fetch the first
+        tokens, register the slots, emit, and harvest prefixes."""
+        items, slots_l, first_ids, lp_out, ks, vs = rec
+        try:
+            firsts = np.asarray(first_ids).tolist()  # device round-trip
+            if lp_out is not None:
+                clps = np.asarray(lp_out[0])
+                valss = np.asarray(lp_out[1])
+                lidss = np.asarray(lp_out[2])
+        except Exception:
+            # Dispatch failed asynchronously; the requests hold slots that
+            # _run's recovery will not free (not registered) — fail them
+            # and reclaim here.
+            for (req, ids, _), slot in zip(items, slots_l):
+                if slot not in self._slots:
+                    self._free.append(slot)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(ids)))
+            raise
+        for i, ((req, ids, _), slot) in enumerate(zip(items, slots_l)):
+            first_lp = None
+            if lp_out is not None and req.params.logprobs is not None:
+                first_lp = self._lp_entry(clps[i], valss[i], lidss[i],
+                                          req.params.logprobs)
+            self._register_slot(req, slot, firsts[i], len(ids),
+                                first_lp=first_lp)
+            if self._paged and self._chunk:
+                # Zero-cost harvest: the prompt's full pages are already in
+                # the pool — register their digests so later prompts share
+                # them on device.  (Only pages entirely covered by the
+                # prompt: decode writes start at position len(ids).)
+                self._register_prompt_pages(ids,
+                                            self._slot_pages.get(slot, []))
+            # Slot layout: harvest into the host prefix cache — but NOT
+            # under admission pressure: the device->host KV copy (tens of
+            # MB per prompt) would starve waiting admissions.
+            elif (self._prefix is not None and self.dispatcher is None
+                    and len(items) == 1 and self._queue.empty()):
+                nfull = len(ids) // self._chunk * self._chunk
+                if nfull and self._prefix.missing_blocks(ids, nfull):
+                    self._prefix.put(ids, np.asarray(ks[:, :, :nfull]),
+                                     np.asarray(vs[:, :, :nfull]), nfull)
+                    self.metrics.prefix_cache_usage_bytes.set(
+                        self._prefix.bytes_used)
+
+    def _assign_slot_pages(self, slot: int, total: int,
+                           head_pages=()) -> np.ndarray:
+        """Allocate a slot's pages (optionally headed by already-incref'd
+        shared prefix pages), record them in _slot_pages, and write the
+        zero-padded table row — THE one place the row/ownership invariant
+        lives.  Returns the table row."""
+        pages = list(head_pages) + self._alloc.alloc(total - len(head_pages))
+        self._slot_pages[slot] = pages
+        row = np.zeros((self._max_pages,), np.int32)
+        row[: len(pages)] = pages
+        self._tables[slot] = row
+        return row
+
+    def _register_prompt_pages(self, ids, pages, digests=None) -> None:
+        from arks_tpu.engine.paged import chain_digests
+        page = self._page_size()
+        nreg = min(len(ids) // page, len(pages))
+        if nreg:
+            if digests is None or len(digests) < nreg:
+                digests = chain_digests(ids, page, nreg)
+            self._alloc.register(digests[:nreg], pages[:nreg])
+            self.metrics.prefix_cache_usage_bytes.set(
+                self._alloc.retained_pages * self._page_bytes)
 
     def _admit_prefilled(self, req: Request) -> None:
         """Admit a request whose prefill ran on another engine (disaggregated
@@ -876,8 +1240,28 @@ class InferenceEngine:
         key = jax.random.PRNGKey(pf.seed)
         try:
             slot = self._free.pop()
-            self._emit("insert_kv", slot=slot, k=np.asarray(k), v=np.asarray(v))
-            self._cache = self._insert_fn(self._cache, k, v, jnp.asarray(slot))
+            if self._paged:
+                page = self._page_size()
+                n_alloc = -(-pf.num_prompt // page)
+                row = self._assign_slot_pages(slot, n_alloc)
+                # Pad T to a page multiple so the page-insert loop reads
+                # whole pages (the tail rows are masked by length).
+                pad_t = n_alloc * page - k.shape[2]
+                if pad_t > 0:
+                    width = [(0, 0)] * 5
+                    width[2] = (0, pad_t)
+                    k = jnp.pad(k, width)
+                    v = jnp.pad(v, width)
+                self._emit("insert_pages", k=np.asarray(k), v=np.asarray(v),
+                           pages=row.copy(), n_pages=n_alloc)
+                self._cache = self._insert_pages_fn(
+                    self._cache, k, v, jnp.asarray(row),
+                    jnp.asarray(n_alloc, jnp.int32))
+            else:
+                self._emit("insert_kv", slot=slot, k=np.asarray(k),
+                           v=np.asarray(v))
+                self._cache = self._insert_fn(self._cache, k, v,
+                                              jnp.asarray(slot))
             self._emit("set_slot", slot=slot, temperature=p.temperature,
                        top_p=p.top_p, top_k=p.top_k, seed=pf.seed,
                        presence=p.presence_penalty, frequency=p.frequency_penalty)
@@ -1025,12 +1409,35 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _start_chunked(self, req: Request, ids: list[int],
-                       prefix_len: int = 0) -> None:
+                       prefix_len: int = 0, prefix_pages=None,
+                       digests=None) -> None:
         p = req.params
         self._request_seed += 1
         seed = p.seed if p.seed is not None else self._request_seed
         slot = self._free.pop()
-        if prefix_len:
+        if self._paged:
+            # Pages must cover positions [0, len+K-1]: while this slot
+            # chunk-prefills, every interleaved decode dispatch's K-step
+            # scan writes garbage rows at len..len+K-1 (device lengths
+            # advance per step for ALL batch rows) — they must land in
+            # owned pages, never a stale/zero table entry that another
+            # sequence's page sits behind.  Shared prefix pages (already
+            # incref'd by match) head the table; only the tail is newly
+            # allocated.
+            page = self._page_size()
+            k_steps = self.ecfg.steps_per_dispatch
+            total = (len(ids) + k_steps - 1) // page + 1
+            shared = list(prefix_pages or [])
+            try:
+                self._assign_slot_pages(slot, total, head_pages=shared)
+            except Exception:
+                self._alloc.decref(shared)
+                self._free.append(slot)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(ids)))
+                raise
+        elif prefix_len:
             # Cached prefix blocks land in the slot first; chunked prefill
             # then continues from prefix_len (a chunk boundary by
             # construction).  The insert is padded to a BUCKETED length so
@@ -1057,7 +1464,8 @@ class InferenceEngine:
                 raise
         self._prefilling[slot] = _ChunkState(request=req, ids=ids,
                                              pos=prefix_len, seed=seed,
-                                             key=jax.random.PRNGKey(seed))
+                                             key=jax.random.PRNGKey(seed),
+                                             digests=digests)
         # Interleaved decode dispatches write garbage KV rows for every slot
         # at its length index; pointing this slot's length at the FINAL
         # prompt position keeps those writes beyond every masked read until
@@ -1072,6 +1480,7 @@ class InferenceEngine:
             if rid in self._aborted:
                 self._aborted.discard(rid)
                 del self._prefilling[slot]
+                self._release_slot_pages(slot)
                 self._free.append(slot)
                 st.request.outputs.put(RequestOutput(
                     request_id=rid, token_ids=[], finished=True,
@@ -1083,16 +1492,26 @@ class InferenceEngine:
         padded = np.zeros((c,), np.int32)
         padded[:valid] = chunk
         try:
-            self._emit("chunk", slot=slot, tokens=padded, start=st.pos,
-                       valid=valid)
-            logits, self._cache = self._chunk_fn(
-                self.params, self._cache, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(padded), jnp.asarray(st.pos, jnp.int32),
-                jnp.asarray(valid, jnp.int32))
+            if self._paged:
+                self._emit("chunk_paged", slot=slot, tokens=padded,
+                           start=st.pos, valid=valid,
+                           tables_row=self._tables[slot].copy())
+                logits, self._cache = self._chunk_fn(
+                    self.params, self._cache, jnp.asarray(self._tables[slot]),
+                    jnp.asarray(padded), jnp.asarray(st.pos, jnp.int32),
+                    jnp.asarray(valid, jnp.int32))
+            else:
+                self._emit("chunk", slot=slot, tokens=padded, start=st.pos,
+                           valid=valid)
+                logits, self._cache = self._chunk_fn(
+                    self.params, self._cache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(padded), jnp.asarray(st.pos, jnp.int32),
+                    jnp.asarray(valid, jnp.int32))
         except Exception:
             # Free the reserved slot and fail the request: _run's recovery
             # only sees registered slots.
             del self._prefilling[slot]
+            self._release_slot_pages(slot)
             self._free.append(slot)
             st.request.outputs.put(RequestOutput(
                 request_id=st.request.request_id, token_ids=[], finished=True,
@@ -1124,9 +1543,19 @@ class InferenceEngine:
         self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1))
         self._register_slot(st.request, slot, first, len(st.ids),
                             first_lp=first_lp)
-        # Harvest the chunk-prefilled prompt (its KV exists only inside the
-        # slotted cache — read it back out before decode grows past it).
-        if self._prefix is not None and self.dispatcher is None:
+        if self._paged and self._chunk:
+            # Zero-cost harvest: every full prompt page is now written —
+            # register the digest chain so later prompts share on device
+            # (st.digests carries the chain computed at match time).
+            self._register_prompt_pages(st.ids,
+                                        self._slot_pages.get(slot, []),
+                                        st.digests)
+        # Slot layout: harvest the chunk-prefilled prompt (its KV exists
+        # only inside the slotted cache — read it back out before decode
+        # grows past it).  Same pressure gate as the one-shot path: the
+        # device->host copy must not starve waiting admissions.
+        elif (self._prefix is not None and self.dispatcher is None
+                and self._queue.empty()):
             nfull = len(st.ids) // self._chunk * self._chunk
             if nfull and self._prefix.missing_blocks(st.ids, nfull):
                 k, v = self._extract_fn(self._cache, jnp.asarray(slot, jnp.int32))
@@ -1215,35 +1644,55 @@ class InferenceEngine:
             for st in self._slots.values():
                 st.draft_synced = False
 
+        if self._paged:
+            # Page growth: every active slot needs pages covering the K
+            # rows this dispatch writes.  Host-only bookkeeping; the pool
+            # is sized so allocation cannot fail for active slots.
+            page = self._page_size()
+            for slot in self._slots:
+                need = (int(self._lengths[slot]) + K - 1) // page + 1
+                row = self._slot_pages[slot]
+                if len(row) < need:
+                    new = self._alloc.alloc(need - len(row))
+                    self._tables[slot, len(row): len(row) + len(new)] = new
+                    row.extend(new)
+
         t0 = time.monotonic()
         # Logprob variant selected per dispatch: only dispatches containing
         # a logprob-bearing slot pay the full-vocab log-softmax.
         want_lp = any(st.request.params.logprobs is not None
                       for st in self._slots.values())
+        tables_arg = jnp.asarray(self._tables) if self._paged else None
         self._emit("decode", tokens=np.array(self._last_token),
-                   lengths=np.array(self._lengths), lp=want_lp)
+                   lengths=np.array(self._lengths), lp=want_lp,
+                   tables=self._tables.copy() if self._paged else None)
         if want_lp:
             self._cache, self._sampling, (toks, clps, lvals, lids) = \
                 self._decode_lp_fn(
                     self.params, self._cache, jnp.asarray(self._last_token),
-                    jnp.asarray(self._lengths), self._sampling)
+                    jnp.asarray(self._lengths), self._sampling, tables_arg)
             clps = np.asarray(clps)     # [K, B]
             lvals = np.asarray(lvals)   # [K, B, L]
             lids = np.asarray(lids)
         else:
             self._cache, self._sampling, toks = self._decode_fn(
                 self.params, self._cache, jnp.asarray(self._last_token),
-                jnp.asarray(self._lengths), self._sampling)
+                jnp.asarray(self._lengths), self._sampling, tables_arg)
         toks = np.asarray(toks)  # [K, B] — host sync point
         dt = time.monotonic() - t0
+        # One bulk C conversion instead of B*K numpy scalar reads (~6k
+        # PyObject boxing calls per dispatch at b192/K32 — measurable host
+        # time the GIL shares with the serving threads).
+        cols = toks.T.tolist()   # [B][K] python ints
 
         for slot in list(self._slots):
             st = self._slots[slot]
+            col = cols[slot]
             n_lp = st.request.params.logprobs
             finished = False
             new_tokens = 0
             for k in range(K):
-                tok = int(toks[k, slot])
+                tok = col[k]
                 st.generated.append(tok)
                 if want_lp and n_lp is not None:
                     st.logprobs.append(self._lp_entry(
@@ -1253,7 +1702,7 @@ class InferenceEngine:
                     finished = True
                     break
             self._lengths[slot] += K  # all K KVs were written on device
-            self._last_token[slot] = int(toks[K - 1, slot])
+            self._last_token[slot] = col[K - 1]
             self.metrics.generation_tokens_total.inc(new_tokens)
             self.metrics.time_per_output_token_seconds.observe(dt / K)
             if finished:
@@ -1282,12 +1731,12 @@ class InferenceEngine:
             self.params, self._draft_params, self._cache, self._draft_cache,
             jnp.asarray(self._last_token), jnp.asarray(self._lengths),
             self._sampling)
-        a = np.asarray(a)            # [B, DK] — host sync point
-        counts = np.asarray(counts)
+        a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
+        counts = np.asarray(counts).tolist()
         dt = time.monotonic() - t0
 
         n_slots = len(self._slots)
-        accepted = sum(int(counts[s]) - 1 for s in self._slots)
+        accepted = sum(counts[s] - 1 for s in self._slots)
         self.metrics.spec_decode_proposed_tokens_total.inc((DK - 1) * n_slots)
         self.metrics.spec_decode_accepted_tokens_total.inc(accepted)
         self._spec_proposed += (DK - 1) * n_slots
@@ -1297,11 +1746,12 @@ class InferenceEngine:
 
         for slot in list(self._slots):
             st = self._slots[slot]
-            c = int(counts[slot])
+            c = counts[slot]
+            row = a[slot]
             finished = False
             new_tokens = 0
             for i in range(c):
-                tok = int(a[slot, i])
+                tok = row[i]
                 st.generated.append(tok)
                 new_tokens += 1
                 if (self._is_stop(st, tok)
@@ -1310,7 +1760,7 @@ class InferenceEngine:
                     break
             # Cache rows valid through the accepted prefix (t0 + c-1 drafts).
             self._lengths[slot] += c
-            self._last_token[slot] = int(a[slot, c - 1])
+            self._last_token[slot] = row[c - 1]
             self.metrics.generation_tokens_total.inc(new_tokens)
             self.metrics.time_per_output_token_seconds.observe(
                 dt / max(new_tokens, 1))
@@ -1347,8 +1797,21 @@ class InferenceEngine:
             return True
         return False
 
+    def _release_slot_pages(self, slot: int) -> None:
+        """Paged layout: return the slot's page references and park it at
+        the write-drop sentinel (its garbage dispatch rows must never land
+        in pages another slot may now own).  Index-retained prefix pages
+        live on for future hits."""
+        if not self._paged:
+            return
+        pages = self._slot_pages.pop(slot, [])
+        if pages:
+            self._alloc.decref(pages)
+        self._lengths[slot] = self._max_pages * self._page_size()
+
     def _finish(self, slot: int, reason: str) -> None:
         st = self._slots.pop(slot)
+        self._release_slot_pages(slot)
         self._free.append(slot)
         p = st.request.params
         if p.presence_penalty or p.frequency_penalty:

@@ -218,7 +218,44 @@ class DispatchFollower:
     def _apply(self, eng, jax, jnp, op: str, p: dict) -> None:
         from arks_tpu.engine import sampler as sampler_mod
 
-        if op in ("prefill", "prefill_lp"):
+        if op in ("admit_batch", "admit_batch_lp"):
+            # Fused batched admission: prefill + sample + insert + set_slot
+            # for M prompts in one dispatch (mirrors the leader's
+            # _admit_fn exactly).  Paged engines receive the page rows by
+            # value — the allocator runs on the leader only.
+            import numpy as _np
+            keys = jnp.asarray(_np.stack(
+                [_np.asarray(self._jax.random.PRNGKey(s))
+                 for s in p["seeds"]]))
+            fn = (eng._admit_lp_fn if op == "admit_batch_lp"
+                  else eng._admit_fn)
+            pages = p.get("pages")
+            out = fn(eng.params, eng._cache, eng._sampling,
+                     jnp.asarray(p["tokens"]),
+                     jnp.asarray(p["lengths"], jnp.int32),
+                     jnp.asarray(p["slots"], jnp.int32),
+                     None if pages is None else jnp.asarray(pages),
+                     None if pages is None else jnp.asarray(
+                         p["n_pages"], jnp.int32),
+                     jnp.asarray(p["temperature"], jnp.float32),
+                     jnp.asarray(p["top_p"], jnp.float32),
+                     jnp.asarray(p["top_k"], jnp.int32), keys,
+                     jnp.asarray(p["presence"], jnp.float32),
+                     jnp.asarray(p["frequency"], jnp.float32))
+            eng._cache, eng._sampling = out[-4], out[-3]
+        elif op == "chunk_paged":
+            _logits, eng._cache = eng._chunk_fn(
+                eng.params, eng._cache, jnp.asarray(p["tables_row"]),
+                jnp.asarray(p["tokens"]),
+                jnp.asarray(p["start"], jnp.int32),
+                jnp.asarray(p["valid"], jnp.int32))
+            self._last_logits = _logits
+        elif op == "insert_pages":
+            eng._cache = eng._insert_pages_fn(
+                eng._cache, jnp.asarray(p["k"]), jnp.asarray(p["v"]),
+                jnp.asarray(p["pages"]),
+                jnp.asarray(p["n_pages"], jnp.int32))
+        elif op in ("prefill", "prefill_lp"):
             key = self._jax.random.PRNGKey(p["seed"])
             args = (eng.params, jnp.asarray(p["tokens"]),
                     jnp.asarray([p["length"]], jnp.int32),
@@ -271,9 +308,11 @@ class DispatchFollower:
                jnp.int32(p["top_k"]), key)
         elif op == "decode":
             fn = eng._decode_lp_fn if p.get("lp") else eng._decode_fn
+            tables = p.get("tables")
             eng._cache, eng._sampling, toks = fn(
                 eng.params, eng._cache, jnp.asarray(p["tokens"]),
-                jnp.asarray(p["lengths"]), eng._sampling)
+                jnp.asarray(p["lengths"]), eng._sampling,
+                None if tables is None else jnp.asarray(tables))
             # Host-sync like the leader, but via block_until_ready —
             # a follower may not address every shard of toks.
             jax.block_until_ready(toks)

@@ -1,0 +1,142 @@
+"""Host-side page allocator for the paged device KV cache.
+
+The device side is arks_tpu.ops.paged_attention (pool + block tables);
+this is the authority over which pool page holds what:
+
+- **Free list + refcounts**: a page is free (refcount 0), private (held by
+  one slot), or shared (held by several slots and/or the prefix index).
+- **Prefix index**: chained content digests (same scheme as
+  engine.prefix_cache) -> page id, LRU-ordered.  Registering a prompt's
+  full pages costs NOTHING on the device — the pages are already there;
+  a later prompt with the same prefix just points its table at them.
+  This replaces the host-resident PrefixKVCache's device->host harvest
+  copies and PCIe re-upload on hits, and because pages/tables are plain
+  dispatch arguments, it works on multi-host gangs (the old design's
+  single-host restriction — VERDICT round 2 item 2).
+- **Eviction**: allocation prefers the free list; under pressure it evicts
+  LRU index-retained pages (refcount held only by the index).  The pool is
+  sized so active slots can always allocate: slots*pages_per_slot worst
+  case is reserved, retention rides the surplus + an explicit extra.
+
+Thread-safety: engine thread only (like the rest of the scheduler state);
+the disaggregated prefill path never touches the allocator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+
+class OutOfPagesError(RuntimeError):
+    pass
+
+
+def chain_digests(ids, page: int, nblocks: int) -> list[bytes]:
+    """Chained content digests: digest j covers ids[: (j+1)*page]."""
+    h = hashlib.sha1()
+    arr = np.asarray(ids, np.int32)
+    out = []
+    for j in range(nblocks):
+        h.update(arr[j * page:(j + 1) * page].tobytes())
+        out.append(h.digest())
+    return out
+
+
+class PageAllocator:
+    def __init__(self, num_pages: int, page: int) -> None:
+        self.page = page
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, -1, -1))
+        self._ref = [0] * num_pages
+        # digest -> page id; LRU order (oldest first).  The index holds ONE
+        # reference on each registered page.
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self._page_digest: dict[int, bytes] = {}
+        # Stats (mirrored into EngineMetrics by the engine).
+        self.hit_tokens = 0
+        self.query_tokens = 0
+
+    # -- allocation ----------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def retained_pages(self) -> int:
+        return len(self._index)
+
+    def alloc(self, n: int) -> list[int]:
+        """n fresh pages (refcount 1 each).  Evicts LRU retained pages as
+        needed; raises OutOfPagesError when even eviction cannot satisfy
+        (pool mis-sized)."""
+        while len(self._free) < n and self._index:
+            self._evict_lru()
+        if len(self._free) < n:
+            raise OutOfPagesError(
+                f"need {n} pages, {len(self._free)} free and nothing "
+                "evictable — pool too small for the active slots")
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def _evict_lru(self) -> None:
+        digest, pg = self._index.popitem(last=False)
+        del self._page_digest[pg]
+        self._ref[pg] -= 1
+        if self._ref[pg] == 0:
+            self._free.append(pg)
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            self._ref[p] += 1
+
+    def decref(self, pages) -> None:
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+            elif self._ref[p] < 0:
+                raise AssertionError(f"page {p} refcount underflow")
+
+    # -- prefix index --------------------------------------------------
+
+    def match(self, digests: list[bytes]) -> list[int]:
+        """Pages for the longest indexed digest-chain prefix; each matched
+        page gets a caller reference (incref) and an LRU touch."""
+        pages = []
+        for d in digests:
+            pg = self._index.get(d)
+            if pg is None:
+                break
+            self._index.move_to_end(d)
+            self._ref[pg] += 1
+            pages.append(pg)
+        return pages
+
+    def register(self, digests: list[bytes], pages: list[int]) -> None:
+        """Put (digest, page) pairs into the index.  The index takes ONE
+        reference per newly-registered page; already-indexed digests keep
+        their existing page (the caller's duplicate page stays owned by the
+        caller alone and is freed on its decref)."""
+        for d, pg in zip(digests, pages):
+            if d in self._index:
+                self._index.move_to_end(d)
+                continue
+            self._index[d] = pg
+            self._page_digest[pg] = d
+            self._ref[pg] += 1
+
+    # -- stats ---------------------------------------------------------
+
+    def record_query(self, num_tokens: int, hit: int) -> None:
+        self.query_tokens += num_tokens
+        self.hit_tokens += hit
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hit_tokens / self.query_tokens if self.query_tokens else 0.0

@@ -89,6 +89,34 @@ def transient_state(temperature, top_p, top_k, key,
     )
 
 
+def transient_state_batch(temperature, top_p, top_k, keys,
+                          vocab_size: int) -> SamplingState:
+    """M-row transient state for BATCHED first-token sampling (fused
+    multi-prompt admissions): all params already [M]-shaped."""
+    m = temperature.shape[0]
+    return SamplingState(
+        temperature=temperature, top_p=top_p, top_k=top_k, key=keys,
+        presence=jnp.zeros((m,), jnp.float32),
+        frequency=jnp.zeros((m,), jnp.float32),
+        counts=jnp.zeros((m, vocab_size), jnp.int32),
+    )
+
+
+def set_slots(state: SamplingState, slots: jnp.ndarray, temperature,
+              top_p, top_k, keys, presence, frequency) -> SamplingState:
+    """Batched set_slot: write M slots' sampling params in one scatter
+    (one compiled program per batch size M)."""
+    return SamplingState(
+        temperature=state.temperature.at[slots].set(temperature),
+        top_p=state.top_p.at[slots].set(top_p),
+        top_k=state.top_k.at[slots].set(top_k),
+        key=state.key.at[slots].set(keys),
+        presence=state.presence.at[slots].set(presence),
+        frequency=state.frequency.at[slots].set(frequency),
+        counts=state.counts.at[slots].set(0),
+    )
+
+
 def clear_slot_penalties(state: SamplingState,
                          slot: jnp.ndarray) -> SamplingState:
     """Zero a freed slot's penalties so the ``penalized`` fast-path gate

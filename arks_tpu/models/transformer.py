@@ -71,6 +71,35 @@ class KVCache(NamedTuple):
         return self.k.shape[3]
 
 
+class PagedKVCache(NamedTuple):
+    """Paged decode cache: pool [num_layers, num_pages, Hkv, page, head_dim].
+
+    A page is a (layer, kv-head)-major stripe of ``page`` consecutive
+    positions of ONE sequence; per-slot block tables [B, MaxP] (owned by
+    the engine, passed as dispatch args) map position p of slot b to pool
+    page tables[b, p // page].  Two tables pointing at one page = zero-copy
+    prefix sharing (arks_tpu.ops.paged_attention).  int8 pools carry
+    per-token scales [L, N, Hkv, page] float32.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page(self) -> int:
+        return self.k.shape[3]
+
+
 # ---------------------------------------------------------------------------
 # Parameter init + sharding specs
 # ---------------------------------------------------------------------------
@@ -175,6 +204,38 @@ def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1,
     spec = P(None, batch, heads, None, None)
     sspec = P(None, batch, heads, None) if quantized else None
     return KVCache(k=spec, v=spec, k_scale=sspec, v_scale=sspec)
+
+
+def init_paged_cache(cfg: ModelConfig, num_pages: int, page: int,
+                     dtype: jnp.dtype | None = None,
+                     quantized: bool = False) -> PagedKVCache:
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page, cfg.head_dim)
+    if quantized:
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32))
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def paged_cache_pspecs(cfg: ModelConfig, tp: int = 1,
+                       quantized: bool = False) -> PagedKVCache:
+    """Pool sharding: kv heads over ``model`` when divisible (pages are
+    whole-sequence stripes, so neither N nor P can shard without breaking
+    page locality)."""
+    heads = AXIS_MODEL if shard_kv_heads(cfg, tp) else None
+    spec = P(None, None, heads, None, None)
+    sspec = P(None, None, heads, None) if quantized else None
+    return PagedKVCache(k=spec, v=spec, k_scale=sspec, v_scale=sspec)
+
+
+def shard_paged_cache(cache: PagedKVCache, cfg: ModelConfig,
+                      mesh: Mesh) -> PagedKVCache:
+    tp = mesh.shape.get(AXIS_MODEL, 1)
+    specs = paged_cache_pspecs(cfg, tp, quantized=cache.quantized)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), cache, specs)
 
 
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
@@ -466,6 +527,227 @@ def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     )
 
 
+def insert_pages(cache: PagedKVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 pages: jnp.ndarray, n_pages: jnp.ndarray) -> PagedKVCache:
+    """Insert prefill KV ([L, 1, T, Hkv, D] time-major) into the first
+    ``n_pages`` pool pages listed in ``pages`` ([T/page] int32, padded).
+
+    The paged counterpart of ``insert``: page j gets positions
+    [j*page, (j+1)*page); the last valid page's tail rows beyond the true
+    prompt length are garbage that every read path masks by length (same
+    invariant as bucket padding in the slot cache).  Pages listed beyond
+    ``n_pages`` are never touched — the engine only allocates what the
+    prompt needs."""
+    page = cache.page
+    kt = jnp.swapaxes(k_new, 2, 3)  # [L, 1, Hkv, T, D]
+    vt = jnp.swapaxes(v_new, 2, 3)
+    quantized = cache.quantized
+    if quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        kt, ks = quantize_kv(kt)    # int8 + [L, 1, Hkv, T] f32
+        vt, vs = quantize_kv(vt)
+    else:
+        kt = kt.astype(cache.k.dtype)
+        vt = vt.astype(cache.v.dtype)
+
+    def body(j, c):
+        kc, vc, ksc, vsc = c
+        pg = pages[j]
+        kb = jax.lax.dynamic_slice(
+            kt, (0, 0, 0, j * page, 0), kt.shape[:3] + (page, kt.shape[4]))
+        vb = jax.lax.dynamic_slice(
+            vt, (0, 0, 0, j * page, 0), vt.shape[:3] + (page, vt.shape[4]))
+        at = (0, pg, 0, 0, 0)
+        kc = jax.lax.dynamic_update_slice(kc, kb, at)
+        vc = jax.lax.dynamic_update_slice(vc, vb, at)
+        if quantized:
+            ksb = jax.lax.dynamic_slice(
+                ks, (0, 0, 0, j * page), ks.shape[:3] + (page,))
+            vsb = jax.lax.dynamic_slice(
+                vs, (0, 0, 0, j * page), vs.shape[:3] + (page,))
+            ksc = jax.lax.dynamic_update_slice(ksc, ksb, at[:-1])
+            vsc = jax.lax.dynamic_update_slice(vsc, vsb, at[:-1])
+        return (kc, vc, ksc, vsc)
+
+    kc, vc, ksc, vsc = jax.lax.fori_loop(
+        0, n_pages.astype(jnp.int32),
+        body, (cache.k, cache.v, cache.k_scale, cache.v_scale))
+    return PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
+def insert_batch(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 slots: jnp.ndarray) -> KVCache:
+    """Insert M prompts' prefill KV ([L, M, T, Hkv, D] time-major) into M
+    slots — the batched-admission counterpart of ``insert`` (M is small
+    and static, so the per-slot writes unroll)."""
+    m = k_new.shape[1]
+    kt = jnp.swapaxes(k_new, 2, 3)  # [L, M, Hkv, T, D]
+    vt = jnp.swapaxes(v_new, 2, 3)
+    if cache.quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        kt, ksn = quantize_kv(kt)
+        vt, vsn = quantize_kv(vt)
+    else:
+        kt = kt.astype(cache.k.dtype)
+        vt = vt.astype(cache.v.dtype)
+    kc, vc, ksc, vsc = cache.k, cache.v, cache.k_scale, cache.v_scale
+    for i in range(m):
+        at = (0, slots[i], 0, 0, 0)
+        kc = jax.lax.dynamic_update_slice(
+            kc, jax.lax.dynamic_slice_in_dim(kt, i, 1, axis=1), at)
+        vc = jax.lax.dynamic_update_slice(
+            vc, jax.lax.dynamic_slice_in_dim(vt, i, 1, axis=1), at)
+        if cache.quantized:
+            ksc = jax.lax.dynamic_update_slice(
+                ksc, jax.lax.dynamic_slice_in_dim(ksn, i, 1, axis=1), at[:-1])
+            vsc = jax.lax.dynamic_update_slice(
+                vsc, jax.lax.dynamic_slice_in_dim(vsn, i, 1, axis=1), at[:-1])
+    return KVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
+def insert_pages_batch(cache: PagedKVCache, k_new: jnp.ndarray,
+                       v_new: jnp.ndarray, pages: jnp.ndarray,
+                       n_pages: jnp.ndarray) -> PagedKVCache:
+    """Batched ``insert_pages``: M prompts ([L, M, T, Hkv, D], T a page
+    multiple) into their page lists ([M, T/page] int32, first n_pages[i]
+    valid per prompt)."""
+    page = cache.page
+    m = k_new.shape[1]
+    kt = jnp.swapaxes(k_new, 2, 3)  # [L, M, Hkv, T, D]
+    vt = jnp.swapaxes(v_new, 2, 3)
+    quantized = cache.quantized
+    if quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        kt, ksn = quantize_kv(kt)
+        vt, vsn = quantize_kv(vt)
+    else:
+        kt = kt.astype(cache.k.dtype)
+        vt = vt.astype(cache.v.dtype)
+    kc, vc, ksc, vsc = cache.k, cache.v, cache.k_scale, cache.v_scale
+
+    for i in range(m):
+        kti = jax.lax.dynamic_slice_in_dim(kt, i, 1, axis=1)  # [L,1,Hkv,T,D]
+        vti = jax.lax.dynamic_slice_in_dim(vt, i, 1, axis=1)
+        if quantized:
+            ksi = jax.lax.dynamic_slice_in_dim(ksn, i, 1, axis=1)
+            vsi = jax.lax.dynamic_slice_in_dim(vsn, i, 1, axis=1)
+
+        def body(j, c, i=i, kti=kti, vti=vti,
+                 ksi=ksi if quantized else None,
+                 vsi=vsi if quantized else None):
+            kc, vc, ksc, vsc = c
+            pg = pages[i, j]
+            at = (0, pg, 0, 0, 0)
+            kb = jax.lax.dynamic_slice(
+                kti, (0, 0, 0, j * page, 0),
+                kti.shape[:3] + (page, kti.shape[4]))
+            vb = jax.lax.dynamic_slice(
+                vti, (0, 0, 0, j * page, 0),
+                vti.shape[:3] + (page, vti.shape[4]))
+            kc = jax.lax.dynamic_update_slice(kc, kb, at)
+            vc = jax.lax.dynamic_update_slice(vc, vb, at)
+            if quantized:
+                ksb = jax.lax.dynamic_slice(
+                    ksi, (0, 0, 0, j * page), ksi.shape[:3] + (page,))
+                vsb = jax.lax.dynamic_slice(
+                    vsi, (0, 0, 0, j * page), vsi.shape[:3] + (page,))
+                ksc = jax.lax.dynamic_update_slice(ksc, ksb, at[:-1])
+                vsc = jax.lax.dynamic_update_slice(vsc, vsb, at[:-1])
+            return (kc, vc, ksc, vsc)
+
+        kc, vc, ksc, vsc = jax.lax.fori_loop(
+            0, n_pages[i].astype(jnp.int32), body, (kc, vc, ksc, vsc))
+    return PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
+def gather_pages(cache: PagedKVCache, tables_row: jnp.ndarray,
+                 layer: jnp.ndarray):
+    """One slot's cache as contiguous per-layer views: returns
+    (k [Hkv, S, D], v, k_scale [Hkv, S] | None, v_scale | None) for
+    ``layer``, gathered through the slot's table row ([MaxP] int32).
+    Chunked prefill's per-slot attention uses this — a full read of one
+    slot's layer cache, which the attention itself would do anyway."""
+    from arks_tpu.ops.paged_attention import paged_gather_kv
+
+    def per(pool):
+        # One pool-gather implementation (paged_attention.paged_gather_kv);
+        # a [1, MaxP] table row is a batch of one.
+        return paged_gather_kv(pool, tables_row[None], layer)[0]
+
+    k = per(cache.k)
+    v = per(cache.v)
+    if cache.quantized:
+        return k, v, per(cache.k_scale), per(cache.v_scale)
+    return k, v, None, None
+
+
+def prefill_chunk_paged(
+    params: Params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    tables_row: jnp.ndarray,  # [MaxP] int32 — the slot's block table
+    tokens: jnp.ndarray,      # [C] int32 — chunk tokens (C == cache.page)
+    start: jnp.ndarray,       # () int32 — global position of tokens[0]
+    valid: jnp.ndarray,       # () int32 — true token count (<= C)
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Chunked prefill against the paged pool: chunk == page, so each chunk
+    fills exactly the page ``tables_row[start / page]`` (one dynamic-slice
+    write, no scatter), and attention reads the slot's pages — including
+    PREFIX pages other slots share, which is how a prefix hit skips its
+    recompute without any KV copy."""
+    c = tokens.shape[0]
+    page = cache.page
+    if c != page:
+        raise ValueError(f"paged chunk size {c} must equal the page size {page}")
+    positions = (start + jnp.arange(c, dtype=jnp.int32))[None]  # [1, C]
+    h = embed_lookup(params["embed"], tokens[None],
+                     params["layers"]["attn_norm"].dtype)       # [1, C, E]
+    quantized = cache.quantized
+    pg = jax.lax.dynamic_index_in_dim(
+        tables_row, start.astype(jnp.int32) // page, 0, keepdims=False)
+
+    def body(carry, xs):
+        h, kc, vc, ksc, vsc = carry
+        lp, layer = xs
+        q, k, v = _block_qkv(h, lp, cfg, positions)
+
+        kt = jnp.swapaxes(k[0], 0, 1)  # [Hkv, C, D]
+        vt = jnp.swapaxes(v[0], 0, 1)
+        at = (layer, pg.astype(jnp.int32), 0, 0, 0)
+        if quantized:
+            from arks_tpu.ops.pallas_attention import quantize_kv
+            kq, ks = quantize_kv(kt)
+            vq, vs = quantize_kv(vt)
+            kc = jax.lax.dynamic_update_slice(kc, kq[None, None], at)
+            vc = jax.lax.dynamic_update_slice(vc, vq[None, None], at)
+            ksc = jax.lax.dynamic_update_slice(ksc, ks[None, None], at[:-1])
+            vsc = jax.lax.dynamic_update_slice(vsc, vs[None, None], at[:-1])
+        else:
+            kc = jax.lax.dynamic_update_slice(kc, kt[None, None].astype(kc.dtype), at)
+            vc = jax.lax.dynamic_update_slice(vc, vt[None, None].astype(vc.dtype), at)
+
+        kc_s, vc_s, ks_s, vs_s = gather_pages(
+            PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc),
+            tables_row, layer)
+        g = cfg.num_heads // cfg.num_kv_heads
+        qg = jnp.transpose(
+            q[0].reshape(c, cfg.num_kv_heads, g, cfg.head_dim), (1, 2, 0, 3))
+        from arks_tpu.ops.attention import chunk_attention_xla
+        attn = chunk_attention_xla(qg, kc_s, vc_s, start, ks_s, vs_s)
+        attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(1, c, cfg.q_dim)
+        attn = _constrain(attn, mesh, None, None, AXIS_MODEL)
+        h = _block_tail(h, attn, lp, cfg, mesh, None)
+        return (h, kc, vc, ksc, vsc), None
+
+    (h, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    h_last = jax.lax.dynamic_index_in_dim(h[0], valid - 1, 0, keepdims=True)
+    logits = _unembed(h_last, params, cfg, mesh, None)
+    return logits, PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
 def extract(cache: KVCache, slot: jnp.ndarray,
             dtype: jnp.dtype | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Read one slot's KV back out time-major ``[L, 1, S, Hkv, D]`` — the
@@ -491,27 +773,39 @@ def extract(cache: KVCache, slot: jnp.ndarray,
 def decode_step(
     params: Params,
     cfg: ModelConfig,
-    cache: KVCache,
+    cache: KVCache | PagedKVCache,
     tokens: jnp.ndarray,   # [B] int32 — current token per slot
     lengths: jnp.ndarray,  # [B] int32 — tokens already in cache per slot
     mesh: Mesh | None = None,
     batch_axis: str | None = None,
-) -> tuple[jnp.ndarray, KVCache]:
+    tables: jnp.ndarray | None = None,  # [B, MaxP] int32 — PagedKVCache only
+) -> tuple[jnp.ndarray, KVCache | PagedKVCache]:
     """Advance every slot one token. The current token's KV is written at
     position ``lengths`` (so the new valid length is lengths+1). Returns
     (logits [B, V] float32, updated cache).
 
-    PRECONDITION: lengths[b] < cache.max_len for every active slot.  At
-    lengths == max_len the KV scatter is silently dropped (JAX out-of-bounds
-    scatter semantics) and logits would be computed against stale cache — the
-    engine must retire or evict a slot before it fills (see
-    arks_tpu.engine.scheduler)."""
+    PRECONDITION (slot cache): lengths[b] < cache.max_len for every active
+    slot.  At lengths == max_len the KV scatter is silently dropped (JAX
+    out-of-bounds scatter semantics) and logits would be computed against
+    stale cache — the engine must retire or evict a slot before it fills.
+    Paged caches take ``tables`` and use lengths >= coverage as the
+    inactive-slot sentinel (write dropped, nothing attended)."""
     b = tokens.shape[0]
     h = embed_lookup(params["embed"], tokens,
                      params["layers"]["attn_norm"].dtype)  # [B, E]
     h = _constrain(h, mesh, batch_axis, None)
     write_idx = lengths.astype(jnp.int32)
     kv_sharded = mesh is not None and shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1))
+    paged = isinstance(cache, PagedKVCache)
+    if paged and tables is None:
+        raise ValueError("decode_step with a PagedKVCache requires tables")
+    if paged:
+        # RoPE positions must be real for active slots; the sentinel value
+        # (>= coverage) only matters to the cache ops, which drop it.
+        cover = tables.shape[1] * cache.page
+        rope_idx = jnp.minimum(write_idx, cover - 1)
+    else:
+        rope_idx = write_idx
 
     # The FULL cache rides the scan carry and each layer updates its own
     # rows in place (decode_update_and_attend).  Scanning over the cache as
@@ -525,11 +819,17 @@ def decode_step(
         q = q.reshape(b, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, write_idx, cfg.rope_theta)
-        k = apply_rope(k, write_idx, cfg.rope_theta)
-        attn, kc, vc, ksc, vsc = decode_update_and_attend(
-            q, k, v, kc, vc, write_idx, layer, mesh, batch_axis, kv_sharded,
-            model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
+        q = apply_rope(q, rope_idx, cfg.rope_theta)
+        k = apply_rope(k, rope_idx, cfg.rope_theta)
+        if paged:
+            from arks_tpu.ops.attention import paged_decode_update_and_attend
+            attn, kc, vc, ksc, vsc = paged_decode_update_and_attend(
+                q, k, v, kc, vc, tables, write_idx, layer, mesh, kv_sharded,
+                model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
+        else:
+            attn, kc, vc, ksc, vsc = decode_update_and_attend(
+                q, k, v, kc, vc, write_idx, layer, mesh, batch_axis,
+                kv_sharded, model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
         attn = attn.reshape(b, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, AXIS_MODEL)
         h = h + qeinsum("bq,qe->be", attn, lp["wo"])
@@ -540,7 +840,8 @@ def decode_step(
         body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     logits = _unembed(h, params, cfg, mesh, batch_axis)
-    return logits, KVCache(k=ks, v=vs, k_scale=kss, v_scale=vss)
+    cls = PagedKVCache if paged else KVCache
+    return logits, cls(k=ks, v=vs, k_scale=kss, v_scale=vss)
 
 
 def verify_step(

@@ -244,6 +244,108 @@ def verify_update_and_attend(
     return out, kc, vc, k_scale, v_scale
 
 
+def paged_decode_update_and_attend(
+    q: jnp.ndarray,        # [B, H, D]
+    k_new: jnp.ndarray,    # [B, Hkv, D]
+    v_new: jnp.ndarray,
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,   # [B, MaxP] int32 block tables
+    write_idx: jnp.ndarray,  # [B] int32 (>= MaxP*P = inactive: write dropped)
+    layer,
+    mesh=None,
+    kv_sharded: bool = False,
+    impl: str | None = None,
+    model_axis: str = "model",
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray | None, jnp.ndarray | None]:
+    """Paged counterpart of ``decode_update_and_attend``: the row lands in
+    the slot's table-mapped page; attention reads only table pages.  A
+    ``write_idx`` at/el beyond the table's coverage marks an INACTIVE slot:
+    its write is dropped and it attends nothing (the engine parks freed
+    slots there so their garbage dispatch rows cannot corrupt shared
+    pages).
+
+    dp meshes are not supported (tables index one global pool); the engine
+    falls back to the slot-contiguous layout there.
+    """
+    b, h, d = q.shape
+    hkv = k_pool.shape[2]
+    g = h // hkv
+    page = k_pool.shape[3]
+    cover = tables.shape[1] * page
+    quantized = k_scale is not None
+    impl = impl or default_decode_impl()
+    tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
+    lane_ok = d % 128 == 0 or jax.default_backend() != "tpu"
+    use_pallas = impl == "pallas" and (kv_sharded or tp_trivial) and lane_ok
+    # Inactive slots attend nothing (their stale tables may point at pages
+    # other slots now own — reading them is wasted bandwidth at best).
+    attend_lens = jnp.where(write_idx >= cover, 0, write_idx + 1)
+
+    if not use_pallas:
+        from arks_tpu.ops.paged_attention import paged_gather_kv, paged_update_xla
+        kp, vp, ks, vs = paged_update_xla(
+            k_pool, v_pool, k_scale, v_scale, k_new, v_new, write_idx,
+            tables, layer)
+        kc = paged_gather_kv(kp, tables, layer)
+        vc = paged_gather_kv(vp, tables, layer)
+        if quantized:
+            ksc = paged_gather_kv(ks, tables, layer)
+            vsc = paged_gather_kv(vs, tables, layer)
+            out = _decode_attention_xla_quant(
+                q.reshape(b, hkv, g, d), kc, vc, ksc, vsc, attend_lens)
+        else:
+            out = decode_attention_xla(q.reshape(b, hkv, g, d), kc, vc,
+                                       attend_lens)
+        return out.reshape(b, h, d), kp, vp, ks, vs
+
+    from arks_tpu.ops.paged_attention import (
+        paged_decode_attention, paged_kv_update, paged_kv_update_quant,
+    )
+    interpret = jax.default_backend() != "tpu"
+
+    def local(qg, kn, vn, kp, vp, ks, vs, tbl, widx, alens, lyr):
+        if quantized:
+            kp, vp, ks, vs = paged_kv_update_quant(
+                kp, vp, ks, vs, kn, vn, widx, tbl, lyr, interpret=interpret)
+        else:
+            kp, vp = paged_kv_update(kp, vp, kn, vn, widx, tbl, lyr,
+                                     interpret=interpret)
+        out = paged_decode_attention(qg, kp, vp, tbl, alens, lyr,
+                                     k_scale=ks, v_scale=vs,
+                                     interpret=interpret)
+        return out, kp, vp, ks, vs
+
+    qg = q.reshape(b, hkv, g, d)
+    if mesh is None or mesh.size == 1:
+        out, kp, vp, ks, vs = local(qg, k_new, v_new, k_pool, v_pool,
+                                    k_scale, v_scale, tables, write_idx,
+                                    attend_lens, layer)
+        return out.reshape(b, h, d), kp, vp, ks, vs
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    model = model_axis if kv_sharded else None
+    qspec = P(None, model, None, None)
+    kvspec = P(None, model, None)
+    pspec = P(None, None, model, None, None)
+    sspec = P(None, None, model, None) if quantized else None
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, pspec, pspec, sspec, sspec,
+                  P(None, None), P(None), P(None), P()),
+        out_specs=(qspec, pspec, pspec, sspec, sspec),
+        check_vma=False,
+    )
+    out, kp, vp, ks, vs = fn(qg, k_new, v_new, k_pool, v_pool,
+                             k_scale, v_scale, tables, write_idx,
+                             attend_lens, jnp.asarray(layer, jnp.int32))
+    return out.reshape(b, h, d), kp, vp, ks, vs
+
+
 def decode_update_and_attend(
     q: jnp.ndarray,        # [B, H, D] — this step's query per slot
     k_new: jnp.ndarray,    # [B, Hkv, D] — this step's KV per slot

@@ -1,0 +1,529 @@
+"""Paged KV cache: block-table Pallas kernels for decode.
+
+The reference's runtimes all serve from a paged KV cache (vLLM paged
+attention / SGLang radix-tree pages — the reference only writes their
+command lines, /root/reference/internal/controller/
+arksapplication_controller.go:941-1014).  This is the TPU formulation:
+
+- **Pool layout** ``[L, N_pages, Hkv, P, D]`` (+ ``[L, N, Hkv, P]`` f32
+  scales for int8): a page is one (layer, kv-head)-major stripe of P
+  tokens, so a page read is a dense DMA — the same property the
+  slot-contiguous cache has, minus the fixed per-slot reservation.
+- **Block tables** ``[B, MaxP] int32`` ride scalar prefetch (SMEM): page j
+  of slot b holds positions [j*P, (j+1)*P).  Sharing = two slots' tables
+  pointing at the same page (prefix reuse with ZERO copies — the
+  slot-contiguous design paid a host round-trip per reuse).
+- **Attention**: same flash-decoding structure as
+  ``pallas_attention.ragged_decode_attention`` (groups of ``block_b``
+  slots, online softmax across the page grid axis), but a group's pages
+  are scattered in the pool, so KV tiles are fetched with **manual
+  double-buffered async DMAs** instead of BlockSpec pipelining: while page
+  j is computed, page j+1's copies are in flight.  Per-slot copies skip
+  pages past that slot's length.
+- **Update**: same aligned read-modify-write trick as the slot kernels,
+  with the row address indirected through the table.
+
+The XLA oracle (`paged_gather_kv` + the existing masked attention) doubles
+as the CPU-test reference and the fallback for unsupported shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA oracle / fallback
+# ---------------------------------------------------------------------------
+
+
+def paged_gather_kv(pool: jnp.ndarray, tables: jnp.ndarray,
+                    layer) -> jnp.ndarray:
+    """Materialize slot-contiguous [B, Hkv, S, D] (or [B, Hkv, S] for
+    scales) from the paged pool — the oracle path; the Pallas kernel never
+    does this."""
+    pool_l = jax.lax.dynamic_index_in_dim(pool, layer, 0, keepdims=False)
+    g = jnp.take(pool_l, tables, axis=0)  # [B, MaxP, Hkv, P, ...]
+    if g.ndim == 5:
+        b, mp, hkv, p, d = g.shape
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(b, hkv, mp * p, d)
+    b, mp, hkv, p = g.shape
+    return jnp.transpose(g, (0, 2, 1, 3)).reshape(b, hkv, mp * p)
+
+
+def paged_update_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
+                     write_idx, tables, layer):
+    """Scatter one KV row per slot through the block table (oracle path —
+    lowers to a full-pool rewrite in XLA, which is why the Pallas kernel
+    exists)."""
+    p = k_pool.shape[3]
+    n = k_pool.shape[1]
+    b, hkv, d = k_new.shape
+    # write_idx beyond the table's coverage = inactive slot: route the
+    # scatter to an out-of-bounds page so jit drops it (the Pallas kernel
+    # guards the same way) — take_along_axis would otherwise CLAMP to the
+    # last page and corrupt it.
+    oob = write_idx >= tables.shape[1] * p
+    safe_idx = jnp.where(oob, 0, write_idx)
+    page = jnp.take_along_axis(
+        tables, (safe_idx // p)[:, None], axis=1)[:, 0]    # [B]
+    page = jnp.where(oob, n, page)
+    off = safe_idx % p
+    l_idx = jnp.full((b,), layer, jnp.int32)
+    h_idx = jnp.arange(hkv)[None, :]
+    quantized = k_scale is not None
+    if quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        k_pool = k_pool.at[l_idx[:, None], page[:, None], h_idx,
+                           off[:, None]].set(kq)
+        v_pool = v_pool.at[l_idx[:, None], page[:, None], h_idx,
+                           off[:, None]].set(vq)
+        k_scale = k_scale.at[l_idx[:, None], page[:, None], h_idx,
+                             off[:, None]].set(ks)
+        v_scale = v_scale.at[l_idx[:, None], page[:, None], h_idx,
+                             off[:, None]].set(vs)
+    else:
+        k_pool = k_pool.at[l_idx[:, None], page[:, None], h_idx,
+                           off[:, None]].set(k_new.astype(k_pool.dtype))
+        v_pool = v_pool.at[l_idx[:, None], page[:, None], h_idx,
+                           off[:, None]].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool, k_scale, v_scale
+
+
+# ---------------------------------------------------------------------------
+# Paged ragged decode attention (manual double-buffered DMA)
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(layer_ref, glens_ref, tables_ref, slens_ref, lens_ref,
+                       q_ref, kpool, vpool, *rest,
+                       block_b: int, page: int, scale: float,
+                       quantized: bool):
+    if quantized:
+        kspool, vspool, o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, \
+            acc_ref, sem = rest
+    else:
+        o_ref, kbuf, vbuf, m_ref, l_ref, acc_ref, sem = rest
+        kspool = vspool = ksbuf = vsbuf = None
+    bi = pl.program_id(0)
+    si = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+    lyr = layer_ref[0]
+
+    def start_copies(page_i, buf):
+        # One DMA per (slot, k/v[, scales]): the group's pages are scattered
+        # in the pool, so there is no single dense tile to fetch.  Copies
+        # for slots already past their length are skipped — but their
+        # V-side buffer rows are ZEROED: uninitialized VMEM can hold NaN
+        # bits, and the flash accumulation computes p@v where masked
+        # positions contribute 0 * v — 0 * NaN would poison the output.
+        # (K garbage is harmless: its scores are replaced after the dot.)
+        for j in range(block_b):
+            b = bi * block_b + j
+            skip = page_i * page >= slens_ref[b]
+
+            @pl.when(jnp.logical_not(skip))
+            def _():
+                pg = tables_ref[b, page_i]
+                pltpu.make_async_copy(
+                    kpool.at[lyr, pg], kbuf.at[buf, j],
+                    sem.at[0, buf, j]).start()
+                pltpu.make_async_copy(
+                    vpool.at[lyr, pg], vbuf.at[buf, j],
+                    sem.at[1, buf, j]).start()
+                if quantized:
+                    pltpu.make_async_copy(
+                        kspool.at[lyr, pg], ksbuf.at[buf, j],
+                        sem.at[2, buf, j]).start()
+                    pltpu.make_async_copy(
+                        vspool.at[lyr, pg], vsbuf.at[buf, j],
+                        sem.at[3, buf, j]).start()
+
+            @pl.when(skip)
+            def _():
+                vbuf[buf, j] = jnp.zeros_like(vbuf[buf, j])
+                if quantized:
+                    vsbuf[buf, j] = jnp.zeros_like(vsbuf[buf, j])
+
+    def wait_copies(page_i, buf):
+        for j in range(block_b):
+            b = bi * block_b + j
+
+            @pl.when(page_i * page < slens_ref[b])
+            def _():
+                pltpu.make_async_copy(kpool.at[lyr, 0], kbuf.at[buf, j],
+                                      sem.at[0, buf, j]).wait()
+                pltpu.make_async_copy(vpool.at[lyr, 0], vbuf.at[buf, j],
+                                      sem.at[1, buf, j]).wait()
+                if quantized:
+                    pltpu.make_async_copy(
+                        kspool.at[lyr, 0], ksbuf.at[buf, j],
+                        sem.at[2, buf, j]).wait()
+                    pltpu.make_async_copy(
+                        vspool.at[lyr, 0], vsbuf.at[buf, j],
+                        sem.at[3, buf, j]).wait()
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        start_copies(0, 0)
+
+    valid = si * page < glens_ref[bi]
+
+    # Double buffering: kick page si+1's copies before computing page si.
+    @pl.when(valid & ((si + 1) * page < glens_ref[bi]))
+    def _prefetch():
+        start_copies(si + 1, (si + 1) % 2)
+
+    @pl.when(valid)
+    def _block():
+        buf = si % 2
+        wait_copies(si, buf)
+        bb, hkv, g, d = q_ref.shape
+        q = q_ref[:].reshape(bb * hkv, g, d)
+        k = kbuf[buf].reshape(bb * hkv, page, d).astype(q.dtype)
+        v = vbuf[buf].reshape(bb * hkv, page, d).astype(q.dtype)
+        scores = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        scores = scores.reshape(bb, hkv, g, page)
+        if quantized:
+            scores = scores * ksbuf[buf].reshape(bb, hkv, 1, page)
+        pos = si * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        lens = lens_ref[0]  # [block_b, 1]
+        scores = jnp.where(pos < lens[:, None, None, :], scores, _NEG_INF)
+
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_curr = jnp.max(scores, axis=3, keepdims=True)
+        m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
+        correction = jnp.exp(m_prev - m_next)
+        p = jnp.exp(scores - m_next[..., :1])
+        l_curr = jnp.sum(p, axis=3, keepdims=True)
+        l_next = l_prev * correction + jnp.broadcast_to(l_curr, l_prev.shape)
+        if quantized:
+            p = p * vsbuf[buf].reshape(bb, hkv, 1, page)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype).reshape(bb * hkv, g, page), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32).reshape(bb, hkv, g, d)
+        acc_ref[:] = acc_ref[:] * correction[..., :1] + pv
+        m_ref[:] = m_next
+        l_ref[:] = l_next
+
+    @pl.when(si == num_pages - 1)
+    def _finish():
+        out = acc_ref[:] / (l_ref[..., :1] + 1e-9)
+        o_ref[:] = out.astype(o_ref.dtype)
+
+
+def _pick_block_b(b: int, target: int) -> int:
+    best = 1
+    for cand in range(1, min(b, target) + 1):
+        if b % cand == 0:
+            best = cand
+    return best
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+def paged_decode_attention(
+    q: jnp.ndarray,        # [B, Hkv, G, D] — one query token per slot
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,   # [B, MaxP] int32 block tables
+    lengths: jnp.ndarray,  # [B] int32 valid positions per slot
+    layer,                 # int32
+    k_scale: jnp.ndarray | None = None,  # [L, N, Hkv, P] f32 (int8 pools)
+    v_scale: jnp.ndarray | None = None,
+    block_b: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """[B, Hkv, G, D] attention over each slot's block-table pages."""
+    b, hkv, g, d = q.shape
+    page = k_pool.shape[3]
+    max_pages = tables.shape[1]
+    quantized = k_scale is not None
+    if block_b is None:
+        # VMEM budget: double-buffered k+v page tiles must fit beside the
+        # accumulators.  int8 pages are half the bytes of bf16.
+        block_b = 16 if k_pool.dtype == jnp.int8 else 8
+    block_b = _pick_block_b(b, block_b)
+    num_groups = b // block_b
+    scale = 1.0 / (d ** 0.5)
+    lengths = lengths.astype(jnp.int32)
+    group_lens = jnp.max(lengths.reshape(num_groups, block_b), axis=1)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    def q_map(bi, si, *prefetch):
+        del si, prefetch
+        return (bi, 0, 0, 0)
+
+    def lens_map(bi, si, *prefetch):
+        del si, prefetch
+        return (bi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_b, 1), lens_map),
+        pl.BlockSpec((block_b, hkv, g, d), q_map),
+        pl.BlockSpec(memory_space=pl.ANY),   # k pool (manual DMA)
+        pl.BlockSpec(memory_space=pl.ANY),   # v pool
+    ]
+    inputs = [layer_arr, group_lens, tables.astype(jnp.int32),
+              lengths, lengths.reshape(num_groups, block_b)[..., None],
+              q, k_pool, v_pool]
+    scratch = [
+        pltpu.VMEM((2, block_b, hkv, page, d), k_pool.dtype),  # kbuf
+        pltpu.VMEM((2, block_b, hkv, page, d), v_pool.dtype),  # vbuf
+    ]
+    n_sem = 2
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        inputs += [k_scale, v_scale]
+        scratch += [pltpu.VMEM((2, block_b, hkv, page), jnp.float32),
+                    pltpu.VMEM((2, block_b, hkv, page), jnp.float32)]
+        n_sem = 4
+    scratch += [
+        pltpu.VMEM((block_b, hkv, g, 128), jnp.float32),  # m
+        pltpu.VMEM((block_b, hkv, g, 128), jnp.float32),  # l
+        pltpu.VMEM((block_b, hkv, g, d), jnp.float32),    # acc
+        pltpu.SemaphoreType.DMA((n_sem, 2, block_b)),
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # layer, group_lens, tables, slot lens
+        grid=(num_groups, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_b, hkv, g, d), q_map),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(_paged_attn_kernel, block_b=block_b,
+                               page=page, scale=scale, quantized=quantized)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# In-place paged KV row update
+# ---------------------------------------------------------------------------
+
+_UPDATE_CHUNK = 16        # bf16 sublane tile
+_UPDATE_CHUNK_INT8 = 32   # int8 sublane tile
+_SCALE_CHUNK = 128        # f32 lane tile
+
+
+def _paged_update_kernel(layer_ref, idx_ref, tables_ref, kn_ref, vn_ref,
+                         kp_in, vp_in, kp_out, vp_out, kscr, vscr, sem,
+                         *, page: int, chunk: int):
+    del kp_in, vp_in
+    b, hkv, _, d = kn_ref.shape
+    max_pos = tables_ref.shape[1] * page
+    lyr = layer_ref[0]
+
+    def body(i, _):
+        @pl.when(idx_ref[i] < max_pos)
+        def _():
+            _write_row(i)
+        return 0
+
+    def _write_row(i):
+        idx = idx_ref[i]
+        pg = tables_ref[i, idx // page]
+        off = idx % page
+        base = (off // chunk) * chunk
+        dst_k = kp_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(base, chunk)]
+        dst_v = vp_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(base, chunk)]
+        rk = pltpu.make_async_copy(dst_k, kscr, sem.at[0])
+        rv = pltpu.make_async_copy(dst_v, vscr, sem.at[1])
+        rk.start()
+        rv.start()
+        rk.wait()
+        rv.wait()
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, hkv, chunk, d), 3)
+        hit = row == (off - base)
+        kscr[:] = jnp.where(hit, kn_ref[pl.ds(i, 1)][None], kscr[:])
+        vscr[:] = jnp.where(hit, vn_ref[pl.ds(i, 1)][None], vscr[:])
+        wk = pltpu.make_async_copy(kscr, dst_k, sem.at[0])
+        wv = pltpu.make_async_copy(vscr, dst_v, sem.at[1])
+        wk.start()
+        wv.start()
+        wk.wait()
+        wv.wait()
+
+    jax.lax.fori_loop(0, b, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_kv_update(
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D]
+    v_pool: jnp.ndarray,
+    k_new: jnp.ndarray,    # [B, Hkv, D]
+    v_new: jnp.ndarray,
+    write_idx: jnp.ndarray,  # [B] int32 position per slot
+    tables: jnp.ndarray,     # [B, MaxP] int32
+    layer,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write one KV row per slot at its table-mapped page, in place."""
+    _, n, hkv, page, d = k_pool.shape
+    if page % _UPDATE_CHUNK != 0:
+        raise ValueError(f"page {page} must be a multiple of {_UPDATE_CHUNK}")
+    kn = k_new.astype(k_pool.dtype)[:, :, None, :]
+    vn = v_new.astype(v_pool.dtype)[:, :, None, :]
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1, hkv, _UPDATE_CHUNK, d), k_pool.dtype),
+            pltpu.VMEM((1, 1, hkv, _UPDATE_CHUNK, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_paged_update_kernel, page=page,
+                               chunk=_UPDATE_CHUNK)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)),
+        # 0=layer, 1=idx, 2=tables, 3=kn, 4=vn, 5=k_pool, 6=v_pool.
+        input_output_aliases={5: 0, 6: 1},
+        interpret=interpret,
+    )(layer_arr, write_idx.astype(jnp.int32), tables.astype(jnp.int32),
+      kn, vn, k_pool, v_pool)
+
+
+def _paged_update_quant_kernel(layer_ref, idx_ref, tables_ref,
+                               kn_ref, vn_ref, ksn_ref, vsn_ref,
+                               kp_in, vp_in, kss_in, vss_in,
+                               kp_out, vp_out, kss_out, vss_out,
+                               kscr, vscr, ksscr, vsscr, sem,
+                               *, page: int):
+    del kp_in, vp_in, kss_in, vss_in
+    b, hkv, _, d = kn_ref.shape
+    max_pos = tables_ref.shape[1] * page
+    ch = _UPDATE_CHUNK_INT8
+    sch = _SCALE_CHUNK
+    lyr = layer_ref[0]
+
+    def body(i, _):
+        @pl.when(idx_ref[i] < max_pos)
+        def _():
+            _write_row(i)
+        return 0
+
+    def _write_row(i):
+        idx = idx_ref[i]
+        pg = tables_ref[i, idx // page]
+        off = idx % page
+        base = (off // ch) * ch
+        sbase = (off // sch) * sch
+        dst_k = kp_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(base, ch)]
+        dst_v = vp_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(base, ch)]
+        dst_ks = kss_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(sbase, sch)]
+        dst_vs = vss_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(sbase, sch)]
+        copies = [pltpu.make_async_copy(dst_k, kscr, sem.at[0]),
+                  pltpu.make_async_copy(dst_v, vscr, sem.at[1]),
+                  pltpu.make_async_copy(dst_ks, ksscr, sem.at[2]),
+                  pltpu.make_async_copy(dst_vs, vsscr, sem.at[3])]
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, hkv, ch, d), 3)
+        hit = row == (off - base)
+        kscr[:] = jnp.where(hit, kn_ref[pl.ds(i, 1)][None], kscr[:])
+        vscr[:] = jnp.where(hit, vn_ref[pl.ds(i, 1)][None], vscr[:])
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, hkv, sch), 3)
+        shit = lane == (off - sbase)
+        ksn = ksn_ref[pl.ds(i, 1)].reshape(1, 1, hkv, 1)
+        vsn = vsn_ref[pl.ds(i, 1)].reshape(1, 1, hkv, 1)
+        ksscr[:] = jnp.where(shit, ksn, ksscr[:])
+        vsscr[:] = jnp.where(shit, vsn, vsscr[:])
+        back = [pltpu.make_async_copy(kscr, dst_k, sem.at[0]),
+                pltpu.make_async_copy(vscr, dst_v, sem.at[1]),
+                pltpu.make_async_copy(ksscr, dst_ks, sem.at[2]),
+                pltpu.make_async_copy(vsscr, dst_vs, sem.at[3])]
+        for c in back:
+            c.start()
+        for c in back:
+            c.wait()
+
+    jax.lax.fori_loop(0, b, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_kv_update_quant(
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] int8
+    v_pool: jnp.ndarray,
+    k_scale: jnp.ndarray,  # [L, N, Hkv, P] f32
+    v_scale: jnp.ndarray,
+    k_new: jnp.ndarray,    # [B, Hkv, D]
+    v_new: jnp.ndarray,
+    write_idx: jnp.ndarray,
+    tables: jnp.ndarray,
+    layer,
+    interpret: bool = False,
+):
+    """int8 variant: quantize the new rows, write values + per-token scales
+    in place through the table."""
+    from arks_tpu.ops.pallas_attention import quantize_kv
+
+    _, n, hkv, page, d = k_pool.shape
+    if page % _SCALE_CHUNK != 0:
+        raise ValueError(f"int8 page {page} must be a multiple of {_SCALE_CHUNK}")
+    kq, ks = quantize_kv(k_new)
+    vq, vs = quantize_kv(v_new)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 4
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=tuple([pl.BlockSpec(memory_space=pl.ANY)] * 4),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1, hkv, _UPDATE_CHUNK_INT8, d), k_pool.dtype),
+            pltpu.VMEM((1, 1, hkv, _UPDATE_CHUNK_INT8, d), v_pool.dtype),
+            pltpu.VMEM((1, 1, hkv, _SCALE_CHUNK), jnp.float32),
+            pltpu.VMEM((1, 1, hkv, _SCALE_CHUNK), jnp.float32),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    kernel = functools.partial(_paged_update_quant_kernel, page=page)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+                   jax.ShapeDtypeStruct(k_scale.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(v_scale.shape, jnp.float32)),
+        # 0=layer, 1=idx, 2=tables, 3=kq, 4=vq, 5=ks, 6=vs,
+        # 7=k_pool, 8=v_pool, 9=k_scale, 10=v_scale.
+        input_output_aliases={7: 0, 8: 1, 9: 2, 10: 3},
+        interpret=interpret,
+    )(layer_arr, write_idx.astype(jnp.int32), tables.astype(jnp.int32),
+      kq[:, :, None, :], vq[:, :, None, :], ks, vs,
+      k_pool, v_pool, k_scale, v_scale)

@@ -114,6 +114,10 @@ class OpenAIServer:
             def log_message(self, fmt, *args):  # quiet
                 pass
 
+            # Default rbufsize(-1) is fine; but the server-level accept
+            # backlog must absorb connection bursts (hundreds of clients
+            # reconnecting at once) — see request_queue_size below.
+
             def _json(self, code: int, payload: dict) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(code)
@@ -186,7 +190,15 @@ class OpenAIServer:
                     with server._active_lock:
                         server._active -= 1
 
-        httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class Server(ThreadingHTTPServer):
+            # A burst of N-hundred concurrent (re)connects overflows the
+            # default backlog of 5 and the kernel RSTs the overflow —
+            # clients saw "connection reset by peer" under load
+            # (bench_serving.py).
+            request_queue_size = 512
+            daemon_threads = True
+
+        httpd = Server((self.host, self.port), Handler)
         with self._active_lock:
             self._httpd = httpd
             stopped = self._stopped

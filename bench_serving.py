@@ -1,0 +1,348 @@
+"""Serving-path benchmark: the REAL stack under concurrent load.
+
+bench.py times the raw fused decode loop — the engine's compute ceiling.
+This benchmark answers the question that actually decides the north star
+(BASELINE.md: >=2,000 tok/s/chip *serving* Qwen2.5-7B): what survives once
+the scheduler, abort bookkeeping, numpy mirrors, queue handoffs, HTTP
+framing, and SSE relay sit between the chip and the client?
+
+Method:
+- This process builds the production engine (w-int8 / kv-int8, b-slot
+  continuous batching) + OpenAIServer, exactly as ``python -m
+  arks_tpu.server`` would.
+- A **separate client process** (stdlib-only, launched with ``python -S``
+  so this image's jax-importing sitecustomize stays out of it) drives
+  ``--clients`` closed-loop streaming completions plus low-rate TTFT
+  probe threads.  Clients deliberately number slightly below the slot
+  count so probes measure loaded-but-admittable TTFT (queueing for a free
+  slot is a capacity question, not a latency one).
+- Sustained throughput = delta of the engine's own
+  ``generation_tokens_total`` over a timed window after warmup, read via
+  the real ``/metrics`` endpoint — every counted token took the full
+  serving path.  Client-side usage totals are kept as a cross-check.
+
+Prints ONE JSON line.  Env knobs mirror bench.py (ARKS_BENCH_MODEL,
+ARKS_BENCH_BATCH, ARKS_BENCH_CACHE_LEN, ARKS_BENCH_STEPS) plus
+ARKS_BENCH_SERVE_SECONDS / _WARMUP / _MAX_TOKENS / _PROMPT_LEN /
+_PROBE_PROMPT_LEN.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+BASELINE_TOK_S_CHIP = 2000.0
+
+
+# ---------------------------------------------------------------------------
+# Client mode (stdlib only — runs under ``python -S``)
+# ---------------------------------------------------------------------------
+
+
+def _client_main(argv: list[str]) -> None:
+    import argparse
+    import http.client
+    import random
+    import threading
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=30.0)
+    ap.add_argument("--max-tokens", type=int, default=256)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--probes", type=int, default=2)
+    ap.add_argument("--probe-prompt-len", type=int, default=512)
+    ap.add_argument("--probe-interval", type=float, default=0.5)
+    args = ap.parse_args(argv)
+
+    stop_at = time.monotonic() + args.seconds
+    lock = threading.Lock()
+    usage_tokens = [0]
+    completed = [0]
+    errors = [0]
+    error_samples: list[str] = []
+    ttfts: list[tuple[float, float]] = []  # (t_sent_monotonic, ttft_s)
+
+    def stream_once(conn, body: dict) -> tuple[int, float | None]:
+        """POST a streaming completion; returns (completion_tokens from the
+        usage frame, time-to-first-content-frame seconds)."""
+        payload = json.dumps(body).encode()
+        t0 = time.monotonic()
+        conn.request("POST", "/v1/completions", body=payload,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            raise RuntimeError(f"HTTP {resp.status}")
+        first = None
+        toks = 0
+        buf = b""
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                for line in frame.splitlines():
+                    if not line.startswith(b"data: ") or line == b"data: [DONE]":
+                        continue
+                    obj = json.loads(line[6:])
+                    if first is None and any(
+                            c.get("text") for c in obj.get("choices", [])):
+                        first = time.monotonic() - t0
+                    u = obj.get("usage")
+                    if u:
+                        toks = int(u.get("completion_tokens", 0))
+        return toks, first
+
+    # Distinct random prompts defeat the prefix cache on purpose: this
+    # measures the no-reuse worst case (the prefix-cache win is measured
+    # separately where it can be controlled).
+    def make_prompt(n: int) -> list[int]:
+        return [random.randint(3, 200) for _ in range(n)]
+
+    def worker() -> None:
+        conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
+        body = {"model": "bench", "stream": True,
+                "stream_options": {"include_usage": True},
+                "max_tokens": args.max_tokens, "temperature": 0.0,
+                "ignore_eos": True}
+        while time.monotonic() < stop_at:
+            body["prompt"] = make_prompt(args.prompt_len)
+            # Jittered lengths de-synchronize completion waves (all-equal
+            # max_tokens would retire every slot at once and make the
+            # admission burst periodic instead of steady-state).
+            body["max_tokens"] = random.randint(
+                max(args.max_tokens // 2, 1), args.max_tokens)
+            try:
+                toks, _ = stream_once(conn, body)
+            except Exception as e:
+                with lock:
+                    errors[0] += 1
+                    if len(error_samples) < 5:
+                        error_samples.append(f"{type(e).__name__}: {e}")
+                conn.close()
+                conn = http.client.HTTPConnection(args.host, args.port,
+                                                  timeout=600)
+                continue
+            with lock:
+                usage_tokens[0] += toks
+                completed[0] += 1
+        conn.close()
+
+    def probe() -> None:
+        conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
+        body = {"model": "bench", "stream": True, "max_tokens": 2,
+                "temperature": 0.0, "ignore_eos": True}
+        while time.monotonic() < stop_at:
+            body["prompt"] = make_prompt(args.probe_prompt_len)
+            t_sent = time.monotonic()
+            try:
+                _, first = stream_once(conn, body)
+            except Exception:
+                conn.close()
+                conn = http.client.HTTPConnection(args.host, args.port,
+                                                  timeout=600)
+                continue
+            if first is not None:
+                with lock:
+                    ttfts.append((t_sent, first))
+            time.sleep(args.probe_interval)
+        conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(args.clients)]
+    threads += [threading.Thread(target=probe, daemon=True)
+                for _ in range(args.probes)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.seconds + 600)
+    print(json.dumps({
+        "client_usage_tokens": usage_tokens[0],
+        "completed_requests": completed[0],
+        "errors": errors[0],
+        "error_samples": error_samples,
+        "wall_s": time.monotonic() - t_start,
+        "ttfts": [(round(ts - t_start, 3), round(v, 4)) for ts, v in ttfts],
+    }))
+
+
+# ---------------------------------------------------------------------------
+# Server mode (the benchmark itself)
+# ---------------------------------------------------------------------------
+
+
+def _scrape(port: int, names: tuple[str, ...]) -> dict[str, float]:
+    """{metric-line-prefix: value} for every series whose name is listed
+    (labeled series keyed as name{labels})."""
+    out: dict[str, float] = {}
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        for line in r.read().decode().splitlines():
+            for name in names:
+                if line.startswith(name + " ") or line.startswith(name + "{"):
+                    key, val = line.rsplit(" ", 1)
+                    out[key] = float(val)
+    return out
+
+
+def run_serving_bench(model: str | None = None) -> dict:
+    """Build the production engine+server, run the load, return results.
+    Importable so bench.py can fold the numbers into its JSON line."""
+    import numpy as np
+
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.server import OpenAIServer
+
+    model = model or os.environ.get("ARKS_BENCH_MODEL", "qwen2.5-7b")
+    slots = int(os.environ.get("ARKS_BENCH_BATCH", "192"))
+    cache_len = int(os.environ.get("ARKS_BENCH_CACHE_LEN", "1024"))
+    steps = int(os.environ.get("ARKS_BENCH_STEPS", "32"))
+    seconds = float(os.environ.get("ARKS_BENCH_SERVE_SECONDS", "30"))
+    warmup = float(os.environ.get("ARKS_BENCH_SERVE_WARMUP", "25"))
+    max_tokens = int(os.environ.get("ARKS_BENCH_SERVE_MAX_TOKENS", "256"))
+    prompt_len = int(os.environ.get("ARKS_BENCH_SERVE_PROMPT_LEN", "128"))
+    probe_len = int(os.environ.get("ARKS_BENCH_SERVE_PROBE_PROMPT_LEN", "512"))
+    weight_dtype = os.environ.get("ARKS_BENCH_WEIGHT_DTYPE", "int8")
+    # Clients sit just under the slot count: probes then measure loaded
+    # TTFT (decode saturated) without conflating it with slot queueing.
+    clients = int(os.environ.get(
+        "ARKS_BENCH_SERVE_CLIENTS", str(max(slots - 8, 1))))
+
+    import jax
+    n_chips = max(len(jax.devices()), 1)
+
+    cfg = get_config(model)
+    ecfg = EngineConfig(
+        model=model, num_slots=slots, max_cache_len=cache_len,
+        steps_per_dispatch=steps, weight_dtype=weight_dtype,
+        prefill_buckets=(128, 256, 512, 1024),
+        tensor_parallel=n_chips if n_chips > 1 else None)
+    engine = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    engine.start()
+    server = OpenAIServer(engine, served_model_name="bench",
+                          host="127.0.0.1", port=0)
+    server.start(background=True)
+
+    # Prime every compiled program the load will hit (prefill buckets for
+    # both prompt lengths, admission-batch variants M in {1,2,4,8}, the
+    # fused decode loop): remote TPU compiles are 20-40s each and must not
+    # land inside the measurement window.
+    import random as _random
+    import threading as _threading
+
+    def _one(plen, seed):
+        rng = _random.Random(seed)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps({"model": "bench",
+                             "prompt": [rng.randint(3, 200)
+                                        for _ in range(plen)],
+                             "max_tokens": steps + 1, "temperature": 0.0,
+                             "ignore_eos": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=600).read()
+
+    t_prime = time.monotonic()
+    for plen in sorted({prompt_len, probe_len}):
+        _one(plen, 0)
+        print(f"# primed bucket {plen} at {time.monotonic()-t_prime:.0f}s",
+              file=sys.stderr, flush=True)
+    for burst in (8, 4, 2):
+        ts = [_threading.Thread(target=_one, args=(prompt_len, 100 + i))
+              for i in range(burst)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        print(f"# primed burst {burst} at {time.monotonic()-t_prime:.0f}s",
+              file=sys.stderr, flush=True)
+
+    total_s = warmup + seconds + 5
+    proc = subprocess.Popen(
+        [sys.executable, "-S", os.path.abspath(__file__), "--client",
+         "--host", "127.0.0.1", "--port", str(server.port),
+         "--clients", str(clients), "--seconds", str(total_s),
+         "--max-tokens", str(max_tokens), "--prompt-len", str(prompt_len),
+         "--probe-prompt-len", str(probe_len)],
+        stdout=subprocess.PIPE, text=True)
+    names = ("generation_tokens_total", "scheduler_seconds_total",
+             "prefix_cache_hit_tokens_total")
+    try:
+        t_launch = time.monotonic()
+        print("# client launched; warming up", file=sys.stderr, flush=True)
+        time.sleep(warmup)
+        s0 = _scrape(server.port, names)
+        t0 = time.monotonic()
+        time.sleep(seconds)
+        s1 = _scrape(server.port, names)
+        t1 = time.monotonic()
+        out, _ = proc.communicate(timeout=total_s + 600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        server.stop()
+        engine.stop()
+
+    client = json.loads(out.strip().splitlines()[-1])
+    window = (t0 - t_launch, t1 - t_launch)  # in client t_start coords (~)
+    ttfts = [v for ts, v in client["ttfts"]
+             if window[0] <= ts <= window[1]] or \
+            [v for _, v in client["ttfts"]]
+    c0 = s0.get("generation_tokens_total", 0.0)
+    c1 = s1.get("generation_tokens_total", 0.0)
+    tok_s_chip = (c1 - c0) / (t1 - t0) / n_chips
+    # Scheduler phase split over the window: where the engine thread spent
+    # its wall time (fractions of the window).
+    phases = {}
+    for key in s1:
+        if key.startswith("scheduler_seconds_total"):
+            phase = key.split('phase="')[-1].rstrip('"}')
+            phases[phase] = round(
+                (s1[key] - s0.get(key, 0.0)) / (t1 - t0), 3)
+    return {
+        "serving_tok_s_chip": round(tok_s_chip, 1),
+        "serving_vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),
+        "serving_ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1)
+        if ttfts else None,
+        "serving_ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 1)
+        if ttfts else None,
+        "serving_clients": clients,
+        "serving_window_s": round(t1 - t0, 1),
+        "serving_completed_requests": client["completed_requests"],
+        "serving_client_errors": client["errors"],
+        "serving_error_samples": client.get("error_samples", []),
+        "serving_prompt_len": prompt_len,
+        "serving_max_tokens": max_tokens,
+        "serving_probe_prompt_len": probe_len,
+        "serving_ttft_samples": len(ttfts),
+        "serving_phase_fractions": phases,
+    }
+
+
+def main() -> None:
+    print(json.dumps({
+        "metric": "serving_throughput",
+        "unit": "tok/s/chip",
+        **run_serving_bench(),
+    }))
+
+
+if __name__ == "__main__":
+    if "--client" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--client"]
+        _client_main(argv)
+    else:
+        main()

@@ -1,0 +1,159 @@
+"""Kernel microbench: paged vs slot-contiguous decode attention on TPU.
+
+Times a fused L-layer update+attend loop (the decode dispatch's attention
+cost) for both cache designs at production shapes.  Gate for the paged
+rollout: paged must be within a few percent of contiguous, or the engine
+default stays slot-contiguous.
+
+Usage: timeout 600 python tools/bench_kernels.py  (runs on the default
+backend; meaningful numbers only on real TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    L = int(os.environ.get("KB_LAYERS", "28"))
+    B = int(os.environ.get("KB_BATCH", "192"))
+    Hkv = int(os.environ.get("KB_HKV", "4"))
+    G = int(os.environ.get("KB_G", "7"))
+    S = int(os.environ.get("KB_S", "1024"))
+    D = int(os.environ.get("KB_D", "128"))
+    P = int(os.environ.get("KB_PAGE", "256"))
+    K = int(os.environ.get("KB_STEPS", "32"))
+    quant = os.environ.get("KB_QUANT", "1") == "1"
+    trials = int(os.environ.get("KB_TRIALS", "5"))
+    interpret = jax.default_backend() != "tpu"
+
+    from arks_tpu.ops.pallas_attention import (
+        kv_cache_update, kv_cache_update_quant, ragged_decode_attention)
+    from arks_tpu.ops.paged_attention import (
+        paged_decode_attention, paged_kv_update, paged_kv_update_quant)
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    q = jax.random.normal(ks[0], (B, Hkv, G, D), jnp.bfloat16)
+    kn = jax.random.normal(ks[1], (B, Hkv, D), jnp.bfloat16)
+    vn = jax.random.normal(ks[2], (B, Hkv, D), jnp.bfloat16)
+    lengths = (jnp.arange(B, dtype=jnp.int32) * 37) % (S - K - 1) + 1
+    N = B * (S // P)
+    max_pages = S // P
+    # Worst-case scatter: pages striped so adjacent slots' pages are far
+    # apart in the pool.
+    tables = ((jnp.arange(B)[:, None] + jnp.arange(max_pages)[None] * B)
+              % N).astype(jnp.int32)
+
+    if quant:
+        kc = jnp.zeros((L, B, Hkv, S, D), jnp.int8)
+        vc = jnp.zeros((L, B, Hkv, S, D), jnp.int8)
+        kcs = jnp.zeros((L, B, Hkv, S), jnp.float32)
+        vcs = jnp.zeros((L, B, Hkv, S), jnp.float32)
+        kp = jnp.zeros((L, N, Hkv, P, D), jnp.int8)
+        vp = jnp.zeros((L, N, Hkv, P, D), jnp.int8)
+        kps = jnp.zeros((L, N, Hkv, P), jnp.float32)
+        vps = jnp.zeros((L, N, Hkv, P), jnp.float32)
+    else:
+        kc = jnp.zeros((L, B, Hkv, S, D), jnp.bfloat16)
+        vc = jnp.zeros((L, B, Hkv, S, D), jnp.bfloat16)
+        kcs = vcs = None
+        kp = jnp.zeros((L, N, Hkv, P, D), jnp.bfloat16)
+        vp = jnp.zeros((L, N, Hkv, P, D), jnp.bfloat16)
+        kps = vps = None
+
+    def contiguous_step(kc, vc, kcs, vcs, lengths):
+        def layer_body(carry, lyr):
+            kc, vc, kcs, vcs, acc = carry
+            if quant:
+                kc, vc, kcs, vcs = kv_cache_update_quant(
+                    kc, vc, kcs, vcs, kn, vn, lengths, lyr,
+                    interpret=interpret)
+            else:
+                kc, vc = kv_cache_update(kc, vc, kn, vn, lengths, lyr,
+                                         interpret=interpret)
+            out = ragged_decode_attention(
+                q, kc, vc, lengths + 1, lyr, k_scale=kcs, v_scale=vcs,
+                block_b=int(os.environ.get("ARKS_ATTN_BLOCK_B", "16")),
+                interpret=interpret)
+            return (kc, vc, kcs, vcs, acc + out.astype(jnp.float32)), None
+
+        def step_body(carry, _):
+            kc, vc, kcs, vcs, lengths = carry
+            (kc, vc, kcs, vcs, acc), _ = jax.lax.scan(
+                layer_body, (kc, vc, kcs, vcs,
+                             jnp.zeros((B, Hkv, G, D), jnp.float32)),
+                jnp.arange(L))
+            return (kc, vc, kcs, vcs, lengths + 1), acc[0, 0, 0, 0]
+
+        (kc, vc, kcs, vcs, lengths), outs = jax.lax.scan(
+            step_body, (kc, vc, kcs, vcs, lengths), None, length=K)
+        return kc, vc, kcs, vcs, outs
+
+    def paged_step(kp, vp, kps, vps, lengths):
+        def layer_body(carry, lyr):
+            kp, vp, kps, vps, acc = carry
+            if quant:
+                kp, vp, kps, vps = paged_kv_update_quant(
+                    kp, vp, kps, vps, kn, vn, lengths, tables, lyr,
+                    interpret=interpret)
+            else:
+                kp, vp = paged_kv_update(kp, vp, kn, vn, lengths, tables,
+                                         lyr, interpret=interpret)
+            out = paged_decode_attention(q, kp, vp, tables, lengths + 1, lyr,
+                                         k_scale=kps, v_scale=vps,
+                                         interpret=interpret)
+            return (kp, vp, kps, vps, acc + out.astype(jnp.float32)), None
+
+        def step_body(carry, _):
+            kp, vp, kps, vps, lengths = carry
+            (kp, vp, kps, vps, acc), _ = jax.lax.scan(
+                layer_body, (kp, vp, kps, vps,
+                             jnp.zeros((B, Hkv, G, D), jnp.float32)),
+                jnp.arange(L))
+            return (kp, vp, kps, vps, lengths + 1), acc[0, 0, 0, 0]
+
+        (kp, vp, kps, vps, lengths), outs = jax.lax.scan(
+            step_body, (kp, vp, kps, vps, lengths), None, length=K)
+        return kp, vp, kps, vps, outs
+
+    results = {}
+    for name, fn, args in (
+        ("contiguous", jax.jit(contiguous_step, donate_argnums=(0, 1, 2, 3)),
+         (kc, vc, kcs, vcs, lengths)),
+        ("paged", jax.jit(paged_step, donate_argnums=(0, 1, 2, 3)),
+         (kp, vp, kps, vps, lengths)),
+    ):
+        if not quant:
+            args = (args[0], args[1], None, None, args[4])
+        *state, outs = fn(*args)
+        np.asarray(outs[-1])  # compile + warmup
+        best = float("inf")
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            *state, outs = fn(*state, lengths)
+            np.asarray(outs[-1])
+            best = min(best, time.perf_counter() - t0)
+        results[name] = best
+        del state
+
+    ratio = results["paged"] / results["contiguous"]
+    print(json.dumps({
+        "contiguous_ms_per_Kstep": round(results["contiguous"] * 1e3, 2),
+        "paged_ms_per_Kstep": round(results["paged"] * 1e3, 2),
+        "paged_vs_contiguous": round(ratio, 3),
+        "shape": f"L{L} B{B} Hkv{Hkv} G{G} S{S} D{D} P{P} K{K} quant={quant}",
+    }))
+
+
+if __name__ == "__main__":
+    main()
