@@ -33,7 +33,9 @@ from arks_tpu.control.resources import (
     VALID_RUNTIMES, Application, GangSet, Model, Service,
 )
 from arks_tpu.control.store import NotFound, Store
-from arks_tpu.control.workloads import gpu_runtime_command, jax_serve_command
+from arks_tpu.control.workloads import (default_runtime_image,
+                                        gpu_runtime_command,
+                                        jax_serve_command)
 
 log = logging.getLogger("arks_tpu.control.application")
 
@@ -203,7 +205,7 @@ class ApplicationController(Controller):
             # operator itself downloads into (deploy/operator.yaml) — in
             # live mode nothing provisions per-model PVCs, so engine pods
             # must mount the volume the weights actually landed on.
-            "image": app.spec.get("runtimeImage", "arks-tpu/engine:latest"),
+            "image": app.spec.get("runtimeImage") or default_runtime_image(runtime),
             "accelerator": app.spec.get("accelerator", "cpu"),
             "modelPvc": (model.spec.get("storage") or {}).get("pvc")
             or "models",

@@ -39,7 +39,7 @@ from arks_tpu.control.resources import (
     DisaggregatedApplication, GangSet, Model, Service,
 )
 from arks_tpu.control.store import NotFound, Store
-from arks_tpu.control.workloads import jax_serve_command
+from arks_tpu.control.workloads import default_runtime_image, jax_serve_command
 
 log = logging.getLogger("arks_tpu.control.disaggregated")
 
@@ -62,9 +62,19 @@ class DisaggregatedApplicationController(Controller):
 
     def __init__(self, store: Store, workers: int = 4,
                  local_platform: str | None = None,
-                 discovery_dir: str | None = None):
+                 discovery_dir: str | None = None,
+                 router_discovery: str = "file"):
         super().__init__(store, workers=workers)
         self.local_platform = local_platform
+        if router_discovery not in ("file", "kubernetes"):
+            raise ValueError(f"router_discovery={router_discovery!r}")
+        # "file": the operator maintains a discovery JSON on a filesystem
+        # it shares with the router (local single-binary mode).
+        # "kubernetes": routers discover prefill/decode pods themselves by
+        # label selector (the reference's --service-discovery; REQUIRED in
+        # live-operator mode, where routers run as cluster pods with no
+        # shared filesystem).
+        self.router_discovery = router_discovery
         self.discovery_dir = discovery_dir or os.path.join(
             tempfile.gettempdir(), "arks-tpu-discovery")
         os.makedirs(self.discovery_dir, exist_ok=True)
@@ -214,8 +224,9 @@ class DisaggregatedApplicationController(Controller):
             "runtime": RUNTIME_JAX,
             "role": component,
             # K8s-driver (live mode) fields — see application_controller.
-            "image": ws.get("runtimeImage",
-                            app.spec.get("runtimeImage", "arks-tpu/engine:latest")),
+            "image": ws.get("runtimeImage")
+            or app.spec.get("runtimeImage")
+            or default_runtime_image(RUNTIME_JAX),
             "accelerator": ws.get("accelerator",
                                   app.spec.get("accelerator", "cpu")),
             "modelPvc": (model.spec.get("storage") or {}).get("pvc")
@@ -231,10 +242,17 @@ class DisaggregatedApplicationController(Controller):
     def _router_spec(self, app: DisaggregatedApplication) -> dict:
         rs = app.spec.get("router", {})
         served = app.served_model_name or app.spec.get("model", {}).get("name", "")
+        if self.router_discovery == "kubernetes":
+            discovery_args = ["--service-discovery",
+                              "--namespace", app.namespace,
+                              "--application", app.name,
+                              "--backend-port", "8080"]
+        else:
+            discovery_args = ["--discovery-file", self._discovery_path(app)]
         cmd = [sys.executable, "-m", "arks_tpu.router",
                "--port", "$(PORT)",
                "--served-model-name", served,
-               "--discovery-file", self._discovery_path(app),
+               *discovery_args,
                # RouterArgs passthrough (reference:
                # arksdisaggregatedapplication_types.go:69-84).
                *[str(a) for a in rs.get("routerArgs", [])]]
@@ -247,8 +265,9 @@ class DisaggregatedApplicationController(Controller):
             "restartPolicy": "RecreateGroupOnPodRestart",
             "runtime": "router",
             "role": "router",
-            "image": rs.get("runtimeImage",
-                            app.spec.get("runtimeImage", "arks-tpu/engine:latest")),
+            "image": rs.get("runtimeImage")
+            or app.spec.get("runtimeImage")
+            or default_runtime_image(RUNTIME_JAX),
             "accelerator": "cpu",
             **({"instanceSpec": rs["instanceSpec"]}
                if rs.get("instanceSpec") else {}),

@@ -74,6 +74,18 @@ TPU_SHAPES: dict[str, TpuTopology] = {
 DEFAULT_IMAGE = "arks-tpu/engine:latest"
 DEFAULT_SCRIPTS_IMAGE = "arks-tpu/engine:latest"
 
+
+def _default_image(runtime: str = "jax") -> str:
+    # Env escape hatches, same contract as the reference
+    # (ARKS_RUNTIME_DEFAULT_*_IMAGE, arksapplication_controller.go:907-939).
+    from arks_tpu.control.workloads import default_runtime_image
+    return default_runtime_image(runtime)
+
+
+def _scripts_image() -> str:
+    from arks_tpu.control.workloads import default_scripts_image
+    return default_scripts_image()
+
 # ---------------------------------------------------------------------------
 # InstanceSpec passthrough (reference: ArksInstanceSpec,
 # api/v1/arksapplication_types.go:80-250 — the ~35-field pod-spec channel
@@ -275,7 +287,9 @@ def _model_storage(model: Model | None, namespace: str,
 # ---------------------------------------------------------------------------
 
 
-def render_model(model: Model, scripts_image: str = DEFAULT_SCRIPTS_IMAGE) -> list[dict]:
+def render_model(model: Model, scripts_image: str | None = None) -> list[dict]:
+    if scripts_image is None:
+        scripts_image = _scripts_image()
     storage = model.spec.get("storage") or {}
     pvc_name = storage.get("pvc") or model.name
     size = storage.get("size", "100Gi")
@@ -373,7 +387,7 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
         ]
     container = {
         "name": "engine",
-        "image": spec.get("image", DEFAULT_IMAGE),
+        "image": spec.get("image") or _default_image(),
         "command": cmd,
         "env": env,
         "ports": [{"containerPort": port, "name": "http"}],
@@ -388,6 +402,13 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
             "limits": {"google.com/tpu": str(shape.chips_per_host)},
         }
     pod: dict = {"subdomain": "$(GROUP)", "containers": [container]}
+    # Disaggregated ROUTER gangs discover tier pods from the API: bind the
+    # per-app discovery ServiceAccount (created by the live driver /
+    # rendered by render_disaggregated).  Part of the pod spec, so it
+    # participates in the revision hash like any other pod change.
+    _app = (gs.labels or {}).get(LABEL_APPLICATION)
+    if spec.get("role") == "router" and _app:
+        pod["serviceAccountName"] = f"arks-{_app}-router"
     pvc = spec.get("modelPvc")
     if pvc:
         container["volumeMounts"] = [{"name": RESERVED_MODELS_VOLUME,
@@ -424,6 +445,19 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
             revision = stable_hash(pod)
     pod = json.loads(json.dumps(pod).replace("$(GROUP)", group))
 
+    # Application/component labels on the TEMPLATE (not the immutable
+    # selector, and deliberately outside the revision hash — adding them
+    # must not re-roll existing fleets): the disaggregated router's
+    # label-selector pod discovery (router.KubeDiscovery) finds tier pods
+    # by arks.ai/application + arks.ai/component.
+    app_label = (gs.labels or {}).get(LABEL_APPLICATION)
+    role_label = (gs.labels or {}).get("arks.ai/role") or spec.get("role")
+    discovery_labels = {}
+    if app_label:
+        discovery_labels[LABEL_APPLICATION] = app_label
+    if role_label:
+        discovery_labels[LABEL_COMPONENT] = role_label
+
     sts = {
         "apiVersion": "apps/v1",
         "kind": "StatefulSet",
@@ -435,7 +469,8 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
             "updateStrategy": {"type": "RollingUpdate"},
             "selector": {"matchLabels": sel},
             "template": {
-                "metadata": {"labels": {**sel, **extra_labels},
+                "metadata": {"labels": {**sel, **extra_labels,
+                                        **discovery_labels},
                              "annotations": {"arks.ai/revision": revision,
                                              **extra_annotations}},
                 "spec": pod,
@@ -505,7 +540,7 @@ def _engine_container(spec: dict, served_model: str, model_path: str | None,
     args += extra_args or []
     container = {
         "name": "engine",
-        "image": spec.get("runtimeImage", DEFAULT_IMAGE),
+        "image": spec.get("runtimeImage") or _default_image(spec.get("runtime", "jax")),
         "command": ["python"],
         "args": args,
         "ports": [{"containerPort": port, "name": "http"}],
@@ -716,13 +751,42 @@ def render_disaggregated(dapp: DisaggregatedApplication,
     router = spec.get("router") or {}
     rport = router.get("port", port)
     rlabels = {LABEL_APPLICATION: dapp.name, LABEL_COMPONENT: "router"}
+    # Label-selector pod discovery needs pods get/list — bootstrap a
+    # namespaced ServiceAccount/Role/RoleBinding exactly like the
+    # reference's sglang-router RBAC
+    # (arksdisaggregatedapplication_controller.go:530-596).  The per-tier
+    # Service addresses stay as env FALLBACK for the bootstrap window
+    # before the first pod list succeeds.
+    sa_name = f"arks-{dapp.name}-router"
+    docs.append({
+        "apiVersion": "v1", "kind": "ServiceAccount",
+        "metadata": _meta(sa_name, dapp.namespace, rlabels),
+    })
+    docs.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+        "metadata": _meta(sa_name, dapp.namespace, rlabels),
+        "rules": [{"apiGroups": [""], "resources": ["pods"],
+                   "verbs": ["get", "list", "watch"]}],
+    })
+    docs.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+        "metadata": _meta(sa_name, dapp.namespace, rlabels),
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "Role", "name": sa_name},
+        "subjects": [{"kind": "ServiceAccount", "name": sa_name,
+                      "namespace": dapp.namespace}],
+    })
     rcontainer = {
         "name": "router",
-        "image": router.get("image", DEFAULT_IMAGE),
+        "image": router.get("image") or _default_image(),
         "command": ["python"],
         "args": ["-m", "arks_tpu.router",
                  "--port", str(rport),
                  "--served-model-name", served,
+                 "--service-discovery",
+                 "--namespace", dapp.namespace,
+                 "--application", dapp.name,
+                 "--backend-port", str(port),
                  *[str(a) for a in router.get("routerArgs", [])]],
         "env": [
             {"name": "ARKS_PREFILL_ADDRS", "value": tiers["prefill"]},
@@ -734,7 +798,7 @@ def render_disaggregated(dapp: DisaggregatedApplication,
             "failureThreshold": 120, "periodSeconds": 5,
         },
     }
-    rpod: dict = {"containers": [rcontainer]}
+    rpod: dict = {"containers": [rcontainer], "serviceAccountName": sa_name}
     ril, ria = apply_instance_spec(rpod, rcontainer, router.get("instanceSpec"))
     if unit is not None:
         # The scheduler/router role joins the unit PodGroup too (reference

@@ -73,10 +73,10 @@ class K8sGangDriver:
     container args) — converges them to its own revision via ONE sequenced
     maxUnavailable=1 rolling pass, never a simultaneous restart.
 
-    Known limitation: disaggregated ROUTER gangs rely on the local-mode
-    discovery file the operator writes to its own filesystem; live-mode
-    routers need the label-selector service discovery (roadmap) — standalone
-    Applications are fully supported.
+    Disaggregated router gangs use label-selector pod discovery
+    (``--service-discovery``, arks_tpu.router.KubeDiscovery) — the live
+    operator wires the controllers with router_discovery="kubernetes" so
+    routers never depend on the operator's filesystem.
     """
 
     def __init__(self, api, serve_port: int = 8080):
@@ -114,10 +114,44 @@ class K8sGangDriver:
         # exactly one ready pod.
         return sts.get("status", {}).get("readyReplicas", 0) >= 1
 
+    def _ensure_router_rbac(self, gs) -> None:
+        """Router gangs list tier pods by label selector: bootstrap the
+        per-app ServiceAccount/Role/RoleBinding (create-if-absent), the
+        reference's sglang-router RBAC
+        (arksdisaggregatedapplication_controller.go:530-596)."""
+        from arks_tpu.control.resources import LABEL_APPLICATION
+        app = (gs.labels or {}).get(LABEL_APPLICATION)
+        if gs.spec.get("role") != "router" or not app:
+            return
+        name = f"arks-{app}-router"
+        meta = {"name": name, "namespace": gs.namespace,
+                "labels": {LABEL_APPLICATION: app}}
+        objs = [
+            ("v1", "serviceaccounts",
+             {"apiVersion": "v1", "kind": "ServiceAccount",
+              "metadata": dict(meta)}),
+            ("rbac.authorization.k8s.io/v1", "roles",
+             {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+              "metadata": dict(meta),
+              "rules": [{"apiGroups": [""], "resources": ["pods"],
+                         "verbs": ["get", "list", "watch"]}]}),
+            ("rbac.authorization.k8s.io/v1", "rolebindings",
+             {"apiVersion": "rbac.authorization.k8s.io/v1",
+              "kind": "RoleBinding", "metadata": dict(meta),
+              "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                          "kind": "Role", "name": name},
+              "subjects": [{"kind": "ServiceAccount", "name": name,
+                            "namespace": gs.namespace}]}),
+        ]
+        for gv, plural, obj in objs:
+            if self.api.get(gv, plural, gs.namespace, name) is None:
+                self.api.create(gv, plural, gs.namespace, obj)
+
     def ensure(self, gs) -> None:
         existing = self._existing(gs)
         replicas = gs.spec.get("replicas", 1)
         want_rev = self._want_revision(gs)
+        self._ensure_router_rbac(gs)
 
         # Create missing groups + headless services (and their gang
         # PodGroups, when a podGroupPolicy asks for one); adopt current ones.
@@ -269,8 +303,12 @@ class LiveOperator:
         self.interval_s = interval_s
         self.store = Store()
         self.driver = K8sGangDriver(api, serve_port=serve_port)
+        # Live-mode routers run as cluster pods: they discover
+        # prefill/decode pods themselves by label selector (a discovery
+        # FILE on the operator's filesystem would be invisible to them).
         self.manager = build_manager(models_root=models_root,
-                                     driver=self.driver, store=self.store)
+                                     driver=self.driver, store=self.store,
+                                     router_discovery="kubernetes")
         self._running = False
         self._thread: threading.Thread | None = None
         # Last status we projected per (plural, ns, name) — avoids writing

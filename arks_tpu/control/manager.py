@@ -22,6 +22,7 @@ def build_manager(
     local_platform: str | None = None,
     rate_source=None,
     autoscale_interval_s: float = 10.0,
+    router_discovery: str = "file",
 ) -> Manager:
     """Wire the controller set over one store.
 
@@ -39,7 +40,8 @@ def build_manager(
     mgr.add(GangSetController(mgr.store, driver))
     mgr.add(ApplicationController(mgr.store, local_platform=local_platform))
     mgr.add(DisaggregatedApplicationController(
-        mgr.store, local_platform=local_platform))
+        mgr.store, local_platform=local_platform,
+        router_discovery=router_discovery))
     mgr.add(EndpointController(mgr.store))
     if rate_source is not None:
         from arks_tpu.control.autoscaler import AutoscalerController

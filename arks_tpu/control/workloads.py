@@ -400,6 +400,28 @@ def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
     return cmd
 
 
+def default_runtime_image(runtime: str) -> str:
+    """Per-runtime default image with env escape hatches — same contract
+    as the reference (ARKS_RUNTIME_DEFAULT_{VLLM,SGLANG,DYNAMO}_IMAGE,
+    arksapplication_controller.go:907-939), extended with the native jax
+    runtime's ARKS_RUNTIME_DEFAULT_JAX_IMAGE.  Spec.runtimeImage always
+    wins; env beats the built-in default."""
+    env = os.environ.get(f"ARKS_RUNTIME_DEFAULT_{runtime.upper()}_IMAGE")
+    if env:
+        return env
+    return {
+        "vllm": "vllm/vllm-openai:v0.8.2",
+        "sglang": "lmsysorg/sglang:v0.4.5-cu124",
+        "dynamo": "scitixai/k8s/dynamo:vllm",
+    }.get(runtime, "arks-tpu/engine:latest")
+
+
+def default_scripts_image() -> str:
+    """Model-download worker image (ARKS_SCRIPTS_IMAGE escape hatch —
+    arksmodel_controller.go:369-375)."""
+    return os.environ.get("ARKS_SCRIPTS_IMAGE", "arks-tpu/engine:latest")
+
+
 def gpu_runtime_command(runtime: str, model_path: str, served_model_name: str,
                         tensor_parallel: int, size: int,
                         common_args: list[str]) -> list[str]:

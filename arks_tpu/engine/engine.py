@@ -552,6 +552,35 @@ class InferenceEngine:
             return ids[0], clp[0], vals[0], lids[0], ks, vs
 
         self._prefill_lp_fn = jax.jit(prefill_and_sample_lp)
+
+        # Detached (disaggregated) prefill: same math, but the KV comes
+        # back REPLICATED over the mesh — on a multi-host gang the leader
+        # must materialize the full [L,1,T,Hkv,D] block for the wire
+        # transfer, and sharded outputs are not addressable across hosts.
+        # (No-op constraint single-host.)
+        def _replicate(x):
+            if mesh is None or mesh.size == 1:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, PartitionSpec()))
+
+        def prefill_detached_prog(params, tokens, length, temperature,
+                                  top_p, top_k, key, want_lp: bool):
+            logits, ks, vs = model_prefill(params, tokens, length)
+            state = sampler_mod.transient_state(temperature, top_p, top_k,
+                                                key, cfg.vocab_size)
+            ids, _ = sampler_mod.sample(logits, state)
+            ks, vs = _replicate(ks), _replicate(vs)
+            if want_lp:
+                clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
+                return ids[0], clp[0], vals[0], lids[0], ks, vs
+            return ids[0], ks, vs
+
+        self._prefill_detached_fn = jax.jit(
+            functools.partial(prefill_detached_prog, want_lp=False))
+        self._prefill_detached_lp_fn = jax.jit(
+            functools.partial(prefill_detached_prog, want_lp=True))
         self._insert_fn = jax.jit(tf.insert, donate_argnums=(0,))
 
         # Fused BATCHED admission: M queued prompts prefill + sample +
@@ -687,7 +716,12 @@ class InferenceEngine:
                                              donate_argnums=(1,))
 
             def spec_loop(params, dparams, cache, dcache, tokens, lengths,
-                          sstate):
+                          sstate, enable, want_lp: bool):
+                # Feed-time counting (as in the fused loop): spec-DISABLED
+                # penalized slots advance one normally-sampled token per
+                # dispatch, so their counts must evolve; eligible slots are
+                # penalty-free and reset at slot reuse.
+                sstate = sampler_mod.count_tokens(sstate, tokens)
                 # Draft DK-1 proposals (greedy slots argmax, sampled slots
                 # draw from their effective filtered distribution)...
                 def body(carry, _):
@@ -713,14 +747,30 @@ class InferenceEngine:
                 block = jnp.concatenate([tokens[:, None], drafts], axis=1)
                 # ...then verify the whole block in ONE target pass and
                 # accept by rejection sampling (exact in distribution;
-                # greedy slots reduce to argmax prefix matching).
+                # greedy slots reduce to argmax prefix matching).  The
+                # per-slot enable mask lets penalized/logprob/desynced
+                # slots ride position 0 normally while the rest speculate.
                 vlogits, cache = tf.verify_step(params, cfg, cache, block,
                                                 lengths, mesh)
                 out, counts, keys = sampler_mod.speculative_accept(
-                    drafts, q_sel, q_probs, q_idx, vlogits, sstate, keys)
+                    drafts, q_sel, q_probs, q_idx, vlogits, sstate, keys,
+                    enable=enable)
+                if want_lp:
+                    # Raw-distribution logprobs for the ONE token each
+                    # disabled lp slot advanced (enabled slots never carry
+                    # logprobs — eligibility excludes them).
+                    clp, vals, lids = sampler_mod.top_logprobs(
+                        vlogits[:, 0], out[:, 0])
+                    return (cache, dcache, out, counts,
+                            sstate._replace(key=keys), clp, vals, lids)
                 return cache, dcache, out, counts, sstate._replace(key=keys)
 
-            self._spec_fn = jax.jit(spec_loop, donate_argnums=(2, 3, 6))
+            self._spec_fn = jax.jit(
+                functools.partial(spec_loop, want_lp=False),
+                donate_argnums=(2, 3, 6))
+            self._spec_lp_fn = jax.jit(
+                functools.partial(spec_loop, want_lp=True),
+                donate_argnums=(2, 3, 6))
 
     # ------------------------------------------------------------------
     # Public API
@@ -1218,9 +1268,10 @@ class InferenceEngine:
         decode side): insert the transferred KV, reconstruct the sampling key
         stream, and continue decoding from the first token."""
         pf = req.prefilled
-        if req.params.logprobs is not None:
-            # The transferred state has no logits for the first token;
-            # serving a partial logprob stream would be silently wrong.
+        if req.params.logprobs is not None and pf.first_lp is None:
+            # A logprob request whose transferred state carries no
+            # first-token logprob data (pre-upgrade prefill peer): serving
+            # a partial stream would be silently wrong — reject cleanly.
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="error", error="logprobs_unavailable",
@@ -1271,7 +1322,9 @@ class InferenceEngine:
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="abort", num_prompt_tokens=pf.num_prompt))
             raise
-        self._register_slot(req, slot, pf.first_token, pf.num_prompt)
+        self._register_slot(req, slot, pf.first_token, pf.num_prompt,
+                            first_lp=pf.first_lp
+                            if req.params.logprobs is not None else None)
 
     @staticmethod
     def _lp_entry(clp, vals, lids, n: int):
@@ -1569,33 +1622,48 @@ class InferenceEngine:
                          params) -> PrefilledState:
         """Run prefill + first-token sampling and return the transferable
         state instead of inserting into this engine's cache.  Thread-safe;
-        called from server threads on a prefill-only engine (no decode loop).
+        called from server threads on a prefill-only engine (no decode
+        loop).  On a multi-host gang the dispatch is mirrored to followers
+        like any other op — the prefill lock serializes the emit+dispatch
+        pair, and a prefill-only engine runs no scheduler thread to
+        interleave with, so followers see the leader's exact order.
 
         One-shot only: the transferred KV is a single [T] block, so prompts
         beyond the largest bucket are rejected (HTTP 400 at the server)."""
-        if self.dispatcher is not None:
-            raise NotImplementedError(
-                "detached prefill on a multi-host gang is not supported; "
-                "run the prefill tier single-host per group")
         if len(prompt_ids) > self._one_shot_limit():
             raise ContextLengthExceededError(
                 f"prompt has {len(prompt_ids)} tokens but the disaggregated "
                 f"prefill limit is {self._one_shot_limit()}")
         ids, padded = self._prepare_prompt(prompt_ids)
 
+        want_lp = getattr(params, "logprobs", None) is not None
+        first_lp = None
         with self._prefill_lock:
             self._request_seed += 1
             seed = params.seed if params.seed is not None else self._request_seed
             key = jax.random.PRNGKey(seed)
-            first_id, ks, vs = self._prefill_fn(
-                self.params, jnp.asarray(padded),
-                jnp.asarray([len(ids)], jnp.int32),
-                jnp.float32(params.temperature), jnp.float32(params.top_p),
-                jnp.int32(params.top_k), key)
+            args = (self.params, jnp.asarray(padded),
+                    jnp.asarray([len(ids)], jnp.int32),
+                    jnp.float32(params.temperature),
+                    jnp.float32(params.top_p),
+                    jnp.int32(params.top_k), key)
+            if want_lp:
+                self._emit("prefill_detached_lp", tokens=padded,
+                           length=len(ids), temperature=params.temperature,
+                           top_p=params.top_p, top_k=params.top_k, seed=seed)
+                first_id, clp, vals, lids, ks, vs = \
+                    self._prefill_detached_lp_fn(*args)
+                first_lp = self._lp_entry(clp, vals, lids, params.logprobs)
+            else:
+                self._emit("prefill_detached", tokens=padded,
+                           length=len(ids), temperature=params.temperature,
+                           top_p=params.top_p, top_k=params.top_k, seed=seed)
+                first_id, ks, vs = self._prefill_detached_fn(*args)
             first = int(first_id)
         self.metrics.prompt_tokens_total.inc(len(ids))
         return PrefilledState(first_token=first, num_prompt=len(ids),
-                              seed=seed, k=np.asarray(ks), v=np.asarray(vs))
+                              seed=seed, k=np.asarray(ks), v=np.asarray(vs),
+                              first_lp=first_lp)
 
     def _decode_dispatch(self) -> None:
         K = self.ecfg.steps_per_dispatch
@@ -1625,22 +1693,26 @@ class InferenceEngine:
         if not self._slots:
             return
 
-        # Speculative path: all slots draft-synced (greedy OR sampled — the
-        # rejection-sampled kernel is exact in distribution either way) and
-        # penalty-free (the spec kernel's per-position dists don't evolve
-        # the penalty counts within a block; penalized slots ride the fused
-        # loop, which does).  Multi-host gangs mirror it like any other
-        # dispatch ("spec" op).
-        if (self._draft_cfg is not None
-                and all(st.draft_synced
-                        and st.request.params.presence_penalty == 0
-                        and st.request.params.frequency_penalty == 0
-                        and st.request.params.logprobs is None
-                        for st in self._slots.values())):
-            return self._spec_dispatch()
+        # Speculative path: runs whenever ANY slot is eligible (draft-
+        # synced, penalty-free, no logprobs — greedy OR sampled, the
+        # rejection-sampled kernel is exact in distribution either way).
+        # Ineligible slots ride the dispatch DISABLED: they advance one
+        # normally-sampled token (penalties applied, logprobs emitted)
+        # while the rest keep speculating — one penalized client no longer
+        # turns speculation off for everyone.  Multi-host gangs mirror it
+        # like any other dispatch ("spec" op).
         if self._draft_cfg is not None:
-            # The fused loop advances the target cache only — every live
-            # slot's draft mirror is stale from here on.
+            eligible = {
+                slot: (st.draft_synced
+                       and st.request.params.presence_penalty == 0
+                       and st.request.params.frequency_penalty == 0
+                       and st.request.params.logprobs is None)
+                for slot, st in self._slots.items()}
+            if any(eligible.values()):
+                return self._spec_dispatch(eligible)
+            # Nobody can speculate: the fused loop advances the target
+            # cache only — every live slot's draft mirror is stale from
+            # here on.
             for st in self._slots.values():
                 st.draft_synced = False
 
@@ -1717,29 +1789,44 @@ class InferenceEngine:
                     num_prompt_tokens=st.num_prompt,
                     logprobs=lp_delta))
 
-    def _spec_dispatch(self) -> None:
-        """One speculative step: draft proposes, target verifies, each slot
-        advances 1..draft_len tokens.  Greedy slots are byte-exact vs the
-        target-only path; sampled slots are exact in distribution (the
-        rejection kernel's guarantee)."""
+    def _spec_dispatch(self, eligible: dict[int, bool]) -> None:
+        """One speculative step: draft proposes, target verifies, each
+        ELIGIBLE slot advances 1..draft_len tokens; disabled slots advance
+        exactly one normally-sampled token (penalties/logprobs served).
+        Greedy slots are byte-exact vs the target-only path; sampled slots
+        are exact in distribution (the rejection kernel's guarantee)."""
         DK = self.ecfg.draft_len
+        enable = np.zeros((self.ecfg.num_slots,), bool)
+        for slot, ok in eligible.items():
+            enable[slot] = ok
+        want_lp = any(st.request.params.logprobs is not None
+                      for st in self._slots.values())
         t0 = time.monotonic()
         self._emit("spec", tokens=np.array(self._last_token),
-                   lengths=np.array(self._lengths))
-        (self._cache, self._draft_cache, a, counts,
-         self._sampling) = self._spec_fn(
-            self.params, self._draft_params, self._cache, self._draft_cache,
-            jnp.asarray(self._last_token), jnp.asarray(self._lengths),
-            self._sampling)
+                   lengths=np.array(self._lengths), enable=enable.copy(),
+                   lp=want_lp)
+        args = (self.params, self._draft_params, self._cache,
+                self._draft_cache, jnp.asarray(self._last_token),
+                jnp.asarray(self._lengths), self._sampling,
+                jnp.asarray(enable))
+        if want_lp:
+            (self._cache, self._draft_cache, a, counts, self._sampling,
+             clps, lvals, lids) = self._spec_lp_fn(*args)
+            clps = np.asarray(clps)
+            lvals = np.asarray(lvals)
+            lids = np.asarray(lids)
+        else:
+            (self._cache, self._draft_cache, a, counts,
+             self._sampling) = self._spec_fn(*args)
         a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
         counts = np.asarray(counts).tolist()
         dt = time.monotonic() - t0
 
-        n_slots = len(self._slots)
-        accepted = sum(counts[s] - 1 for s in self._slots)
-        self.metrics.spec_decode_proposed_tokens_total.inc((DK - 1) * n_slots)
+        n_spec = sum(1 for s in self._slots if enable[s])
+        accepted = sum(counts[s] - 1 for s in self._slots if enable[s])
+        self.metrics.spec_decode_proposed_tokens_total.inc((DK - 1) * n_spec)
         self.metrics.spec_decode_accepted_tokens_total.inc(accepted)
-        self._spec_proposed += (DK - 1) * n_slots
+        self._spec_proposed += (DK - 1) * n_spec
         self._spec_accepted += accepted
         self.metrics.spec_decode_acceptance_rate.set(
             self._spec_accepted / max(self._spec_proposed, 1))
@@ -1748,11 +1835,17 @@ class InferenceEngine:
             st = self._slots[slot]
             c = counts[slot]
             row = a[slot]
+            n_lp = st.request.params.logprobs
             finished = False
             new_tokens = 0
             for i in range(c):
                 tok = row[i]
                 st.generated.append(tok)
+                if want_lp and n_lp is not None:
+                    # Disabled lp slots advance exactly one token (i == 0);
+                    # its entry comes from the position-0 verifier logits.
+                    st.logprobs.append(self._lp_entry(
+                        clps[slot], lvals[slot], lids[slot], n_lp))
                 new_tokens += 1
                 if (self._is_stop(st, tok)
                         or len(st.generated) >= st.request.params.max_tokens):
@@ -1768,10 +1861,13 @@ class InferenceEngine:
                 self._finish(slot, self._finish_reason(st))
             else:
                 delta = st.generated[st.num_emitted:]
+                lp_delta = (st.logprobs[st.num_emitted:]
+                            if n_lp is not None else None)
                 st.num_emitted = len(st.generated)
                 st.request.outputs.put(RequestOutput(
                     request_id=st.request.request_id, token_ids=delta,
-                    num_prompt_tokens=st.num_prompt))
+                    num_prompt_tokens=st.num_prompt,
+                    logprobs=lp_delta))
 
     # ------------------------------------------------------------------
     # Stop handling

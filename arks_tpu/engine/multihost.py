@@ -255,6 +255,19 @@ class DispatchFollower:
                 eng._cache, jnp.asarray(p["k"]), jnp.asarray(p["v"]),
                 jnp.asarray(p["pages"]),
                 jnp.asarray(p["n_pages"], jnp.int32))
+        elif op in ("prefill_detached", "prefill_detached_lp"):
+            # Disaggregated prefill on a gang: mirror the replicated-KV
+            # prefill program (the leader materializes the full block for
+            # the wire transfer; followers just keep collectives aligned).
+            key = self._jax.random.PRNGKey(p["seed"])
+            fn = (eng._prefill_detached_lp_fn if op.endswith("_lp")
+                  else eng._prefill_detached_fn)
+            out = fn(eng.params, jnp.asarray(p["tokens"]),
+                     jnp.asarray([p["length"]], jnp.int32),
+                     jnp.float32(p["temperature"]),
+                     jnp.float32(p["top_p"]),
+                     jnp.int32(p["top_k"]), key)
+            jax.block_until_ready(out[0])
         elif op in ("prefill", "prefill_lp"):
             key = self._jax.random.PRNGKey(p["seed"])
             args = (eng.params, jnp.asarray(p["tokens"]),
@@ -327,11 +340,14 @@ class DispatchFollower:
         elif op == "spec":
             # Key lockstep rides the shared _sampling state: both sides
             # evolve it with the kernel's deterministic splits.
-            (eng._cache, eng._draft_cache, _, counts,
-             eng._sampling) = eng._spec_fn(
+            fn = eng._spec_lp_fn if p.get("lp") else eng._spec_fn
+            out = fn(
                 eng.params, eng._draft_params, eng._cache, eng._draft_cache,
                 jnp.asarray(p["tokens"]), jnp.asarray(p["lengths"]),
-                eng._sampling)
+                eng._sampling, jnp.asarray(p["enable"]))
+            eng._cache, eng._draft_cache = out[0], out[1]
+            counts = out[3]
+            eng._sampling = out[4]
             jax.block_until_ready(counts)
         elif op == "reset":
             eng._reset_device_state()

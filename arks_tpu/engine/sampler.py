@@ -231,6 +231,7 @@ def speculative_accept(
     target_logits: jnp.ndarray,  # [B, K, V] verifier logits per position
     state: SamplingState,
     keys: jnp.ndarray,          # [B, 2]
+    enable: jnp.ndarray | None = None,  # [B] bool; False = no speculation
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rejection-sampled acceptance (Leviathan et al.): accept draft i with
     prob min(1, p_i(d_i)/q_i(d_i)); at the first rejection sample from the
@@ -240,6 +241,12 @@ def speculative_accept(
     temperature/top-k/top-p dist ``sample`` uses) — the draft only changes
     how many land per dispatch.  Greedy slots reduce to exact argmax
     matching + the argmax bonus token.
+
+    ``enable`` gates speculation PER SLOT: a disabled slot (penalized /
+    logprob-bearing / stale draft mirror) advances exactly ONE token,
+    sampled from the target's position-0 logits through the NORMAL path —
+    penalties included — so one such request no longer drops the whole
+    batch off the speculative path.
 
     Returns (tokens [B, K] — first counts[b] are valid, counts [B] in
     1..K, advanced keys)."""
@@ -288,4 +295,11 @@ def speculative_accept(
 
     out = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
     out = out.at[jnp.arange(b), j].set(y)
+
+    if enable is not None:
+        # Disabled slots: one token via the regular sampler (which applies
+        # presence/frequency penalties) from the position-0 target logits.
+        plain, _ = sample(target_logits[:, 0], state._replace(key=r_keys))
+        out = jnp.where(enable[:, None], out, out.at[:, 0].set(plain))
+        counts = jnp.where(enable, counts, 1)
     return out, counts, carry_keys

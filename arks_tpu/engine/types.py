@@ -42,6 +42,11 @@ class PrefilledState:
     seed: int
     k: object  # np.ndarray | jax.Array [L, 1, T, Hkv, D]
     v: object
+    # First-token logprob data (chosen_logprob, [(token_id, logprob)...]),
+    # present when the request asked for logprobs — the decode side serves
+    # the logprob stream seamlessly from here (its own dispatches cover
+    # every later token).
+    first_lp: object | None = None
 
 
 @dataclasses.dataclass

@@ -30,8 +30,12 @@ def main() -> None:
                         "else memory (single replica); redis = shared store "
                         "for HA gateways (reference redis_impl.go parity)")
     p.add_argument("--redis-addr", default="127.0.0.1:6379",
-                   help="RESP server address for --backend redis (real "
-                        "Redis, or python -m arks_tpu.gateway.rediskv)")
+                   help="RESP server address(es) for --backend redis — "
+                        "comma-separated list selects cluster mode; with "
+                        "--redis-sentinel-master the list is sentinel "
+                        "addresses (reference cmd/gateway/main.go:137-170)")
+    p.add_argument("--redis-sentinel-master", default=None,
+                   help="Redis Sentinel master name (enables sentinel mode)")
     p.add_argument("--max-body-bytes", type=int, default=4 * 1024 * 1024,
                    help="request-body cap -> 413 (reference "
                         "ClientTrafficPolicy 4MiB client buffer)")
@@ -55,9 +59,9 @@ def main() -> None:
     if args.backend == "redis":
         from arks_tpu.gateway.ratelimiter import RateLimiter
         from arks_tpu.gateway.rediskv import (
-            RedisCounterBackend, RedisQuotaService, RespClient)
-        host, _, port = args.redis_addr.partition(":")
-        client = RespClient(host, int(port or 6379))
+            RedisCounterBackend, RedisQuotaService, make_resp_client)
+        client = make_resp_client(args.redis_addr,
+                                  args.redis_sentinel_master)
         rate_limiter = RateLimiter(RedisCounterBackend(client))
         quota = RedisQuotaService(client)
     elif args.backend == "memory":

@@ -162,6 +162,15 @@ class RespClient:
         have executed the commands, and re-applying INCRBYs would double-
         count rate windows and permanently inflate quota ledgers.
         """
+        replies = self.pipeline_raw(*commands)
+        for r in replies:
+            if isinstance(r, RespError):
+                raise r
+        return replies
+
+    def pipeline_raw(self, *commands: tuple) -> list:
+        """Like ``pipeline`` but error replies come back as RespError
+        VALUES — the cluster client inspects them for redirects."""
         payload = b"".join(_encode_command(c) for c in commands)
         try:
             conn = self._conn()
@@ -172,17 +181,236 @@ class RespClient:
             conn = self._conn()
             conn.sock.sendall(payload)
         try:
-            replies = [conn.read_reply() for _ in commands]
+            return [conn.read_reply() for _ in commands]
         except (OSError, ConnectionError):
             self._drop_conn()
             raise
-        for r in replies:
-            if isinstance(r, RespError):
-                raise r
-        return replies
 
     def command(self, *args):
         return self.pipeline(tuple(args))[0]
+
+
+# ---------------------------------------------------------------------------
+# Cluster + sentinel topologies (reference cmd/gateway/main.go:137-170:
+# redis.NewUniversalClient — sentinel when a master name is set, cluster
+# when several addresses are given, else single).
+# ---------------------------------------------------------------------------
+
+
+def _crc16(data: bytes) -> int:
+    """CRC16-CCITT (XMODEM) — the Redis Cluster key-slot hash."""
+    crc = 0
+    for byte in data:
+        crc ^= byte << 8
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x1021) if crc & 0x8000 else (crc << 1)
+            crc &= 0xFFFF
+    return crc
+
+
+def key_slot(key) -> int:
+    """Cluster slot for a key, honoring {hash tags}."""
+    k = key if isinstance(key, bytes) else str(key).encode()
+    start = k.find(b"{")
+    if start >= 0:
+        end = k.find(b"}", start + 1)
+        if end > start + 1:
+            k = k[start + 1: end]
+    return _crc16(k) % 16384
+
+
+class RespClusterClient:
+    """Slot-routing client over several cluster nodes — the counter/quota
+    backends' commands are all single-key, so routing is: hash the key,
+    send to the slot's node, follow ``-MOVED``/``-ASK`` redirects (and
+    remember MOVED re-mappings).  Pipelines are regrouped per node and the
+    replies re-assembled in request order.  Same public surface as
+    ``RespClient``."""
+
+    def __init__(self, addrs: list[tuple[str, int]], timeout_s: float = 5.0):
+        if not addrs:
+            raise ValueError("cluster mode needs at least one address")
+        self.timeout_s = timeout_s
+        self._clients: dict[tuple[str, int], RespClient] = {}
+        self._default = tuple(addrs[0])
+        self._slots: dict[int, tuple[str, int]] = {}
+        self._lock = threading.Lock()
+        # Fail fast needs ONE reachable seed, not all of them — a seed down
+        # for maintenance must not block gateway startup when the rest of
+        # the cluster can serve every slot.
+        last: Exception | None = None
+        for a in addrs:
+            try:
+                self._default = tuple(a)
+                self._client(self._default)
+                return
+            except (OSError, ConnectionError) as e:
+                last = e
+        raise ConnectionError(f"no cluster seed reachable: {last}")
+
+    def _client(self, addr: tuple[str, int]) -> RespClient:
+        with self._lock:
+            c = self._clients.get(addr)
+        if c is not None:
+            return c
+        # Connect OUTSIDE the lock: a slow node's connect timeout must not
+        # freeze every other thread's slot lookups.  Double-checked insert
+        # tolerates a racing duplicate (the loser is closed).
+        c = RespClient(addr[0], addr[1], self.timeout_s)
+        with self._lock:
+            cur = self._clients.get(addr)
+            if cur is not None:
+                close_me, c = c, cur
+            else:
+                self._clients[addr] = c
+                close_me = None
+        if close_me is not None:
+            close_me.close()
+        return c
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
+
+    @staticmethod
+    def _cmd_key(cmd: tuple):
+        # Every command the gateway issues is single-key with the key at
+        # position 1 (GET/SET/INCRBY/EXPIRE/TTL/DEL); keyless commands
+        # (PING/FLUSHALL) route to the default node.
+        return cmd[1] if len(cmd) > 1 else None
+
+    def _addr_for(self, cmd: tuple) -> tuple[str, int]:
+        key = self._cmd_key(cmd)
+        if key is None:
+            return self._default
+        with self._lock:
+            return self._slots.get(key_slot(key), self._default)
+
+    @staticmethod
+    def _parse_redirect(err: RespError) -> tuple[str, int, int] | None:
+        parts = str(err).split()
+        if len(parts) == 3 and parts[0] in ("MOVED", "ASK"):
+            host, _, port = parts[2].rpartition(":")
+            return int(parts[1]), (host, int(port)), parts[0]
+        return None
+
+    def _follow_redirect(self, cmd: tuple, err: RespError):
+        red = self._parse_redirect(err)
+        if red is None:
+            raise err
+        slot, new_addr, kind = red
+        if kind == "MOVED":
+            with self._lock:
+                self._slots[int(slot)] = new_addr
+        target = self._client(new_addr)
+        if kind == "ASK":
+            reply = target.pipeline_raw(("ASKING",), cmd)[1]
+        else:
+            reply = target.pipeline_raw(cmd)[0]
+        if isinstance(reply, RespError):
+            raise reply
+        return reply
+
+    def pipeline(self, *commands: tuple) -> list:
+        # Group commands by their slot's node so same-node batches (the
+        # hot-path INCRBY+TTL pair) stay ONE round trip; redirected replies
+        # are retried individually and the results restored to input order.
+        by_addr: dict[tuple[str, int], list[int]] = {}
+        for i, cmd in enumerate(commands):
+            by_addr.setdefault(self._addr_for(cmd), []).append(i)
+        out: list = [None] * len(commands)
+        for addr, idxs in by_addr.items():
+            replies = self._client(addr).pipeline_raw(
+                *(commands[i] for i in idxs))
+            for i, reply in zip(idxs, replies):
+                if isinstance(reply, RespError):
+                    reply = self._follow_redirect(commands[i], reply)
+                out[i] = reply
+        return out
+
+    def command(self, *args):
+        return self.pipeline(tuple(args))[0]
+
+
+class SentinelRespClient(RespClient):
+    """RESP client that resolves its master through Redis Sentinel
+    (``SENTINEL GET-MASTER-ADDR-BY-NAME``) and RE-resolves on connection
+    loss or on a ``-READONLY`` reply (failover promoted a replica)."""
+
+    def __init__(self, sentinel_addrs: list[tuple[str, int]],
+                 master_name: str, timeout_s: float = 5.0):
+        self.sentinels = [tuple(a) for a in sentinel_addrs]
+        self.master_name = master_name
+        self._resolve()
+        super().__init__(self.host, self.port, timeout_s)
+
+    def _resolve(self) -> None:
+        last: Exception | None = None
+        for host, port in self.sentinels:
+            try:
+                c = _Conn(host, port, 5.0)
+                try:
+                    c.sock.sendall(_encode_command(
+                        ("SENTINEL", "GET-MASTER-ADDR-BY-NAME",
+                         self.master_name)))
+                    reply = c.read_reply()
+                finally:
+                    c.close()
+                if isinstance(reply, list) and len(reply) == 2:
+                    self.host = reply[0].decode()
+                    self.port = int(reply[1])
+                    return
+                last = RespError(f"sentinel {host}:{port} returned {reply!r}")
+            except (OSError, ConnectionError) as e:
+                last = e
+        raise ConnectionError(
+            f"no sentinel could resolve master {self.master_name!r}: {last}")
+
+    def _drop_conn(self) -> None:
+        super()._drop_conn()
+        # The master may have moved: ask the sentinels again before the
+        # next connection attempt.
+        try:
+            self._resolve()
+        except ConnectionError:
+            log.warning("sentinel re-resolution failed; keeping %s:%s",
+                        self.host, self.port, exc_info=True)
+
+    def pipeline(self, *commands: tuple) -> list:
+        try:
+            return super().pipeline(*commands)
+        except RespError as e:
+            if not str(e).startswith("READONLY"):
+                raise
+            # Failover flipped this node to replica: re-resolve and retry
+            # once.  (READONLY on a read-modify batch means the batch did
+            # not execute — safe to resend.)
+            self._drop_conn()
+            return super().pipeline(*commands)
+
+
+def make_resp_client(addrs: str, sentinel_master: str | None = None,
+                     timeout_s: float = 5.0):
+    """Factory matching the reference's UniversalClient selection
+    (cmd/gateway/main.go:137-170): comma-separated ``addrs`` + a sentinel
+    master name -> sentinel; several addrs -> cluster; one -> single."""
+    parsed = []
+    for a in addrs.split(","):
+        a = a.strip()
+        if not a:
+            continue
+        host, sep, port = a.rpartition(":")
+        if sep and port.isdigit():
+            parsed.append((host, int(port)))
+        else:
+            parsed.append((a, 6379))  # bare hostname defaults like redis-cli
+    if sentinel_master:
+        return SentinelRespClient(parsed, sentinel_master, timeout_s)
+    if len(parsed) > 1:
+        return RespClusterClient(parsed, timeout_s)
+    return RespClient(parsed[0][0], parsed[0][1], timeout_s)
 
 
 # ---------------------------------------------------------------------------
@@ -316,6 +544,26 @@ class _Handler(socketserver.StreamRequestHandler):
     def _dispatch(self, kv: _KV, args: list[bytes]) -> bytes:
         cmd = args[0].upper()
         now = time.time()
+        srv = self.server
+        # Topology test doubles: sentinel resolution + cluster redirects.
+        if cmd == b"SENTINEL" and len(args) >= 3 \
+                and args[1].upper() == b"GET-MASTER-ADDR-BY-NAME":
+            master = getattr(srv, "sentinel_masters", {}).get(
+                args[2].decode())
+            if master is None:
+                return b"*-1\r\n"
+            h, p = str(master[0]).encode(), str(master[1]).encode()
+            return (b"*2\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+                    % (len(h), h, len(p), p))
+        if cmd == b"ASKING":
+            return b"+OK\r\n"
+        moved = getattr(srv, "moved_slots", None)
+        if moved and len(args) > 1:
+            slot = key_slot(args[1])
+            target = moved.get(slot)
+            if target is not None:
+                return (b"-MOVED %d %s\r\n"
+                        % (slot, str(target).encode()))
         with kv.lock:
             if cmd in (b"SET", b"INCRBY"):
                 kv.gc(now)
@@ -368,7 +616,20 @@ class RespServer:
                                                     bind_and_activate=True)
         self._srv.daemon_threads = True
         self._srv.kv = _KV()  # type: ignore[attr-defined]
+        # Topology test doubles (see _Handler._dispatch):
+        # sentinel_masters: {master_name: (host, port)};
+        # moved_slots: {slot: "host:port"} -> -MOVED redirects.
+        self._srv.sentinel_masters = {}  # type: ignore[attr-defined]
+        self._srv.moved_slots = {}  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address
+
+    @property
+    def sentinel_masters(self) -> dict:
+        return self._srv.sentinel_masters  # type: ignore[attr-defined]
+
+    @property
+    def moved_slots(self) -> dict:
+        return self._srv.moved_slots  # type: ignore[attr-defined]
 
     def start(self, background: bool = True) -> None:
         if background:

@@ -79,6 +79,96 @@ def _env_addrs(name: str) -> list[str]:
     return [a for a in v.split(",") if a]
 
 
+class KubeDiscovery:
+    """Label-selector pod discovery against the Kubernetes API — the native
+    counterpart of the reference router's ``--service-discovery
+    --prefill-selector/--decode-selector`` mode
+    (/root/reference/internal/controller/
+    arksdisaggregatedapplication_controller.go:1630-1670).
+
+    Lists pods labeled ``arks.ai/application=<app>`` with
+    ``arks.ai/component`` prefill/decode, keeps READY ones (worker
+    processes of a gang return 503 on /readiness, so only leaders are
+    Ready — exactly the addresses that serve), and addresses them as
+    ``podIP:containerPort`` (first declared container port; falls back to
+    ``backend_port``).  Results are cached for ``interval_s`` — the same
+    poll cadence the live operator uses; env fallback
+    (ARKS_PREFILL_ADDRS/ARKS_DECODE_ADDRS) covers bootstrap windows."""
+
+    def __init__(self, api, namespace: str, application: str,
+                 backend_port: int = 8080, interval_s: float = 2.0):
+        self.api = api
+        self.namespace = namespace
+        self.application = application
+        self.backend_port = backend_port
+        self.interval = interval_s
+        self._lock = threading.Lock()
+        self._at = 0.0
+        self._prefill: list[str] = _env_addrs("ARKS_PREFILL_ADDRS")
+        self._decode: list[str] = _env_addrs("ARKS_DECODE_ADDRS")
+
+    @staticmethod
+    def _ready(pod: dict) -> bool:
+        if pod.get("status", {}).get("phase") != "Running":
+            return False
+        for c in pod.get("status", {}).get("conditions", []):
+            if c.get("type") == "Ready":
+                return c.get("status") == "True"
+        return False
+
+    def _addr(self, pod: dict) -> str | None:
+        ip = pod.get("status", {}).get("podIP")
+        if not ip:
+            return None
+        port = self.backend_port
+        for c in pod.get("spec", {}).get("containers", []):
+            ports = c.get("ports") or []
+            if ports:
+                port = ports[0].get("containerPort", port)
+                break
+        return f"{ip}:{port}"
+
+    def _refresh(self) -> None:
+        roles: dict[str, list[str]] = {"prefill": [], "decode": []}
+        for pod in self.api.list("v1", "pods", self.namespace):
+            labels = pod.get("metadata", {}).get("labels", {})
+            if labels.get("arks.ai/application") != self.application:
+                continue
+            role = labels.get("arks.ai/component")
+            if role not in roles or not self._ready(pod):
+                continue
+            addr = self._addr(pod)
+            if addr:
+                roles[role].append(addr)
+        # Keep env fallback while a tier has no discovered pods yet.
+        # (Swap under the lock: backends() reads these concurrently.)
+        with self._lock:
+            if roles["prefill"]:
+                self._prefill = sorted(roles["prefill"])
+            if roles["decode"]:
+                self._decode = sorted(roles["decode"])
+
+    def backends(self) -> tuple[list[str], list[str]]:
+        # The API list happens OUTSIDE the lock and only one thread does it
+        # (the _at timestamp claims the refresh): a slow apiserver degrades
+        # to a stale backend set, never to every request blocking on the
+        # discovery lock.
+        now = time.monotonic()
+        refresh = False
+        with self._lock:
+            if now - self._at >= self.interval:
+                self._at = now  # claim (and back off a full interval on error)
+                refresh = True
+        if refresh:
+            try:
+                self._refresh()
+            except Exception:
+                log.warning("pod discovery failed; keeping last set",
+                            exc_info=True)
+        with self._lock:
+            return list(self._prefill), list(self._decode)
+
+
 # Prompt-prefix window the cache_aware policy keys on.  Long enough to
 # separate distinct system prompts, short enough that divergent tails (the
 # user turn) don't defeat the affinity.

@@ -20,6 +20,20 @@ def main() -> None:
     p.add_argument("--discovery-file", default=None,
                    help="JSON {prefill: [addr], decode: [addr]}; falls back "
                         "to ARKS_PREFILL_ADDRS/ARKS_DECODE_ADDRS env")
+    p.add_argument("--service-discovery", action="store_true",
+                   help="discover prefill/decode pods from the Kubernetes "
+                        "API by label selector (the reference router's "
+                        "--service-discovery mode) instead of a file")
+    p.add_argument("--namespace", default=None,
+                   help="pod namespace for --service-discovery (default: "
+                        "the pod's own namespace)")
+    p.add_argument("--application", default=None,
+                   help="arks.ai/application label value to select")
+    p.add_argument("--backend-port", type=int, default=8080,
+                   help="fallback port when a pod declares no containerPort")
+    p.add_argument("--discovery-interval", type=float, default=2.0)
+    p.add_argument("--kube-api", default=None,
+                   help="apiserver base URL (default: in-cluster config)")
     p.add_argument("--policy", default="cache_aware",
                    choices=("round_robin", "cache_aware"),
                    help="cache_aware pins shared prompt prefixes to one "
@@ -31,9 +45,23 @@ def main() -> None:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    from arks_tpu.router import Discovery, Router
+    from arks_tpu.router import Discovery, KubeDiscovery, Router
 
-    router = Router(Discovery(args.discovery_file), args.served_model_name,
+    if args.service_discovery:
+        from arks_tpu.control.k8s_client import KubeApi
+
+        api = (KubeApi(args.kube_api) if args.kube_api
+               else KubeApi.in_cluster())
+        namespace = args.namespace or KubeApi.namespace_in_cluster()
+        if not args.application:
+            p.error("--service-discovery requires --application")
+        discovery = KubeDiscovery(api, namespace, args.application,
+                                  backend_port=args.backend_port,
+                                  interval_s=args.discovery_interval)
+    else:
+        discovery = Discovery(args.discovery_file)
+
+    router = Router(discovery, args.served_model_name,
                     host=args.host, port=args.port, policy=args.policy)
     router.start(background=False)
 

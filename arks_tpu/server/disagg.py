@@ -70,10 +70,13 @@ class PrefillServer(OpenAIServer):
                                     "type": "invalid_request_error",
                                     "code": "context_length_exceeded"}})
             return True
-        payload = kv_transfer.pack(
-            {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
-             "seed": pf.seed},
-            [pf.k, pf.v])
+        meta = {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
+                "seed": pf.seed}
+        if pf.first_lp is not None:
+            # First-token logprob data rides the JSON meta (floats + ids);
+            # the decode side serves the rest of the logprob stream itself.
+            meta["first_lp"] = pf.first_lp
+        payload = kv_transfer.pack(meta, [pf.k, pf.v])
         h.send_response(200)
         h.send_header("Content-Type", "application/octet-stream")
         h.send_header("Content-Length", str(len(payload)))
@@ -115,13 +118,19 @@ class DecodeServer(OpenAIServer):
             return h._error(502, f"prefill pull failed: {e}")
 
         params, stop_strings = _sampling_from_body(body, self.engine.tokenizer)
+        # JSON round-trips the logprob entry as nested lists; restore the
+        # engine's (chosen, [(id, lp), ...]) tuple shape.
+        first_lp = meta.get("first_lp")
+        if first_lp is not None:
+            first_lp = (float(first_lp[0]),
+                        [(int(i), float(lp)) for i, lp in first_lp[1]])
         req = Request(
             request_id=f"req-{uuid.uuid4().hex[:16]}",
             prompt_ids=[], params=params,
             prefilled=PrefilledState(
                 first_token=int(meta["first_token"]),
                 num_prompt=int(meta["num_prompt"]),
-                seed=int(meta["seed"]), k=k, v=v))
+                seed=int(meta["seed"]), k=k, v=v, first_lp=first_lp))
         self.engine.add_request(req)
         self._respond(h, req, chat, model, body, stop_strings)
 

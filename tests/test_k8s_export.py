@@ -376,3 +376,48 @@ def test_invalid_mode_rejected():
     })
     with pytest.raises(ValueError, match="legacy|unified"):
         render_disaggregated(dapp)
+
+
+def test_runtime_image_env_hatches(monkeypatch):
+    """ARKS_RUNTIME_DEFAULT_*_IMAGE / ARKS_SCRIPTS_IMAGE escape hatches
+    (reference arksapplication_controller.go:907-939, arksmodel_controller
+    .go:369-375): spec wins > env > built-in default."""
+    from arks_tpu.control.k8s_export import render_application, render_model
+    from arks_tpu.control.resources import Application, Model
+    from arks_tpu.control.workloads import default_runtime_image
+
+    # Built-in defaults: jax image native; GPU runtimes mirror the
+    # reference's pinned defaults.
+    assert default_runtime_image("jax") == "arks-tpu/engine:latest"
+    assert default_runtime_image("vllm").startswith("vllm/vllm-openai")
+    assert default_runtime_image("sglang").startswith("lmsysorg/sglang")
+
+    monkeypatch.setenv("ARKS_RUNTIME_DEFAULT_JAX_IMAGE", "reg.io/jax:v9")
+    monkeypatch.setenv("ARKS_RUNTIME_DEFAULT_VLLM_IMAGE", "reg.io/vllm:v9")
+    monkeypatch.setenv("ARKS_SCRIPTS_IMAGE", "reg.io/scripts:v9")
+    assert default_runtime_image("jax") == "reg.io/jax:v9"
+    assert default_runtime_image("vllm") == "reg.io/vllm:v9"
+
+    app = Application(name="a1", spec={
+        "replicas": 1, "size": 1, "runtime": "jax",
+        "model": {"name": "m1"}, "servedModelName": "s",
+        "modelConfig": "tiny"})
+    docs = render_application(app)
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+    img = sts["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert img == "reg.io/jax:v9"
+
+    # spec.runtimeImage still wins over the env hatch.
+    app2 = Application(name="a2", spec={
+        "replicas": 1, "size": 1, "runtime": "jax",
+        "model": {"name": "m1"}, "servedModelName": "s",
+        "modelConfig": "tiny", "runtimeImage": "custom:1"})
+    docs2 = render_application(app2)
+    sts2 = next(d for d in docs2 if d["kind"] == "StatefulSet")
+    assert sts2["spec"]["template"]["spec"]["containers"][0]["image"] == "custom:1"
+
+    mdocs = render_model(Model(name="m1", spec={
+        "model": "org/m", "source": {"huggingface": {}}}))
+    job = next(d for d in mdocs if d["kind"] == "Job")
+    assert (job["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "reg.io/scripts:v9")

@@ -315,3 +315,44 @@ def test_live_unified_to_legacy_cleans_unit_podgroup(live):
     # Legacy per-group PodGroups take its place.
     wait_for(lambda: api.get("scheduling.x-k8s.io/v1alpha1", "podgroups",
                              "default", "arks-sw-prefill-0"))
+
+
+def test_live_disagg_router_service_discovery(live):
+    """Live-mode routers discover tier pods by label selector: the router
+    gangset command carries --service-discovery, its pods bind the
+    bootstrap ServiceAccount (Role/RoleBinding created like the reference's
+    sglang-router RBAC), and tier pods carry the application/component
+    labels the selector matches."""
+    api, op = live
+    api.create(GV, "arksmodels", "default",
+               _cr("ArksModel", "m1", {"model": "org/m"}))
+    api.create(GV, "arksdisaggregatedapplications", "default", _cr(
+        "ArksDisaggregatedApplication", "sd1", {
+            "runtime": "jax", "model": {"name": "m1"},
+            "servedModelName": "sd-served", "modelConfig": "tiny",
+            "prefill": {"replicas": 1}, "decode": {"replicas": 1},
+            "router": {"replicas": 1},
+        }))
+    router_sts = wait_for(lambda: api.get(
+        "apps/v1", "statefulsets", "default", "arks-sd1-router-0"))
+    tmpl = router_sts["spec"]["template"]
+    c = tmpl["spec"]["containers"][0]
+    args = c.get("command", []) + c.get("args", [])
+    assert "--service-discovery" in args
+    assert "--application" in args and "sd1" in args
+    assert "--discovery-file" not in args
+    assert tmpl["spec"]["serviceAccountName"] == "arks-sd1-router"
+    # RBAC bootstrap (reference :530-596).
+    assert api.get("v1", "serviceaccounts", "default", "arks-sd1-router")
+    role = api.get("rbac.authorization.k8s.io/v1", "roles", "default",
+                   "arks-sd1-router")
+    assert {"pods"} == set(role["rules"][0]["resources"])
+    assert api.get("rbac.authorization.k8s.io/v1", "rolebindings",
+                   "default", "arks-sd1-router")
+    # Tier pods carry the labels KubeDiscovery selects on.
+    for tier in ("prefill", "decode"):
+        sts = api.get("apps/v1", "statefulsets", "default",
+                      f"arks-sd1-{tier}-0")
+        labels = sts["spec"]["template"]["metadata"]["labels"]
+        assert labels["arks.ai/application"] == "sd1"
+        assert labels["arks.ai/component"] == tier

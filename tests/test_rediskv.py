@@ -186,3 +186,122 @@ def test_two_gateways_share_rate_limit(resp):
     finally:
         gw1.stop()
         gw2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cluster + sentinel topologies (reference cmd/gateway/main.go:137-170)
+# ---------------------------------------------------------------------------
+
+
+def test_key_slot_crc16_and_hashtags():
+    from arks_tpu.gateway.rediskv import key_slot
+
+    # Known CRC16-XMODEM vectors from the Redis Cluster spec.
+    assert key_slot("123456789") == 0x31C3 % 16384
+    assert key_slot("{user1000}.following") == key_slot("{user1000}.followers")
+    assert key_slot("foo{}{bar}") == key_slot("foo{}{bar}")  # empty tag: whole key
+
+
+def test_cluster_client_follows_moved_redirects():
+    from arks_tpu.gateway.rediskv import (
+        RespClusterClient, RespServer, key_slot)
+
+    a, b = RespServer(), RespServer()
+    a.start()
+    b.start()
+    try:
+        key = "arks:quota:namespace=d:quotaname=q:type=total"
+        # Node A disowns the key's slot and points at B.
+        a.moved_slots[key_slot(key)] = f"127.0.0.1:{b.port}"
+        client = RespClusterClient([("127.0.0.1", a.port)])
+        client.command("SET", key, 41)
+        assert int(client.command("INCRBY", key, 1)) == 42
+        # The MOVED mapping stuck: the value lives on B only.
+        from arks_tpu.gateway.rediskv import RespClient
+        direct_b = RespClient("127.0.0.1", b.port)
+        assert direct_b.command("GET", key) == b"42"
+        direct_a_val = None  # A never stored it (it redirected)
+        client.close()
+        direct_b.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_cluster_backend_parity_with_single():
+    """The rate-limit/quota backends behave identically over a cluster
+    client with redirects and over a single-node client."""
+    from arks_tpu.gateway.rediskv import (
+        RedisCounterBackend, RespClient, RespClusterClient, RespServer,
+        key_slot)
+
+    a, b = RespServer(), RespServer()
+    a.start()
+    b.start()
+    try:
+        key = "arks:rl:ns=d:user=u:model=m:rpm:12345"
+        a.moved_slots[key_slot(key)] = f"127.0.0.1:{b.port}"
+        cluster = RedisCounterBackend(RespClusterClient([("127.0.0.1", a.port)]))
+        single_srv = RespServer()
+        single_srv.start()
+        single = RedisCounterBackend(
+            RespClient("127.0.0.1", single_srv.port))
+        for backend in (cluster, single):
+            assert backend.get(key) == 0
+            assert backend.incr(key, 3, ttl_s=60) == 3
+            assert backend.incr(key, 2, ttl_s=60) == 5
+            assert backend.get(key) == 5
+        single_srv.stop()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_sentinel_client_resolves_and_refollows_master():
+    from arks_tpu.gateway.rediskv import (
+        RespServer, SentinelRespClient)
+
+    master1, master2, sentinel = RespServer(), RespServer(), RespServer()
+    for s in (master1, master2, sentinel):
+        s.start()
+    try:
+        sentinel.sentinel_masters["mymaster"] = ("127.0.0.1", master1.port)
+        client = SentinelRespClient([("127.0.0.1", sentinel.port)],
+                                    "mymaster")
+        client.command("SET", "k", "v1")
+        assert client.command("GET", "k") == b"v1"
+        # Failover: sentinel now points at master2; killing master1 forces
+        # a reconnect, which re-resolves through the sentinel.
+        sentinel.sentinel_masters["mymaster"] = ("127.0.0.1", master2.port)
+        master1.stop()
+        client.command("SET", "k", "v2")
+        assert client.command("GET", "k") == b"v2"
+        client.close()
+    finally:
+        for s in (master2, sentinel):
+            s.stop()
+
+
+def test_make_resp_client_topology_selection():
+    from arks_tpu.gateway.rediskv import (
+        RespClient, RespClusterClient, RespServer, SentinelRespClient,
+        make_resp_client)
+
+    a, b = RespServer(), RespServer()
+    a.start()
+    b.start()
+    try:
+        single = make_resp_client(f"127.0.0.1:{a.port}")
+        assert type(single) is RespClient
+        cluster = make_resp_client(
+            f"127.0.0.1:{a.port},127.0.0.1:{b.port}")
+        assert type(cluster) is RespClusterClient
+        a.sentinel_masters["m"] = ("127.0.0.1", b.port)
+        sent = make_resp_client(f"127.0.0.1:{a.port}", sentinel_master="m")
+        assert type(sent) is SentinelRespClient
+        assert (sent.host, sent.port) == ("127.0.0.1", b.port)
+        for c in (single, cluster, sent):
+            c.close()
+    finally:
+        a.stop()
+        b.stop()
