@@ -21,7 +21,9 @@ import logging
 import os
 import ssl
 import threading
+import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 log = logging.getLogger("arks_tpu.control.k8s")
@@ -149,6 +151,31 @@ class KubeApi:
             if e.status != 404:
                 raise
 
+    def watch(self, gv: str, plural: str, namespace: str | None = None,
+              since_rv: int = 0, timeout_s: float = 30.0):
+        """Stream watch events ({'type', 'object'} dicts) from
+        ``?watch=1`` until the server closes the window (apiserver
+        timeoutSeconds semantics).  410 = resourceVersion too old, caller
+        must relist."""
+        path = self._obj_path(gv, plural, namespace)
+        qs = urllib.parse.urlencode({
+            "watch": "1", "resourceVersion": str(since_rv),
+            "timeoutSeconds": str(int(timeout_s)),
+        })
+        req = urllib.request.Request(self.base_url + path + "?" + qs)
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s + 10,
+                                        context=self._ctx) as r:
+                for line in r:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")[:500])
+
 
 # ---------------------------------------------------------------------------
 # Fake apiserver (tests + local dry runs)
@@ -175,11 +202,16 @@ class FakeKubeApi:
     that only touches .status.  Records (verb, path) tuples in ``actions``.
     """
 
+    _EVENT_WINDOW = 4096  # watch history; older resourceVersions get 410
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
         # (gv, plural, namespace, name) -> obj dict
         self._objs: dict[tuple, dict] = {}
         self._rv = 0
+        # Watch event log: (rv, type, key, obj snapshot), bounded window.
+        self._events: list[tuple[int, str, tuple, dict]] = []
         self.actions: list[tuple[str, str]] = []
 
     def _key(self, gv, plural, namespace, name):
@@ -189,15 +221,55 @@ class FakeKubeApi:
         self._rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
 
+    def _emit_event(self, typ: str, key: tuple, obj: dict) -> None:
+        """Record a watch event (caller holds the lock, obj already
+        bumped — DELETED events carry the last seen object)."""
+        self._events.append((self._rv, typ, key, json.loads(json.dumps(obj))))
+        if len(self._events) > self._EVENT_WINDOW:
+            del self._events[: len(self._events) - self._EVENT_WINDOW]
+        self._cond.notify_all()
+
     def _record(self, verb, gv, plural, namespace, name=""):
         self.actions.append((verb, f"{gv}/{plural}/{namespace or ''}/{name}"))
 
     def list(self, gv, plural, namespace=None) -> list[dict]:
         with self._lock:
+            self._record("list", gv, plural, namespace)
             return [json.loads(json.dumps(o)) for (g, p, ns, _), o
                     in sorted(self._objs.items())
                     if g == gv and p == plural
                     and (namespace is None or ns == namespace)]
+
+    def watch(self, gv, plural, namespace=None, since_rv=0,
+              timeout_s: float = 30.0):
+        """Yield {'type', 'object'} events newer than ``since_rv`` until
+        ``timeout_s`` passes with nothing new (apiserver watch semantics;
+        the caller reopens with the last seen resourceVersion).  Raises
+        410 when ``since_rv`` predates the retained window — the caller
+        must relist."""
+        with self._lock:
+            self._record("watch", gv, plural, namespace)
+        last = int(since_rv)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._cond:
+                if (last and self._events
+                        and last < self._events[0][0] - 1):
+                    raise ApiError(410, "resourceVersion too old")
+                batch = [
+                    (rv, typ, obj) for rv, typ, (g, p, ns, _), obj
+                    in self._events
+                    if rv > last and g == gv and p == plural
+                    and (namespace is None or ns == namespace)]
+                if not batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._cond.wait(remaining)
+                    continue
+            for rv, typ, obj in batch:
+                last = max(last, rv)
+                yield {"type": typ, "object": obj}
 
     def get(self, gv, plural, namespace, name) -> dict | None:
         with self._lock:
@@ -215,6 +287,7 @@ class FakeKubeApi:
             self._bump(stored)
             self._objs[key] = stored
             self._record("create", gv, plural, namespace, name)
+            self._emit_event("ADDED", key, stored)
             return json.loads(json.dumps(stored))
 
     def patch(self, gv, plural, namespace, name, patch, subresource=None) -> dict:
@@ -239,6 +312,7 @@ class FakeKubeApi:
             self._bump(obj)
             self._record(f"patch{':' + subresource if subresource else ''}",
                          gv, plural, namespace, name)
+            self._emit_event("MODIFIED", key, obj)
             self._maybe_finish_delete(key)
             return json.loads(json.dumps(self._objs[key])) \
                 if key in self._objs else {}
@@ -264,6 +338,7 @@ class FakeKubeApi:
             self._bump(stored)
             self._objs[key] = stored
             self._record("replace", gv, plural, namespace, name)
+            self._emit_event("MODIFIED", key, stored)
             return json.loads(json.dumps(stored))
 
     def delete(self, gv, plural, namespace, name) -> None:
@@ -276,13 +351,21 @@ class FakeKubeApi:
             if obj["metadata"].get("finalizers"):
                 obj["metadata"]["deletionTimestamp"] = "now"
                 self._bump(obj)
+                self._emit_event("MODIFIED", key, obj)
             else:
+                # Stamp the deletion's OWN resourceVersion on the event
+                # object — watchers resume from the event object's rv, and
+                # a stale rv would redeliver the DELETED event forever.
+                self._bump(obj)
+                self._emit_event("DELETED", key, obj)
                 del self._objs[key]
 
     def _maybe_finish_delete(self, key) -> None:
         obj = self._objs.get(key)
         if (obj is not None and obj["metadata"].get("deletionTimestamp")
                 and not obj["metadata"].get("finalizers")):
+            self._bump(obj)
+            self._emit_event("DELETED", key, obj)
             del self._objs[key]
 
 
@@ -332,6 +415,11 @@ class FakeApiServer:
                     parsed = server._parse(self.path)
                 except ValueError as e:
                     return self._send(400, {"message": str(e)})
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query)
+                if (method == "GET" and query.get("watch", ["0"])[0] == "1"
+                        and parsed[3] is None):
+                    return self._stream_watch(parsed, query)
                 try:
                     code, payload = server._dispatch(method, *parsed,
                                                      body=self._body()
@@ -340,6 +428,55 @@ class FakeApiServer:
                 except ApiError as e:
                     return self._send(e.status, {"message": str(e)})
                 self._send(code, payload)
+
+            def _stream_watch(self, parsed, query) -> None:
+                """apiserver watch semantics: chunked JSON lines of
+                {'type', 'object'} events, held open until timeoutSeconds."""
+                gv, plural, namespace, _, _ = parsed
+                since = int(query.get("resourceVersion", ["0"])[0] or 0)
+                timeout = float(query.get("timeoutSeconds", ["30"])[0])
+                events = server.fake.watch(gv, plural, namespace,
+                                           since_rv=since,
+                                           timeout_s=timeout)
+                # Pull the FIRST event (or the 410) before committing to a
+                # 200 — the generator only validates since_rv lazily, and
+                # an error after send_response would corrupt the chunk
+                # stream with a second status line.
+                try:
+                    first = next(events, None)
+                except ApiError as e:
+                    return self._send(e.status, {"message": str(e)})
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def emit(ev) -> None:
+                        data = json.dumps(ev).encode() + b"\n"
+                        self.wfile.write(f"{len(data):x}\r\n".encode()
+                                         + data + b"\r\n")
+                        self.wfile.flush()
+
+                    if first is not None:
+                        emit(first)
+                        for ev in events:
+                            emit(ev)
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except ApiError as e:
+                    # Mid-stream expiry: apiserver semantics — an ERROR
+                    # event in the 200 stream, never a second status line.
+                    try:
+                        emit({"type": "ERROR",
+                              "object": {"code": e.status,
+                                         "message": str(e)}})
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
             def do_GET(self):
                 self._route("GET")

@@ -287,6 +287,30 @@ def _model_storage(model: Model | None, namespace: str,
 # ---------------------------------------------------------------------------
 
 
+def render_router_rbac(app_name: str, namespace: str,
+                       labels: dict | None = None) -> list[dict]:
+    """The disaggregated router's pod-discovery RBAC triple — ONE source
+    for the gitops render and the live driver's create-if-absent bootstrap
+    (reference sglang-router RBAC,
+    arksdisaggregatedapplication_controller.go:530-596)."""
+    name = f"arks-{app_name}-router"
+    labels = labels or {LABEL_APPLICATION: app_name}
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": _meta(name, namespace, labels)},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+         "metadata": _meta(name, namespace, labels),
+         "rules": [{"apiGroups": [""], "resources": ["pods"],
+                    "verbs": ["get", "list", "watch"]}]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+         "metadata": _meta(name, namespace, labels),
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "Role", "name": name},
+         "subjects": [{"kind": "ServiceAccount", "name": name,
+                       "namespace": namespace}]},
+    ]
+
+
 def render_model(model: Model, scripts_image: str | None = None) -> list[dict]:
     if scripts_image is None:
         scripts_image = _scripts_image()
@@ -432,24 +456,16 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
                                     spec.get("podGroupPolicy"))
     extra_labels = {**il, **pl}
     extra_annotations = {**ia, **pa}
-    if revision is None:
-        # Group-independent: hash BEFORE substituting the group name (it
-        # feeds the coordinator address/subdomain; pod-group markers are
-        # group-NAMED, so hash the policy input rather than the stamped
-        # label value).  Specs without the new fields keep the legacy hash
-        # input — an operator upgrade must not re-revision (and roll) every
-        # unchanged gang in the fleet.
-        if il or ia or spec.get("podGroupPolicy"):
-            revision = stable_hash((pod, il, ia, spec.get("podGroupPolicy")))
-        else:
-            revision = stable_hash(pod)
-    pod = json.loads(json.dumps(pod).replace("$(GROUP)", group))
 
     # Application/component labels on the TEMPLATE (not the immutable
-    # selector, and deliberately outside the revision hash — adding them
-    # must not re-roll existing fleets): the disaggregated router's
-    # label-selector pod discovery (router.KubeDiscovery) finds tier pods
-    # by arks.ai/application + arks.ai/component.
+    # selector): the disaggregated router's label-selector pod discovery
+    # (router.KubeDiscovery) finds tier pods by arks.ai/application +
+    # arks.ai/component.  For DISAGG gangs (spec.role set) they join the
+    # revision hash — an upgraded live operator must roll pre-existing
+    # tier fleets exactly once so their pods become discoverable (without
+    # labels the router would see no backends, and live-mode router
+    # gangsets carry no env fallback).  Standalone gangs keep them out of
+    # the hash — purely informational there, no re-roll on upgrade.
     app_label = (gs.labels or {}).get(LABEL_APPLICATION)
     role_label = (gs.labels or {}).get("arks.ai/role") or spec.get("role")
     discovery_labels = {}
@@ -457,6 +473,23 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
         discovery_labels[LABEL_APPLICATION] = app_label
     if role_label:
         discovery_labels[LABEL_COMPONENT] = role_label
+
+    if revision is None:
+        # Group-independent: hash BEFORE substituting the group name (it
+        # feeds the coordinator address/subdomain; pod-group markers are
+        # group-NAMED, so hash the policy input rather than the stamped
+        # label value).  Specs without the new fields keep the legacy hash
+        # input — an operator upgrade must not re-revision (and roll) every
+        # unchanged STANDALONE gang in the fleet.
+        hash_labels = discovery_labels if spec.get("role") else None
+        if hash_labels:
+            revision = stable_hash((pod, il, ia,
+                                    spec.get("podGroupPolicy"), hash_labels))
+        elif il or ia or spec.get("podGroupPolicy"):
+            revision = stable_hash((pod, il, ia, spec.get("podGroupPolicy")))
+        else:
+            revision = stable_hash(pod)
+    pod = json.loads(json.dumps(pod).replace("$(GROUP)", group))
 
     sts = {
         "apiVersion": "apps/v1",
@@ -758,24 +791,7 @@ def render_disaggregated(dapp: DisaggregatedApplication,
     # Service addresses stay as env FALLBACK for the bootstrap window
     # before the first pod list succeeds.
     sa_name = f"arks-{dapp.name}-router"
-    docs.append({
-        "apiVersion": "v1", "kind": "ServiceAccount",
-        "metadata": _meta(sa_name, dapp.namespace, rlabels),
-    })
-    docs.append({
-        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
-        "metadata": _meta(sa_name, dapp.namespace, rlabels),
-        "rules": [{"apiGroups": [""], "resources": ["pods"],
-                   "verbs": ["get", "list", "watch"]}],
-    })
-    docs.append({
-        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
-        "metadata": _meta(sa_name, dapp.namespace, rlabels),
-        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
-                    "kind": "Role", "name": sa_name},
-        "subjects": [{"kind": "ServiceAccount", "name": sa_name,
-                      "namespace": dapp.namespace}],
-    })
+    docs.extend(render_router_rbac(dapp.name, dapp.namespace, rlabels))
     rcontainer = {
         "name": "router",
         "image": router.get("image") or _default_image(),

@@ -20,9 +20,12 @@ around this repo's existing controllers:
   the local drivers use, and reads group readiness back from StatefulSet
   status.
 
-Polling model: list-based resync every ``interval_s`` (the watch-stream
-upgrade is an optimization, not a correctness need — controllers are
-level-triggered).
+Ingest model: WATCH streams per kind with resourceVersion resume (the
+reference is watch-driven controller-runtime, cmd/main.go:255-301) —
+spec changes propagate at event latency with O(1) apiserver requests per
+change.  A periodic full list resync stays as the level-triggered safety
+net, and pure polling remains available (``use_watch=False`` or an api
+without ``.watch``).
 """
 
 from __future__ import annotations
@@ -79,9 +82,31 @@ class K8sGangDriver:
     routers never depend on the operator's filesystem.
     """
 
-    def __init__(self, api, serve_port: int = 8080):
+    def __init__(self, api, serve_port: int = 8080,
+                 sts_cache_ttl_s: float = 0.5):
         self.api = api
         self.serve_port = serve_port
+        # One reconcile tick touches MANY gangsets; each used to pay its
+        # own full StatefulSet list.  A short-TTL per-namespace cache
+        # batches them into one list per tick (writes invalidate).
+        self._sts_cache_ttl = sts_cache_ttl_s
+        self._sts_cache: dict[str, tuple[float, list[dict]]] = {}
+        self._sts_cache_lock = threading.Lock()
+
+    def _list_statefulsets(self, namespace: str) -> list[dict]:
+        now = time.monotonic()
+        with self._sts_cache_lock:
+            hit = self._sts_cache.get(namespace)
+            if hit and now - hit[0] < self._sts_cache_ttl:
+                return hit[1]
+        items = self.api.list("apps/v1", "statefulsets", namespace)
+        with self._sts_cache_lock:
+            self._sts_cache[namespace] = (now, items)
+        return items
+
+    def _invalidate_sts_cache(self, namespace: str) -> None:
+        with self._sts_cache_lock:
+            self._sts_cache.pop(namespace, None)
 
     def _render(self, gs, index: int) -> tuple[dict, dict]:
         from arks_tpu.control.k8s_export import render_group_from_gangset
@@ -95,7 +120,7 @@ class K8sGangDriver:
 
     def _existing(self, gs) -> dict[int, dict]:
         out = {}
-        for sts in self.api.list("apps/v1", "statefulsets", gs.namespace):
+        for sts in self._list_statefulsets(gs.namespace):
             labels = sts["metadata"].get("labels", {})
             if labels.get("arks.ai/gangset") == gs.name:
                 out[int(labels.get("arks.ai/group", -1))] = sts
@@ -114,38 +139,26 @@ class K8sGangDriver:
         # exactly one ready pod.
         return sts.get("status", {}).get("readyReplicas", 0) >= 1
 
+    _RBAC_PLURALS = {"ServiceAccount": ("v1", "serviceaccounts"),
+                     "Role": ("rbac.authorization.k8s.io/v1", "roles"),
+                     "RoleBinding": ("rbac.authorization.k8s.io/v1",
+                                     "rolebindings")}
+
     def _ensure_router_rbac(self, gs) -> None:
         """Router gangs list tier pods by label selector: bootstrap the
-        per-app ServiceAccount/Role/RoleBinding (create-if-absent), the
-        reference's sglang-router RBAC
-        (arksdisaggregatedapplication_controller.go:530-596)."""
+        per-app ServiceAccount/Role/RoleBinding (create-if-absent) from
+        the SAME render the gitops path uses (k8s_export.render_router_rbac
+        — one source, no drift)."""
+        from arks_tpu.control.k8s_export import render_router_rbac
         from arks_tpu.control.resources import LABEL_APPLICATION
         app = (gs.labels or {}).get(LABEL_APPLICATION)
         if gs.spec.get("role") != "router" or not app:
             return
-        name = f"arks-{app}-router"
-        meta = {"name": name, "namespace": gs.namespace,
-                "labels": {LABEL_APPLICATION: app}}
-        objs = [
-            ("v1", "serviceaccounts",
-             {"apiVersion": "v1", "kind": "ServiceAccount",
-              "metadata": dict(meta)}),
-            ("rbac.authorization.k8s.io/v1", "roles",
-             {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
-              "metadata": dict(meta),
-              "rules": [{"apiGroups": [""], "resources": ["pods"],
-                         "verbs": ["get", "list", "watch"]}]}),
-            ("rbac.authorization.k8s.io/v1", "rolebindings",
-             {"apiVersion": "rbac.authorization.k8s.io/v1",
-              "kind": "RoleBinding", "metadata": dict(meta),
-              "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
-                          "kind": "Role", "name": name},
-              "subjects": [{"kind": "ServiceAccount", "name": name,
-                            "namespace": gs.namespace}]}),
-        ]
-        for gv, plural, obj in objs:
+        for doc in render_router_rbac(app, gs.namespace):
+            gv, plural = self._RBAC_PLURALS[doc["kind"]]
+            name = doc["metadata"]["name"]
             if self.api.get(gv, plural, gs.namespace, name) is None:
-                self.api.create(gv, plural, gs.namespace, obj)
+                self.api.create(gv, plural, gs.namespace, doc)
 
     def ensure(self, gs) -> None:
         existing = self._existing(gs)
@@ -165,11 +178,13 @@ class K8sGangDriver:
             self._ensure_podgroup(gs, i, name, converge_target=(i == 0))
             if i not in existing:
                 self.api.create("apps/v1", "statefulsets", gs.namespace, sts)
+                self._invalidate_sts_cache(gs.namespace)
         # Scale down (the group's PodGroups go with it, whatever flavor).
         for i, sts in existing.items():
             if i >= replicas:
                 name = sts["metadata"]["name"]
                 self.api.delete("apps/v1", "statefulsets", gs.namespace, name)
+                self._invalidate_sts_cache(gs.namespace)
                 self.api.delete("v1", "services", gs.namespace, name)
                 for gv in PODGROUP_FLAVORS:
                     self.api.delete(gv, "podgroups", gs.namespace, name)
@@ -195,6 +210,7 @@ class K8sGangDriver:
                     cur["metadata"].get("resourceVersion", ""))
                 self.api.replace("apps/v1", "statefulsets", gs.namespace,
                                  name, desired)
+                self._invalidate_sts_cache(gs.namespace)
 
     @staticmethod
     def _unit_name(gs) -> str | None:
@@ -273,6 +289,7 @@ class K8sGangDriver:
         for i, sts in self._existing(gs).items():
             name = sts["metadata"]["name"]
             self.api.delete("apps/v1", "statefulsets", gs.namespace, name)
+            self._invalidate_sts_cache(gs.namespace)
             self.api.delete("v1", "services", gs.namespace, name)
             # Unconditional: a policy REMOVED from the spec must not orphan
             # PodGroups created under the old spec.
@@ -296,11 +313,22 @@ class LiveOperator:
     """Runs the existing controller set against a real apiserver."""
 
     def __init__(self, api, models_root: str, interval_s: float = 1.0,
-                 serve_port: int = 8080):
+                 serve_port: int = 8080, use_watch: bool = True,
+                 resync_interval_s: float | None = None):
         from arks_tpu.control.manager import build_manager
 
         self.api = api
         self.interval_s = interval_s
+        # Watch-driven ingest (the reference is watch-driven controller-
+        # runtime, cmd/main.go:255-301): spec changes propagate at event
+        # latency instead of poll latency, and apiserver load per change is
+        # O(1) instead of O(cluster size x poll rate).  A periodic full
+        # resync (list) remains the level-triggered safety net, and poll
+        # mode stays available for api objects without watch support.
+        self.use_watch = use_watch and hasattr(api, "watch")
+        self.resync_interval_s = (resync_interval_s
+                                  if resync_interval_s is not None
+                                  else max(interval_s * 30, 15.0))
         self.store = Store()
         self.driver = K8sGangDriver(api, serve_port=serve_port)
         # Live-mode routers run as cluster pods: they discover
@@ -311,9 +339,13 @@ class LiveOperator:
                                      router_discovery="kubernetes")
         self._running = False
         self._thread: threading.Thread | None = None
+        self._watchers: list[threading.Thread] = []
         # Last status we projected per (plural, ns, name) — avoids writing
         # an unchanged status every poll.
         self._projected: dict[tuple, dict] = {}
+        # CRs with a deletionTimestamp whose store teardown is in flight.
+        self._deleting: set[tuple] = set()
+        self._deleting_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -323,6 +355,13 @@ class LiveOperator:
         self._thread = threading.Thread(target=self._loop, name="live-sync",
                                         daemon=True)
         self._thread.start()
+        if self.use_watch:
+            for kind, plural, wire_kind in KINDS:
+                t = threading.Thread(
+                    target=self._watch_loop, args=(kind, plural),
+                    name=f"live-watch-{plural}", daemon=True)
+                t.start()
+                self._watchers.append(t)
 
     def stop(self) -> None:
         self._running = False
@@ -331,12 +370,111 @@ class LiveOperator:
         self.manager.stop()
 
     def _loop(self) -> None:
+        next_resync = 0.0
         while self._running:
             try:
-                self.sync_once()
+                if not self.use_watch or time.monotonic() >= next_resync:
+                    # Full level-triggered pass (poll mode: every tick;
+                    # watch mode: periodic safety net).
+                    self.sync_once()
+                    next_resync = time.monotonic() + self.resync_interval_s
+                else:
+                    # Between resyncs the apiserver work is store-driven:
+                    # project changed statuses, finish in-flight deletions.
+                    self._project_all()
+                    self._finish_deletions()
             except Exception:
                 log.exception("live sync iteration failed")
             time.sleep(self.interval_s)
+
+    # -- watch path ----------------------------------------------------
+
+    def _watch_loop(self, kind, plural) -> None:
+        rv = 0
+        while self._running:
+            try:
+                for ev in self.api.watch(GV, plural, since_rv=rv,
+                                         timeout_s=max(self.interval_s * 5,
+                                                       5.0)):
+                    obj = ev.get("object") or {}
+                    if ev.get("type") == "ERROR":
+                        # Real apiservers deliver expiry as an ERROR event
+                        # inside a 200 stream (Status code 410), not as an
+                        # HTTP error — route it to the relist branch below
+                        # instead of spinning on the stale resourceVersion.
+                        from arks_tpu.control.k8s_client import ApiError
+                        raise ApiError(int(obj.get("code", 500)),
+                                       obj.get("message", "watch error"))
+                    meta = obj.get("metadata", {})
+                    try:
+                        rv = max(rv, int(meta.get("resourceVersion", 0)))
+                    except (TypeError, ValueError):
+                        pass
+                    self._handle_event(kind, plural, ev.get("type"), obj)
+                    if not self._running:
+                        return
+            except Exception as e:
+                status = getattr(e, "status", None)
+                if status == 410:
+                    # Fell off the event window: relist from scratch.
+                    rv = 0
+                    try:
+                        self.sync_once()
+                    except Exception:
+                        log.exception("post-410 resync failed")
+                else:
+                    log.warning("watch %s failed; retrying", plural,
+                                exc_info=True)
+                    time.sleep(self.interval_s)
+
+    def _handle_event(self, kind, plural, typ: str | None, cr: dict) -> None:
+        meta = cr.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        name = meta.get("name")
+        if not name:
+            return
+        if typ == "DELETED":
+            # Force-removed (finalizer bypassed): tear down the store side.
+            try:
+                self.store.delete(kind, name, ns)
+            except NotFound:
+                pass
+            with self._deleting_lock:
+                self._deleting.discard((kind, plural, ns, name))
+            return
+        if meta.get("deletionTimestamp"):
+            with self._deleting_lock:
+                self._deleting.add((kind, plural, ns, name))
+            self._handle_cr_deletion(kind, plural, ns, name)
+            return
+        self._ensure_finalizer(plural, ns, name, meta)
+        self._ingest(kind, cr, ns, name)
+
+    def _project_all(self) -> None:
+        for kind, plural, _ in KINDS:
+            for obj in self.store.list(kind):
+                try:
+                    self._project_status(kind, plural, obj.namespace,
+                                         obj.name)
+                except Exception:
+                    log.exception("status projection failed for %s/%s",
+                                  plural, obj.name)
+
+    def _finish_deletions(self) -> None:
+        with self._deleting_lock:
+            pending = list(self._deleting)
+        for key in pending:
+            kind, plural, ns, name = key
+            try:
+                cr = self.api.get(GV, plural, ns, name)
+                if cr is None:
+                    with self._deleting_lock:
+                        self._deleting.discard(key)
+                    continue
+                self._handle_cr_deletion(kind, plural, ns, name)
+            except Exception:
+                log.exception("deletion finalization failed for %s/%s",
+                              plural, name)
 
     # -- one sync pass -------------------------------------------------
 
@@ -358,7 +496,7 @@ class LiveOperator:
                     continue
                 self._ensure_finalizer(plural, ns, name, meta)
                 self._ingest(kind, cr, ns, name)
-                self._project_status(kind, plural, ns, name, cr)
+                self._project_status(kind, plural, ns, name)
             # CRs force-removed from the apiserver (finalizer bypassed)
             # still tear down their store objects.
             for obj in self.manager.store.list(kind):
@@ -389,7 +527,7 @@ class LiveOperator:
             except Conflict:
                 pass  # next poll retries against the fresh object
 
-    def _project_status(self, kind, plural, ns, name, cr: dict) -> None:
+    def _project_status(self, kind, plural, ns, name) -> None:
         obj = self.store.try_get(kind, name, ns)
         if obj is None or not obj.status:
             return

@@ -250,7 +250,14 @@ class Gateway:
                     return self._error(404, f"no route {self.path}")
                 gw._handle_inference(self)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class Server(ThreadingHTTPServer):
+            # Absorb connection bursts (hundreds of concurrent clients
+            # reconnecting at once): the default backlog of 5 makes the
+            # kernel RST the overflow (measured in tools/bench_gateway.py).
+            request_queue_size = 512
+            daemon_threads = True
+
+        self._httpd = Server((self.host, self.port), Handler)
         self.port = self._httpd.server_port
         self.syncer.start()
         if background:

@@ -356,3 +356,62 @@ def test_live_disagg_router_service_discovery(live):
         labels = sts["spec"]["template"]["metadata"]["labels"]
         assert labels["arks.ai/application"] == "sd1"
         assert labels["arks.ai/component"] == tier
+
+
+def test_watch_driven_propagation_and_bounded_requests():
+    """VERDICT (round-2 item 6): watch streams drive ingest — a CR change
+    propagates in well under the resync interval, with a BOUNDED number of
+    apiserver requests per change (no per-tick full relists)."""
+    api = FakeKubeApi()
+    # Long intervals: if propagation relied on polling/resync, this test
+    # would time out; only the watch path can deliver the spec in time.
+    op = LiveOperator(api, models_root="/tmp/watch-models", interval_s=0.2,
+                      resync_interval_s=3600.0)
+    op.start()
+    try:
+        assert op.use_watch
+        time.sleep(0.5)  # initial resync done; watchers armed
+        api.create(GV, "arksmodels", "default",
+                   _cr("ArksModel", "wm1", {"model": "org/m",
+                                            "source": None}))
+        t0 = time.monotonic()
+        wait_for(lambda: op.store.try_get(
+            __import__("arks_tpu.control.resources",
+                       fromlist=["Model"]).Model, "wm1"), timeout=5)
+        assert time.monotonic() - t0 < 2.0  # event latency, not resync
+        # Spec UPDATE also rides the watch.
+        api.patch(GV, "arksmodels", "default", "wm1",
+                  {"spec": {"model": "org/m2"}})
+        wait_for(lambda: op.store.get(
+            __import__("arks_tpu.control.resources",
+                       fromlist=["Model"]).Model, "wm1")
+            .spec.get("model") == "org/m2", timeout=5)
+
+        # Bounded request count: between changes, the operator must not
+        # hammer the apiserver with full relists.  Allow status writes and
+        # the pending watch re-opens; assert LISTS stay flat.
+        time.sleep(0.5)
+        lists_before = sum(1 for v, _ in api.actions if v == "list")
+        time.sleep(2.0)
+        lists_after = sum(1 for v, _ in api.actions if v == "list")
+        assert lists_after - lists_before <= 2, (
+            f"{lists_after - lists_before} lists in 2s of idle watch mode")
+    finally:
+        op.stop()
+
+
+def test_poll_mode_still_works_without_watch():
+    """APIs without watch support (use_watch=False) keep the old polling
+    behavior end to end."""
+    api = FakeKubeApi()
+    op = LiveOperator(api, models_root="/tmp/poll-models", interval_s=0.1,
+                      use_watch=False)
+    op.start()
+    try:
+        assert not op.use_watch
+        api.create(GV, "arksmodels", "default",
+                   _cr("ArksModel", "pm1", {"model": "org/m"}))
+        from arks_tpu.control.resources import Model
+        wait_for(lambda: op.store.try_get(Model, "pm1"), timeout=5)
+    finally:
+        op.stop()

@@ -199,7 +199,11 @@ def test_key_slot_crc16_and_hashtags():
     # Known CRC16-XMODEM vectors from the Redis Cluster spec.
     assert key_slot("123456789") == 0x31C3 % 16384
     assert key_slot("{user1000}.following") == key_slot("{user1000}.followers")
-    assert key_slot("foo{}{bar}") == key_slot("foo{}{bar}")  # empty tag: whole key
+    # Empty first tag => the WHOLE key hashes (spec rule), so the later
+    # {bar} tag must NOT be used.
+    from arks_tpu.gateway.rediskv import _crc16
+    assert key_slot("foo{}{bar}") == _crc16(b"foo{}{bar}") % 16384
+    assert key_slot("foo{}{bar}") != key_slot("bar")
 
 
 def test_cluster_client_follows_moved_redirects():

@@ -1,0 +1,181 @@
+"""Gateway data-plane load test: N-hundred concurrent SSE streams.
+
+The reference fronts with Envoy (a C++ event loop); this gateway is a
+threaded Python proxy with a native usage scanner.  This harness measures
+what that is actually good for: aggregate streamed frames/s and per-frame
+relay overhead at high concurrency, gateway vs DIRECT-to-backend, using a
+synthetic SSE backend so the numbers isolate the PROXY (no model time).
+
+Usage: python tools/bench_gateway.py [--streams 200] [--frames 50]
+Prints one JSON line; paste results into docs/monitoring.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_backend(frames: int, frame_interval_s: float, body_bytes: int):
+    """Synthetic OpenAI-ish SSE backend: ``frames`` data frames per
+    request, then a usage frame and [DONE]."""
+    filler = "x" * body_bytes
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def frame(obj):
+                data = b"data: " + json.dumps(obj).encode() + b"\n\n"
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+            for i in range(frames):
+                frame({"choices": [{"delta": {"content": filler}}]})
+                if frame_interval_s:
+                    time.sleep(frame_interval_s)
+            frame({"choices": [],
+                   "usage": {"prompt_tokens": 7, "completion_tokens": frames,
+                             "total_tokens": 7 + frames}})
+            data = b"data: [DONE]\n\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+    class Server(ThreadingHTTPServer):
+        request_queue_size = 512
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def run_load(url: str, path: str, streams: int, rounds: int,
+             headers: dict | None = None) -> dict:
+    import http.client
+
+    host, _, port = url.partition(":")
+    lock = threading.Lock()
+    stats = {"frames": 0, "streams": 0, "errors": 0, "ttfb": []}
+    body = json.dumps({"model": "lt", "stream": True,
+                       "stream_options": {"include_usage": True},
+                       "messages": [{"role": "user", "content": "load"}],
+                       }).encode()
+
+    def worker():
+        conn = http.client.HTTPConnection(host, int(port), timeout=120)
+        for _ in range(rounds):
+            try:
+                t0 = time.monotonic()
+                conn.request("POST", path, body=body, headers={
+                    "Content-Type": "application/json", **(headers or {})})
+                resp = conn.getresponse()
+                first = None
+                n = 0
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    if first is None:
+                        first = time.monotonic() - t0
+                    n += chunk.count(b"data: ")
+                with lock:
+                    stats["frames"] += n
+                    stats["streams"] += 1
+                    if first is not None:
+                        stats["ttfb"].append(first)
+            except Exception:
+                with lock:
+                    stats["errors"] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(host, int(port), timeout=120)
+        conn.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(streams)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    ttfb = sorted(stats["ttfb"])
+    return {
+        "streams_done": stats["streams"], "errors": stats["errors"],
+        "frames_per_s": round(stats["frames"] / wall, 1),
+        "streams_per_s": round(stats["streams"] / wall, 1),
+        "ttfb_p50_ms": round(ttfb[len(ttfb) // 2] * 1e3, 1) if ttfb else None,
+        "ttfb_p99_ms": round(ttfb[int(len(ttfb) * 0.99)] * 1e3, 1)
+        if ttfb else None,
+        "wall_s": round(wall, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--streams", type=int, default=200)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--frames", type=int, default=50)
+    ap.add_argument("--frame-interval-ms", type=float, default=0.0,
+                    help="per-frame backend pacing (0 = as fast as possible "
+                         "-> measures the relay ceiling)")
+    ap.add_argument("--frame-bytes", type=int, default=64)
+    args = ap.parse_args()
+
+    from arks_tpu.control import resources as res
+    from arks_tpu.control.store import Store
+    from arks_tpu.gateway.server import Gateway
+
+    backend = make_backend(args.frames, args.frame_interval_ms / 1e3,
+                           args.frame_bytes)
+    baddr = f"127.0.0.1:{backend.server_address[1]}"
+
+    store = Store()
+    ep = res.Endpoint(name="lt", spec={"defaultWeight": 1})
+    ep.status["routes"] = [{"backend": {"addresses": [baddr]}, "weight": 1}]
+    store.create(ep)
+    store.create(res.Token(name="lt-user", spec={
+        "token": "sk-lt",
+        "qos": [{"endpoint": {"name": "lt"},
+                 "rateLimits": [{"type": "rpm", "value": 10_000_000}]}]}))
+    gw = Gateway(store, host="127.0.0.1", port=0)
+    gw.start(background=True)
+
+    direct = run_load(baddr, "/v1/chat/completions", args.streams, args.rounds)
+    via_gw = run_load(f"127.0.0.1:{gw.port}", "/v1/chat/completions",
+                      args.streams, args.rounds,
+                      headers={"Authorization": "Bearer sk-lt"})
+    gw.stop()
+    overhead = (1 - via_gw["frames_per_s"] / direct["frames_per_s"]
+                if direct["frames_per_s"] else None)
+    print(json.dumps({
+        "config": {"streams": args.streams, "rounds": args.rounds,
+                   "frames": args.frames,
+                   "frame_interval_ms": args.frame_interval_ms,
+                   "frame_bytes": args.frame_bytes},
+        "direct": direct,
+        "gateway": via_gw,
+        "gateway_throughput_overhead": round(overhead, 3)
+        if overhead is not None else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()
