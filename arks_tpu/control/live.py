@@ -346,6 +346,8 @@ class LiveOperator:
         # CRs with a deletionTimestamp whose store teardown is in flight.
         self._deleting: set[tuple] = set()
         self._deleting_lock = threading.Lock()
+        # Per-CR last-ingested resourceVersion (the stale-resync fence).
+        self._ingested_rv: dict[tuple, int] = {}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -406,11 +408,15 @@ class LiveOperator:
                         raise ApiError(int(obj.get("code", 500)),
                                        obj.get("message", "watch error"))
                     meta = obj.get("metadata", {})
+                    self._handle_event(kind, plural, ev.get("type"), obj)
+                    # Advance the resume point only AFTER the event is
+                    # handled: a handler error reopens the watch at the old
+                    # rv and replays the event (handlers are idempotent)
+                    # instead of silently dropping it until resync.
                     try:
                         rv = max(rv, int(meta.get("resourceVersion", 0)))
                     except (TypeError, ValueError):
                         pass
-                    self._handle_event(kind, plural, ev.get("type"), obj)
                     if not self._running:
                         return
             except Exception as e:
@@ -441,6 +447,7 @@ class LiveOperator:
                 pass
             with self._deleting_lock:
                 self._deleting.discard((kind, plural, ns, name))
+            self._ingested_rv.pop((kind.KIND, ns, name), None)
             return
         if meta.get("deletionTimestamp"):
             with self._deleting_lock:
@@ -505,6 +512,8 @@ class LiveOperator:
                         self.store.delete(kind, obj.name, obj.namespace)
                     except NotFound:
                         pass
+                    self._ingested_rv.pop(
+                        (kind.KIND, obj.namespace, obj.name), None)
 
     def _ensure_finalizer(self, plural, ns, name, meta) -> None:
         fins = meta.get("finalizers") or []
@@ -513,6 +522,18 @@ class LiveOperator:
                            {"metadata": {"finalizers": fins + [FINALIZER]}})
 
     def _ingest(self, kind, cr: dict, ns: str, name: str) -> None:
+        # resourceVersion fence: a periodic-resync LIST snapshot can be
+        # staler than what a watcher thread already ingested — applying it
+        # would revert the store to an old spec until the next resync.
+        try:
+            rv = int(cr.get("metadata", {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            rv = 0
+        key = (kind.KIND, ns, name)
+        if rv and rv <= self._ingested_rv.get(key, 0):
+            return
+        if rv:
+            self._ingested_rv[key] = rv
         spec = cr.get("spec", {})
         labels = cr.get("metadata", {}).get("labels", {}) or {}
         obj = self.store.try_get(kind, name, ns)

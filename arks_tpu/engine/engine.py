@@ -404,8 +404,9 @@ class InferenceEngine:
             # adds retention headroom on top.
             kv_bytes = 1 if engine_cfg.kv_quantized else jnp.dtype(
                 self._cache_dtype(dtype)).itemsize
+            d_store = tf.cache_head_dim(cfg, self._pad_head())
             page_bytes = (cfg.num_layers * cfg.num_kv_heads * page
-                          * cfg.head_dim * kv_bytes * 2)
+                          * d_store * kv_bytes * 2)
             if engine_cfg.kv_quantized:
                 page_bytes += cfg.num_layers * cfg.num_kv_heads * page * 4 * 2
             extra = 0
@@ -418,7 +419,8 @@ class InferenceEngine:
             self._page_bytes = page_bytes
             self._cache = tf.init_paged_cache(
                 cfg, num_pages, page, self._cache_dtype(dtype),
-                quantized=engine_cfg.kv_quantized)
+                quantized=engine_cfg.kv_quantized,
+                pad_head=self._pad_head())
             if mesh is not None:
                 self._cache = tf.shard_paged_cache(self._cache, cfg, mesh)
             self._alloc = PageAllocator(num_pages, page)
@@ -436,7 +438,8 @@ class InferenceEngine:
             self._cache = tf.init_cache(cfg, engine_cfg.num_slots,
                                         engine_cfg.max_cache_len,
                                         self._cache_dtype(dtype),
-                                        quantized=engine_cfg.kv_quantized)
+                                        quantized=engine_cfg.kv_quantized,
+                                        pad_head=self._pad_head())
             if mesh is not None:
                 self._cache = self._shard_cache(self._cache)
 
@@ -477,7 +480,8 @@ class InferenceEngine:
             self._draft_params = dparams
             self._draft_cache = tf.init_cache(
                 dcfg, engine_cfg.num_slots, engine_cfg.max_cache_len,
-                self._cache_dtype(dtype), quantized=engine_cfg.kv_quantized)
+                self._cache_dtype(dtype), quantized=engine_cfg.kv_quantized,
+                pad_head=self._pad_head())
             if mesh is not None:
                 self._draft_cache = tf.shard_cache(self._draft_cache, dcfg, mesh)
 
@@ -817,6 +821,20 @@ class InferenceEngine:
         kvd = self.ecfg.resolve_kv_cache_dtype()
         return jnp.bfloat16 if kvd == "bf16" else engine_dtype
 
+    def _pad_head(self) -> bool:
+        """Lane-pad the stored KV head dim to 128 for d<128 models so they
+        ride the compiled Pallas decode kernels instead of the XLA
+        fallback (exact math — zero K lanes add 0 to scores, padded V
+        columns are sliced off; ops/attention prescales q).  Costs
+        128/head_dim x KV HBM; ARKS_PAD_HEAD_DIM=0 opts out."""
+        if os.environ.get("ARKS_PAD_HEAD_DIM", "1") != "1":
+            return False
+        from arks_tpu.ops.attention import default_decode_impl
+        return (jax.default_backend() == "tpu"
+                and default_decode_impl() == "pallas"
+                and self.cfg.head_dim % 128 != 0
+                and self._pp == 1)
+
     def _page_size(self) -> int:
         """Page size = chunk size (a reused prefix then ends exactly where
         the tail chunk prefill starts), or 256 when chunking is off."""
@@ -846,8 +864,10 @@ class InferenceEngine:
         if dp > 1:
             blockers.append("data parallelism")
         if (jax.default_backend() == "tpu"
-                and self.cfg.head_dim % 128 != 0):
-            blockers.append("head_dim not 128-lane aligned")
+                and self.cfg.head_dim % 128 != 0
+                and not self._pad_head()):
+            blockers.append("head_dim not 128-lane aligned (and lane "
+                            "padding disabled)")
         page = self._page_size()
         if page % self._page_align() != 0:
             blockers.append(f"page size {page} not {self._page_align()}-aligned")
@@ -927,7 +947,8 @@ class InferenceEngine:
             page = self._page_size()
             self._cache = tf.init_paged_cache(
                 self.cfg, self._alloc.num_pages, page,
-                self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized)
+                self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized,
+                pad_head=self._pad_head())
             if self.mesh is not None:
                 self._cache = tf.shard_paged_cache(self._cache, self.cfg,
                                                    self.mesh)
@@ -938,7 +959,8 @@ class InferenceEngine:
             self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
                                         self.ecfg.max_cache_len,
                                         self._cache_dtype(dtype),
-                                        quantized=self.ecfg.kv_quantized)
+                                        quantized=self.ecfg.kv_quantized,
+                                        pad_head=self._pad_head())
             if self.mesh is not None:
                 self._cache = self._shard_cache(self._cache)
         self._sampling = sampler_mod.init_sampling_state(
@@ -947,7 +969,8 @@ class InferenceEngine:
         if self._draft_cfg is not None:
             self._draft_cache = tf.init_cache(
                 self._draft_cfg, self.ecfg.num_slots, self.ecfg.max_cache_len,
-                self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized)
+                self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized,
+                pad_head=self._pad_head())
             if self.mesh is not None:
                 self._draft_cache = tf.shard_cache(
                     self._draft_cache, self._draft_cfg, self.mesh)

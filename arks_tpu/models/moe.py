@@ -135,13 +135,24 @@ def moe_ffn_grouped(x: jnp.ndarray, mp: Params, cfg) -> jnp.ndarray:
     xs = jnp.take(x2, token_of, axis=0)                     # [T*k, E] sorted
     group_sizes = jnp.bincount(flat_expert, length=nx)
 
-    # ragged_dot needs plain arrays; dequantized expert weights materialize
-    # here (prefill-only path — dense/decode keeps the fused dequant).
-    gate = jax.lax.ragged_dot(xs, dequantize(mp["w_gate"], x.dtype), group_sizes)
-    up = jax.lax.ragged_dot(xs, dequantize(mp["w_up"], x.dtype), group_sizes)
-    act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
-    down = jax.lax.ragged_dot(act, dequantize(mp["w_down"], x.dtype),
-                              group_sizes)                  # [T*k, E]
+    from arks_tpu.ops.moe_kernel import grouped_ffn, moe_impl
+    if moe_impl() == "pallas":
+        # Block-sparse Pallas grouped matmul: int8 expert dequant stays
+        # FUSED (per-channel scales on the accumulator) instead of
+        # materializing full-width weights for ragged_dot.
+        down = grouped_ffn(xs, jnp.take(flat_expert, order), group_sizes,
+                           mp["w_gate"], mp["w_up"], mp["w_down"], x.dtype)
+    else:
+        # ragged_dot needs plain arrays; dequantized expert weights
+        # materialize here (prefill-only path — dense/decode keeps the
+        # fused dequant).
+        gate = jax.lax.ragged_dot(xs, dequantize(mp["w_gate"], x.dtype),
+                                  group_sizes)
+        up = jax.lax.ragged_dot(xs, dequantize(mp["w_up"], x.dtype),
+                                group_sizes)
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype) * up
+        down = jax.lax.ragged_dot(act, dequantize(mp["w_down"], x.dtype),
+                                  group_sizes)              # [T*k, E]
 
     w = jnp.take(vals.reshape(-1), order).astype(down.dtype)   # [T*k]
     out = jnp.zeros((n, e), down.dtype).at[token_of].add(down * w[:, None])

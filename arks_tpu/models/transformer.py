@@ -184,11 +184,30 @@ def param_pspecs(cfg: ModelConfig, tp: int = 1) -> Params:
     return specs
 
 
+def cache_head_dim(cfg: ModelConfig, pad_head: bool = False) -> int:
+    """Stored head dim: padded up to the 128-lane tile when requested, so
+    models with head_dim < 128 (qwen2.5-0.5b, tiny test configs) ride the
+    compiled Pallas decode kernels instead of the XLA fallback.  Zero
+    padding is EXACT: padded K lanes add 0 to every q.k score and padded V
+    lanes produce output columns the caller slices off."""
+    if pad_head and cfg.head_dim % 128 != 0:
+        return -(-cfg.head_dim // 128) * 128
+    return cfg.head_dim
+
+
+def pad_heads(x: jnp.ndarray, d_store: int) -> jnp.ndarray:
+    """Zero-pad the trailing head dim to the cache's stored width (ONE
+    implementation — the attention ops' _pad_last)."""
+    from arks_tpu.ops.attention import _pad_last
+    return _pad_last(x, d_store)
+
+
 def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
                dtype: jnp.dtype | None = None,
-               quantized: bool = False) -> KVCache:
+               quantized: bool = False, pad_head: bool = False) -> KVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_len, cfg.head_dim)
+    shape = (cfg.num_layers, num_slots, cfg.num_kv_heads, max_len,
+             cache_head_dim(cfg, pad_head))
     if quantized:
         return KVCache(
             k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
@@ -208,9 +227,11 @@ def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1,
 
 def init_paged_cache(cfg: ModelConfig, num_pages: int, page: int,
                      dtype: jnp.dtype | None = None,
-                     quantized: bool = False) -> PagedKVCache:
+                     quantized: bool = False,
+                     pad_head: bool = False) -> PagedKVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page, cfg.head_dim)
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page,
+             cache_head_dim(cfg, pad_head))
     if quantized:
         return PagedKVCache(
             k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
@@ -451,8 +472,8 @@ def prefill_chunk(
         q, k, v = _block_qkv(h, lp, cfg, positions)
 
         # Write the chunk's KV rows (head-major cache layout).
-        kt = jnp.swapaxes(k[0], 0, 1)  # [Hkv, C, D]
-        vt = jnp.swapaxes(v[0], 0, 1)
+        kt = pad_heads(jnp.swapaxes(k[0], 0, 1), kc.shape[-1])
+        vt = pad_heads(jnp.swapaxes(v[0], 0, 1), kc.shape[-1])
         at = (layer, slot.astype(jnp.int32), 0, start.astype(jnp.int32), 0)
         if quantized:
             from arks_tpu.ops.pallas_attention import quantize_kv
@@ -482,9 +503,15 @@ def prefill_chunk(
         g = cfg.num_heads // cfg.num_kv_heads
         qg = jnp.transpose(
             q[0].reshape(c, cfg.num_kv_heads, g, cfg.head_dim), (1, 2, 0, 3))
+        d_store = kc.shape[-1]
+        if d_store != cfg.head_dim:
+            # Lane-padded cache: pad q (prescaled so the op's 1/sqrt(stored
+            # d) nets to 1/sqrt(head_dim)); the padded V columns slice off.
+            qg = pad_heads(qg, d_store) * ((d_store / cfg.head_dim) ** 0.5)
         from arks_tpu.ops.attention import chunk_attention_xla
         attn = chunk_attention_xla(qg, kc_s, vc_s, start, ks_s, vs_s)
-        attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(1, c, cfg.q_dim)
+        attn = jnp.transpose(attn[..., : cfg.head_dim],
+                             (2, 0, 1, 3)).reshape(1, c, cfg.q_dim)
         attn = _constrain(attn, mesh, None, None, AXIS_MODEL)
         h = _block_tail(h, attn, lp, cfg, mesh, None)
         return (h, kc, vc, ksc, vsc), None
@@ -508,8 +535,8 @@ def insert(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     rows quantized to int8 + per-token scales here.
     """
     start = (0, slot.astype(jnp.int32), 0, 0, 0)
-    k_new = jnp.swapaxes(k_new, 2, 3)  # [L, 1, Hkv, T, D]
-    v_new = jnp.swapaxes(v_new, 2, 3)
+    k_new = pad_heads(jnp.swapaxes(k_new, 2, 3), cache.k.shape[-1])
+    v_new = pad_heads(jnp.swapaxes(v_new, 2, 3), cache.v.shape[-1])
     if cache.quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
         kq, ks = quantize_kv(k_new)  # int8 [L,1,Hkv,T,D], f32 [L,1,Hkv,T]
@@ -539,8 +566,8 @@ def insert_pages(cache: PagedKVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     ``n_pages`` are never touched — the engine only allocates what the
     prompt needs."""
     page = cache.page
-    kt = jnp.swapaxes(k_new, 2, 3)  # [L, 1, Hkv, T, D]
-    vt = jnp.swapaxes(v_new, 2, 3)
+    kt = pad_heads(jnp.swapaxes(k_new, 2, 3), cache.k.shape[-1])
+    vt = pad_heads(jnp.swapaxes(v_new, 2, 3), cache.v.shape[-1])
     quantized = cache.quantized
     if quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
@@ -581,8 +608,8 @@ def insert_batch(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     slots — the batched-admission counterpart of ``insert`` (M is small
     and static, so the per-slot writes unroll)."""
     m = k_new.shape[1]
-    kt = jnp.swapaxes(k_new, 2, 3)  # [L, M, Hkv, T, D]
-    vt = jnp.swapaxes(v_new, 2, 3)
+    kt = pad_heads(jnp.swapaxes(k_new, 2, 3), cache.k.shape[-1])
+    vt = pad_heads(jnp.swapaxes(v_new, 2, 3), cache.v.shape[-1])
     if cache.quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
         kt, ksn = quantize_kv(kt)
@@ -613,8 +640,8 @@ def insert_pages_batch(cache: PagedKVCache, k_new: jnp.ndarray,
     valid per prompt)."""
     page = cache.page
     m = k_new.shape[1]
-    kt = jnp.swapaxes(k_new, 2, 3)  # [L, M, Hkv, T, D]
-    vt = jnp.swapaxes(v_new, 2, 3)
+    kt = pad_heads(jnp.swapaxes(k_new, 2, 3), cache.k.shape[-1])
+    vt = pad_heads(jnp.swapaxes(v_new, 2, 3), cache.v.shape[-1])
     quantized = cache.quantized
     if quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
@@ -712,8 +739,8 @@ def prefill_chunk_paged(
         lp, layer = xs
         q, k, v = _block_qkv(h, lp, cfg, positions)
 
-        kt = jnp.swapaxes(k[0], 0, 1)  # [Hkv, C, D]
-        vt = jnp.swapaxes(v[0], 0, 1)
+        kt = pad_heads(jnp.swapaxes(k[0], 0, 1), kc.shape[-1])
+        vt = pad_heads(jnp.swapaxes(v[0], 0, 1), kc.shape[-1])
         at = (layer, pg.astype(jnp.int32), 0, 0, 0)
         if quantized:
             from arks_tpu.ops.pallas_attention import quantize_kv
@@ -733,9 +760,15 @@ def prefill_chunk_paged(
         g = cfg.num_heads // cfg.num_kv_heads
         qg = jnp.transpose(
             q[0].reshape(c, cfg.num_kv_heads, g, cfg.head_dim), (1, 2, 0, 3))
+        d_store = kc.shape[-1]
+        if d_store != cfg.head_dim:
+            # Lane-padded cache: pad q (prescaled so the op's 1/sqrt(stored
+            # d) nets to 1/sqrt(head_dim)); the padded V columns slice off.
+            qg = pad_heads(qg, d_store) * ((d_store / cfg.head_dim) ** 0.5)
         from arks_tpu.ops.attention import chunk_attention_xla
         attn = chunk_attention_xla(qg, kc_s, vc_s, start, ks_s, vs_s)
-        attn = jnp.transpose(attn, (2, 0, 1, 3)).reshape(1, c, cfg.q_dim)
+        attn = jnp.transpose(attn[..., : cfg.head_dim],
+                             (2, 0, 1, 3)).reshape(1, c, cfg.q_dim)
         attn = _constrain(attn, mesh, None, None, AXIS_MODEL)
         h = _block_tail(h, attn, lp, cfg, mesh, None)
         return (h, kc, vc, ksc, vsc), None

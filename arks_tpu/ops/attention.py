@@ -36,6 +36,16 @@ _NEG_INF = -1e30
 _lane_warned: set[int] = set()
 
 
+def _pad_last(x, d_store: int):
+    """Zero-pad the trailing (head) dim to the cache's stored width —
+    exact: padded K lanes add 0 to every q.k score, padded V lanes yield
+    output columns the caller slices off."""
+    if x is None or x.shape[-1] == d_store:
+        return x
+    width = [(0, 0)] * (x.ndim - 1) + [(0, d_store - x.shape[-1])]
+    return jnp.pad(x, width)
+
+
 def default_decode_impl() -> str:
     """'pallas' on real TPU, 'xla' elsewhere; override via ARKS_ATTN_IMPL."""
     impl = os.environ.get("ARKS_ATTN_IMPL", "auto")
@@ -192,9 +202,16 @@ def verify_update_and_attend(
     [B, Hkv, G, K, S] stays modest; under a mesh the partitioner reshards
     exactly as the non-pallas decode branch does."""
     del mesh, batch_axis, kv_sharded, model_axis, lengths
-    b, kk, h, d = q.shape
+    b, kk, h, d_model = q.shape
     hkv = k_cache.shape[2]
     g = h // hkv
+    # Lane padding (see decode_update_and_attend): pad to the stored head
+    # dim, prescale q to keep the effective 1/sqrt(d_model) scale.
+    d = k_cache.shape[-1]
+    if d != d_model:
+        q = _pad_last(q, d) * ((d / d_model) ** 0.5)
+        k_new = _pad_last(k_new, d)
+        v_new = _pad_last(v_new, d)
     quantized = k_scale is not None
 
     kc_l = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
@@ -233,7 +250,8 @@ def verify_update_and_attend(
     out = jnp.einsum("bkgqs,bksd->bkgqd", probs.astype(q.dtype),
                      vc_l.astype(q.dtype),
                      preferred_element_type=jnp.float32)
-    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, kk, h, d).astype(q.dtype)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(
+        b, kk, h, d)[..., :d_model].astype(q.dtype)
 
     kc = jax.lax.dynamic_update_index_in_dim(k_cache, kc_l, layer, 0)
     vc = jax.lax.dynamic_update_index_in_dim(v_cache, vc_l, layer, 0)
@@ -271,11 +289,18 @@ def paged_decode_update_and_attend(
     dp meshes are not supported (tables index one global pool); the engine
     falls back to the slot-contiguous layout there.
     """
-    b, h, d = q.shape
+    b, h, d_model = q.shape
     hkv = k_pool.shape[2]
     g = h // hkv
     page = k_pool.shape[3]
     cover = tables.shape[1] * page
+    # Lane padding (see the slot op): pad to the pool's stored head dim,
+    # prescale q so the kernels' 1/sqrt(stored d) nets to 1/sqrt(d_model).
+    d = k_pool.shape[-1]
+    if d != d_model:
+        q = _pad_last(q, d) * ((d / d_model) ** 0.5)
+        k_new = _pad_last(k_new, d)
+        v_new = _pad_last(v_new, d)
     quantized = k_scale is not None
     impl = impl or default_decode_impl()
     tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
@@ -300,7 +325,7 @@ def paged_decode_update_and_attend(
         else:
             out = decode_attention_xla(q.reshape(b, hkv, g, d), kc, vc,
                                        attend_lens)
-        return out.reshape(b, h, d), kp, vp, ks, vs
+        return out.reshape(b, h, d)[..., :d_model], kp, vp, ks, vs
 
     from arks_tpu.ops.paged_attention import (
         paged_decode_attention, paged_kv_update, paged_kv_update_quant,
@@ -324,7 +349,7 @@ def paged_decode_update_and_attend(
         out, kp, vp, ks, vs = local(qg, k_new, v_new, k_pool, v_pool,
                                     k_scale, v_scale, tables, write_idx,
                                     attend_lens, layer)
-        return out.reshape(b, h, d), kp, vp, ks, vs
+        return out.reshape(b, h, d)[..., :d_model], kp, vp, ks, vs
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -343,7 +368,7 @@ def paged_decode_update_and_attend(
     out, kp, vp, ks, vs = fn(qg, k_new, v_new, k_pool, v_pool,
                              k_scale, v_scale, tables, write_idx,
                              attend_lens, jnp.asarray(layer, jnp.int32))
-    return out.reshape(b, h, d), kp, vp, ks, vs
+    return out.reshape(b, h, d)[..., :d_model], kp, vp, ks, vs
 
 
 def decode_update_and_attend(
@@ -381,9 +406,19 @@ def decode_update_and_attend(
     don't divide the TP axis (replicated-KV regime) we stay on the XLA path,
     which the partitioner reshards automatically.
     """
-    b, h, d = q.shape
+    b, h, d_model = q.shape
     hkv = k_cache.shape[2]
     g = h // hkv
+    # Lane padding: a cache stored wider than the model head dim (see
+    # transformer.cache_head_dim) lets d<128 models ride the compiled
+    # kernels; inputs pad up here and the output slices back down.  The
+    # kernels scale scores by 1/sqrt(stored d); prescaling q by
+    # sqrt(d_store/d_model) restores the true 1/sqrt(d_model).
+    d = k_cache.shape[-1]
+    if d != d_model:
+        q = _pad_last(q, d) * ((d / d_model) ** 0.5)
+        k_new = _pad_last(k_new, d)
+        v_new = _pad_last(v_new, d)
     quantized = k_scale is not None
     impl = impl or default_decode_impl()
     # The kernels also serve dp-only meshes (trivial model axis): the op is
@@ -391,12 +426,11 @@ def decode_update_and_attend(
     # (tp > 1 not dividing Hkv) needs the XLA partitioner.
     tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
     # Mosaic tiles the last (lane) dim at 128: compiled-TPU kernels require
-    # head_dim % 128 == 0.  That covers the 1.5B+ model registry (d=128);
-    # d=64 models (qwen2.5-0.5b) and tiny test configs fall back to the
-    # XLA path — slower per step but correct (the kernel would fail to
-    # compile; lane-padding the kernels is the future fix).  Interpret mode
-    # has no such constraint, so CPU kernel tests still exercise the Pallas
-    # path at small D.
+    # a 128-multiple STORED head dim.  The engine pads the cache for d<128
+    # models (ARKS_PAD_HEAD_DIM=0 disables); an unpadded narrow cache
+    # falls back to the XLA path — slower per step but correct.  Interpret
+    # mode has no such constraint, so CPU kernel tests still exercise the
+    # Pallas path at small D.
     lane_ok = d % 128 == 0 or jax.default_backend() != "tpu"
     if impl == "pallas" and not lane_ok and d not in _lane_warned:
         _lane_warned.add(d)
@@ -437,7 +471,7 @@ def decode_update_and_attend(
             ks, vs = k_scale, v_scale
         kc = jax.lax.dynamic_update_index_in_dim(k_cache, kc_l, layer, 0)
         vc = jax.lax.dynamic_update_index_in_dim(v_cache, vc_l, layer, 0)
-        return out.reshape(b, h, d), kc, vc, ks, vs
+        return out.reshape(b, h, d)[..., :d_model], kc, vc, ks, vs
 
     from arks_tpu.ops.pallas_attention import (
         kv_cache_update, kv_cache_update_quant, ragged_decode_attention,
@@ -463,7 +497,7 @@ def decode_update_and_attend(
     if mesh is None or mesh.size == 1:
         out, kc, vc, ks, vs = local(qg, k_new, v_new, k_cache, v_cache,
                                     k_scale, v_scale, write_idx, layer)
-        return out.reshape(b, h, d), kc, vc, ks, vs
+        return out.reshape(b, h, d)[..., :d_model], kc, vc, ks, vs
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -482,4 +516,4 @@ def decode_update_and_attend(
     out, kc, vc, ks, vs = fn(qg, k_new, v_new, k_cache, v_cache,
                              k_scale, v_scale, write_idx,
                              jnp.asarray(layer, jnp.int32))
-    return out.reshape(b, h, d), kc, vc, ks, vs
+    return out.reshape(b, h, d)[..., :d_model], kc, vc, ks, vs

@@ -1,0 +1,130 @@
+"""Lane-padded KV caches (head_dim < 128 models on the Pallas path).
+
+The stored head dim pads up to the 128-lane tile (transformer.
+cache_head_dim); q is prescaled so the effective attention scale stays
+1/sqrt(head_dim), and outputs slice the padded columns off — every padded
+path must match its unpadded oracle EXACTLY (float tolerance)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arks_tpu.models import get_config
+from arks_tpu.models import transformer as tf
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_config("tiny")  # head_dim 8 -> pads to 128
+    params = tf.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    return cfg, params
+
+
+def _seed_caches(cfg, plain, padded, lengths, key):
+    for slot in range(len(lengths)):
+        plen = int(lengths[slot])
+        pk = jax.random.normal(jax.random.fold_in(key, slot),
+                               (cfg.num_layers, 1, plen, cfg.num_kv_heads,
+                                cfg.head_dim), jnp.float32)
+        pv = pk * 0.5 + 1.0
+        plain = tf.insert(plain, pk, pv, jnp.asarray(slot))
+        padded = tf.insert(padded, pk, pv, jnp.asarray(slot))
+    return plain, padded
+
+
+def test_cache_head_dim_padding_rule():
+    cfg = get_config("tiny")
+    assert tf.cache_head_dim(cfg, pad_head=False) == cfg.head_dim
+    assert tf.cache_head_dim(cfg, pad_head=True) == 128
+    big = get_config("qwen2.5-7b")
+    assert tf.cache_head_dim(big, pad_head=True) == big.head_dim  # 128 already
+
+
+def test_decode_step_padded_matches_plain(setup):
+    cfg, params = setup
+    slots = 4
+    plain = tf.init_cache(cfg, slots, 64, jnp.float32)
+    padded = tf.init_cache(cfg, slots, 64, jnp.float32, pad_head=True)
+    assert padded.k.shape[-1] == 128
+    lengths = jnp.asarray([3, 9, 17, 5], jnp.int32)
+    plain, padded = _seed_caches(cfg, plain, padded, lengths,
+                                 jax.random.PRNGKey(1))
+    tokens = jnp.asarray([4, 5, 6, 7], jnp.int32)
+    ref, plain = tf.decode_step(params, cfg, plain, tokens, lengths)
+    got, padded = tf.decode_step(params, cfg, padded, tokens, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+    # Step 2: the padded write of step 1 reads back correctly.
+    nxt = jnp.argmax(ref, axis=-1).astype(jnp.int32)
+    ref2, _ = tf.decode_step(params, cfg, plain, nxt, lengths + 1)
+    got2, _ = tf.decode_step(params, cfg, padded, nxt, lengths + 1)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref2),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_verify_step_padded_matches_plain(setup):
+    cfg, params = setup
+    slots, kk = 2, 3
+    plain = tf.init_cache(cfg, slots, 64, jnp.float32)
+    padded = tf.init_cache(cfg, slots, 64, jnp.float32, pad_head=True)
+    lengths = jnp.asarray([5, 11], jnp.int32)
+    plain, padded = _seed_caches(cfg, plain, padded, lengths,
+                                 jax.random.PRNGKey(2))
+    tokens = jnp.asarray([[3, 4, 5], [6, 7, 8]], jnp.int32)
+    ref, _ = tf.verify_step(params, cfg, plain, tokens, lengths)
+    got, _ = tf.verify_step(params, cfg, padded, tokens, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_chunk_prefill_padded_matches_one_shot(setup):
+    cfg, params = setup
+    prompt = list(np.random.default_rng(5).integers(2, 200, size=37))
+    toks = jnp.asarray([prompt], jnp.int32)
+    ref, _, _ = tf.prefill(params, cfg, toks,
+                           jnp.asarray([len(prompt)], jnp.int32))
+
+    cache = tf.init_cache(cfg, 2, 64, jnp.float32, pad_head=True)
+    C = 16
+    logits = None
+    for start in range(0, len(prompt), C):
+        chunk = prompt[start: start + C]
+        padded = np.zeros((C,), np.int32)
+        padded[: len(chunk)] = chunk
+        logits, cache = tf.prefill_chunk(
+            params, cfg, cache, jnp.asarray(0), jnp.asarray(padded),
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(len(chunk), jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_paged_padded_matches_plain(setup):
+    cfg, params = setup
+    slots, max_pages, page = 2, 4, 16
+    plain = tf.init_paged_cache(cfg, slots * max_pages + 1, page,
+                                jnp.float32)
+    padded = tf.init_paged_cache(cfg, slots * max_pages + 1, page,
+                                 jnp.float32, pad_head=True)
+    assert padded.k.shape[-1] == 128
+    tables = jnp.arange(slots * max_pages, dtype=jnp.int32).reshape(
+        slots, max_pages)
+    lengths = jnp.asarray([7, 19], jnp.int32)
+    key = jax.random.PRNGKey(3)
+    for slot in range(slots):
+        plen = int(lengths[slot])
+        n = -(-plen // page)
+        pk = jax.random.normal(jax.random.fold_in(key, slot),
+                               (cfg.num_layers, 1, n * page,
+                                cfg.num_kv_heads, cfg.head_dim), jnp.float32)
+        pv = pk * 2.0
+        plain = tf.insert_pages(plain, pk, pv, tables[slot], jnp.asarray(n))
+        padded = tf.insert_pages(padded, pk, pv, tables[slot], jnp.asarray(n))
+    tokens = jnp.asarray([3, 4], jnp.int32)
+    ref, _ = tf.decode_step(params, cfg, plain, tokens, lengths,
+                            tables=tables)
+    got, _ = tf.decode_step(params, cfg, padded, tokens, lengths,
+                            tables=tables)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)

@@ -331,3 +331,52 @@ def test_chunked_prefill_garbage_writes_cannot_corrupt_shared_pages():
     # The victim's output must equal an interference-free run.
     ref_out, _ = _run_engine("paged", [([3] * 16, 0)], max_tokens=40)
     assert outs["victim"] == ref_out[0]
+
+
+def test_engine_paged_on_tp_mesh():
+    """Paged engine over a 2-way tensor-parallel mesh (virtual CPU
+    devices): pool sharded on kv heads, tables as dispatch args — outputs
+    must match the meshless paged engine (the multi-chip shape the driver
+    dry-runs)."""
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.engine.types import Request, SamplingParams
+
+    cfg = get_config("tiny")
+
+    def run(tp):
+        ecfg = EngineConfig(model="tiny", num_slots=4, max_cache_len=64,
+                            prefill_buckets=(8, 16, 32),
+                            steps_per_dispatch=4, prefill_chunk=16,
+                            kv_layout="paged")
+        mesh = None
+        if tp > 1:
+            from arks_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(tensor_parallel=tp,
+                             devices=jax.devices()[:tp])
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer(), mesh=mesh)
+        outs = []
+        eng.start()
+        try:
+            for i, prompt in enumerate(([3] * 20, [3] * 20, [5, 6, 7])):
+                r = Request(request_id=f"t{i}", prompt_ids=list(prompt),
+                            params=SamplingParams(max_tokens=5,
+                                                  temperature=0.0,
+                                                  ignore_eos=True))
+                eng.add_request(r)
+                toks = []
+                while True:
+                    o = r.outputs.get(timeout=120)
+                    toks.extend(o.token_ids)
+                    if o.finished:
+                        break
+                outs.append(toks)
+        finally:
+            eng.stop()
+        return outs, eng
+
+    base, _ = run(1)
+    sharded, eng = run(2)
+    assert sharded == base
+    assert eng.mesh is not None and eng.mesh.shape.get("model") == 2
+    assert eng._alloc.hit_tokens > 0  # prefix sharing under the mesh too
