@@ -57,6 +57,11 @@ def main() -> None:
                    help="int8 = weight-only quantization (w8a16): fits "
                         "7B-class models on one 16GB chip, halves decode "
                         "weight reads")
+    p.add_argument("--kv-layout", default="auto",
+                   choices=("auto", "slot", "paged"),
+                   help="device KV layout: paged = block-table pool with "
+                        "on-device prefix sharing (TPU default); slot = "
+                        "contiguous per-slot cache (spec-decode/pp/cp/dp)")
     p.add_argument("--prefix-cache-mb", type=int, default=256,
                    help="host-RAM budget for prefix KV reuse (0 disables)")
     p.add_argument("--draft-model", default=None,
@@ -175,6 +180,7 @@ def main() -> None:
         dtype=args.dtype, kv_cache_dtype=args.kv_cache_dtype,
         weight_dtype=args.weight_dtype, seed=args.seed,
         prefix_cache_mb=args.prefix_cache_mb,
+        kv_layout=args.kv_layout,
         draft_model=args.draft_model, draft_len=args.draft_len,
     )
     draft_cfg = draft_params = None
