@@ -497,6 +497,15 @@ class InferenceEngine:
         self._running = False
         self._thread: threading.Thread | None = None
         self._request_seed = engine_cfg.seed
+        # Decode/admission overlap: issue the decode dispatch async and do
+        # admission host work while the device computes.  Pays off where
+        # device compute and host logistics are truly parallel (TPU);
+        # on CPU the "device" shares the host's cores, so the reorder only
+        # delays new slots' first decode — sequential there.
+        # ARKS_OVERLAP_DECODE=0/1 overrides.
+        _ov = os.environ.get("ARKS_OVERLAP_DECODE", "auto")
+        self._overlap = (_ov == "1" or
+                         (_ov != "0" and jax.default_backend() == "tpu"))
         # Multi-host: a DispatchLeader when this engine drives follower
         # processes (arks_tpu.engine.multihost); None single-host.
         self.dispatcher = None
@@ -983,26 +992,50 @@ class InferenceEngine:
                       if s not in self._slots]
 
     def step(self, block_s: float = 0.05) -> bool:
-        """One scheduler iteration: admit pending requests, advance at most
-        ONE prefill chunk, then one decode dispatch.  The chunk/decode
-        interleave bounds how long a long-prompt burst can stall decoding
-        slots: one chunk dispatch, not one whole prefill.  Returns True if
-        any work was done."""
+        """One scheduler iteration: issue ONE decode dispatch (async),
+        admit pending requests and advance at most one prefill chunk WHILE
+        it computes, then fan the decode results out.  The overlap hides
+        admission host work (numpy packing, digests, page allocation, the
+        dispatch-issue latency) behind decode compute; device work still
+        executes in issue order on the stream.  The chunk/decode interleave
+        bounds how long a long-prompt burst can stall decoding slots: one
+        chunk dispatch, not one whole prefill.  Returns True if any work
+        was done.
+
+        Speculative engines keep the sequential order (the spec dispatch
+        resolves inline).  Phase-seconds note: with the overlap, waits on
+        the shared device stream land in whichever phase fetches first —
+        the breakdown attributes WALL time, not device time."""
         t0 = time.monotonic()
-        worked = self._admit()
+        pending = None
+        worked = False
+        if self._slots and self._draft_cfg is None and self._overlap:
+            pending = self._issue_decode()  # may retire/abort even if None
+            worked = True
         t1 = time.monotonic()
-        if t1 - t0 > 1e-4:
-            self.metrics.scheduler_seconds_total.inc(t1 - t0, phase="admit")
+        if worked:
+            self.metrics.scheduler_seconds_total.inc(t1 - t0, phase="decode")
+        worked = self._admit() or worked
+        t2 = time.monotonic()
+        if t2 - t1 > 1e-4:
+            self.metrics.scheduler_seconds_total.inc(t2 - t1, phase="admit")
         if self._prefilling:
             self._process_chunk()
-            t2 = time.monotonic()
-            self.metrics.scheduler_seconds_total.inc(t2 - t1, phase="chunk")
-            t1 = t2
+            t3 = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(t3 - t2, phase="chunk")
+            t2 = t3
             worked = True
-        if self._slots:
+        if pending is not None:
+            self._resolve_decode(pending, exclude_s=t2 - t1)
+            self.metrics.scheduler_seconds_total.inc(
+                time.monotonic() - t2, phase="decode")
+        elif self._slots and (self._draft_cfg is not None
+                              or not self._overlap):
+            # Sequential order: speculative engines, and platforms where
+            # the overlap cannot pay (see _overlap above).
             self._decode_dispatch()
             self.metrics.scheduler_seconds_total.inc(
-                time.monotonic() - t1, phase="decode")
+                time.monotonic() - t2, phase="decode")
             worked = True
         if not worked:
             # Idle: wait briefly for a request, then try admission again.
@@ -1689,6 +1722,21 @@ class InferenceEngine:
                               first_lp=first_lp)
 
     def _decode_dispatch(self) -> None:
+        rec = self._issue_decode()
+        if rec is not None:
+            self._resolve_decode(rec)
+
+    def _issue_decode(self):
+        """Decode bookkeeping + ASYNC dispatch.  Returns the pending record
+        for _resolve_decode, or None when nothing dispatched (no live
+        slots, or the speculative path ran synchronously).
+
+        The issue/resolve split lets step() overlap admission host work
+        with the in-flight decode: aborted/retired slots free their pages
+        BEFORE the dispatch snapshot (their rows carry the write-drop
+        sentinel), so pages handed to admissions during the flight cannot
+        be written by it, and admissions' device work queues after the
+        decode on the stream."""
         K = self.ecfg.steps_per_dispatch
         with self._abort_lock:
             aborted = set(self._aborted)
@@ -1714,7 +1762,7 @@ class InferenceEngine:
             if int(self._lengths[slot]) + 1 + margin > self.ecfg.max_cache_len:
                 self._finish(slot, "length")
         if not self._slots:
-            return
+            return None
 
         # Speculative path: runs whenever ANY slot is eligible (draft-
         # synced, penalty-free, no logprobs — greedy OR sampled, the
@@ -1732,7 +1780,8 @@ class InferenceEngine:
                        and st.request.params.logprobs is None)
                 for slot, st in self._slots.items()}
             if any(eligible.values()):
-                return self._spec_dispatch(eligible)
+                self._spec_dispatch(eligible)
+                return None
             # Nobody can speculate: the fused loop advances the target
             # cache only — every live slot's draft mirror is stale from
             # here on.
@@ -1761,26 +1810,40 @@ class InferenceEngine:
         self._emit("decode", tokens=np.array(self._last_token),
                    lengths=np.array(self._lengths), lp=want_lp,
                    tables=self._tables.copy() if self._paged else None)
+        lp_devs = None
         if want_lp:
             self._cache, self._sampling, (toks, clps, lvals, lids) = \
                 self._decode_lp_fn(
                     self.params, self._cache, jnp.asarray(self._last_token),
                     jnp.asarray(self._lengths), self._sampling, tables_arg)
-            clps = np.asarray(clps)     # [K, B]
-            lvals = np.asarray(lvals)   # [K, B, L]
-            lids = np.asarray(lids)
+            lp_devs = (clps, lvals, lids)
         else:
             self._cache, self._sampling, toks = self._decode_fn(
                 self.params, self._cache, jnp.asarray(self._last_token),
                 jnp.asarray(self._lengths), self._sampling, tables_arg)
+        # Snapshot the dispatch's slot set: slots admitted while this
+        # dispatch is in flight are NOT part of it (their rows carried the
+        # free-slot sentinel at issue).
+        return (list(self._slots.keys()), want_lp, toks, lp_devs, K, t0)
+
+    def _resolve_decode(self, rec, exclude_s: float = 0.0) -> None:
+        """Host-sync tail: fetch the dispatch's tokens and fan them out to
+        the SNAPSHOT slots.  ``exclude_s`` subtracts the overlapped
+        admit/chunk wall time from the TPOT observation — in overlap mode
+        issue-to-resolve spans that host work, which is not decode time."""
+        snapshot, want_lp, toks, lp_devs, K, t0 = rec
         toks = np.asarray(toks)  # [K, B] — host sync point
-        dt = time.monotonic() - t0
+        if lp_devs is not None:
+            clps = np.asarray(lp_devs[0])    # [K, B]
+            lvals = np.asarray(lp_devs[1])   # [K, B, L]
+            lids = np.asarray(lp_devs[2])
+        dt = max(time.monotonic() - t0 - exclude_s, 1e-6)
         # One bulk C conversion instead of B*K numpy scalar reads (~6k
         # PyObject boxing calls per dispatch at b192/K32 — measurable host
         # time the GIL shares with the serving threads).
         cols = toks.T.tolist()   # [B][K] python ints
 
-        for slot in list(self._slots):
+        for slot in snapshot:
             st = self._slots[slot]
             col = cols[slot]
             n_lp = st.request.params.logprobs

@@ -267,3 +267,49 @@ def test_frequency_penalty_reduces_repetition():
     assert len(set(penalized)) > len(set(plain))
     # Deterministic (greedy + penalties is still deterministic).
     assert run(0.5, 1.5) == penalized
+
+
+def test_overlap_decode_matches_sequential(monkeypatch):
+    """ARKS_OVERLAP_DECODE=1 (the TPU default: decode issued async,
+    admissions overlap the in-flight dispatch) must produce byte-identical
+    outputs to the sequential order, including slot churn and prefix
+    sharing."""
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.engine.types import Request, SamplingParams
+    from arks_tpu.models import get_config
+
+    cfg = get_config("tiny")
+    prompts = [[3] * 20, [3] * 20, [5, 6, 7], [9] * 33, [4, 8]]
+
+    def run(overlap):
+        monkeypatch.setenv("ARKS_OVERLAP_DECODE", overlap)
+        ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                            prefill_buckets=(8, 16, 32),
+                            steps_per_dispatch=4, prefill_chunk=16,
+                            kv_layout="paged")
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+        assert eng._overlap == (overlap == "1")
+        eng.start()
+        outs = []
+        try:
+            reqs = [Request(request_id=f"o{i}", prompt_ids=list(p),
+                            params=SamplingParams(max_tokens=6,
+                                                  temperature=0.0,
+                                                  ignore_eos=True))
+                    for i, p in enumerate(prompts)]
+            for r in reqs:  # burst: more requests than slots -> churn
+                eng.add_request(r)
+            for r in reqs:
+                toks = []
+                while True:
+                    o = r.outputs.get(timeout=120)
+                    toks.extend(o.token_ids)
+                    if o.finished:
+                        break
+                outs.append(toks)
+        finally:
+            eng.stop()
+        return outs
+
+    assert run("1") == run("0")
