@@ -323,6 +323,18 @@ class FakeKubeApi:
             cur = self._objs.get(key)
             if cur is None:
                 raise ApiError(404, f"{plural}/{name} not found")
+            # Optimistic concurrency (real apiserver semantics): a PUT
+            # carrying a stale resourceVersion is a 409.  Leader election
+            # depends on this — two contenders replacing the same Lease
+            # must not both win.  A missing rv skips the check (legacy
+            # callers).
+            sent_rv = str(obj.get("metadata", {}).get("resourceVersion", "")
+                          or "")
+            cur_rv = str(cur.get("metadata", {}).get("resourceVersion", ""))
+            if sent_rv and sent_rv != cur_rv:
+                raise ApiError(
+                    409, f"{plural}/{name}: resourceVersion conflict "
+                         f"(sent {sent_rv}, current {cur_rv})")
             stored = json.loads(json.dumps(obj))
             stored["metadata"]["name"] = name
             stored["metadata"].setdefault("namespace", namespace)

@@ -1,0 +1,247 @@
+"""Leader election over ``coordination.k8s.io/v1`` Leases.
+
+The reference manager runs controller-runtime leader election with lease id
+``e4ada7ad.arks.ai`` (/root/reference/cmd/main.go:198-216) so a second
+operator replica idles until the holder dies.  Same protocol here:
+
+- ONE Lease object; the holder renews ``renewTime`` every ``retry_period_s``.
+- A contender takes over when the lease is unheld or ``renewTime +
+  leaseDurationSeconds`` has passed, via a resourceVersion-fenced PUT —
+  the apiserver's optimistic concurrency guarantees a single winner.
+- Graceful shutdown RELEASES the lease (empty holderIdentity) so the
+  standby takes over immediately instead of waiting out the duration.
+
+The elector only flips a flag and fires callbacks; what "leading" means
+(start/stop the reconcile machinery) belongs to the caller (LiveOperator).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+
+from arks_tpu.control.k8s_client import ApiError
+
+log = logging.getLogger("arks_tpu.control.leader")
+
+LEASE_GV = "coordination.k8s.io/v1"
+# Same lease id the reference manager uses (cmd/main.go:211).
+DEFAULT_LEASE_NAME = "e4ada7ad.arks.ai"
+
+
+def _rfc3339(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse_rfc3339(s: str | None) -> float | None:
+    if not s:
+        return None
+    try:
+        return datetime.datetime.fromisoformat(
+            s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
+def default_identity() -> str:
+    """hostname_pid_uuid — the controller-runtime identity shape (unique
+    per process even across restarts of the same pod)."""
+    return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaderElector:
+    """Acquire/renew a Lease in a background thread; fire callbacks on
+    leadership transitions.  ``on_stopped_leading`` fires when a held lease
+    cannot be renewed (apiserver took it away or renewals kept failing past
+    the lease duration) — the caller decides whether that is fatal."""
+
+    def __init__(self, api, namespace: str = "default",
+                 name: str = DEFAULT_LEASE_NAME,
+                 identity: str | None = None,
+                 lease_duration_s: float = 15.0,
+                 retry_period_s: float = 2.0,
+                 on_started_leading=None,
+                 on_stopped_leading=None):
+        self.api = api
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or default_identity()
+        self.lease_duration_s = lease_duration_s
+        self.retry_period_s = retry_period_s
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+        self._last_renew_ok = 0.0
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    # -- protocol ------------------------------------------------------
+
+    def _spec(self, prev: dict | None, now: float) -> dict:
+        prev = prev or {}
+        took_over = prev.get("holderIdentity") != self.identity
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": max(int(self.lease_duration_s), 1),
+            "acquireTime": _rfc3339(now) if took_over
+            else prev.get("acquireTime", _rfc3339(now)),
+            "renewTime": _rfc3339(now),
+            "leaseTransitions": int(prev.get("leaseTransitions", 0))
+            + (1 if took_over and prev.get("holderIdentity") else 0),
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        """One protocol step.  Returns True iff this process holds the
+        lease after the step."""
+        now = time.time()
+        lease = self.api.get(LEASE_GV, "leases", self.namespace, self.name)
+        if lease is None:
+            obj = {"apiVersion": LEASE_GV, "kind": "Lease",
+                   "metadata": {"name": self.name,
+                                "namespace": self.namespace},
+                   "spec": self._spec(None, now)}
+            try:
+                self.api.create(LEASE_GV, "leases", self.namespace, obj)
+            except ApiError as e:
+                if e.status == 409:  # lost the creation race
+                    return False
+                raise
+            log.info("acquired leader lease %s/%s as %s", self.namespace,
+                     self.name, self.identity)
+            return True
+
+        spec = lease.get("spec", {}) or {}
+        holder = spec.get("holderIdentity") or ""
+        renew = _parse_rfc3339(spec.get("renewTime")
+                               or spec.get("acquireTime"))
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.lease_duration_s)
+        expired = renew is None or now > renew + duration
+        if holder and holder != self.identity and not expired:
+            return False  # held by a live leader
+
+        obj = {"apiVersion": LEASE_GV, "kind": "Lease",
+               "metadata": {"name": self.name, "namespace": self.namespace,
+                            "resourceVersion": str(
+                                lease.get("metadata", {})
+                                .get("resourceVersion", ""))},
+               "spec": self._spec(spec, now)}
+        try:
+            self.api.replace(LEASE_GV, "leases", self.namespace, self.name,
+                             obj)
+        except ApiError as e:
+            if e.status == 409:  # another contender won this round
+                return False
+            raise
+        if holder != self.identity:
+            log.info("acquired leader lease %s/%s as %s (previous holder "
+                     "%r, expired=%s)", self.namespace, self.name,
+                     self.identity, holder, expired)
+        return True
+
+    def release(self) -> None:
+        """Give the lease up explicitly (graceful shutdown): the standby
+        takes over at its next retry instead of waiting out the duration."""
+        if not self._leading:
+            return
+        try:
+            lease = self.api.get(LEASE_GV, "leases", self.namespace,
+                                 self.name)
+            if lease is None or (lease.get("spec", {})
+                                 .get("holderIdentity") != self.identity):
+                return
+            obj = {"apiVersion": LEASE_GV, "kind": "Lease",
+                   "metadata": {"name": self.name,
+                                "namespace": self.namespace,
+                                "resourceVersion": str(
+                                    lease.get("metadata", {})
+                                    .get("resourceVersion", ""))},
+                   "spec": {**lease.get("spec", {}), "holderIdentity": "",
+                            "renewTime": None}}
+            self.api.replace(LEASE_GV, "leases", self.namespace, self.name,
+                             obj)
+            log.info("released leader lease %s/%s", self.namespace,
+                     self.name)
+        except Exception:
+            log.warning("lease release failed (standby will take over "
+                        "after expiry)", exc_info=True)
+        finally:
+            self._leading = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="leader-elector", daemon=True)
+        self._thread.start()
+
+    def stop(self, release: bool = True) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if release:
+            self.release()
+        else:
+            self._leading = False
+
+    def _loop(self) -> None:
+        while self._running:
+            ok = False
+            try:
+                ok = self.try_acquire_or_renew()
+                if ok:
+                    self._last_renew_ok = time.time()
+            except Exception:
+                log.exception("leader election step failed")
+            if ok and not self._leading:
+                self._leading = True
+                if self.on_started_leading is not None:
+                    try:
+                        self.on_started_leading()
+                    except Exception:
+                        # A callback failure must not kill the elector
+                        # thread with _leading stuck True (renewals would
+                        # stop while this process still claims the lease).
+                        # This process failed to START leading: give the
+                        # lease up so a healthy replica can.
+                        log.exception("on_started_leading failed; "
+                                      "releasing the lease")
+                        self.release()
+            elif self._leading and not ok:
+                # Renewals may fail transiently (apiserver blip): leadership
+                # is only LOST once the lease duration has passed without a
+                # successful renewal — or another holder took the lease.
+                held_elsewhere = False
+                try:
+                    lease = self.api.get(LEASE_GV, "leases", self.namespace,
+                                         self.name)
+                    holder = (lease or {}).get("spec", {}).get(
+                        "holderIdentity")
+                    held_elsewhere = bool(holder) and holder != self.identity
+                except Exception:
+                    pass
+                if held_elsewhere or (time.time() - self._last_renew_ok
+                                      > self.lease_duration_s):
+                    self._leading = False
+                    log.warning("leadership lost (lease %s/%s)",
+                                self.namespace, self.name)
+                    if self.on_stopped_leading is not None:
+                        try:
+                            self.on_stopped_leading()
+                        except Exception:
+                            log.exception("on_stopped_leading failed")
+            self._wake.wait(self.retry_period_s)
+            self._wake.clear()

@@ -314,11 +314,20 @@ class LiveOperator:
 
     def __init__(self, api, models_root: str, interval_s: float = 1.0,
                  serve_port: int = 8080, use_watch: bool = True,
-                 resync_interval_s: float | None = None):
+                 resync_interval_s: float | None = None,
+                 leader_elector=None, exit_on_lost_lease: bool = True):
         from arks_tpu.control.manager import build_manager
 
         self.api = api
         self.interval_s = interval_s
+        # Leader election (reference cmd/main.go:198-216): with an elector,
+        # the reconcile machinery starts only on lease acquisition —
+        # standby replicas ingest nothing and write nothing.  Losing a held
+        # lease is fatal by default (controller-runtime semantics: caches
+        # and in-flight writes are no longer trustworthy); tests pass
+        # exit_on_lost_lease=False to observe the transition in-process.
+        self.elector = leader_elector
+        self.exit_on_lost_lease = exit_on_lost_lease
         # Watch-driven ingest (the reference is watch-driven controller-
         # runtime, cmd/main.go:255-301): spec changes propagate at event
         # latency instead of poll latency, and apiserver load per change is
@@ -338,6 +347,8 @@ class LiveOperator:
                                      driver=self.driver, store=self.store,
                                      router_discovery="kubernetes")
         self._running = False
+        self._started = False
+        self._machinery_started = False
         self._thread: threading.Thread | None = None
         self._watchers: list[threading.Thread] = []
         # Last status we projected per (plural, ns, name) — avoids writing
@@ -352,24 +363,90 @@ class LiveOperator:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
-        self.manager.start()
-        self._running = True
-        self._thread = threading.Thread(target=self._loop, name="live-sync",
-                                        daemon=True)
-        self._thread.start()
-        if self.use_watch:
-            for kind, plural, wire_kind in KINDS:
-                t = threading.Thread(
-                    target=self._watch_loop, args=(kind, plural),
-                    name=f"live-watch-{plural}", daemon=True)
-                t.start()
-                self._watchers.append(t)
+        self._started = True
+        if self.elector is None:
+            self._start_machinery()
+            return
+        self.elector.on_started_leading = self._start_machinery
+        self.elector.on_stopped_leading = self._on_lost_lease
+        self.elector.start()
 
-    def stop(self) -> None:
+    def _start_machinery(self) -> None:
+        """Start controllers + ingest.  With an elector this fires from the
+        elector thread on lease acquisition; without one, from start()."""
+        if self._machinery_started:
+            return
+        self._machinery_started = True
+        try:
+            self.manager.start()
+            self._running = True
+            self._thread = threading.Thread(target=self._loop,
+                                            name="live-sync", daemon=True)
+            self._thread.start()
+            if self.use_watch:
+                for kind, plural, wire_kind in KINDS:
+                    t = threading.Thread(
+                        target=self._watch_loop, args=(kind, plural),
+                        name=f"live-watch-{plural}", daemon=True)
+                    t.start()
+                    self._watchers.append(t)
+        except Exception:
+            # Leave a clean slate: the elector releases the lease on a
+            # failed start callback, and a later re-acquisition must be
+            # able to try again.
+            self._machinery_started = False
+            self._running = False
+            raise
+
+    def _on_lost_lease(self) -> None:
+        if self.exit_on_lost_lease:
+            log.critical("leader lease lost; exiting so the replacement "
+                         "leader reconciles from a fresh cache")
+            import os
+            os._exit(1)
+        log.warning("leader lease lost; stopping reconcile machinery")
+        self._stop_machinery()
+
+    def _stop_machinery(self) -> None:
+        if not self._machinery_started:
+            return
+        self._machinery_started = False
         self._running = False
         if self._thread:
             self._thread.join(timeout=10)
         self.manager.stop()
+
+    @property
+    def is_leader(self) -> bool:
+        """True when reconciling (always, without an elector)."""
+        return self._machinery_started if self.elector is None \
+            else self.elector.is_leader
+
+    @property
+    def healthy(self) -> bool:
+        """Liveness: a standby is healthy idling; a leader is healthy only
+        while its sync thread is."""
+        if not self._machinery_started:
+            return True
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def ready(self) -> bool:
+        """Readiness gates SERVICE TRAFFIC, not liveness: the operator pod
+        embeds the QoS gateway, and a standby's gateway serves an EMPTY
+        store (it ingests nothing until it leads) — so only the leader may
+        be in the Service's endpoints.  Standbys stay alive via /healthz
+        and flip ready the moment they acquire the lease."""
+        return self._started and (self.elector is None
+                                  or self.elector.is_leader)
+
+    def stop(self) -> None:
+        if self.elector is not None:
+            # Release FIRST: the standby takes over at its next retry
+            # instead of waiting out the lease duration.
+            self.elector.stop(release=True)
+        self._stop_machinery()
+        self._started = False
 
     def _loop(self) -> None:
         next_resync = 0.0
@@ -578,6 +655,62 @@ class LiveOperator:
                 self._projected.pop((plural, ns, name), None)
 
 
+class HealthServer:
+    """``/healthz`` + ``/readyz`` for the operator pod — the endpoints the
+    reference manager wires at :8081 (/root/reference/cmd/main.go:320-327)
+    and that deploy/operator.yaml's probes hit.  Standby replicas are live
+    but NOT ready (readiness keeps the embedded gateway's Service pointed
+    at the leader — a standby's gateway would serve an empty store); a
+    leader whose sync thread died fails liveness so the kubelet restarts
+    it."""
+
+    def __init__(self, operator: "LiveOperator", host: str = "0.0.0.0",
+                 port: int = 8082):
+        import http.server
+        import json as _json
+        import socketserver
+
+        op = operator
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet probes
+                pass
+
+            def do_GET(self):
+                if self.path.split("?")[0] == "/healthz":
+                    ok = op.healthy
+                elif self.path.split("?")[0] == "/readyz":
+                    ok = op.ready
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = _json.dumps({
+                    "ok": ok, "leader": op.is_leader,
+                    "identity": getattr(op.elector, "identity", None),
+                }).encode()
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._srv = Server((host, port), Handler)
+        self.host, self.port = self._srv.server_address
+
+    def start(self) -> None:
+        threading.Thread(target=self._srv.serve_forever,
+                         name="operator-health", daemon=True).start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
 def main() -> None:
     import argparse
 
@@ -597,6 +730,14 @@ def main() -> None:
     p.add_argument("--gateway-port", type=int, default=8081,
                    help="embedded QoS gateway over the live store (0 = off) "
                         "— ArksToken/Quota/Endpoint CRs gate traffic here")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="coordination.k8s.io Lease leader election: extra "
+                        "replicas idle until the holder dies "
+                        "(reference cmd/main.go:198-216)")
+    p.add_argument("--leader-elect-namespace", default=None,
+                   help="lease namespace (default: the pod's namespace)")
+    p.add_argument("--health-port", type=int, default=8082,
+                   help="/healthz + /readyz endpoint port (0 = off)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -610,8 +751,22 @@ def main() -> None:
                       verify=not args.insecure_skip_tls_verify)
     else:
         api = KubeApi.in_cluster()
+    elector = None
+    if args.leader_elect:
+        from arks_tpu.control.leader import LeaderElector
+        ns = args.leader_elect_namespace
+        if ns is None:
+            try:
+                ns = KubeApi.namespace_in_cluster()
+            except Exception:
+                ns = "default"
+        elector = LeaderElector(api, namespace=ns)
     op = LiveOperator(api, models_root=args.models_root,
-                      interval_s=args.interval)
+                      interval_s=args.interval, leader_elector=elector)
+    health = None
+    if args.health_port:
+        health = HealthServer(op, port=args.health_port)
+        health.start()
     op.start()
     gw = None
     if args.gateway_port:
@@ -626,6 +781,8 @@ def main() -> None:
     except KeyboardInterrupt:
         if gw is not None:
             gw.stop()
+        if health is not None:
+            health.stop()
         op.stop()
 
 

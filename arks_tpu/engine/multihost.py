@@ -341,10 +341,12 @@ class DispatchFollower:
             # Key lockstep rides the shared _sampling state: both sides
             # evolve it with the kernel's deterministic splits.
             fn = eng._spec_lp_fn if p.get("lp") else eng._spec_fn
+            tables = p.get("tables")
             out = fn(
                 eng.params, eng._draft_params, eng._cache, eng._draft_cache,
                 jnp.asarray(p["tokens"]), jnp.asarray(p["lengths"]),
-                eng._sampling, jnp.asarray(p["enable"]))
+                eng._sampling, jnp.asarray(p["enable"]),
+                None if tables is None else jnp.asarray(tables))
             eng._cache, eng._draft_cache = out[0], out[1]
             counts = out[3]
             eng._sampling = out[4]

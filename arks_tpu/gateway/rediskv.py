@@ -182,8 +182,12 @@ class RespClient:
             conn.sock.sendall(payload)
         try:
             return [conn.read_reply() for _ in commands]
-        except (OSError, ConnectionError):
+        except (OSError, ConnectionError) as e:
             self._drop_conn()
+            # Mark for the cluster client: the batch MAY have executed
+            # (failure while reading replies) — re-executing it on another
+            # node could double-count INCRBYs.
+            e._resp_read_phase = True  # type: ignore[attr-defined]
             raise
 
     def command(self, *args):
@@ -234,6 +238,9 @@ class RespClusterClient:
         self._clients: dict[tuple[str, int], RespClient] = {}
         self._default = tuple(addrs[0])
         self._slots: dict[int, tuple[str, int]] = {}
+        # Known nodes = seeds + masters learned from CLUSTER SLOTS + MOVED
+        # targets: the candidate set for failover when a node dies.
+        self._nodes: set[tuple[str, int]] = {tuple(a) for a in addrs}
         self._lock = threading.Lock()
         # Fail fast needs ONE reachable seed, not all of them — a seed down
         # for maintenance must not block gateway startup when the rest of
@@ -243,10 +250,75 @@ class RespClusterClient:
             try:
                 self._default = tuple(a)
                 self._client(self._default)
+                self._bootstrap_slots()
                 return
             except (OSError, ConnectionError) as e:
                 last = e
         raise ConnectionError(f"no cluster seed reachable: {last}")
+
+    def _bootstrap_slots(self) -> None:
+        """Populate the slot map up front via ``CLUSTER SLOTS`` so commands
+        go to the right node on the FIRST try, and record every master as a
+        failover candidate.  Best-effort: a standalone Redis answers -ERR
+        (cluster support disabled) and the client falls back to learning
+        mappings from MOVED redirects."""
+        with self._lock:
+            candidates = [self._default] + sorted(self._nodes
+                                                  - {self._default})
+        for addr in candidates:
+            try:
+                reply = self._client(addr).pipeline_raw(
+                    ("CLUSTER", "SLOTS"))[0]
+            except (OSError, ConnectionError):
+                continue
+            if isinstance(reply, RespError) or not isinstance(reply, list):
+                return  # not a cluster — MOVED-learning mode
+            mapping: dict[int, tuple[str, int]] = {}
+            nodes: set[tuple[str, int]] = set()
+            for entry in reply:
+                try:
+                    start, end, master = int(entry[0]), int(entry[1]), entry[2]
+                    host = master[0].decode() \
+                        if isinstance(master[0], (bytes, bytearray)) \
+                        else str(master[0])
+                    node = (host, int(master[1]))
+                except (TypeError, ValueError, IndexError):
+                    continue
+                nodes.add(node)
+                for s in range(start, end + 1):
+                    mapping[s] = node
+            with self._lock:
+                self._slots.update(mapping)
+                self._nodes |= nodes
+            return
+
+    def _failover(self, dead: tuple[str, int]) -> bool:
+        """``dead`` stopped answering: drop its client, purge its slot
+        entries, re-point the default at a reachable survivor, and re-learn
+        the topology (the cluster may have promoted a replica).  Returns
+        True if another node is available to retry against."""
+        with self._lock:
+            c = self._clients.pop(dead, None)
+            self._nodes.discard(dead)
+            self._slots = {s: a for s, a in self._slots.items() if a != dead}
+            survivors = sorted(self._nodes)
+            was_default = self._default == dead
+        if c is not None:
+            c.close()
+        if not survivors:
+            return False
+        if was_default:
+            for cand in survivors:
+                try:
+                    self._client(cand)
+                except (OSError, ConnectionError):
+                    continue
+                self._default = cand
+                break
+            else:
+                return False
+        self._bootstrap_slots()
+        return True
 
     def _client(self, addr: tuple[str, int]) -> RespClient:
         with self._lock:
@@ -304,11 +376,18 @@ class RespClusterClient:
         if kind == "MOVED":
             with self._lock:
                 self._slots[int(slot)] = new_addr
-        target = self._client(new_addr)
-        if kind == "ASK":
-            reply = target.pipeline_raw(("ASKING",), cmd)[1]
-        else:
-            reply = target.pipeline_raw(cmd)[0]
+                self._nodes.add(new_addr)  # redirect target = live master
+        try:
+            target = self._client(new_addr)
+            if kind == "ASK":
+                reply = target.pipeline_raw(("ASKING",), cmd)[1]
+            else:
+                reply = target.pipeline_raw(cmd)[0]
+        except (OSError, ConnectionError) as e:
+            # Tag the failing hop so pipeline() can run its failover path
+            # (the redirect pointed at a node that just died).
+            e._arks_addr = new_addr  # type: ignore[attr-defined]
+            raise
         if isinstance(reply, RespError):
             raise reply
         return reply
@@ -317,18 +396,55 @@ class RespClusterClient:
         # Group commands by their slot's node so same-node batches (the
         # hot-path INCRBY+TTL pair) stay ONE round trip; redirected replies
         # are retried individually and the results restored to input order.
-        by_addr: dict[tuple[str, int], list[int]] = {}
-        for i, cmd in enumerate(commands):
-            by_addr.setdefault(self._addr_for(cmd), []).append(i)
+        #
+        # Node failure: connect/send failures CANNOT have executed, so the
+        # affected commands are re-routed through the relearned topology
+        # (bounded retries).  A failure while READING replies may have
+        # executed — the topology is still relearned, but the error
+        # propagates (re-running INCRBYs would double-count rate windows;
+        # same policy as RespClient.pipeline).
         out: list = [None] * len(commands)
-        for addr, idxs in by_addr.items():
-            replies = self._client(addr).pipeline_raw(
-                *(commands[i] for i in idxs))
-            for i, reply in zip(idxs, replies):
-                if isinstance(reply, RespError):
-                    reply = self._follow_redirect(commands[i], reply)
-                out[i] = reply
-        return out
+        todo = list(range(len(commands)))
+        last: Exception | None = None
+        for _ in range(3):
+            by_addr: dict[tuple[str, int], list[int]] = {}
+            for i in todo:
+                by_addr.setdefault(self._addr_for(commands[i]), []).append(i)
+            failed: list[int] = []
+            for addr, idxs in by_addr.items():
+                try:
+                    replies = self._client(addr).pipeline_raw(
+                        *(commands[i] for i in idxs))
+                except (OSError, ConnectionError) as e:
+                    alive = self._failover(addr)
+                    if getattr(e, "_resp_read_phase", False) or not alive:
+                        raise
+                    last = e
+                    failed.extend(idxs)
+                    continue
+                for i, reply in zip(idxs, replies):
+                    if isinstance(reply, RespError):
+                        try:
+                            reply = self._follow_redirect(commands[i], reply)
+                        except (OSError, ConnectionError) as e:
+                            # The REDIRECT TARGET died mid-hop: same
+                            # failover rules as a direct node failure (a
+                            # connect/send failure never executed, so the
+                            # command is safe to re-route).
+                            hop = getattr(e, "_arks_addr", None)
+                            alive = hop is not None and self._failover(hop)
+                            if getattr(e, "_resp_read_phase", False) \
+                                    or not alive:
+                                raise
+                            last = e
+                            failed.append(i)
+                            continue
+                    out[i] = reply
+            if not failed:
+                return out
+            todo = failed
+        raise last if last is not None else ConnectionError(
+            "cluster pipeline retries exhausted")
 
     def command(self, *args):
         return self.pipeline(tuple(args))[0]
@@ -557,6 +673,17 @@ class _Handler(socketserver.StreamRequestHandler):
                     % (len(h), h, len(p), p))
         if cmd == b"ASKING":
             return b"+OK\r\n"
+        if cmd == b"CLUSTER" and len(args) >= 2 \
+                and args[1].upper() == b"SLOTS":
+            ranges = getattr(srv, "cluster_slots", None)
+            if not ranges:
+                return b"-ERR This instance has cluster support disabled\r\n"
+            out = b"*%d\r\n" % len(ranges)
+            for start, end, host, port in ranges:
+                h = str(host).encode()
+                out += (b"*3\r\n:%d\r\n:%d\r\n*2\r\n$%d\r\n%s\r\n:%d\r\n"
+                        % (int(start), int(end), len(h), h, int(port)))
+            return out
         moved = getattr(srv, "moved_slots", None)
         if moved and len(args) > 1:
             slot = key_slot(args[1])
@@ -618,9 +745,11 @@ class RespServer:
         self._srv.kv = _KV()  # type: ignore[attr-defined]
         # Topology test doubles (see _Handler._dispatch):
         # sentinel_masters: {master_name: (host, port)};
-        # moved_slots: {slot: "host:port"} -> -MOVED redirects.
+        # moved_slots: {slot: "host:port"} -> -MOVED redirects;
+        # cluster_slots: [(start, end, host, port)] -> CLUSTER SLOTS reply.
         self._srv.sentinel_masters = {}  # type: ignore[attr-defined]
         self._srv.moved_slots = {}  # type: ignore[attr-defined]
+        self._srv.cluster_slots = []  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address
 
     @property
@@ -630,6 +759,10 @@ class RespServer:
     @property
     def moved_slots(self) -> dict:
         return self._srv.moved_slots  # type: ignore[attr-defined]
+
+    @property
+    def cluster_slots(self) -> list:
+        return self._srv.cluster_slots  # type: ignore[attr-defined]
 
     def start(self, background: bool = True) -> None:
         if background:

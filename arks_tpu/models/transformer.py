@@ -880,12 +880,13 @@ def decode_step(
 def verify_step(
     params: Params,
     cfg: ModelConfig,
-    cache: KVCache,
+    cache: KVCache | PagedKVCache,
     tokens: jnp.ndarray,   # [B, K] int32 — K tokens per slot (t0 + drafts)
     lengths: jnp.ndarray,  # [B] int32 — tokens already in cache per slot
     mesh: Mesh | None = None,
     batch_axis: str | None = None,
-) -> tuple[jnp.ndarray, KVCache]:
+    tables: jnp.ndarray | None = None,  # [B, MaxP] int32 — PagedKVCache only
+) -> tuple[jnp.ndarray, KVCache | PagedKVCache]:
     """Multi-token decode: advance every slot K tokens in ONE pass.
 
     The speculative-decoding verifier (and a general batched multi-token
@@ -895,22 +896,43 @@ def verify_step(
     Rows written for later-rejected draft tokens become garbage beyond the
     accepted length — every read path masks by position, and the next
     dispatch overwrites them (the same invariant as decode_step's padding
-    writes)."""
+    writes).
+
+    Paged caches take ``tables``; ``lengths >= coverage`` is the inactive-
+    slot sentinel (block writes dropped, nothing attended), exactly as in
+    ``decode_step``.  A verify block may cross a page boundary — the paged
+    update routes each row through the table independently."""
     b, kk = tokens.shape
     h = embed_lookup(params["embed"], tokens,
                      params["layers"]["attn_norm"].dtype)      # [B, K, E]
     h = _constrain(h, mesh, batch_axis, None, None)
     positions = lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)  # [B, K]
     kv_sharded = mesh is not None and shard_kv_heads(cfg, mesh.shape.get(AXIS_MODEL, 1))
-    from arks_tpu.ops.attention import verify_update_and_attend
+    paged = isinstance(cache, PagedKVCache)
+    if paged and tables is None:
+        raise ValueError("verify_step with a PagedKVCache requires tables")
+    if paged:
+        # RoPE positions must be real for active slots; the sentinel value
+        # (>= coverage) only matters to the cache ops, which drop it.
+        cover = tables.shape[1] * cache.page
+        rope_pos = jnp.minimum(positions, cover - 1)
+    else:
+        rope_pos = positions
+    from arks_tpu.ops.attention import (
+        paged_verify_update_and_attend, verify_update_and_attend)
 
     def body(carry, xs):
         h, kc, vc, ksc, vsc = carry
         lp, layer = xs
-        q, k, v = _block_qkv(h, lp, cfg, positions)  # [B, K, H(.kv), D]
-        attn, kc, vc, ksc, vsc = verify_update_and_attend(
-            q, k, v, kc, vc, positions, lengths, layer, mesh, batch_axis,
-            kv_sharded, model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
+        q, k, v = _block_qkv(h, lp, cfg, rope_pos)   # [B, K, H(.kv), D]
+        if paged:
+            attn, kc, vc, ksc, vsc = paged_verify_update_and_attend(
+                q, k, v, kc, vc, tables, positions, layer, mesh, kv_sharded,
+                model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
+        else:
+            attn, kc, vc, ksc, vsc = verify_update_and_attend(
+                q, k, v, kc, vc, positions, lengths, layer, mesh, batch_axis,
+                kv_sharded, model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
         attn = attn.reshape(b, kk, cfg.q_dim)
         attn = _constrain(attn, mesh, batch_axis, None, AXIS_MODEL)
         h = _block_tail(h, attn, lp, cfg, mesh, batch_axis)
@@ -922,7 +944,8 @@ def verify_step(
     # unembed_logits is 2D-shaped; fold K into the batch for the vocab dot.
     logits = _unembed(h.reshape(b * kk, -1), params, cfg, mesh,
                       batch_axis).reshape(b, kk, -1)
-    return logits, KVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+    cls = PagedKVCache if paged else KVCache
+    return logits, cls(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
 
 
 # ---------------------------------------------------------------------------

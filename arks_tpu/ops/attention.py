@@ -262,6 +262,77 @@ def verify_update_and_attend(
     return out, kc, vc, k_scale, v_scale
 
 
+def paged_verify_update_and_attend(
+    q: jnp.ndarray,        # [B, K, H, D] — K tokens per slot
+    k_new: jnp.ndarray,    # [B, K, Hkv, D]
+    v_new: jnp.ndarray,
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,   # [B, MaxP] int32 block tables
+    positions: jnp.ndarray,  # [B, K] int32 — write positions per token
+    layer,
+    mesh=None,
+    kv_sharded: bool = False,
+    model_axis: str = "model",
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray | None, jnp.ndarray | None]:
+    """Paged speculative-verify: write the K-row block through the block
+    table (a block may cross a page boundary mid-dispatch), then attend
+    each query over its table pages — index s valid iff s <=
+    positions[b, k].  Positions at/past the table coverage are the
+    inactive-slot sentinel: writes dropped, nothing attended.
+
+    XLA path only, like the slot-layout ``verify_update_and_attend``: K is
+    small (draft lengths 2-8), so the gather + [B, Hkv, G, K, S] scores
+    stay modest; under a TP mesh the partitioner splits the Hkv axis the
+    same way the paged XLA decode fallback does."""
+    del mesh, kv_sharded, model_axis
+    b, kk, h, d_model = q.shape
+    hkv = k_pool.shape[2]
+    g = h // hkv
+    page = k_pool.shape[3]
+    cover = tables.shape[1] * page
+    # Lane padding (see decode_update_and_attend): pad to the pool's stored
+    # head dim, prescale q to keep the effective 1/sqrt(d_model) scale.
+    d = k_pool.shape[-1]
+    if d != d_model:
+        q = _pad_last(q, d) * ((d / d_model) ** 0.5)
+        k_new = _pad_last(k_new, d)
+        v_new = _pad_last(v_new, d)
+    quantized = k_scale is not None
+
+    from arks_tpu.ops.paged_attention import (
+        paged_gather_kv, paged_update_block_xla)
+    kp, vp, ks, vs = paged_update_block_xla(
+        k_pool, v_pool, k_scale, v_scale, k_new, v_new, positions, tables,
+        layer)
+    kc = paged_gather_kv(kp, tables, layer)    # [B, Hkv, cover, D]
+    vc = paged_gather_kv(vp, tables, layer)
+
+    scale = 1.0 / (d ** 0.5)
+    qg = jnp.transpose(q.reshape(b, kk, hkv, g, d),
+                       (0, 2, 3, 1, 4))        # [B, Hkv, G, K, D]
+    scores = jnp.einsum("bkgqd,bksd->bkgqs", qg, kc.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * scale
+    if quantized:
+        ksc = paged_gather_kv(ks, tables, layer)   # [B, Hkv, cover]
+        vsc = paged_gather_kv(vs, tables, layer)
+        scores = scores * ksc[:, :, None, None, :]
+    valid = (jnp.arange(cover)[None, None] <= positions[:, :, None]) \
+        & (positions[:, :, None] < cover)          # [B, K, S]
+    scores = jnp.where(valid[:, None, None], scores, _NEG_INF)
+    probs = _softmax(scores, axis=-1)
+    if quantized:
+        probs = probs * vsc[:, :, None, None, :]
+    out = jnp.einsum("bkgqs,bksd->bkgqd", probs.astype(q.dtype),
+                     vc.astype(q.dtype), preferred_element_type=jnp.float32)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(
+        b, kk, h, d)[..., :d_model].astype(q.dtype)
+    return out, kp, vp, ks, vs
+
+
 def paged_decode_update_and_attend(
     q: jnp.ndarray,        # [B, H, D]
     k_new: jnp.ndarray,    # [B, Hkv, D]

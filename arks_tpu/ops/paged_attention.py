@@ -99,6 +99,44 @@ def paged_update_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
     return k_pool, v_pool, k_scale, v_scale
 
 
+def paged_update_block_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
+                           positions, tables, layer):
+    """Scatter a K-row KV BLOCK per slot (the speculative-verify write)
+    through the block table in one gather+scatter.  ``k_new``/``v_new`` are
+    [B, K, Hkv, D]; row k of slot b lands at position ``positions[b, k]``
+    (which may cross a page boundary mid-block).  Positions at/past the
+    table's coverage are dropped — the inactive-slot sentinel, same
+    out-of-bounds-page guard as ``paged_update_xla``."""
+    p = k_pool.shape[3]
+    n = k_pool.shape[1]
+    b, kk, hkv, d = k_new.shape
+    cover = tables.shape[1] * p
+    oob = positions >= cover                              # [B, K]
+    safe = jnp.where(oob, 0, positions)
+    page = jnp.take_along_axis(tables, safe // p, axis=1)  # [B, K]
+    page = jnp.where(oob, n, page)
+    off = safe % p
+    l_idx = jnp.full((b, kk, hkv), layer, jnp.int32)
+    pg = page[:, :, None]
+    of = off[:, :, None]
+    h_idx = jnp.arange(hkv)[None, None, :]
+    quantized = k_scale is not None
+    if quantized:
+        from arks_tpu.ops.pallas_attention import quantize_kv
+        kq, ksn = quantize_kv(k_new)
+        vq, vsn = quantize_kv(v_new)
+        k_pool = k_pool.at[l_idx, pg, h_idx, of].set(kq)
+        v_pool = v_pool.at[l_idx, pg, h_idx, of].set(vq)
+        k_scale = k_scale.at[l_idx, pg, h_idx, of].set(ksn)
+        v_scale = v_scale.at[l_idx, pg, h_idx, of].set(vsn)
+    else:
+        k_pool = k_pool.at[l_idx, pg, h_idx, of].set(
+            k_new.astype(k_pool.dtype))
+        v_pool = v_pool.at[l_idx, pg, h_idx, of].set(
+            v_new.astype(v_pool.dtype))
+    return k_pool, v_pool, k_scale, v_scale
+
+
 # ---------------------------------------------------------------------------
 # Paged ragged decode attention (manual double-buffered DMA)
 # ---------------------------------------------------------------------------

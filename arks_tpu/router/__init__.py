@@ -90,7 +90,8 @@ class KubeDiscovery:
     ``arks.ai/component`` prefill/decode, keeps READY ones (worker
     processes of a gang return 503 on /readiness, so only leaders are
     Ready — exactly the addresses that serve), and addresses them as
-    ``podIP:containerPort`` (first declared container port; falls back to
+    ``podIP:containerPort`` (the port named ``http`` — k8s_export's serving
+    port name — else a single unambiguous declared port; falls back to
     ``backend_port``).  Results are cached for ``interval_s`` — the same
     poll cadence the live operator uses; env fallback
     (ARKS_PREFILL_ADDRS/ARKS_DECODE_ADDRS) covers bootstrap windows."""
@@ -120,13 +121,22 @@ class KubeDiscovery:
         ip = pod.get("status", {}).get("podIP")
         if not ip:
             return None
-        port = self.backend_port
-        for c in pod.get("spec", {}).get("containers", []):
-            ports = c.get("ports") or []
-            if ports:
-                port = ports[0].get("containerPort", port)
-                break
-        return f"{ip}:{port}"
+        # Prefer the port NAMED "http" (the name k8s_export assigns to the
+        # serving port): a pod whose first declared port is a metrics port,
+        # or with a sidecar ordered first, must not silently hijack routing.
+        # A single unnamed declared port is unambiguous and honored; any
+        # other ambiguity falls back to backend_port.
+        declared = [p for c in pod.get("spec", {}).get("containers", [])
+                    for p in (c.get("ports") or []) if p.get("containerPort")]
+        for p in declared:
+            if p.get("name") == "http":
+                return f"{ip}:{p['containerPort']}"
+        if len(declared) == 1 and not declared[0].get("name"):
+            # Unnamed single port: unambiguous.  A single NAMED non-http
+            # port (e.g. only a metrics port declared) is not a serving
+            # port — fall through to backend_port.
+            return f"{ip}:{declared[0]['containerPort']}"
+        return f"{ip}:{self.backend_port}"
 
     def _refresh(self) -> None:
         roles: dict[str, list[str]] = {"prefill": [], "decode": []}

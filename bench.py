@@ -32,14 +32,60 @@ from __future__ import annotations
 import functools
 import json
 import os
+import subprocess
+import sys
 import time
 
+# Importing jax is safe before the probe — backend init is lazy (only
+# jax.devices()/first dispatch touches the tunnel).
 import jax
+
+# This image's sitecustomize imports jax at interpreter startup under the
+# default platform, so the JAX_PLATFORMS env var alone is TOO LATE by the
+# time bench.py runs — apply it through jax.config (same trick as
+# tests/conftest.py).  Without this, a CPU run of the bench would still
+# probe the TPU tunnel and hang when it is down.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import numpy as np
 
 BASELINE_TOK_S_CHIP = 2000.0
 TARGET_TTFT_MS = 200.0
+
+
+def probe_backend(timeout_s: float = 180.0, attempts: int = 3,
+                  backoff_s: float = 30.0) -> tuple[bool, str]:
+    """Probe JAX backend init in a SUBPROCESS with a timeout, retrying with
+    bounded backoff.  Backend init on a tunneled TPU platform can *hang
+    forever* (not just raise) when the tunnel is down — probing in-process
+    would mean the driver gets a timeout and no JSON at all.  Returns
+    (ok, last_error)."""
+    last = ""
+    # The probe must target the SAME platform the bench will use; the
+    # sitecustomize-imported jax ignores a late JAX_PLATFORMS env var, so
+    # route it through jax.config (see the module-level note).
+    code = ("import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "print(len(jax.devices()))\n")
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s)
+            if r.returncode == 0:
+                return True, ""
+            last = (r.stderr or r.stdout).strip().splitlines()[-1][-500:] \
+                if (r.stderr or r.stdout).strip() else f"rc={r.returncode}"
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung past {timeout_s:.0f}s (tunnel down?)"
+        if i + 1 < attempts:
+            print(f"# backend probe {i + 1}/{attempts} failed: {last}; "
+                  f"retrying in {backoff_s:.0f}s", file=sys.stderr, flush=True)
+            time.sleep(backoff_s)
+    return False, last
 
 
 def pallas_parity_check(kv_quant: bool) -> float:
@@ -114,6 +160,7 @@ def main() -> None:
     from arks_tpu.models import transformer as tf
 
     model = os.environ.get("ARKS_BENCH_MODEL", "qwen2.5-7b")
+    result: dict = {}
     # 192 beats 128 by ~9% and keeps ~2GB more HBM headroom than 256 on a
     # 16GB v5e (256 was only ~1% faster than 192 when measured).
     batch = int(os.environ.get("ARKS_BENCH_BATCH", "192"))
@@ -130,106 +177,154 @@ def main() -> None:
     weight_dtype = os.environ.get("ARKS_BENCH_WEIGHT_DTYPE", "int8")
     kv_quant = kv_dtype == "int8"
 
+    result["metric"] = (f"decode_throughput_{model}_b{batch}"
+                        f"_w-{weight_dtype}_kv-{kv_dtype}")
+    result["value"] = 0.0
+    result["unit"] = "tok/s/chip"
+    result["vs_baseline"] = 0.0
+
+    # Backend availability gate: a flaky tunnel must produce a structured
+    # JSON line — under the SAME metric name as a real run, so the failure
+    # evidence lands next to the numbers it annotates — not a stack trace
+    # and rc=1 (BENCH_r03 lost a round of evidence that way).
+    ok, err = probe_backend(
+        timeout_s=float(os.environ.get("ARKS_BENCH_PROBE_TIMEOUT", "180")),
+        attempts=int(os.environ.get("ARKS_BENCH_PROBE_ATTEMPTS", "3")),
+        backoff_s=float(os.environ.get("ARKS_BENCH_PROBE_BACKOFF", "30")))
+    if not ok:
+        result["error"] = f"jax backend unavailable after retries: {err}"
+        print(json.dumps(result))
+        return
+
     cfg = get_config(model)
     n_chips = len(jax.devices())
-    mesh = None
-    if n_chips > 1:
-        from arks_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh(tensor_parallel=n_chips)
 
-    if weight_dtype == "int8":
-        params = quant.init_params_quantized(cfg, jax.random.PRNGKey(0))
-    else:
-        params = tf.init_params(cfg, jax.random.PRNGKey(0))
-    if mesh is not None:
-        params = tf.shard_params(params, cfg, mesh)
+    # ---- Raw-loop sections: fault-isolated so a failure here still leaves
+    # a serving run + a parsable JSON line. ---------------------------------
+    try:
+        mesh = None
+        if n_chips > 1:
+            from arks_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(tensor_parallel=n_chips)
 
-    # ---- TTFT: bucketed single-prompt prefill + first-token argmax --------
-    def first_token(params, tokens, lengths):
-        logits, ks, vs = tf.prefill(params, cfg, tokens, lengths, mesh)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if weight_dtype == "int8":
+            params = quant.init_params_quantized(cfg, jax.random.PRNGKey(0))
+        else:
+            params = tf.init_params(cfg, jax.random.PRNGKey(0))
+        if mesh is not None:
+            params = tf.shard_params(params, cfg, mesh)
 
-    prefill_fn = jax.jit(first_token)
-    toks = jnp.zeros((1, prompt_len), jnp.int32)
-    lens = jnp.asarray([prompt_len], jnp.int32)
-    np.asarray(prefill_fn(params, toks, lens))  # warmup/compile
-    ttft_ms = []
-    for _ in range(ttft_trials):
-        t0 = time.perf_counter()
-        np.asarray(prefill_fn(params, toks, lens))  # host fetch = barrier
-        ttft_ms.append((time.perf_counter() - t0) * 1e3)
-    ttft_p50 = float(np.percentile(ttft_ms, 50))
+        # -- TTFT: bucketed single-prompt prefill + first-token argmax
+        # (UNLOADED — the loaded counterpart comes from the serving bench).
+        def first_token(params, tokens, lengths):
+            logits, ks, vs = tf.prefill(params, cfg, tokens, lengths, mesh)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # ---- Decode throughput: fused multi-step loop -------------------------
-    cache = tf.init_cache(cfg, num_slots=batch, max_len=cache_len,
-                          quantized=kv_quant)
+        prefill_fn = jax.jit(first_token)
+        toks = jnp.zeros((1, prompt_len), jnp.int32)
+        lens = jnp.asarray([prompt_len], jnp.int32)
+        np.asarray(prefill_fn(params, toks, lens))  # warmup/compile
+        ttft_ms = []
+        for _ in range(ttft_trials):
+            t0 = time.perf_counter()
+            np.asarray(prefill_fn(params, toks, lens))  # host fetch = barrier
+            ttft_ms.append((time.perf_counter() - t0) * 1e3)
+        ttft_p50 = float(np.percentile(ttft_ms, 50))
+        result["ttft_p50_ms"] = round(ttft_p50, 1)
+        result["ttft_prompt_len"] = prompt_len
+        result["ttft_vs_target"] = round(TARGET_TTFT_MS / ttft_p50, 3)
 
-    def multi_step(params, cache, tokens, lengths):
-        def body(carry, _):
-            cache, tokens, lengths = carry
-            logits, cache = tf.decode_step(params, cfg, cache, tokens, lengths, mesh)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (cache, nxt, lengths + 1), nxt
-        (cache, tokens, lengths), out = jax.lax.scan(
-            body, (cache, tokens, lengths), None, length=steps)
-        return cache, tokens, lengths, out
+        # -- Decode throughput: fused multi-step loop
+        cache = tf.init_cache(cfg, num_slots=batch, max_len=cache_len,
+                              quantized=kv_quant)
 
-    fn = jax.jit(multi_step, donate_argnums=(1,))
-    tokens = jnp.zeros((batch,), jnp.int32)
-    # Mid-cache lengths: each decode step attends ~cache_len/2 of KV,
-    # a representative steady-state working set.
-    lengths = jnp.full((batch,), cache_len // 2, jnp.int32)
+        def multi_step(params, cache, tokens, lengths):
+            def body(carry, _):
+                cache, tokens, lengths = carry
+                logits, cache = tf.decode_step(
+                    params, cfg, cache, tokens, lengths, mesh)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (cache, nxt, lengths + 1), nxt
+            (cache, tokens, lengths), out = jax.lax.scan(
+                body, (cache, tokens, lengths), None, length=steps)
+            return cache, tokens, lengths, out
 
-    cache, tokens, lengths, out = fn(params, cache, tokens, lengths)
-    np.asarray(out[-1])  # warmup/compile
-
-    best = float("inf")
-    for _ in range(trials):
+        fn = jax.jit(multi_step, donate_argnums=(1,))
+        tokens = jnp.zeros((batch,), jnp.int32)
+        # Mid-cache lengths: each decode step attends ~cache_len/2 of KV,
+        # a representative steady-state working set.
         lengths = jnp.full((batch,), cache_len // 2, jnp.int32)
-        t0 = time.perf_counter()
-        cache, tokens, lengths, out = fn(params, cache, tokens, lengths)
-        np.asarray(out[-1])  # host fetch of sampled ids = completion barrier
-        best = min(best, time.perf_counter() - t0)
 
-    tok_s_chip = batch * steps / best / max(n_chips, 1)
+        cache, tokens, lengths, out = fn(params, cache, tokens, lengths)
+        np.asarray(out[-1])  # warmup/compile
+
+        best = float("inf")
+        for _ in range(trials):
+            lengths = jnp.full((batch,), cache_len // 2, jnp.int32)
+            t0 = time.perf_counter()
+            cache, tokens, lengths, out = fn(params, cache, tokens, lengths)
+            np.asarray(out[-1])  # host fetch of ids = completion barrier
+            best = min(best, time.perf_counter() - t0)
+
+        tok_s_chip = batch * steps / best / max(n_chips, 1)
+        result["value"] = round(tok_s_chip, 1)
+        result["vs_baseline"] = round(tok_s_chip / BASELINE_TOK_S_CHIP, 3)
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        result["raw_error"] = f"{type(e).__name__}: {e}"
 
     # TPU-side kernel parity rides every bench run: the Pallas decode path
     # must agree with the XLA oracle ON DEVICE, not just in CPU interpret
     # mode.  bf16 accumulation + (for int8) requantization of the new row
     # bound the tolerance.
-    parity_diff = pallas_parity_check(kv_quant)
-    parity_ok = parity_diff < (0.075 if kv_quant else 0.05)
+    if jax.default_backend() == "tpu":  # interpret-mode parity is a unit test
+        try:
+            parity_diff = pallas_parity_check(kv_quant)
+            result["pallas_parity_maxdiff"] = round(parity_diff, 5)
+            result["pallas_parity_ok"] = \
+                parity_diff < (0.075 if kv_quant else 0.05)
+        except Exception as e:
+            result["pallas_parity_error"] = f"{type(e).__name__}: {e}"
 
     # Serving-path numbers (engine + OpenAI server + SSE under concurrent
     # load — bench_serving.py): the honest counterpart of the raw-loop
-    # number above.  Raw-bench device buffers are dropped first so the
-    # serving engine's params+cache fit HBM alongside nothing.
-    serving = {}
+    # number above, and the number BASELINE.md actually specifies.
+    # Raw-bench device buffers are dropped first so the serving engine's
+    # params+cache fit HBM alongside nothing.
     if os.environ.get("ARKS_BENCH_SERVING", "1") != "0":
         import gc
-        del params, cache, tokens, lengths, out, fn, prefill_fn
+        # Names are defined in this order; a mid-raw failure leaves a
+        # prefix, and del stops at the first missing name — fine, the rest
+        # were never created.
+        try:
+            del params, prefill_fn, cache, fn, tokens, lengths, out
+        except NameError:
+            pass
         gc.collect()
         try:
             from bench_serving import run_serving_bench
-            serving = run_serving_bench(model)
+            result.update(run_serving_bench(model))
         except Exception as e:  # the raw-loop numbers must still print
             import traceback
             traceback.print_exc()
-            serving = {"serving_error": f"{type(e).__name__}: {e}"}
+            result["serving_error"] = f"{type(e).__name__}: {e}"
+        # Loaded TTFT vs the 200ms target rides the top-level pass/fail
+        # fields next to the unloaded prefill number.
+        lp50 = result.get("serving_ttft_p50_ms")
+        if lp50:
+            result["serving_ttft_vs_target"] = round(TARGET_TTFT_MS / lp50, 3)
 
-    print(json.dumps({
-        "metric": f"decode_throughput_{model}_b{batch}_w-{weight_dtype}_kv-{kv_dtype}",
-        "value": round(tok_s_chip, 1),
-        "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),
-        "ttft_p50_ms": round(ttft_p50, 1),
-        "ttft_prompt_len": prompt_len,
-        "ttft_vs_target": round(TARGET_TTFT_MS / ttft_p50, 3),
-        "pallas_parity_maxdiff": round(parity_diff, 5),
-        "pallas_parity_ok": parity_ok,
-        **serving,
-    }))
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # last-resort: ALWAYS emit a parsable line
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0, "unit": "tok/s/chip",
+            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"}))
+        raise SystemExit(0)

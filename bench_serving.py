@@ -222,6 +222,10 @@ def run_serving_bench(model: str | None = None) -> dict:
         "ARKS_BENCH_SERVE_CLIENTS", str(max(slots - 8, 1))))
 
     import jax
+    # Honor a late JAX_PLATFORMS (the sitecustomize-imported jax read the
+    # platform at interpreter startup — see bench.py's module note).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     n_chips = max(len(jax.devices()), 1)
 
     cfg = get_config(model)
@@ -313,6 +317,9 @@ def run_serving_bench(model: str | None = None) -> dict:
             phases[phase] = round(
                 (s1[key] - s0.get(key, 0.0)) / (t1 - t0), 3)
     return {
+        # Which engine path produced these numbers (kv layout, decode
+        # impl, overlap...) — the resolved config, not the requested one.
+        "serving_engine_config": engine.resolved_config,
         "serving_tok_s_chip": round(tok_s_chip, 1),
         "serving_vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),
         "serving_ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1)

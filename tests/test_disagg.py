@@ -476,6 +476,43 @@ def test_kube_discovery_env_fallback_until_pods_appear(monkeypatch):
     assert decode == ["svc-d:8080"]       # tier without pods keeps fallback
 
 
+def test_kube_discovery_prefers_http_named_port(monkeypatch):
+    """A metrics port declared first (or a sidecar container ordered first)
+    must not hijack routing: the port named ``http`` wins; with several
+    unnamed ports and no ``http``, fall back to backend_port."""
+    from arks_tpu.control.k8s_client import FakeKubeApi
+    from arks_tpu.router import KubeDiscovery
+
+    monkeypatch.delenv("ARKS_PREFILL_ADDRS", raising=False)
+    monkeypatch.delenv("ARKS_DECODE_ADDRS", raising=False)
+    api = FakeKubeApi()
+    pod = _pod("p0", "d1", "prefill", "10.0.0.1", 9999)
+    pod["spec"]["containers"] = [
+        {"name": "sidecar", "ports": [{"containerPort": 9400,
+                                       "name": "metrics"}]},
+        {"name": "engine", "ports": [{"containerPort": 9999},
+                                     {"containerPort": 8081, "name": "http"}]},
+    ]
+    api.create("v1", "pods", "default", pod)
+    amb = _pod("d0", "d1", "decode", "10.0.0.2", 9999)
+    amb["spec"]["containers"] = [
+        {"name": "engine", "ports": [{"containerPort": 9400},
+                                     {"containerPort": 9999}]}]
+    api.create("v1", "pods", "default", amb)
+    met = _pod("d1p", "d1", "decode", "10.0.0.3", 9400)
+    met["spec"]["containers"] = [
+        {"name": "engine", "ports": [{"containerPort": 9400,
+                                      "name": "metrics"}]}]
+    api.create("v1", "pods", "default", met)
+
+    disc = KubeDiscovery(api, "default", "d1", backend_port=8080,
+                         interval_s=0.0)
+    prefill, decode = disc.backends()
+    assert prefill == ["10.0.0.1:8081"]   # named http beats declared order
+    # Ambiguous unnamed pair AND a lone named-metrics port both fall back.
+    assert decode == ["10.0.0.2:8080", "10.0.0.3:8080"]
+
+
 def test_router_with_kube_discovery_end_to_end():
     """A real Router using KubeDiscovery against a (fake) apiserver routes
     to real in-process prefill/decode servers discovered as pods — the

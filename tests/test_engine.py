@@ -207,6 +207,21 @@ def test_metrics_populated(engine):
     assert "time_to_first_token_seconds_bucket" in text
 
 
+def test_resolved_config_surfaced(engine):
+    """The RESOLVED engine configuration (auto decisions included) rides
+    /metrics as an _info gauge and the engine object, so bench_serving and
+    dashboards can tell which perf envelope produced a number."""
+    rc = engine.resolved_config
+    assert rc["kv_layout"] in ("paged", "slot")
+    assert rc["decode_impl"] in ("pallas", "xla")
+    assert rc["pad_head"] in ("true", "false")
+    assert rc["overlap"] in ("true", "false")
+    text = engine.metrics.registry.render()
+    assert "engine_config_info{" in text
+    assert f'kv_layout="{rc["kv_layout"]}"' in text
+    assert f'decode_impl="{rc["decode_impl"]}"' in text
+
+
 def test_cache_len_alignment_rounds_up_for_pallas(monkeypatch):
     """A misaligned --max-model-len must self-correct at startup, not raise
     deep inside the first decode dispatch (kernel DMA tiling constraints)."""

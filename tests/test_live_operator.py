@@ -415,3 +415,155 @@ def test_poll_mode_still_works_without_watch():
         wait_for(lambda: op.store.try_get(Model, "pm1"), timeout=5)
     finally:
         op.stop()
+
+
+# ---------------------------------------------------------------------------
+# Leader election (reference cmd/main.go:198-216) + health endpoints
+# ---------------------------------------------------------------------------
+
+
+def _mk_op(api, tmp_path, ident, lease_s=8.0, retry_s=0.05):
+    # Default lease is deliberately LONG: on a loaded CI box a starved
+    # elector thread must not lose its lease mid-test (the expiry test
+    # passes its own short duration).
+    from arks_tpu.control.leader import LeaderElector
+    elector = LeaderElector(api, namespace="arks-system", identity=ident,
+                            lease_duration_s=lease_s, retry_period_s=retry_s)
+    return LiveOperator(api, models_root=str(tmp_path / ident),
+                        interval_s=0.1, leader_elector=elector,
+                        exit_on_lost_lease=False)
+
+
+def test_leader_election_single_writer(tmp_path):
+    """TWO operators against one apiserver: exactly one acquires the Lease
+    and reconciles; the standby ingests NOTHING and writes nothing."""
+    api = FakeKubeApi()
+    a = _mk_op(api, tmp_path, "op-a")
+    b = _mk_op(api, tmp_path, "op-b")
+    a.start()
+    wait_for(lambda: a.is_leader)
+    b.start()
+    try:
+        _mk_app(api, replicas=1)
+        wait_for(lambda: _sts_names(api) == ["arks-app1-0"])
+        # Sustained: the standby never became leader, never started its
+        # machinery, and its store saw nothing.
+        time.sleep(0.5)
+        assert a.is_leader and not b.is_leader
+        assert a._machinery_started and not b._machinery_started
+        from arks_tpu.control import resources as res
+        assert b.store.list(res.Application) == []
+        lease = api.get("coordination.k8s.io/v1", "leases", "arks-system",
+                        "e4ada7ad.arks.ai")
+        assert lease["spec"]["holderIdentity"] == "op-a"
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_leader_failover_on_graceful_release(tmp_path):
+    """Stopping the leader RELEASES the lease; the standby takes over at
+    its next retry and reconciles new CRs."""
+    api = FakeKubeApi()
+    a = _mk_op(api, tmp_path, "op-a")
+    b = _mk_op(api, tmp_path, "op-b")
+    a.start()
+    wait_for(lambda: a.is_leader)
+    b.start()
+    try:
+        _mk_app(api, replicas=1)
+        wait_for(lambda: _sts_names(api) == ["arks-app1-0"])
+        a.stop()
+        wait_for(lambda: b.is_leader)
+        wait_for(lambda: b._machinery_started)
+        # The new leader reconciles: a second app materializes.
+        api.create(GV, "arksapplications", "default", _cr(
+            "ArksApplication", "app2", {
+                "replicas": 1, "size": 1, "runtime": "jax",
+                "model": {"name": "m1"}, "servedModelName": "m2",
+                "modelConfig": "tiny"}))
+        wait_for(lambda: "arks-app2-0" in _sts_names(api))
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_leader_failover_on_lease_expiry(tmp_path):
+    """A CRASHED leader (no release) is replaced once its lease expires —
+    the takeover path a wedged holder exercises."""
+    api = FakeKubeApi()
+    a = _mk_op(api, tmp_path, "op-a", lease_s=0.6)
+    b = _mk_op(api, tmp_path, "op-b", lease_s=0.6)
+    a.start()
+    wait_for(lambda: a.is_leader)
+    b.start()
+    try:
+        # Simulate a crash: the elector thread dies WITHOUT releasing.
+        a.elector.stop(release=False)
+        a._stop_machinery()
+        t0 = time.monotonic()
+        wait_for(lambda: b.is_leader, timeout=10.0)
+        took = time.monotonic() - t0
+        assert took >= 0.2  # expiry-gated, not instant
+        lease = api.get("coordination.k8s.io/v1", "leases", "arks-system",
+                        "e4ada7ad.arks.ai")
+        assert lease["spec"]["holderIdentity"] == "op-b"
+        assert lease["spec"]["leaseTransitions"] >= 1
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_health_endpoints(tmp_path):
+    """/healthz + /readyz over HTTP: leader live+ready; standby live but
+    NOT ready (readiness gates the embedded gateway's Service endpoints to
+    the leader — a standby's gateway would serve an empty store)."""
+    import json
+    import urllib.request
+
+    from arks_tpu.control.live import HealthServer
+
+    api = FakeKubeApi()
+    a = _mk_op(api, tmp_path, "op-a")
+    b = _mk_op(api, tmp_path, "op-b")
+    ha = HealthServer(a, host="127.0.0.1", port=0)
+    hb = HealthServer(b, host="127.0.0.1", port=0)
+    ha.start()
+    hb.start()
+    a.start()
+    wait_for(lambda: a.is_leader)
+    b.start()
+    try:
+        import urllib.error
+
+        def hit(port, path):
+            try:
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5)
+                return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        for path in ("/healthz", "/readyz"):
+            code, body = hit(ha.port, path)
+            assert code == 200 and body["leader"] is True
+        # Standby: live (healthz 200) but NOT ready (readyz 503) — the
+        # gateway Service must route to the leader only.
+        code, body = hit(hb.port, "/healthz")
+        assert code == 200 and body["leader"] is False
+        code, body = hit(hb.port, "/readyz")
+        assert code == 503 and body["ok"] is False
+        assert body["identity"] == "op-b"
+        # Unknown path -> 404.
+        import urllib.error
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{ha.port}/nope",
+                                   timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        hb.stop()
+        ha.stop()
+        b.stop()
+        a.stop()

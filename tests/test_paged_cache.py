@@ -70,6 +70,68 @@ def test_decode_step_paged_matches_slot(quantized):
                                rtol=2e-2 if quantized else 2e-4)
 
 
+@pytest.mark.parametrize("quantized", [False, True])
+def test_verify_step_paged_matches_slot(quantized):
+    """Speculative verify over the paged cache == slot cache: same block
+    logits, and the K written rows land where the next dispatch reads.
+    lengths are chosen so one slot's block CROSSES a page boundary
+    (29..32 with page 16)."""
+    cfg, params, slot_cache, pool, tables, slots, max_len = _mk(quantized)
+    key = jax.random.PRNGKey(2)
+    K = 4
+    blocks = jax.random.randint(key, (slots, K), 2, 200, jnp.int32)
+    lengths = jnp.asarray([3, 17, 29, 5], jnp.int32)
+
+    for slot in range(slots):
+        plen = int(lengths[slot])
+        pk = jax.random.normal(jax.random.fold_in(key, slot),
+                               (cfg.num_layers, 1, plen, cfg.num_kv_heads,
+                                cfg.head_dim), jnp.float32)
+        pv = pk * 0.5 + 1.0
+        slot_cache = tf.insert(slot_cache, pk, pv, jnp.asarray(slot))
+        n_pages = -(-plen // PAGE)
+        pad = n_pages * PAGE - plen
+        pkp = jnp.pad(pk, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        pvp = jnp.pad(pv, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        pool = tf.insert_pages(pool, pkp, pvp, tables[slot],
+                               jnp.asarray(n_pages))
+
+    logits_s, slot_cache = tf.verify_step(params, cfg, slot_cache, blocks,
+                                          lengths)
+    logits_p, pool = tf.verify_step(params, cfg, pool, blocks, lengths,
+                                    tables=tables)
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(logits_s),
+                               atol=2e-2 if quantized else 2e-4,
+                               rtol=2e-2 if quantized else 2e-4)
+
+    # Follow-up decode reads the verify-written rows through the tables.
+    nxt = jnp.argmax(logits_s[:, -1], axis=-1).astype(jnp.int32)
+    l2 = lengths + K
+    logits_s2, _ = tf.decode_step(params, cfg, slot_cache, nxt, l2)
+    logits_p2, _ = tf.decode_step(params, cfg, pool, nxt, l2, tables=tables)
+    np.testing.assert_allclose(np.asarray(logits_p2), np.asarray(logits_s2),
+                               atol=2e-2 if quantized else 2e-4,
+                               rtol=2e-2 if quantized else 2e-4)
+
+
+def test_verify_step_paged_sentinel_drops_block_write():
+    """Inactive slots (sentinel length) must not touch any page during a
+    speculative verify — their whole K-row block is dropped."""
+    cfg, params, _, pool, tables, slots, max_len = _mk()
+    K = 4
+    blocks = jnp.zeros((slots, K), jnp.int32)
+    lengths = jnp.asarray([3, max_len, max_len, max_len], jnp.int32)
+    before_k = np.asarray(pool.k)
+    _, pool2 = tf.verify_step(params, cfg, pool, blocks, lengths,
+                              tables=tables)
+    after_k = np.asarray(pool2.k)
+    # Slot 0 writes positions 3..6 -> table page 0 only.
+    touched = {int(tables[0, 0])}
+    for pg in range(pool.num_pages):
+        if pg not in touched:
+            np.testing.assert_array_equal(after_k[:, pg], before_k[:, pg])
+
+
 def test_decode_step_paged_sentinel_drops_write():
     """An inactive slot (sentinel length) must not touch any page."""
     cfg, params, _, pool, tables, slots, max_len = _mk()

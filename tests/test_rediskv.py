@@ -232,6 +232,66 @@ def test_cluster_client_follows_moved_redirects():
         b.stop()
 
 
+def test_cluster_client_bootstraps_slot_map():
+    """CLUSTER SLOTS at construction routes keys to the right node on the
+    FIRST try — no MOVED round trip — and records every master as a
+    failover candidate."""
+    from arks_tpu.gateway.rediskv import (
+        RespClusterClient, RespServer, key_slot)
+
+    a, b = RespServer(), RespServer()
+    a.start()
+    b.start()
+    try:
+        key = "arks:quota:namespace=d:quotaname=q:type=total"
+        slot = key_slot(key)
+        topo = [(0, slot - 1, "127.0.0.1", a.port),
+                (slot, 16383, "127.0.0.1", b.port)]
+        a.cluster_slots.extend(topo)
+        b.cluster_slots.extend(topo)
+        client = RespClusterClient([("127.0.0.1", a.port)])
+        assert client._slots[slot] == ("127.0.0.1", b.port)
+        assert ("127.0.0.1", b.port) in client._nodes
+        client.command("SET", key, 7)
+        # Straight to B — A (which would MOVED-redirect via moved_slots)
+        # never saw the key.
+        from arks_tpu.gateway.rediskv import RespClient
+        direct_b = RespClient("127.0.0.1", b.port)
+        assert direct_b.command("GET", key) == b"7"
+        direct_b.close()
+        client.close()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_cluster_client_fails_over_when_default_node_dies():
+    """Losing the seed/default node must not strand commands for
+    not-yet-learned slots: the client drops the dead node, re-points at a
+    survivor, relearns the topology, and retries (ADVICE r3)."""
+    from arks_tpu.gateway.rediskv import (
+        RespClusterClient, RespServer)
+
+    a, b = RespServer(), RespServer()
+    a.start()
+    b.start()
+    try:
+        topo = [(0, 16383, "127.0.0.1", b.port)]
+        # A knows the topology; B owns every slot.
+        a.cluster_slots.extend(topo)
+        b.cluster_slots.extend(topo)
+        client = RespClusterClient([("127.0.0.1", a.port)])
+        a.stop()
+        # Keyless commands route to the default (dead A) — the failover
+        # path must retry them on B.
+        assert client.command("PING") == "PONG"
+        assert client.command("SET", "k", "1") == "OK"
+        assert client.command("GET", "k") == b"1"
+        client.close()
+    finally:
+        b.stop()
+
+
 def test_cluster_backend_parity_with_single():
     """The rate-limit/quota backends behave identically over a cluster
     client with redirects and over a single-node client."""

@@ -334,3 +334,81 @@ def test_mixed_logprob_batch_keeps_speculating():
     # a (chosen_logprob <= 0, top list) pair.
     assert len(lps) == 6
     assert all(entry[0] <= 0 and len(entry[1]) == 2 for entry in lps)
+
+
+# ---------------------------------------------------------------------------
+# Paged target cache + speculative decoding (the two production defaults
+# together — previously mutually exclusive)
+# ---------------------------------------------------------------------------
+
+
+def _run_layout(kv_layout, prompts, draft_model, max_tokens=20,
+                temperature=0.0, seed=None, sequential=False):
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=4, max_cache_len=64,
+                        prefill_buckets=(16, 32), steps_per_dispatch=4,
+                        prefill_chunk=16, kv_layout=kv_layout,
+                        draft_model=draft_model, draft_len=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    reqs = [Request(f"r{i}", p, SamplingParams(
+        max_tokens=max_tokens, temperature=temperature, seed=seed,
+        ignore_eos=True)) for i, p in enumerate(prompts)]
+    if sequential:
+        # One at a time: the second request's prefix lookup then sees the
+        # first's pages in the digest index (deterministic hit).
+        outs = []
+        for r in reqs:
+            eng.add_request(r)
+            _drive(eng, n_steps=600)
+            outs.append(_collect(r)[0])
+        return outs, eng
+    for r in reqs:
+        eng.add_request(r)
+    _drive(eng, n_steps=600)
+    return [_collect(r)[0] for r in reqs], eng
+
+
+def test_paged_spec_greedy_exactness():
+    """Paged target + spec decode == slot target-only greedy, with verify
+    blocks crossing page boundaries (page 16, 20 generated tokens) and the
+    spec path actually firing."""
+    base, _ = _run_layout("slot", PROMPTS, None)
+    spec, eng = _run_layout("paged", PROMPTS, "tiny-gqa")
+    assert spec == base
+    assert eng._paged          # the layout actually resolved to paged
+    assert eng._spec_proposed > 0
+    # All request pages released after finish (no leak through the spec
+    # write path); only index-retained prefix pages hold refs.
+    assert eng._alloc.free_pages == (
+        eng._alloc.num_pages - eng._alloc.retained_pages)
+
+
+def test_paged_spec_prefix_sharing_stays_clean():
+    """A shared prefix page must survive a sibling's speculative decode:
+    the verify block writes land only in slot-owned tail pages."""
+    shared = list(range(3, 23))           # 20 tokens -> one full page of 16
+    prompts = [shared + [30], shared + [40]]
+    base, _ = _run_layout("slot", prompts, None, max_tokens=12,
+                          sequential=True)
+    spec, eng = _run_layout("paged", prompts, "tiny-gqa", max_tokens=12,
+                            sequential=True)
+    assert spec == base
+    assert eng._alloc.hit_tokens > 0      # the second prompt reused pages
+    assert eng._spec_proposed > 0
+
+
+def test_paged_spec_sampled_deterministic():
+    """Sampled requests through paged+spec: valid tokens, deterministic
+    per seed, and identical to the slot layout (same kernels, same keys)."""
+    out1, eng = _run_layout("paged", PROMPTS[:2], "tiny-gqa",
+                            temperature=0.8, seed=11)
+    assert eng._spec_proposed > 0
+    cfg = get_config("tiny")
+    assert all(len(o) == 20 for o in out1)
+    assert all(0 <= t < cfg.vocab_size for o in out1 for t in o)
+    out2, _ = _run_layout("paged", PROMPTS[:2], "tiny-gqa",
+                          temperature=0.8, seed=11)
+    assert out2 == out1
+    slot_out, _ = _run_layout("slot", PROMPTS[:2], "tiny-gqa",
+                              temperature=0.8, seed=11)
+    assert slot_out == out1
