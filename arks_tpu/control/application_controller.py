@@ -174,9 +174,17 @@ class ApplicationController(Controller):
             self.store.update(existing)
 
     def _generate_gangset_spec(self, app: Application, model: Model) -> dict:
+        from arks_tpu.control.k8s_export import try_shape
+
         runtime = app.spec.get("runtime", RUNTIME_JAX)
         tp = app.spec.get("tensorParallel", 1)
-        size = app.spec.get("size", 1)
+        shape = try_shape(app.spec.get("accelerator"))
+        # Gang size defaults to what the accelerator shape REQUIRES: a
+        # multi-host slice (v5e-16 = 4 hosts) or multi-slice spec
+        # (tpu-v5p-16x2 = 2 slices x 2 hosts = 4 pods) sets it; an
+        # explicit spec.size wins.
+        size = app.spec.get("size") or (shape.total_hosts if shape else 1)
+        num_slices = shape.slices if shape else 1
         served = app.served_model_name or model.name
         common = list(app.spec.get("runtimeCommonArgs", []))
         model_path = model.status.get("path", RESERVED_MODELS_PATH)
@@ -187,7 +195,8 @@ class ApplicationController(Controller):
                 port_token="$(PORT)", tensor_parallel=tp, size=size,
                 common_args=common, model_path=model_path,
                 platform=self.local_platform,
-                context_parallel=app.spec.get("contextParallel", 1))
+                context_parallel=app.spec.get("contextParallel", 1),
+                num_slices=num_slices)
         else:
             leader_cmd = gpu_runtime_command(
                 runtime, model_path, served, tp, size, common)

@@ -196,9 +196,15 @@ class DisaggregatedApplicationController(Controller):
 
     def _worker_spec(self, app: DisaggregatedApplication, model: Model,
                      component: str) -> dict:
+        from arks_tpu.control.k8s_export import try_shape
+
         ws = app.spec.get(component, {})
         tp = ws.get("tensorParallel", app.spec.get("tensorParallel", 1))
-        size = ws.get("size", 1)
+        # Same shape derivation as the Application path: a multi-host /
+        # multi-slice accelerator sizes the tier's gang (explicit size
+        # wins) — the live and gitops renderings must agree.
+        shape = try_shape(ws.get("accelerator", app.spec.get("accelerator")))
+        size = ws.get("size") or (shape.total_hosts if shape else 1)
         served = app.served_model_name or model.name
         common = list(ws.get("runtimeCommonArgs",
                              app.spec.get("runtimeCommonArgs", [])))
@@ -213,7 +219,8 @@ class DisaggregatedApplicationController(Controller):
             # Ring-attention prefill for long prompts — most useful on the
             # prefill tier (decode replicates over the seq axis).
             context_parallel=ws.get("contextParallel",
-                                    app.spec.get("contextParallel", 1)))
+                                    app.spec.get("contextParallel", 1)),
+            num_slices=shape.slices if shape else 1)
         return {
             "replicas": ws.get("replicas", 1),
             "size": size,
@@ -290,7 +297,7 @@ class DisaggregatedApplicationController(Controller):
         for tier in ("prefill", "decode"):
             ws = {**app.spec, **(app.spec.get(tier) or {})}
             total += ws.get("replicas", 1) * _shape(
-                ws.get("accelerator", "cpu")).hosts
+                ws.get("accelerator", "cpu")).total_hosts
         return {"name": f"arks-{app.name}", "minMember": total}
 
     def _ensure_gangset(self, app: DisaggregatedApplication, model: Model,

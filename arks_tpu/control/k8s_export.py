@@ -54,8 +54,13 @@ from arks_tpu.control.resources import (
 class TpuTopology:
     accelerator: str      # GKE gke-tpu-accelerator label
     topology: str         # GKE gke-tpu-topology label
-    hosts: int            # pods per slice (gang size)
+    hosts: int            # pods per slice (gang size = hosts * slices)
     chips_per_host: int
+    slices: int = 1       # multi-slice: ICI slices joined over DCN
+
+    @property
+    def total_hosts(self) -> int:
+        return self.hosts * self.slices
 
 
 # Common GKE TPU shapes (accelerator spec string -> node pool selectors).
@@ -264,12 +269,34 @@ def _meta(name: str, namespace: str, labels: dict | None = None) -> dict:
             "labels": {LABEL_MANAGED_BY: MANAGED_BY, **(labels or {})}}
 
 
+def try_shape(accelerator: str | None) -> TpuTopology | None:
+    """``_shape``, tolerant: None for unset/cpu/unknown accelerators (the
+    local drivers don't need node topology).  Controllers use this to
+    derive gang size / slice count from the accelerator spec."""
+    if not accelerator or accelerator == "cpu":
+        return None
+    try:
+        return _shape(accelerator)
+    except ValueError:
+        return None
+
+
 def _shape(accelerator: str) -> TpuTopology:
     shape = TPU_SHAPES.get(accelerator)
-    if shape is None:
-        raise ValueError(f"unknown accelerator {accelerator!r}; "
-                         f"known: {sorted(TPU_SHAPES)}")
-    return shape
+    if shape is not None:
+        return shape
+    # Multi-slice spec: "<base>x<slices>" (e.g. "tpu-v5p-16x2" = two
+    # v5p-16 ICI slices joined over DCN).  Each pod stays inside one
+    # slice's node pool (same per-slice selectors); the gang spans
+    # hosts * slices pods and the engine builds an outermost 'slice'
+    # mesh axis (--num-slices).
+    base_name, _, n = accelerator.rpartition("x")
+    base = TPU_SHAPES.get(base_name)
+    if base is not None and n.isdigit() and int(n) >= 2:
+        return dataclasses.replace(base, slices=int(n))
+    raise ValueError(f"unknown accelerator {accelerator!r}; "
+                     f"known: {sorted(TPU_SHAPES)} "
+                     "(multi-slice: <base>x<slices>, e.g. tpu-v5p-16x2)")
 
 
 def _model_storage(model: Model | None, namespace: str,
@@ -409,6 +436,10 @@ def render_group_from_gangset(gs, index: int, port: int = 8080,
             {"name": "ARKS_GANG_SECRET",
              "value": stable_hash((gs.namespace, gs.name, "gang-secret"))},
         ]
+    if shape.slices > 1:
+        # Multi-slice gang: the engine builds an outermost 'slice' mesh
+        # axis over DCN (server --num-slices reads this too).
+        env.append({"name": "ARKS_NUM_SLICES", "value": str(shape.slices)})
     container = {
         "name": "engine",
         "image": spec.get("image") or _default_image(),
@@ -579,9 +610,11 @@ def _engine_container(spec: dict, served_model: str, model_path: str | None,
         "ports": [{"containerPort": port, "name": "http"}],
         "env": [
             # JAX multi-host rendezvous (LWS env contract translated).
-            {"name": "ARKS_NUM_PROCESSES", "value": str(shape.hosts)},
+            {"name": "ARKS_NUM_PROCESSES", "value": str(shape.total_hosts)},
             {"name": "ARKS_PROCESS_ID", "valueFrom": {"fieldRef": {
                 "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}}},
+            *([{"name": "ARKS_NUM_SLICES", "value": str(shape.slices)}]
+              if shape.slices > 1 else []),
         ],
         # /readiness is leader-only (process 0), so Services selecting the
         # whole gang still route requests to the leader exclusively.
@@ -639,7 +672,7 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
         extra_annotations = {**ia, **pa}
         if podgroup_unit is None:
             pg = render_podgroup(group, namespace, spec.get("podGroupPolicy"),
-                                 min_member=shape.hosts, labels=sel)
+                                 min_member=shape.total_hosts, labels=sel)
             if pg is not None:
                 docs.append(pg)
         docs.append({
@@ -668,7 +701,7 @@ def _render_gangs(prefix: str, namespace: str, base_labels: dict,
             "metadata": _meta(group, namespace, sel),
             "spec": {
                 "serviceName": group,
-                "replicas": shape.hosts,
+                "replicas": shape.total_hosts,
                 # Gang semantics: all hosts start together; a slice is
                 # atomic, so any pod restart restarts the group
                 # (LWS RecreateGroupOnPodRestart analogue via TPU slice
@@ -765,7 +798,7 @@ def render_disaggregated(dapp: DisaggregatedApplication,
         tspec.update(spec.get(tier) or {})
         shape = _shape(tspec.get("accelerator", "cpu"))
         labels = {LABEL_APPLICATION: dapp.name, LABEL_COMPONENT: tier}
-        unit_members += tspec.get("replicas", 1) * shape.hosts
+        unit_members += tspec.get("replicas", 1) * shape.total_hosts
         docs.extend(_render_gangs(
             f"arks-{dapp.name}-{tier}", dapp.namespace, labels,
             tspec.get("replicas", 1), shape, tspec, served, model_path, pvc,

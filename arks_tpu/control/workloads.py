@@ -384,7 +384,8 @@ def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
                       tensor_parallel: int, size: int, common_args: list[str],
                       model_path: str | None = None,
                       platform: str | None = None,
-                      context_parallel: int = 1) -> list[str]:
+                      context_parallel: int = 1,
+                      num_slices: int = 1) -> list[str]:
     cmd = [sys.executable, "-m", "arks_tpu.server",
            "--model", model_arg,
            "--served-model-name", served_model_name,
@@ -392,6 +393,8 @@ def jax_serve_command(model_arg: str, served_model_name: str, port_token: str,
            "--tensor-parallel-size", str(tensor_parallel)]
     if context_parallel > 1:
         cmd += ["--context-parallel-size", str(context_parallel)]
+    if num_slices > 1:
+        cmd += ["--num-slices", str(num_slices)]
     if model_path:
         cmd += ["--model-path", model_path]
     if platform:

@@ -467,9 +467,10 @@ class InferenceEngine:
             if self._pp > 1:
                 raise ValueError(
                     "speculative decoding is incompatible with pipeline_parallel")
-            if mesh is not None and mesh.shape.get(tf.AXIS_DATA, 1) > 1:
+            if tf.batch_axis_for(mesh) is not None:
                 raise ValueError(
-                    "speculative decoding requires data_parallel == 1")
+                    "speculative decoding requires data_parallel == 1 "
+                    "(and no slice axis)")
             if engine_cfg.draft_len < 2:
                 raise ValueError("draft_len must be >= 2")
             from arks_tpu.models import get_config
@@ -546,7 +547,7 @@ class InferenceEngine:
 
     def _build_programs(self) -> None:
         cfg, mesh = self.cfg, self.mesh
-        batch_axis = tf.AXIS_DATA if (mesh is not None and mesh.shape.get(tf.AXIS_DATA, 1) > 1) else None
+        batch_axis = tf.batch_axis_for(mesh)  # ("slice","data") on multislice
         # Context parallelism: prefill's T shards over 'seq' and attention
         # runs as a ring (parallel.ring) — serving reaches the same
         # long-context path the trainer and dryrun exercise.
@@ -909,7 +910,10 @@ class InferenceEngine:
             raise ValueError(f"kv_layout={layout!r}")
         if layout == "slot":
             return False
-        dp = self.mesh.shape.get(tf.AXIS_DATA, 1) if self.mesh is not None else 1
+        from arks_tpu.parallel.mesh import AXIS_SLICE
+        dp = (self.mesh.shape.get(tf.AXIS_DATA, 1)
+              * self.mesh.shape.get(AXIS_SLICE, 1)) \
+            if self.mesh is not None else 1
         blockers = []
         if self._pp > 1:
             blockers.append("pipeline parallelism")

@@ -87,7 +87,19 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class DispatchLeader:
-    """Leader side: accepts follower connections, broadcasts dispatches."""
+    """Leader side: accepts follower connections, broadcasts dispatches.
+
+    Worker-wedge detection: followers HEARTBEAT on the channel's return
+    direction (it is otherwise leader→follower only), and a per-connection
+    reader thread tracks the last-seen timestamp.  ``follower_health``
+    surfaces staleness to the serving readiness gate (so a hung-but-
+    connected worker drops the gang out of Service endpoints within a
+    bounded window), and a monitor thread ESCALATES past
+    ``ARKS_GANG_WEDGE_FATAL_S``: the leader exits so the gang driver
+    restarts the whole group — the same shared-fate policy as a broken
+    channel (engine._emit), and the behavior the reference buys from LWS
+    RecreateGroupOnPodRestart (arksapplication_controller.go:581-584),
+    which only reacts to pod DEATH; the heartbeat also catches hangs."""
 
     def __init__(self, bind_host: str, port: int, num_followers: int,
                  accept_timeout_s: float = 120.0):
@@ -97,6 +109,10 @@ class DispatchLeader:
         self._srv.listen(num_followers)
         self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
+        self._hb_lock = threading.Lock()
+        self._last_hb: list[float] = []
+        self._wedge_fatal_s = float(
+            os.environ.get("ARKS_GANG_WEDGE_FATAL_S", "120"))
         secret = _secret()
         deadline = time.monotonic() + accept_timeout_s
         while len(self._conns) < num_followers:
@@ -124,6 +140,48 @@ class DispatchLeader:
                 continue
             log.info("follower connected from %s", addr)
             self._conns.append(conn)
+            self._last_hb.append(time.monotonic())
+        for i, conn in enumerate(self._conns):
+            threading.Thread(target=self._hb_reader, args=(i, conn),
+                             name=f"dispatch-hb-{i}", daemon=True).start()
+        if self._conns and self._wedge_fatal_s > 0:
+            threading.Thread(target=self._wedge_monitor,
+                             name="dispatch-wedge-monitor",
+                             daemon=True).start()
+
+    def _hb_reader(self, idx: int, conn: socket.socket) -> None:
+        """Drain the follower's return direction (heartbeats only)."""
+        while True:
+            try:
+                op, _ = _recv_msg(conn)
+            except (OSError, ConnectionError):
+                return  # channel death is handled by broadcast/sendall
+            if op == "hb":
+                with self._hb_lock:
+                    self._last_hb[idx] = time.monotonic()
+
+    def _wedge_monitor(self) -> None:
+        while True:
+            time.sleep(max(self._wedge_fatal_s / 8, 0.25))
+            health = self.follower_health(self._wedge_fatal_s)
+            if health["stale"]:
+                log.critical(
+                    "follower(s) %s heartbeat stale > %.0fs (hung, not "
+                    "dead); exiting so the gang driver restarts the whole "
+                    "group", health["stale"], self._wedge_fatal_s)
+                os._exit(71)
+
+    def follower_health(self, stale_after_s: float) -> dict:
+        """Heartbeat ages per follower; ``stale`` lists followers not heard
+        from within ``stale_after_s`` (the readiness gate's input)."""
+        now = time.monotonic()
+        with self._hb_lock:
+            ages = [now - t for t in self._last_hb]
+        return {
+            "followers": len(ages),
+            "max_heartbeat_age_s": round(max(ages, default=0.0), 3),
+            "stale": [i for i, a in enumerate(ages) if a > stale_after_s],
+        }
 
     def broadcast(self, op: str, payload: dict) -> None:
         # Serialize ONCE: insert_kv payloads carry whole KV tensors.
@@ -188,6 +246,22 @@ class DispatchFollower:
                 time.sleep(0.5)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
+    def _hb_loop(self, interval_s: float) -> None:
+        """Send liveness beats on the channel's return direction.  A
+        separate thread from the dispatch loop ON PURPOSE: a worker wedged
+        inside a dispatch (deadlocked collective, stuck DMA) keeps its
+        socket open but stops beating only if the whole process stops —
+        SIGSTOP, OOM-thrash, runaway GC — which is exactly the "hung, not
+        dead" class the leader's wedge monitor exists for.  jit compiles
+        and device waits release the GIL, so beats flow through them."""
+        while not self._hb_stop.is_set():
+            try:
+                with self._send_lock:
+                    _send_msg(self._sock, ("hb", {}))
+            except (OSError, ConnectionError):
+                return
+            self._hb_stop.wait(interval_s)
+
     def run(self) -> None:
         """Dispatch loop; returns when the leader sends stop/disconnects."""
         import jax
@@ -196,6 +270,18 @@ class DispatchFollower:
         from arks_tpu.engine import sampler as sampler_mod
 
         eng = self.engine
+        self._hb_stop = threading.Event()
+        self._send_lock = threading.Lock()
+        threading.Thread(
+            target=self._hb_loop,
+            args=(float(os.environ.get("ARKS_GANG_HB_INTERVAL", "2")),),
+            name="dispatch-hb", daemon=True).start()
+        try:
+            self._run_inner(eng, jax, jnp)
+        finally:
+            self._hb_stop.set()
+
+    def _run_inner(self, eng, jax, jnp) -> None:
         while True:
             try:
                 op, p = _recv_msg(self._sock)

@@ -216,9 +216,23 @@ def init_cache(cfg: ModelConfig, num_slots: int, max_len: int,
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
+def batch_axis_for(mesh: "Mesh | None"):
+    """The mesh axes a batch dimension shards over: ``("slice", "data")``
+    on a multi-slice mesh (dp rides DCN across slices AND ICI within),
+    ``"data"``/``"slice"`` when only one is populated, None otherwise.
+    PartitionSpec entries accept the tuple directly."""
+    if mesh is None:
+        return None
+    from arks_tpu.parallel.mesh import AXIS_SLICE
+    axes = [a for a in (AXIS_SLICE, AXIS_DATA) if mesh.shape.get(a, 1) > 1]
+    if not axes:
+        return None
+    return axes[0] if len(axes) == 1 else tuple(axes)
+
+
 def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1,
-                 quantized: bool = False) -> KVCache:
-    batch = AXIS_DATA if dp > 1 else None
+                 quantized: bool = False, batch=None) -> KVCache:
+    batch = batch if batch is not None else (AXIS_DATA if dp > 1 else None)
     heads = AXIS_MODEL if shard_kv_heads(cfg, tp) else None
     spec = P(None, batch, heads, None, None)
     sspec = P(None, batch, heads, None) if quantized else None
@@ -271,8 +285,8 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 
 def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
     tp = mesh.shape.get(AXIS_MODEL, 1)
-    dp = mesh.shape.get(AXIS_DATA, 1)
-    specs = cache_pspecs(cfg, tp, dp, quantized=cache.quantized)
+    specs = cache_pspecs(cfg, tp, quantized=cache.quantized,
+                         batch=batch_axis_for(mesh))
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), cache, specs)
 

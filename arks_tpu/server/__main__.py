@@ -45,6 +45,12 @@ def main() -> None:
                         "axis with a microbatched decode pipeline — HBM "
                         "capacity scaling for models beyond one chip; "
                         "exclusive with tp/dp/cp in one engine")
+    p.add_argument("--num-slices", type=int, default=None,
+                   help="multi-slice serving: an outermost 'slice' mesh "
+                        "axis spanning ICI slices joined over DCN (v5p "
+                        "multi-slice) — batch/dp shards across slices, tp "
+                        "psums stay slice-local (parallel.mesh."
+                        "make_multislice_mesh)")
     p.add_argument("--num-slots", type=int, default=8)
     p.add_argument("--max-model-len", type=int, default=1024)
     p.add_argument("--steps-per-dispatch", type=int, default=4)
@@ -118,14 +124,21 @@ def main() -> None:
         model_path = args.model_path
 
     n_dev = len(jax.devices())
-    if (args.dp < 1 or args.cp < 1 or args.pp < 1
+    # The k8s renderer passes the slice count by env (ARKS_NUM_SLICES);
+    # an explicit --num-slices flag wins — including an explicit 1 (the
+    # unset default is None, so forcing single-slice in a multi-slice pod
+    # is expressible).
+    if args.num_slices is None:
+        args.num_slices = int(os.environ.get("ARKS_NUM_SLICES", "1"))
+    if (args.dp < 1 or args.cp < 1 or args.pp < 1 or args.num_slices < 1
             or (args.tp is not None and args.tp < 1)):
         raise SystemExit("parallel-size flags must be >= 1")
     if args.pp > 1:
         tp = args.tp or 1  # pp is exclusive with tp; don't auto-fill tp
     else:
-        tp = args.tp or max(n_dev // (args.dp * args.cp), 1)
-    want = tp * args.dp * args.cp * args.pp
+        tp = args.tp or max(
+            n_dev // (args.dp * args.cp * args.num_slices), 1)
+    want = tp * args.dp * args.cp * args.pp * args.num_slices
     if want > n_dev:
         raise SystemExit(
             f"requested tp={tp} x dp={args.dp} x cp={args.cp} "
@@ -160,9 +173,16 @@ def main() -> None:
             # more (e.g. a forced multi-device CPU platform) than the spec
             # wants.
             devices = jax.devices()[:want]
-        mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp,
-                         context_parallel=args.cp,
-                         pipeline_parallel=args.pp, devices=devices)
+        if args.num_slices > 1:
+            from arks_tpu.parallel.mesh import make_multislice_mesh
+            mesh = make_multislice_mesh(
+                args.num_slices, tensor_parallel=tp, data_parallel=args.dp,
+                context_parallel=args.cp, pipeline_parallel=args.pp,
+                devices=devices)
+        else:
+            mesh = make_mesh(tensor_parallel=tp, data_parallel=args.dp,
+                             context_parallel=args.cp,
+                             pipeline_parallel=args.pp, devices=devices)
 
     params = None
     if model_path:

@@ -85,6 +85,20 @@ def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str
 
 
 class OpenAIServer:
+    def follower_wedge(self) -> str | None:
+        """Non-None when a gang follower's dispatch-channel heartbeat is
+        stale (hung-but-connected worker): the readiness reason string.
+        ARKS_GANG_STALE_S bounds the detection window."""
+        disp = getattr(self.engine, "dispatcher", None)
+        if disp is None or not hasattr(disp, "follower_health"):
+            return None
+        h = disp.follower_health(float(os.environ.get("ARKS_GANG_STALE_S",
+                                                      "15")))
+        if h["stale"]:
+            return (f"follower heartbeat stale: {h['stale']} "
+                    f"(max age {h['max_heartbeat_age_s']}s)")
+        return None
+
     def __init__(self, engine: InferenceEngine, served_model_name: str,
                  host: str = "0.0.0.0", port: int = 8080) -> None:
         self.engine = engine
@@ -150,10 +164,19 @@ class OpenAIServer:
                         self._error(503, "worker process (leader serves)")
                     elif server.draining:
                         self._error(503, "draining")
-                    elif server._ready.is_set():
-                        self._json(200, {"status": "ready"})
-                    else:
+                    elif not server._ready.is_set():
                         self._error(503, "not ready")
+                    else:
+                        # Worker-wedge gate: a follower that is alive but
+                        # hung (SIGSTOP, OOM-thrash) stops heartbeating on
+                        # the dispatch channel — the gang must leave the
+                        # Service endpoints within a bounded window, not
+                        # when a collective finally times out.
+                        wedged = server.follower_wedge()
+                        if wedged:
+                            self._error(503, wedged)
+                        else:
+                            self._json(200, {"status": "ready"})
                 else:
                     self._error(404, f"no route {self.path}")
 

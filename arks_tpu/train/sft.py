@@ -50,7 +50,9 @@ def forward_hidden(params, cfg: ModelConfig, tokens: jnp.ndarray,
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     h = jnp.take(params["embed"], tokens, axis=0)
-    batch_axis = tf.AXIS_DATA if mesh is not None and mesh.shape.get(tf.AXIS_DATA, 1) > 1 else None
+    # ("slice", "data") on a multi-slice mesh: the gradient psum then spans
+    # DCN once per step (the only slice-crossing collective).
+    batch_axis = tf.batch_axis_for(mesh)
 
     def body(h, lp):
         h, _, _ = tf.prefill_layer(h, lp, cfg, positions, mesh, batch_axis)
@@ -103,6 +105,6 @@ def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation,
         optimizer)
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
-    data_spec = NamedSharding(mesh, P(tf.AXIS_DATA, None))
+    data_spec = NamedSharding(mesh, P(tf.batch_axis_for(mesh), None))
     return jax.jit(step, donate_argnums=(0,),
                    in_shardings=(None, data_spec, data_spec, data_spec))

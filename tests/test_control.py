@@ -413,3 +413,40 @@ def test_autoscaler_splits_demand_across_peer_apps(tmp_path):
                 for n in ("peer-a", "peer-b")] == [2, 2]
     finally:
         mgr.stop()
+
+
+def test_multislice_accelerator_maps_to_gang_and_flags(stack):
+    """North-star config #5: a multi-slice accelerator spec
+    ("tpu-v5p-16x2" = 2 slices x 2 hosts) sizes the gang to ALL hosts
+    across slices, and the serve command carries --num-slices so the
+    engine builds the DCN-crossing 'slice' mesh axis."""
+    mgr, store, driver = stack
+    store.create(res.Model(name="m-ms", spec={"model": "org/ms"}))
+    store.create(res.Application(name="ms-app", spec={
+        "replicas": 1, "runtime": "jax", "model": {"name": "m-ms"},
+        "servedModelName": "ms-served", "tensorParallel": 4,
+        "modelConfig": "tiny", "accelerator": "tpu-v5p-16x2"}))
+    assert mgr.wait_idle()
+    gs = store.get(res.GangSet, "ms-app")
+    assert gs.spec["size"] == 4              # 2 hosts/slice x 2 slices
+    cmd = " ".join(gs.spec["leader"]["command"])
+    assert "--num-slices 2" in cmd
+    assert gs.spec["accelerator"] == "tpu-v5p-16x2"
+
+    # Single-slice shapes keep deriving size from the shape too.
+    store.create(res.Application(name="ss-app", spec={
+        "replicas": 1, "runtime": "jax", "model": {"name": "m-ms"},
+        "servedModelName": "ss-served", "tensorParallel": 4,
+        "modelConfig": "tiny", "accelerator": "tpu-v5e-16"}))
+    assert mgr.wait_idle()
+    gs2 = store.get(res.GangSet, "ss-app")
+    assert gs2.spec["size"] == 4             # 4 hosts, one slice
+    assert "--num-slices" not in " ".join(gs2.spec["leader"]["command"])
+
+    # An explicit spec.size always wins over the shape derivation.
+    store.create(res.Application(name="ovr-app", spec={
+        "replicas": 1, "runtime": "jax", "model": {"name": "m-ms"},
+        "servedModelName": "ovr-served", "size": 2,
+        "modelConfig": "tiny", "accelerator": "tpu-v5e-16"}))
+    assert mgr.wait_idle()
+    assert store.get(res.GangSet, "ovr-app").spec["size"] == 2

@@ -136,3 +136,53 @@ def test_per_kind_samples_parse_and_render():
     pgs = [d["metadata"]["name"] for d in docs if d["kind"] == "PodGroup"]
     assert sorted(pgs) == ["arks-qwen-pd", "arks-qwen2.5-7b-0",
                            "arks-qwen2.5-7b-1"]
+
+
+def test_flagship_examples_render():
+    """BASELINE.json configs #3 and #5 as checked-in examples: Llama-3-8B
+    TP over v5e-8, and Qwen2.5-72B on multi-host v5p-16 with an
+    Orbax-converting Model — both must load and render to gangs with the
+    right topology, size, and rendezvous env."""
+    import glob
+
+    from arks_tpu.control.__main__ import apply_manifests
+    from arks_tpu.control.k8s_export import render_store
+    from arks_tpu.control.store import Store
+
+    store = Store()
+    files = sorted(glob.glob("examples/flagship/*.yaml"))
+    assert len(files) == 2
+    for f in files:
+        apply_manifests(store, f)
+    docs = render_store(store)
+    sts = {d["metadata"]["name"]: d for d in docs
+           if d["kind"] == "StatefulSet"}
+
+    # #3: v5e-8 = one host, 8 chips, tp=8; real-tokenizer weights arrive
+    # via the Model's HF download (a Job in the render).
+    v5e = sts["arks-llama3-8b-0"]
+    assert v5e["spec"]["replicas"] == 1
+    pod = v5e["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    c = pod["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    assert "--tensor-parallel-size" in c["args"]
+    assert c["args"][c["args"].index("--tensor-parallel-size") + 1] == "8"
+
+    # #5: v5p-16 = 2 hosts x 4 chips; the gang spans both hosts with the
+    # jax.distributed env contract.
+    v5p = sts["arks-qwen2.5-72b-0"]
+    assert v5p["spec"]["replicas"] == 2
+    pod = v5p["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2x2"
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert env["ARKS_NUM_PROCESSES"]["value"] == "2"
+    assert "ARKS_COORDINATOR_ADDRESS" in env
+
+    # Both Models download from HF and convert to Orbax shards.
+    jobs = [d for d in docs if d["kind"] == "Job"]
+    assert len(jobs) == 2
+    for j in jobs:
+        jenv = {e["name"]: e.get("value") for e in
+                j["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert jenv.get("ARKS_CONVERT_ORBAX") == "1"

@@ -211,6 +211,35 @@ def test_disaggregated_phase_machine(fake_stack, tmp_path):
     wait_for(lambda: store.try_get(res.GangSet, "pd-router") is None)
 
 
+def test_disaggregated_tier_size_derives_from_accelerator(fake_stack):
+    """Disagg tiers size their gangs from the accelerator shape exactly
+    like the Application path (live and gitops renderings must agree):
+    multi-host shapes set size, multi-slice ones add --num-slices, and
+    the unified unit PodGroup counts every pod across slices."""
+    mgr, driver = fake_stack
+    store = mgr.store
+    store.create(res.Model(name="m-acc", spec={"model": "test/m"}))
+    store.create(res.DisaggregatedApplication(name="pda", spec={
+        "mode": "unified",
+        "model": {"name": "m-acc"}, "servedModelName": "pda-served",
+        "modelConfig": "tiny",
+        "podGroupPolicy": {"kubeScheduling": {}},
+        "router": {"replicas": 1},
+        "prefill": {"replicas": 1, "accelerator": "tpu-v5e-16"},
+        "decode": {"replicas": 1, "accelerator": "tpu-v5p-16x2"},
+    }))
+    pre = wait_for(lambda: store.try_get(res.GangSet, "pda-prefill"))
+    dec = wait_for(lambda: store.try_get(res.GangSet, "pda-decode"))
+    assert pre.spec["size"] == 4                       # v5e-16: 4 hosts
+    assert dec.spec["size"] == 4                       # 2 slices x 2 hosts
+    assert "--num-slices 2" in " ".join(dec.spec["leader"]["command"])
+    assert "--num-slices" not in " ".join(pre.spec["leader"]["command"])
+    # Unit PodGroup spans router + all tier pods across slices: 1 + 4 + 4.
+    assert pre.spec["podGroupUnit"]["minMember"] == 9
+    store.delete(res.DisaggregatedApplication, "pda")
+    wait_for(lambda: store.try_get(res.GangSet, "pda-router") is None)
+
+
 def test_disaggregated_rejects_non_jax_runtime(fake_stack):
     mgr, _ = fake_stack
     store = mgr.store

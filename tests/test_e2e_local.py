@@ -253,6 +253,65 @@ def test_gang_member_death_restarts_group_and_serving_recovers(stack):
     wait_for(served_again, timeout=240, interval=2.0)
 
 
+def test_follower_wedge_unreadies_gang_then_restarts(stack, monkeypatch):
+    """Worker-wedge failure injection: SIGSTOP a gang FOLLOWER (alive but
+    hung — the case member-death detection cannot see).  The follower's
+    dispatch-channel heartbeat goes stale, the leader's /readiness flips
+    503 within the bounded window (gang out of Service endpoints), and
+    past the fatal deadline the leader exits so the driver restarts the
+    whole group (the LWS RecreateGroupOnPodRestart behavior, extended to
+    hangs)."""
+    import os as _os
+    import signal as _signal
+    import urllib.error
+
+    mgr, gw, driver = stack
+    store = mgr.store
+    # Env is inherited by the spawned gang processes (driver launches with
+    # this process's environ): tight heartbeat/stale/fatal windows.
+    monkeypatch.setenv("ARKS_GANG_HB_INTERVAL", "0.3")
+    monkeypatch.setenv("ARKS_GANG_STALE_S", "2")
+    monkeypatch.setenv("ARKS_GANG_WEDGE_FATAL_S", "10")
+    addr = _launch_gang(store, "wedge-gang", "wedge-served")
+    assert _complete(addr, "wedge-served", "pre-wedge", 4)[
+        "usage"]["completion_tokens"] == 4
+
+    gs = store.get(res.GangSet, "wedge-gang")
+    group = driver._groups[gs.key][0]
+    old_procs = list(group.procs)
+    follower = old_procs[1]
+    _os.kill(follower.pid, _signal.SIGSTOP)
+    try:
+        # Readiness flips within the stale window — the worker is alive
+        # (not reaped) yet the gang must leave Service endpoints.
+        def unready():
+            assert follower.poll() is None  # still "alive" (stopped)
+            try:
+                urllib.request.urlopen(f"http://{addr}/readiness",
+                                       timeout=5)
+                return False
+            except urllib.error.HTTPError as e:
+                return e.code == 503 and b"heartbeat" in e.read()
+            except Exception:
+                return False
+        wait_for(unready, timeout=30)
+
+        # Escalation: leader exits past the fatal deadline, the driver
+        # restarts the WHOLE group with fresh processes.
+        def regrouped():
+            g = driver._groups.get(gs.key, {}).get(0)
+            if g is None or g.procs is old_procs:
+                return False
+            return (len(g.procs) == 2
+                    and all(p.poll() is None for p in g.procs)
+                    and all(p.pid != q.pid
+                            for p, q in zip(g.procs, old_procs)))
+        wait_for(regrouped, timeout=120)
+    finally:
+        if follower.poll() is None:
+            _os.kill(follower.pid, _signal.SIGCONT)
+
+
 def test_counter_store_outage_fails_cleanly():
     """A dead shared counter store (Redis down) must fail requests quickly
     and cleanly — bounded by the client's socket timeout — not hang the

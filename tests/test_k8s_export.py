@@ -421,3 +421,33 @@ def test_runtime_image_env_hatches(monkeypatch):
     job = next(d for d in mdocs if d["kind"] == "Job")
     assert (job["spec"]["template"]["spec"]["containers"][0]["image"]
             == "reg.io/scripts:v9")
+
+
+def test_multislice_application_renders_dcn_gang():
+    """"tpu-v5e-16x2": per-replica gang spans BOTH slices (8 pods), the
+    rendezvous contract counts every host, ARKS_NUM_SLICES rides the env,
+    and pods still select the per-slice node pool (each pod lives inside
+    one slice; only the 'slice' mesh axis crosses DCN)."""
+    docs = render_application(_app(accelerator="tpu-v5e-16x2", replicas=1))
+    sets = [d for d in docs if d["kind"] == "StatefulSet"]
+    assert len(sets) == 1
+    ss = sets[0]
+    base = TPU_SHAPES["tpu-v5e-16"]
+    assert ss["spec"]["replicas"] == base.hosts * 2
+    pod = ss["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] \
+        == base.topology
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert env["ARKS_NUM_PROCESSES"]["value"] == str(base.hosts * 2)
+    assert env["ARKS_NUM_SLICES"]["value"] == "2"
+    assert "ARKS_COORDINATOR_ADDRESS" in env
+
+
+def test_unknown_accelerator_suggests_multislice_syntax():
+    import pytest as _pytest
+
+    from arks_tpu.control.k8s_export import _shape
+    with _pytest.raises(ValueError, match="multi-slice"):
+        _shape("tpu-v9z-64")
+    shape = _shape("tpu-v5p-16x2")
+    assert shape.slices == 2 and shape.total_hosts == 4
