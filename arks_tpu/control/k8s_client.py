@@ -114,6 +114,24 @@ class KubeApi:
             path += f"/{subresource}"
         return path
 
+    # -- auth ----------------------------------------------------------
+
+    def token_review(self, token: str) -> bool:
+        """authentication.k8s.io/v1 TokenReview: is this bearer token a
+        valid cluster identity?  The operator's metrics endpoint gates on
+        this — the authn half of the reference manager's
+        WithAuthenticationAndAuthorization filter (cmd/main.go:157-169)."""
+        try:
+            out = self._request(
+                "POST",
+                self._obj_path("authentication.k8s.io/v1", "tokenreviews",
+                               None),
+                {"apiVersion": "authentication.k8s.io/v1",
+                 "kind": "TokenReview", "spec": {"token": token}})
+        except (ApiError, OSError):
+            return False  # fail CLOSED: unverifiable = unauthenticated
+        return bool((out or {}).get("status", {}).get("authenticated"))
+
     # -- resource ops --------------------------------------------------
 
     def list(self, gv: str, plural: str, namespace: str | None = None) -> list[dict]:
@@ -213,6 +231,11 @@ class FakeKubeApi:
         # Watch event log: (rv, type, key, obj snapshot), bounded window.
         self._events: list[tuple[int, str, tuple, dict]] = []
         self.actions: list[tuple[str, str]] = []
+        # TokenReview double: bearer tokens token_review() accepts.
+        self.valid_tokens: set[str] = set()
+
+    def token_review(self, token: str) -> bool:
+        return token in self.valid_tokens
 
     def _key(self, gv, plural, namespace, name):
         return (gv, plural, namespace or "", name)
@@ -561,6 +584,12 @@ class FakeApiServer:
                 raise ApiError(404, f"{plural}/{name} not found")
             return 200, obj
         if method == "POST":
+            if plural == "tokenreviews":
+                # Nameless review resource: answered, never stored.
+                tok = (body or {}).get("spec", {}).get("token", "")
+                return 201, {"apiVersion": gv, "kind": "TokenReview",
+                             "status": {"authenticated":
+                                        f.token_review(tok)}}
             return 201, f.create(gv, plural, namespace, body)
         if method == "PATCH":
             return 200, f.patch(gv, plural, namespace, name, body,

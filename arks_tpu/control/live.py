@@ -346,6 +346,24 @@ class LiveOperator:
         self.manager = build_manager(models_root=models_root,
                                      driver=self.driver, store=self.store,
                                      router_discovery="kubernetes")
+        # Operator-process metrics (reference manager serves its own
+        # controller-runtime families behind authn — cmd/main.go:157-169;
+        # HealthServer exposes this registry at /metrics).
+        from arks_tpu.utils import metrics as prom
+        self.metrics_registry = prom.Registry()
+        self._m_sync = self.metrics_registry.counter(
+            "operator_sync_iterations_total",
+            "Reconcile/status-projection loop iterations")
+        self._m_events = self.metrics_registry.counter(
+            "operator_watch_events_total", "Watch events handled, by kind")
+        self._m_ingests = self.metrics_registry.counter(
+            "operator_spec_ingests_total", "CR specs ingested into the store")
+        self._m_projections = self.metrics_registry.counter(
+            "operator_status_projections_total",
+            "Status subresource patches written")
+        self._m_leader = self.metrics_registry.gauge(
+            "operator_is_leader", "1 when this replica holds the lease")
+        self._m_leader.set(0.0)  # standbys must expose a sample too
         self._running = False
         self._started = False
         self._machinery_started = False
@@ -451,6 +469,8 @@ class LiveOperator:
     def _loop(self) -> None:
         next_resync = 0.0
         while self._running:
+            self._m_sync.inc()
+            self._m_leader.set(1.0 if self.is_leader else 0.0)
             try:
                 if not self.use_watch or time.monotonic() >= next_resync:
                     # Full level-triggered pass (poll mode: every tick;
@@ -511,6 +531,7 @@ class LiveOperator:
                     time.sleep(self.interval_s)
 
     def _handle_event(self, kind, plural, typ: str | None, cr: dict) -> None:
+        self._m_events.inc(plural=plural, type=typ or "UNKNOWN")
         meta = cr.get("metadata", {})
         ns = meta.get("namespace", "default")
         name = meta.get("name")
@@ -617,11 +638,13 @@ class LiveOperator:
         if obj is None:
             self.store.create(kind(name=name, namespace=ns, labels=labels,
                                    spec=spec))
+            self._m_ingests.inc(kind=kind.KIND)
         elif obj.spec != spec or obj.labels != labels:
             obj.spec = spec
             obj.labels = labels
             try:
                 self.store.update(obj)
+                self._m_ingests.inc(kind=kind.KIND)
             except Conflict:
                 pass  # next poll retries against the fresh object
 
@@ -634,6 +657,7 @@ class LiveOperator:
             return
         self.api.patch(GV, plural, ns, name, {"status": obj.status},
                        subresource="status")
+        self._m_projections.inc(plural=plural)
         self._projected[key] = {k: v for k, v in obj.status.items()}
 
     def _handle_cr_deletion(self, kind, plural, ns, name) -> None:
@@ -656,30 +680,64 @@ class LiveOperator:
 
 
 class HealthServer:
-    """``/healthz`` + ``/readyz`` for the operator pod — the endpoints the
-    reference manager wires at :8081 (/root/reference/cmd/main.go:320-327)
-    and that deploy/operator.yaml's probes hit.  Standby replicas are live
-    but NOT ready (readiness keeps the embedded gateway's Service pointed
-    at the leader — a standby's gateway would serve an empty store); a
-    leader whose sync thread died fails liveness so the kubelet restarts
-    it."""
+    """``/healthz`` + ``/readyz`` + ``/metrics`` for the operator pod — the
+    endpoints the reference manager wires (/root/reference/cmd/
+    main.go:157-169,320-327), probes hit the first two.  Standby replicas
+    are live but NOT ready (readiness keeps the embedded gateway's Service
+    pointed at the leader — a standby's gateway would serve an empty
+    store); a leader whose sync thread died fails liveness so the kubelet
+    restarts it.  ``/metrics`` serves the operator's own registry and is
+    TokenReview-authenticated when ``metrics_auth_api`` is wired (the
+    reference's WithAuthenticationAndAuthorization filter's authn half)."""
 
     def __init__(self, operator: "LiveOperator", host: str = "0.0.0.0",
-                 port: int = 8082):
+                 port: int = 8082, metrics_auth_api=None):
         import http.server
         import json as _json
         import socketserver
 
         op = operator
+        auth_api = metrics_auth_api
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet probes
                 pass
 
+            def _metrics(self) -> None:
+                # TokenReview-gated when an auth api is wired — the authn
+                # the reference manager's metrics filter runs
+                # (cmd/main.go:157-169).  Probes stay unauthenticated.
+                if auth_api is not None:
+                    hdr = self.headers.get("Authorization") or ""
+                    tok = hdr[7:].strip() if hdr.startswith("Bearer ") else ""
+                    if not tok:
+                        self.send_response(401)
+                        self.send_header("WWW-Authenticate", "Bearer")
+                        self.end_headers()
+                        return
+                    if not auth_api.token_review(tok):
+                        self.send_response(403)
+                        self.end_headers()
+                        return
+                # Leadership is sampled at RENDER time: the gauge must be
+                # truthful on a standby (whose _loop never runs) and after
+                # an in-process demotion (whose _loop stopped).
+                op._m_leader.set(1.0 if op.is_leader else 0.0)
+                text = op.metrics_registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+
             def do_GET(self):
-                if self.path.split("?")[0] == "/healthz":
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    return self._metrics()
+                if path == "/healthz":
                     ok = op.healthy
-                elif self.path.split("?")[0] == "/readyz":
+                elif path == "/readyz":
                     ok = op.ready
                 else:
                     self.send_response(404)
@@ -737,7 +795,11 @@ def main() -> None:
     p.add_argument("--leader-elect-namespace", default=None,
                    help="lease namespace (default: the pod's namespace)")
     p.add_argument("--health-port", type=int, default=8082,
-                   help="/healthz + /readyz endpoint port (0 = off)")
+                   help="/healthz + /readyz + /metrics endpoint port "
+                        "(0 = off)")
+    p.add_argument("--insecure-metrics", action="store_true",
+                   help="serve /metrics without TokenReview authentication "
+                        "(the reference manager authenticates by default)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -765,7 +827,9 @@ def main() -> None:
                       interval_s=args.interval, leader_elector=elector)
     health = None
     if args.health_port:
-        health = HealthServer(op, port=args.health_port)
+        health = HealthServer(
+            op, port=args.health_port,
+            metrics_auth_api=None if args.insecure_metrics else api)
         health.start()
     op.start()
     gw = None
