@@ -1250,7 +1250,7 @@ class InferenceEngine:
                 self._request_seed += 1
                 seed = p.seed if p.seed is not None else self._request_seed
                 seeds.append(seed)
-                keys.append(np.asarray(jax.random.PRNGKey(seed)))
+                keys.append(sampler_mod.np_prng_key(seed))
                 slot = self._free.pop()
                 slots_l.append(slot)
                 if self._paged:
@@ -1399,7 +1399,7 @@ class InferenceEngine:
             k = k[:, :, : self.ecfg.max_cache_len]
             v = v[:, :, : self.ecfg.max_cache_len]
         p = req.params
-        key = jax.random.PRNGKey(pf.seed)
+        key = jnp.asarray(sampler_mod.np_prng_key(pf.seed))
         try:
             slot = self._free.pop()
             if self._paged:
@@ -1628,7 +1628,8 @@ class InferenceEngine:
                 raise
         self._prefilling[slot] = _ChunkState(request=req, ids=ids,
                                              pos=prefix_len, seed=seed,
-                                             key=jax.random.PRNGKey(seed),
+                                             key=jnp.asarray(
+                                                 sampler_mod.np_prng_key(seed)),
                                              digests=digests)
         # Interleaved decode dispatches write garbage KV rows for every slot
         # at its length index; pointing this slot's length at the FINAL
@@ -1752,7 +1753,7 @@ class InferenceEngine:
         with self._prefill_lock:
             self._request_seed += 1
             seed = params.seed if params.seed is not None else self._request_seed
-            key = jax.random.PRNGKey(seed)
+            key = jnp.asarray(sampler_mod.np_prng_key(seed))
             args = (self.params, jnp.asarray(padded),
                     jnp.asarray([len(ids)], jnp.int32),
                     jnp.float32(params.temperature),

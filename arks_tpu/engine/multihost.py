@@ -311,8 +311,7 @@ class DispatchFollower:
             # value — the allocator runs on the leader only.
             import numpy as _np
             keys = jnp.asarray(_np.stack(
-                [_np.asarray(self._jax.random.PRNGKey(s))
-                 for s in p["seeds"]]))
+                [sampler_mod.np_prng_key(s) for s in p["seeds"]]))
             fn = (eng._admit_lp_fn if op == "admit_batch_lp"
                   else eng._admit_fn)
             pages = p.get("pages")
@@ -345,7 +344,7 @@ class DispatchFollower:
             # Disaggregated prefill on a gang: mirror the replicated-KV
             # prefill program (the leader materializes the full block for
             # the wire transfer; followers just keep collectives aligned).
-            key = self._jax.random.PRNGKey(p["seed"])
+            key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             fn = (eng._prefill_detached_lp_fn if op.endswith("_lp")
                   else eng._prefill_detached_fn)
             out = fn(eng.params, jnp.asarray(p["tokens"]),
@@ -355,7 +354,7 @@ class DispatchFollower:
                      jnp.int32(p["top_k"]), key)
             jax.block_until_ready(out[0])
         elif op in ("prefill", "prefill_lp"):
-            key = self._jax.random.PRNGKey(p["seed"])
+            key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             args = (eng.params, jnp.asarray(p["tokens"]),
                     jnp.asarray([p["length"]], jnp.int32),
                     jnp.float32(p["temperature"]), jnp.float32(p["top_p"]),
@@ -379,7 +378,7 @@ class DispatchFollower:
         elif op == "set_slot":
             from arks_tpu.engine.types import SamplingParams
 
-            key = self._jax.random.PRNGKey(p["seed"])
+            key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             params = SamplingParams(
                 temperature=p["temperature"], top_p=p["top_p"],
                 top_k=p["top_k"],
@@ -398,7 +397,7 @@ class DispatchFollower:
                 jnp.asarray(p["valid"], jnp.int32))
             self._last_logits = _logits
         elif op in ("sample_one", "sample_one_lp"):
-            key = self._jax.random.PRNGKey(p["seed"])
+            key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             fn = (eng._sample_one_lp_fn if op == "sample_one_lp"
                   else eng._sample_one_fn)
             fn(self._last_logits,

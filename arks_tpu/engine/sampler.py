@@ -16,9 +16,40 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 TOP_K_MAX = 64
 TOP_LOGPROBS_MAX = 8
+
+_NP_KEY_OK: bool | None = None
+
+
+def np_prng_key(seed: int) -> np.ndarray:
+    """Host-side ``jax.random.PRNGKey`` for the default threefry impl —
+    byte-identical key data with ZERO device dispatches.  PRNGKey costs a
+    traced jit + device round-trip (~0.7ms); at tens of admissions per
+    scheduler cycle that is real engine-thread time (profiled: ~5% of the
+    host-side loop).  Self-checks against jax once (covering x32/x64 and
+    impl differences) and falls back to the real thing on mismatch.
+
+    Used by BOTH the leader's admission batching and the follower's
+    dispatch replay — the two must produce identical keys or gang
+    sampling diverges.  Unlike ``jax.random.PRNGKey``, seeds outside the
+    int64 range are MASKED rather than rejected: every key site (leader
+    and follower) goes through this helper, so an absurd client-supplied
+    seed yields a consistent key everywhere instead of an OverflowError
+    on one side of a gang collective."""
+    global _NP_KEY_OK
+    if _NP_KEY_OK is None:
+        probe = (1 << 35) + 7  # high bits exercise the truncation rule
+        _NP_KEY_OK = bool(
+            np.array_equal(np.array([0, probe & 0xFFFFFFFF], np.uint32),
+                           np.asarray(jax.random.PRNGKey(probe)))
+            and np.array_equal(np.array([0, (-1) & 0xFFFFFFFF], np.uint32),
+                               np.asarray(jax.random.PRNGKey(-1))))
+    if not _NP_KEY_OK:
+        return np.asarray(jax.random.PRNGKey(seed))
+    return np.array([0, seed & 0xFFFFFFFF], np.uint32)
 
 
 def top_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray

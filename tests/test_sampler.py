@@ -88,3 +88,19 @@ def test_penalties_are_identity_at_zero():
     st = sm.count_tokens(st, jnp.asarray([1, 2, 3]))  # counts but no penalty
     ids, _ = sm.sample(logits, st)
     assert np.array_equal(np.asarray(ids), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_np_prng_key_matches_jax():
+    """The host-side key constructor must be byte-identical to
+    jax.random.PRNGKey — leader admissions and follower replay both use
+    it, and a mismatch would silently diverge gang sampling."""
+    import jax
+    import numpy as np
+
+    from arks_tpu.engine.sampler import np_prng_key
+
+    for seed in (0, 1, 7, 2**31 - 1, 2**31, 2**63 - 1, -1, -2**31,
+                 123456789):
+        np.testing.assert_array_equal(
+            np_prng_key(seed), np.asarray(jax.random.PRNGKey(seed)),
+            err_msg=f"seed={seed}")
