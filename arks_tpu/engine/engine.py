@@ -268,6 +268,10 @@ class EngineMetrics:
         self.scheduler_seconds_total = r.counter(
             "scheduler_seconds_total",
             "Engine-thread wall seconds by scheduler phase")
+        self.decode_resolve_wait_seconds_total = r.counter(
+            "decode_resolve_wait_seconds_total",
+            "Seconds blocked fetching decode results (pure device-stream "
+            "wait, unpolluted by overlapped host work)")
         # Resolved-config info gauge (value always 1, config as labels —
         # the kube-state-metrics "_info" idiom): which KV layout / decode
         # impl / overlap mode a replica ACTUALLY runs, so an operator can
@@ -1878,7 +1882,14 @@ class InferenceEngine:
         admit/chunk wall time from the TPOT observation — in overlap mode
         issue-to-resolve spans that host work, which is not decode time."""
         snapshot, want_lp, toks, lp_devs, K, t0 = rec
+        t_wait = time.monotonic()
         toks = np.asarray(toks)  # [K, B] — host sync point
+        # Pure device-stream wait, free of overlapped host work: the
+        # trustworthy device-bound signal for bench_serving's attribution
+        # (the phase-seconds breakdown attributes WALL time, which in
+        # overlap mode can land waits in whichever phase fetches first).
+        self.metrics.decode_resolve_wait_seconds_total.inc(
+            time.monotonic() - t_wait)
         if lp_devs is not None:
             clps = np.asarray(lp_devs[0])    # [K, B]
             lvals = np.asarray(lp_devs[1])   # [K, B, L]
@@ -1954,8 +1965,11 @@ class InferenceEngine:
         else:
             (self._cache, self._draft_cache, a, counts,
              self._sampling) = self._spec_fn(*args)
+        t_wait = time.monotonic()
         a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
         counts = np.asarray(counts).tolist()
+        self.metrics.decode_resolve_wait_seconds_total.inc(
+            time.monotonic() - t_wait)
         dt = time.monotonic() - t0
 
         n_spec = sum(1 for s in self._slots if enable[s])

@@ -283,7 +283,8 @@ def run_serving_bench(model: str | None = None) -> dict:
          "--probe-prompt-len", str(probe_len)],
         stdout=subprocess.PIPE, text=True)
     names = ("generation_tokens_total", "scheduler_seconds_total",
-             "prefix_cache_hit_tokens_total")
+             "prefix_cache_hit_tokens_total",
+             "decode_resolve_wait_seconds_total")
     try:
         t_launch = time.monotonic()
         print("# client launched; warming up", file=sys.stderr, flush=True)
@@ -316,6 +317,12 @@ def run_serving_bench(model: str | None = None) -> dict:
             phase = key.split('phase="')[-1].rstrip('"}')
             phases[phase] = round(
                 (s1[key] - s0.get(key, 0.0)) / (t1 - t0), 3)
+    # Pure device-stream wait fraction: trustworthy in overlap mode, where
+    # the phase-seconds wall attribution can land waits in whichever phase
+    # fetched first.
+    dw_key = "decode_resolve_wait_seconds_total"
+    device_wait = round((s1.get(dw_key, 0.0) - s0.get(dw_key, 0.0))
+                        / (t1 - t0), 3)
     return {
         # Which engine path produced these numbers (kv layout, decode
         # impl, overlap...) — the resolved config, not the requested one.
@@ -336,6 +343,7 @@ def run_serving_bench(model: str | None = None) -> dict:
         "serving_probe_prompt_len": probe_len,
         "serving_ttft_samples": len(ttfts),
         "serving_phase_fractions": phases,
+        "serving_device_wait_fraction": device_wait,
     }
 
 

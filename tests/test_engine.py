@@ -220,6 +220,13 @@ def test_resolved_config_surfaced(engine):
     assert "engine_config_info{" in text
     assert f'kv_layout="{rc["kv_layout"]}"' in text
     assert f'decode_impl="{rc["decode_impl"]}"' in text
+    # The pure device-wait counter rides every decode resolve (the
+    # overlap-mode-trustworthy signal bench_serving reports): a SAMPLE
+    # line must exist (earlier tests in this module drove decodes), not
+    # just the HELP/TYPE header.
+    assert "decode_resolve_wait_seconds_total " in text.replace(
+        "# HELP decode_resolve_wait_seconds_total ", "").replace(
+        "# TYPE decode_resolve_wait_seconds_total ", "")
 
 
 def test_cache_len_alignment_rounds_up_for_pallas(monkeypatch):
