@@ -1956,6 +1956,10 @@ class InferenceEngine:
                 self._draft_cache, jnp.asarray(self._last_token),
                 jnp.asarray(self._lengths), self._sampling,
                 jnp.asarray(enable), tables_arg)
+        # The wait timer starts BEFORE the first host fetch — in the lp
+        # branch that is the clps conversion, not np.asarray(a) (a later
+        # fetch of an already-materialized stream reads as ~0 wait).
+        t_wait = time.monotonic()
         if want_lp:
             (self._cache, self._draft_cache, a, counts, self._sampling,
              clps, lvals, lids) = self._spec_lp_fn(*args)
@@ -1965,7 +1969,6 @@ class InferenceEngine:
         else:
             (self._cache, self._draft_cache, a, counts,
              self._sampling) = self._spec_fn(*args)
-        t_wait = time.monotonic()
         a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
         counts = np.asarray(counts).tolist()
         self.metrics.decode_resolve_wait_seconds_total.inc(

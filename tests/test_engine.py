@@ -221,12 +221,18 @@ def test_resolved_config_surfaced(engine):
     assert f'kv_layout="{rc["kv_layout"]}"' in text
     assert f'decode_impl="{rc["decode_impl"]}"' in text
     # The pure device-wait counter rides every decode resolve (the
-    # overlap-mode-trustworthy signal bench_serving reports): a SAMPLE
-    # line must exist (earlier tests in this module drove decodes), not
-    # just the HELP/TYPE header.
-    assert "decode_resolve_wait_seconds_total " in text.replace(
-        "# HELP decode_resolve_wait_seconds_total ", "").replace(
-        "# TYPE decode_resolve_wait_seconds_total ", "")
+    # overlap-mode-trustworthy signal bench_serving reports).  Drive one
+    # tiny request HERE so a sample line exists even when this test runs
+    # alone, then assert a non-comment line (comment lines start '# ').
+    req = Request("rc-cfg", [5, 6, 7], SamplingParams(
+        max_tokens=3, temperature=0.0, ignore_eos=True))
+    engine.add_request(req)
+    for _ in range(50):
+        engine.step(block_s=0.01)
+        if req.outputs.qsize() and engine.num_running == 0:
+            break
+    text = engine.metrics.registry.render()
+    assert "\ndecode_resolve_wait_seconds_total " in text
 
 
 def test_cache_len_alignment_rounds_up_for_pallas(monkeypatch):
