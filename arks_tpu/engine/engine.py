@@ -1956,19 +1956,23 @@ class InferenceEngine:
                 self._draft_cache, jnp.asarray(self._last_token),
                 jnp.asarray(self._lengths), self._sampling,
                 jnp.asarray(enable), tables_arg)
-        # The wait timer starts BEFORE the first host fetch — in the lp
-        # branch that is the clps conversion, not np.asarray(a) (a later
-        # fetch of an already-materialized stream reads as ~0 wait).
-        t_wait = time.monotonic()
+        # The wait timer starts AFTER the async dispatch returns but
+        # BEFORE the first host fetch — in the lp branch the clps
+        # conversion is that first fetch, not np.asarray(a) (a later
+        # fetch of an already-materialized stream reads as ~0 wait, and
+        # timing the jit call itself would fold trace/compile into the
+        # "pure device wait" contract).
         if want_lp:
             (self._cache, self._draft_cache, a, counts, self._sampling,
              clps, lvals, lids) = self._spec_lp_fn(*args)
+            t_wait = time.monotonic()
             clps = np.asarray(clps)
             lvals = np.asarray(lvals)
             lids = np.asarray(lids)
         else:
             (self._cache, self._draft_cache, a, counts,
              self._sampling) = self._spec_fn(*args)
+            t_wait = time.monotonic()
         a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
         counts = np.asarray(counts).tolist()
         self.metrics.decode_resolve_wait_seconds_total.inc(

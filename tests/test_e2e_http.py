@@ -128,8 +128,11 @@ def test_http_two_operators_leader_election_and_expiry_failover(tmp_path):
                             interval_s=0.1, leader_elector=elector,
                             exit_on_lost_lease=False)
 
-    a = mk("op-a", lease_s=1.0)
-    b = mk("op-b", lease_s=1.0)
+    # 5s lease: long enough that suite-load starvation cannot steal
+    # it mid-test, short enough that the expiry-failover phase stays
+    # quick.
+    a = mk("op-a", lease_s=5.0)
+    b = mk("op-b", lease_s=5.0)
     client = KubeApi(srv.url)
     a.start()
     try:
@@ -158,7 +161,7 @@ def test_http_two_operators_leader_election_and_expiry_failover(tmp_path):
         a.elector.stop(release=False)
         a._stop_machinery()
         t0 = time.monotonic()
-        wait_for(lambda: b.is_leader, timeout=15.0)
+        wait_for(lambda: b.is_leader, timeout=30.0)
         assert time.monotonic() - t0 >= 0.3   # expiry-gated, not instant
         wait_for(lambda: b._machinery_started)
         lease = client.get("coordination.k8s.io/v1", "leases",

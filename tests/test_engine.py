@@ -227,10 +227,7 @@ def test_resolved_config_surfaced(engine):
     req = Request("rc-cfg", [5, 6, 7], SamplingParams(
         max_tokens=3, temperature=0.0, ignore_eos=True))
     engine.add_request(req)
-    for _ in range(50):
-        engine.step(block_s=0.01)
-        if req.outputs.qsize() and engine.num_running == 0:
-            break
+    _drive(engine)
     text = engine.metrics.registry.render()
     assert "\ndecode_resolve_wait_seconds_total " in text
 

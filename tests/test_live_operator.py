@@ -422,10 +422,11 @@ def test_poll_mode_still_works_without_watch():
 # ---------------------------------------------------------------------------
 
 
-def _mk_op(api, tmp_path, ident, lease_s=8.0, retry_s=0.05):
-    # Default lease is deliberately LONG: on a loaded CI box a starved
-    # elector thread must not lose its lease mid-test (the expiry test
-    # passes its own short duration).
+def _mk_op(api, tmp_path, ident, lease_s=30.0, retry_s=0.05):
+    # Default lease is deliberately LONG: on a loaded CI box (e2e gang
+    # subprocesses from earlier test files can linger through teardown) a
+    # starved elector thread must not lose its lease mid-test (the expiry
+    # test passes its own short duration).
     from arks_tpu.control.leader import LeaderElector
     elector = LeaderElector(api, namespace="arks-system", identity=ident,
                             lease_duration_s=lease_s, retry_period_s=retry_s)
