@@ -338,3 +338,25 @@ def test_overlap_decode_matches_sequential(monkeypatch):
         return outs
 
     assert run("1") == run("0")
+
+
+def test_admit_batch_sizes_env_override(monkeypatch):
+    """ARKS_ADMIT_BATCH_SIZES tunes the fused-admission fill sizes without
+    a code change (the serving sweep's knob): parsed, normalized
+    descending, floor of 1 enforced, surfaced in resolved config, and the
+    engine still serves correctly with a deeper ladder."""
+    monkeypatch.setenv("ARKS_ADMIT_BATCH_SIZES", "2,16,4")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=4, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert eng._admit_sizes == (16, 4, 2, 1)
+    assert eng.resolved_config["admit_batch_sizes"] == "16,4,2,1"
+    reqs = [Request(f"ab{i}", [3 + i, 9, 11], SamplingParams(
+        max_tokens=4, temperature=0.0, ignore_eos=True)) for i in range(3)]
+    for r in reqs:
+        eng.add_request(r)
+    _drive(eng)
+    for r in reqs:
+        ids, fin = _collect(r)
+        assert len(ids) == 4 and fin.finished
