@@ -1122,6 +1122,9 @@ class InferenceEngine:
             raise ValueError(
                 f"ARKS_ADMIT_BATCH_SIZES={raw!r}: expected comma-separated "
                 "integers (e.g. \"16,8,4,2,1\")") from e
+        if any(s < 1 for s in sizes):
+            raise ValueError(
+                f"ARKS_ADMIT_BATCH_SIZES={raw!r}: sizes must be >= 1")
         return tuple(sorted(sizes | {1}, reverse=True))
 
     def _admit(self) -> bool:

@@ -241,7 +241,7 @@ def run_serving_bench(model: str | None = None) -> dict:
     server.start(background=True)
 
     # Prime every compiled program the load will hit (prefill buckets for
-    # both prompt lengths, admission-batch variants M in {1,2,4,8}, the
+    # both prompt lengths, every resolved admission-batch variant M, the
     # fused decode loop): remote TPU compiles are 20-40s each and must not
     # land inside the measurement window.
     import random as _random
@@ -264,7 +264,10 @@ def run_serving_bench(model: str | None = None) -> dict:
         _one(plen, 0)
         print(f"# primed bucket {plen} at {time.monotonic()-t_prime:.0f}s",
               file=sys.stderr, flush=True)
-    for burst in (8, 4, 2):
+    # Prime every admission-batch variant the ENGINE resolved (the ladder
+    # is env-tunable — a swept M=16 program must not compile inside the
+    # measurement window).
+    for burst in [s for s in engine._admit_sizes if s > 1]:
         ts = [_threading.Thread(target=_one, args=(prompt_len, 100 + i))
               for i in range(burst)]
         for t in ts:

@@ -1,0 +1,97 @@
+"""Serving-path knob sweep: find the best scheduler configuration on-chip.
+
+Runs the REAL serving benchmark (bench_serving.run_serving_bench — engine +
+OpenAI server + SSE under concurrent load) once per configuration, each in
+a FRESH subprocess (engine/env state cannot leak between configs), and
+prints one JSON line per run plus a ranked summary.  The knobs swept are
+exactly the env-tunable scheduler levers:
+
+- ARKS_BENCH_STEPS       (decode steps per dispatch, K)
+- ARKS_ADMIT_BATCH_SIZES (fused-admission fill ladder)
+- ARKS_OVERLAP_DECODE    (decode/admission overlap)
+
+Usage:
+  timeout 3600 python tools/bench_sweep.py               # default grid
+  SWEEP_GRID='[{"ARKS_BENCH_STEPS":"64"}]' python tools/bench_sweep.py
+
+Each config costs ~2-4 min on the chip (priming + warmup + window); the
+default grid is 6 configs.  Meaningful only on real TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_GRID = [
+    {},  # production defaults — the baseline the others must beat
+    {"ARKS_BENCH_STEPS": "64"},
+    {"ARKS_ADMIT_BATCH_SIZES": "16,8,4,2,1"},
+    {"ARKS_BENCH_STEPS": "64", "ARKS_ADMIT_BATCH_SIZES": "16,8,4,2,1"},
+    {"ARKS_OVERLAP_DECODE": "0"},
+    {"ARKS_BENCH_STEPS": "16"},
+]
+
+
+SWEPT_KEYS = ("ARKS_BENCH_STEPS", "ARKS_ADMIT_BATCH_SIZES",
+              "ARKS_OVERLAP_DECODE")
+
+
+def run_config(overrides: dict[str, str], timeout_s: float) -> dict:
+    env = dict(os.environ)
+    # The swept knobs start CLEAN: a pre-exported ARKS_* from earlier
+    # experimentation must not contaminate the "defaults" baseline (the
+    # config label must describe what actually ran).
+    for key in SWEPT_KEYS:
+        env.pop(key, None)
+    env.update(overrides)
+    code = ("import json\n"
+            "from bench_serving import run_serving_bench\n"
+            "print('SWEEP_RESULT ' + json.dumps(run_serving_bench()))\n")
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"config": overrides, "error": f"timeout {timeout_s:.0f}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("SWEEP_RESULT "):
+            out = json.loads(line[len("SWEEP_RESULT "):])
+            out["config"] = overrides
+            out["wall_s"] = round(time.monotonic() - t0, 1)
+            return out
+    tail = (r.stderr or r.stdout).strip().splitlines()[-1:]
+    return {"config": overrides,
+            "error": f"rc={r.returncode}: {tail[0][-300:] if tail else ''}"}
+
+
+def main() -> None:
+    grid = json.loads(os.environ.get("SWEEP_GRID", "null")) or DEFAULT_GRID
+    per_run_timeout = float(os.environ.get("SWEEP_RUN_TIMEOUT", "600"))
+    results = []
+    for i, overrides in enumerate(grid):
+        print(f"# sweep {i + 1}/{len(grid)}: {overrides or 'defaults'}",
+              file=sys.stderr, flush=True)
+        res = run_config(overrides, per_run_timeout)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+    ranked = sorted((r for r in results if "serving_tok_s_chip" in r),
+                    key=lambda r: -r["serving_tok_s_chip"])
+    print(json.dumps({
+        "metric": "serving_sweep_best",
+        "ranking": [{"config": r["config"],
+                     "serving_tok_s_chip": r["serving_tok_s_chip"],
+                     "serving_ttft_p50_ms": r.get("serving_ttft_p50_ms")}
+                    for r in ranked],
+        "errors": [r for r in results if "error" in r],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
