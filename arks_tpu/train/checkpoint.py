@@ -56,6 +56,8 @@ def _stored_dtype(manager, step: int):
     """The checkpoint's own parameter dtype (Orbax metadata) — restoring
     into a template of a DIFFERENT dtype would silently cast the state and
     break bit-identical resume.  None when metadata is unavailable."""
+    import logging
+
     import orbax.checkpoint as ocp
 
     try:
@@ -63,8 +65,16 @@ def _stored_dtype(manager, step: int):
             os.path.join(manager.directory, str(step), "default"))
         tree = getattr(meta.item_metadata, "tree", meta.item_metadata)
         return jax.numpy.dtype(tree["params"]["embed"].dtype)
-    except Exception:
-        return None  # caller falls back to train_init's default (f32)
+    except (KeyError, TypeError, AttributeError, FileNotFoundError,
+            ValueError) as e:
+        # Loud fallback: a silently-wrong template dtype would upcast a
+        # bf16 checkpoint and break bit-identical resume — if this fires,
+        # pass dtype= explicitly (Orbax metadata layout likely changed).
+        logging.getLogger("arks_tpu.train.checkpoint").warning(
+            "could not read checkpoint dtype metadata (%s: %s); "
+            "defaulting the restore template to float32 — pass dtype= "
+            "explicitly if the run used another dtype", type(e).__name__, e)
+        return None
 
 
 def _sharded_template(abstract: TrainState, cfg, mesh) -> TrainState:

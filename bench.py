@@ -1,6 +1,10 @@
 """North-star benchmark on real hardware: Qwen2.5-7B on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Output contract: the LAST stdout line is the result JSON
+({"metric", "value", "unit", "vs_baseline", ...extras}).  A raw-loop
+checkpoint line precedes the final combined line so a run killed mid-
+serving still leaves parsable evidence; consumers must take the last
+line, not parse the whole stream.
 Baseline: BASELINE.md north star — >=2,000 tok/s/chip decode throughput AND
 p50 TTFT < 200 ms on Qwen2.5-7B (the reference publishes no numbers of its
 own; these targets come from BASELINE.json).  ``vs_baseline`` is computed on
@@ -286,6 +290,13 @@ def main() -> None:
                 parity_diff < (0.075 if kv_quant else 0.05)
         except Exception as e:
             result["pallas_parity_error"] = f"{type(e).__name__}: {e}"
+
+    # Checkpoint line BEFORE the long serving phase: if the driver's
+    # timeout kills this process mid-serving, the last printed JSON line
+    # is still a parsed raw-loop result instead of nothing.  A completed
+    # run prints the combined line after it, which then takes precedence
+    # as the final line.
+    print(json.dumps(result), flush=True)
 
     # Serving-path numbers (engine + OpenAI server + SSE under concurrent
     # load — bench_serving.py): the honest counterpart of the raw-loop
