@@ -70,6 +70,10 @@ class PackedDataset:
         if not self.records:
             raise ValueError("dataset is empty")
         self.eos = (tokenizer.eos_token_ids or (0,))[0]
+        # Tokenize ONCE (order-independent): epochs only reshuffle+repack,
+        # so multi-epoch runs and resume fast-forward never re-pay the
+        # tokenizer.
+        self._docs = [self._doc_tokens(r) for r in self.records]
 
     def _doc_tokens(self, rec: dict) -> tuple[list[int], list[int]]:
         """(token_ids, loss_mask) for one document, EOS-terminated."""
@@ -95,7 +99,7 @@ class PackedDataset:
         buf_mask: list[int] = []
         out = []
         for i in order:
-            ids, mask = self._doc_tokens(self.records[i])
+            ids, mask = self._docs[i]
             buf_ids.extend(ids)
             buf_mask.extend(mask)
             while len(buf_ids) > t:  # need t+1 to form targets for t
