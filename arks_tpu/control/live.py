@@ -432,6 +432,7 @@ class LiveOperator:
         self._running = False
         if self._thread:
             self._thread.join(timeout=10)
+        self._thread = None  # a later restart's healthy window is clean
         self.manager.stop()
 
     @property
@@ -443,10 +444,13 @@ class LiveOperator:
     @property
     def healthy(self) -> bool:
         """Liveness: a standby is healthy idling; a leader is healthy only
-        while its sync thread is."""
+        while its sync thread is.  ``_thread is None`` while machinery is
+        STARTING (the flag flips before manager.start() finishes and the
+        thread exists) — that window is healthy, not a dead loop."""
         if not self._machinery_started:
             return True
-        return self._thread is not None and self._thread.is_alive()
+        t = self._thread
+        return t is None or t.is_alive()
 
     @property
     def ready(self) -> bool:

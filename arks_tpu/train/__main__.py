@@ -83,11 +83,37 @@ def main() -> None:
     if args.batch_size % args.dp:
         raise SystemExit(f"--batch-size {args.batch_size} must divide by "
                          f"--data-parallel {args.dp}")
+    if args.ckpt_every < 1 or args.log_every < 1:
+        raise SystemExit("--ckpt-every and --log-every must be >= 1")
 
     optimizer = optax.adamw(args.lr, weight_decay=args.weight_decay)
     step_fn = make_train_step(cfg, optimizer, mesh)
 
     manager = make_manager(args.ckpt_dir) if args.ckpt_dir else None
+    if args.ckpt_dir:
+        # Resume fence: the fast-forward replay is only bit-identical when
+        # the data-shaping arguments match the original run — a silently
+        # different stream would re-train some windows and skip others.
+        import json as _json
+        shape = {"model": args.model, "data": sorted(args.data),
+                 "seq_len": args.seq_len, "batch_size": args.batch_size,
+                 "seed": args.seed}
+        fence = os.path.join(args.ckpt_dir, "trainer_config.json")
+        if os.path.exists(fence):
+            with open(fence) as f:
+                prev = _json.load(f)
+            if prev != shape:
+                diff = {k: (prev.get(k), shape[k]) for k in shape
+                        if prev.get(k) != shape[k]}
+                raise SystemExit(
+                    f"--ckpt-dir {args.ckpt_dir} was written with different "
+                    f"data-shaping args (stored vs given): {diff} — resume "
+                    "would not replay the same stream; use a fresh dir or "
+                    "the original arguments")
+        else:
+            os.makedirs(args.ckpt_dir, exist_ok=True)
+            with open(fence, "w") as f:
+                _json.dump(shape, f)
     if manager is not None and manager.latest_step() is not None:
         state = restore_train_state(manager, cfg, optimizer, mesh)
         log.info("resumed from step %d (%s)", int(state.step),

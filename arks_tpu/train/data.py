@@ -74,6 +74,7 @@ class PackedDataset:
         # so multi-epoch runs and resume fast-forward never re-pay the
         # tokenizer.
         self._docs = [self._doc_tokens(r) for r in self.records]
+        self._window_cache: tuple[int, list] | None = None
 
     def _doc_tokens(self, rec: dict) -> tuple[list[int], list[int]]:
         """(token_ids, loss_mask) for one document, EOS-terminated."""
@@ -92,6 +93,11 @@ class PackedDataset:
                                                  list[int]]]:
         """All (tokens, targets, loss_mask) windows of the epoch's shuffled
         stream (shard-independent — the basis every shard stripes over)."""
+        # One-epoch memo: the trainer's startup batches_per_epoch() and
+        # the first epoch() pack the same windows.
+        if self._window_cache is not None and \
+                self._window_cache[0] == epoch:
+            return self._window_cache[1]
         order = list(range(len(self.records)))
         random.Random(f"{self.seed}/{epoch}").shuffle(order)
         t = self.seq_len
@@ -108,6 +114,7 @@ class PackedDataset:
                 del buf_ids[:t], buf_mask[:t]
                 # Loss applies where the TARGET is a trainable position.
                 out.append((window[:t], window[1: t + 1], wmask[1: t + 1]))
+        self._window_cache = (epoch, out)
         return out
 
     def batches_per_epoch(self, epoch: int = 0) -> int:

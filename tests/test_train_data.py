@@ -206,3 +206,29 @@ def test_trainer_cli_end_to_end_with_resume(tmp_path):
     # Loss kept improving across the restart boundary.
     losses = [float(m) for m in re.findall(r"loss (\d+\.\d+)", out1 + out2)]
     assert len(losses) >= 4 and losses[-1] < losses[0]
+
+
+def test_trainer_cli_resume_fence_rejects_changed_shape(tmp_path):
+    """Resuming with different data-shaping args must FAIL LOUDLY — a
+    silently different stream would break the bit-identical replay."""
+    import subprocess
+    import sys
+
+    data = tmp_path / "c.jsonl"
+    data.write_text("\n".join(json.dumps(r) for r in _records(24)) + "\n")
+
+    def run(extra):
+        return subprocess.run(
+            [sys.executable, "-m", "arks_tpu.train", "--model", "tiny",
+             "--data", str(data), "--seq-len", "32", "--steps", "2",
+             "--ckpt-dir", str(tmp_path / "run"), "--platform", "cpu",
+             *extra],
+            capture_output=True, text=True, timeout=420)
+
+    assert run(["--batch-size", "4"]).returncode == 0
+    r = run(["--batch-size", "8"])
+    assert r.returncode != 0
+    assert "different data-shaping args" in r.stderr
+    assert "batch_size" in r.stderr
+    # Original arguments still resume fine.
+    assert run(["--batch-size", "4", "--steps", "4"]).returncode == 0
