@@ -1,3 +1,10 @@
+from arks_tpu.train.checkpoint import (
+    make_manager, restore_train_state, save_train_state)
+from arks_tpu.train.data import PackedDataset, prefetch, read_jsonl
 from arks_tpu.train.sft import TrainState, make_train_step, train_init
 
-__all__ = ["TrainState", "make_train_step", "train_init"]
+__all__ = [
+    "PackedDataset", "TrainState", "make_manager", "make_train_step",
+    "prefetch", "read_jsonl", "restore_train_state", "save_train_state",
+    "train_init",
+]

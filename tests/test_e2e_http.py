@@ -160,14 +160,20 @@ def test_http_two_operators_leader_election_and_expiry_failover(tmp_path):
         # resourceVersion-fenced PUT over HTTP.
         a.elector.stop(release=False)
         a._stop_machinery()
-        t0 = time.monotonic()
+        from arks_tpu.control.leader import _parse_rfc3339
+        dead = client.get("coordination.k8s.io/v1", "leases",
+                          "arks-system", "e4ada7ad.arks.ai")["spec"]
+        expiry = (_parse_rfc3339(dead["renewTime"])
+                  + dead["leaseDurationSeconds"])
         wait_for(lambda: b.is_leader, timeout=30.0)
-        assert time.monotonic() - t0 >= 0.3   # expiry-gated, not instant
         wait_for(lambda: b._machinery_started)
         lease = client.get("coordination.k8s.io/v1", "leases",
                            "arks-system", "e4ada7ad.arks.ai")
         assert lease["spec"]["holderIdentity"] == "op-b"
         assert int(lease["spec"]["leaseTransitions"]) >= 1
+        # EXPIRY-gated takeover, proven from the Lease's own timestamps:
+        # op-b acquired only after the dead leader's lease ran out.
+        assert _parse_rfc3339(lease["spec"]["acquireTime"]) >= expiry
 
         # The new leader reconciles fresh CRs.
         client.create(GV, "arksapplications", "default", _cr(
