@@ -108,10 +108,16 @@ class EngineConfig:
     # measured FASTER than the slot cache at production shapes
     # (tools/bench_kernels.py: 0.96x int8 b192, 0.78x bf16 b96) and it
     # works on multi-host gangs.  "auto" = paged on TPU whenever the
-    # engine shape allows (no pp / cp / dp, lane-aligned head_dim,
+    # engine shape allows (no pp / dp, lane-aligned head_dim,
     # chunk == page alignment); slot elsewhere — the slot layout remains
-    # the fallback for those paths.  Speculative decoding rides paged:
-    # the target cache pages, the draft mirror stays slot-contiguous.
+    # the fallback for those paths.  Speculative decoding rides paged
+    # (the target cache pages, the draft mirror stays slot-contiguous),
+    # and so does context parallelism (one-shot prefill rides the ring;
+    # the pool is seq-replicated, so tables/pages are unaffected — chunk
+    # tails run unsharded over seq, as they do on the slot layout).
+    # dp stays slot by design: the pool has no batch dim to shard and
+    # per-dp-shard pools would fragment the prefix index; pp stays slot
+    # because stage-sharded pools need a paged pp decode program.
     kv_layout: str = "auto"
     # Host-RAM budget for the prefix KV cache (0 disables).  Shared prompt
     # prefixes (system prompts, few-shot preambles, multi-turn history)
@@ -923,8 +929,6 @@ class InferenceEngine:
         blockers = []
         if self._pp > 1:
             blockers.append("pipeline parallelism")
-        if self._cp > 1:
-            blockers.append("context parallelism")
         if dp > 1:
             blockers.append("data parallelism")
         if (jax.default_backend() == "tpu"
