@@ -115,9 +115,13 @@ class EngineConfig:
     # and so does context parallelism (one-shot prefill rides the ring;
     # the pool is seq-replicated, so tables/pages are unaffected — chunk
     # tails run unsharded over seq, as they do on the slot layout).
+    # Pipeline parallelism pages too: the pool shards over 'stage' on its
+    # layer dim and decode pipelines microbatches through the block
+    # tables (parallel.pipeline.pp_decode_step_paged) — page-granular
+    # allocation instead of per-slot max_cache_len reservations, the HBM
+    # lever pp exists for (chunking/prefix reuse stay off under pp).
     # dp stays slot by design: the pool has no batch dim to shard and
-    # per-dp-shard pools would fragment the prefix index; pp stays slot
-    # because stage-sharded pools need a paged pp decode program.
+    # per-dp-shard pools would fragment the prefix index.
     kv_layout: str = "auto"
     # Host-RAM budget for the prefix KV cache (0 disables).  Shared prompt
     # prefixes (system prompts, few-shot preambles, multi-turn history)
@@ -428,7 +432,10 @@ class InferenceEngine:
             if engine_cfg.kv_quantized:
                 page_bytes += cfg.num_layers * cfg.num_kv_heads * page * 4 * 2
             extra = 0
-            if engine_cfg.prefix_cache_mb:
+            # Retention pages only help when prefix sharing can actually
+            # register/match them, which rides the chunk path — under pp
+            # (chunking off) they would be permanently dead HBM.
+            if engine_cfg.prefix_cache_mb and self._chunk:
                 extra = max(engine_cfg.prefix_cache_mb * 2**20 // page_bytes, 0)
                 # The byte budget is tuned for 7B-class pools; cap by
                 # proportion so tiny test models don't allocate huge pools.
@@ -440,7 +447,11 @@ class InferenceEngine:
                 quantized=engine_cfg.kv_quantized,
                 pad_head=self._pad_head())
             if mesh is not None:
-                self._cache = tf.shard_paged_cache(self._cache, cfg, mesh)
+                if self._pp > 1:
+                    from arks_tpu.parallel.pipeline import shard_paged_cache_pp
+                    self._cache = shard_paged_cache_pp(self._cache, mesh)
+                else:
+                    self._cache = tf.shard_paged_cache(self._cache, cfg, mesh)
             self._alloc = PageAllocator(num_pages, page)
             self._tables = np.zeros((engine_cfg.num_slots, max_pages),
                                     np.int32)
@@ -576,6 +587,10 @@ class InferenceEngine:
                 return pp_mod.pp_prefill(params, cfg, tokens, length, mesh)
 
             def model_decode(params, cache, tokens, lengths, tables=None):
+                if tables is not None:
+                    return pp_mod.pp_decode_step_paged(
+                        params, cfg, cache, tables, tokens, lengths, mesh,
+                        num_mb)
                 return pp_mod.pp_decode_step(params, cfg, cache, tokens,
                                              lengths, mesh, num_mb)
         else:
@@ -891,8 +906,10 @@ class InferenceEngine:
 
     def _page_size(self) -> int:
         """Page size = chunk size (a reused prefix then ends exactly where
-        the tail chunk prefill starts), or 256 when chunking is off."""
-        return self._chunk or 256
+        the tail chunk prefill starts), or 256 when chunking is off —
+        capped by the cache window so small configs (pp disables chunking)
+        still page."""
+        return self._chunk or min(256, self.ecfg.max_cache_len)
 
     def _page_align(self) -> int:
         """Kernel alignment for the page size (compiled TPU only): int8
@@ -927,8 +944,6 @@ class InferenceEngine:
               * self.mesh.shape.get(AXIS_SLICE, 1)) \
             if self.mesh is not None else 1
         blockers = []
-        if self._pp > 1:
-            blockers.append("pipeline parallelism")
         if dp > 1:
             blockers.append("data parallelism")
         if (jax.default_backend() == "tpu"

@@ -170,6 +170,156 @@ def shard_cache_pp(cache, mesh, stage_axis: str = AXIS_STAGE):
         v_scale=put(cache.v_scale, spec) if cache.quantized else None)
 
 
+def shard_paged_cache_pp(cache, mesh, stage_axis: str = AXIS_STAGE):
+    """Paged pool sharded over the STAGE axis on its layer dim — the paged
+    counterpart of ``shard_cache_pp``.  Pages (dim 1) stay whole: block
+    tables index one global page id space and every stage holds its own
+    layers' rows of each page."""
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    spec = P(stage_axis)
+    return tf.PagedKVCache(
+        k=put(cache.k, spec), v=put(cache.v, spec),
+        k_scale=put(cache.k_scale, spec) if cache.quantized else None,
+        v_scale=put(cache.v_scale, spec) if cache.quantized else None)
+
+
+def pp_decode_step_paged(
+    params,
+    cfg,
+    cache,                 # PagedKVCache, pool sharded over ``stage`` on L
+    tables: jnp.ndarray,   # [B, MaxP] int32 block tables
+    tokens: jnp.ndarray,   # [B] int32
+    lengths: jnp.ndarray,  # [B] int32
+    mesh,
+    num_microbatches: int,
+    stage_axis: str = AXIS_STAGE,
+):
+    """One decode token for every slot against the PAGED pool, layers
+    pipelined over stages — the paged counterpart of ``pp_decode_step``.
+
+    The pool has no batch dim, so unlike the slot path there is no
+    per-microbatch cache slice: the whole (stage-local) pool rides the
+    tick carry and each microbatch writes through its rows of the block
+    tables.  Bubble ticks skip via ``lax.cond`` (a bubble write through a
+    clamped microbatch's tables would corrupt a REAL slot's pages); freed
+    slots parked at the coverage sentinel are dropped inside the paged op,
+    as on the single-stage path (transformer.decode_step).
+
+    NOTE: the tick/bubble/clamp pipelining scaffolding here is the TWIN of
+    ``pp_decode_step``'s — the two differ only in per-tick cache access
+    (whole pool + table row here vs dynamic batch slice there).  A fix to
+    the bubble-skip, out_idx clamp, or psum-collection logic in one almost
+    certainly applies to the other.
+    """
+    num_stages = mesh.shape[stage_axis]
+    if cfg.num_layers % num_stages != 0:
+        raise ValueError(f"{cfg.num_layers} layers not divisible into "
+                         f"{num_stages} stages")
+    b = tokens.shape[0]
+    m = num_microbatches
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    mbs = b // m
+    quantized = cache.quantized
+    compute_dtype = params["layers"]["attn_norm"].dtype
+    page = cache.page
+    cover = tables.shape[1] * page
+    from arks_tpu.ops.attention import paged_decode_update_and_attend
+
+    def local(layers_local, embed, kc, vc, ksc, vsc, tables, tokens, lengths):
+        s_ax = jax.lax.axis_size(stage_axis)
+        s_id = jax.lax.axis_index(stage_axis)
+        perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
+        toks_mb = tokens.reshape(m, mbs)
+        lens_mb = lengths.reshape(m, mbs)
+        tbl_mb = tables.reshape(m, mbs, -1)
+        e = embed.shape[1]
+
+        def run_stage(h, kc, vc, ksc, vsc, tbl, lens):
+            write_idx = lens.astype(jnp.int32)
+            # RoPE positions must be real for active slots; the sentinel
+            # (>= coverage) only matters to the paged op, which drops it.
+            rope_idx = jnp.minimum(write_idx, cover - 1)
+
+            def body(carry, xs):
+                h, kc, vc, ksc, vsc = carry
+                lp, layer = xs
+                x = tf.rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+                q, k, v = tf._qkv(x, lp, cfg)
+                q = q.reshape(mbs, cfg.num_heads, cfg.head_dim)
+                k = k.reshape(mbs, cfg.num_kv_heads, cfg.head_dim)
+                v = v.reshape(mbs, cfg.num_kv_heads, cfg.head_dim)
+                q = tf.apply_rope(q, rope_idx, cfg.rope_theta)
+                k = tf.apply_rope(k, rope_idx, cfg.rope_theta)
+                # XLA impl for the same reason as the slot pp path: tiny
+                # per-stage microbatches bind the kernels' batch tiling.
+                attn, kc, vc, ksc, vsc = paged_decode_update_and_attend(
+                    q, k, v, kc, vc, tbl, write_idx, layer, impl="xla",
+                    k_scale=ksc, v_scale=vsc)
+                attn = attn.reshape(mbs, cfg.q_dim)
+                h = h + tf.qeinsum("bq,qe->be", attn, lp["wo"])
+                h = h + tf._mlp(h, lp, cfg, None, None)
+                return (h, kc, vc, ksc, vsc), None
+
+            n_local = jax.tree.leaves(layers_local)[0].shape[0]
+            (h, kc, vc, ksc, vsc), _ = jax.lax.scan(
+                body, (h, kc, vc, ksc, vsc),
+                (layers_local, jnp.arange(n_local, dtype=jnp.int32)))
+            return h, kc, vc, ksc, vsc
+
+        buf = jnp.zeros((mbs, e), compute_dtype)
+        h_acc = jnp.zeros((m, mbs, e), compute_dtype)
+
+        def tick(carry, ti):
+            kc, vc, ksc, vsc, buf, h_acc = carry
+            mi = ti - s_id
+            valid = (mi >= 0) & (mi < m)
+            mi_c = jnp.clip(mi, 0, m - 1)
+            toks = jax.lax.dynamic_index_in_dim(toks_mb, mi_c, 0, keepdims=False)
+            lens = jax.lax.dynamic_index_in_dim(lens_mb, mi_c, 0, keepdims=False)
+            tbl = jax.lax.dynamic_index_in_dim(tbl_mb, mi_c, 0, keepdims=False)
+            h0 = tf.embed_lookup(embed, toks, compute_dtype)
+            h_in = jnp.where(s_id == 0, h0, buf)
+
+            def do(h_in, kc, vc, ksc, vsc, tbl, lens):
+                return run_stage(h_in, kc, vc, ksc, vsc, tbl, lens)
+
+            def skip(h_in, kc, vc, ksc, vsc, tbl, lens):
+                return jnp.zeros_like(h_in), kc, vc, ksc, vsc
+
+            h_out, kc, vc, ksc, vsc = jax.lax.cond(
+                valid, do, skip, h_in, kc, vc, ksc, vsc, tbl, lens)
+            out_idx = jnp.clip(ti - (s_ax - 1), 0, m - 1)
+            h_acc = jax.lax.dynamic_update_slice(
+                h_acc, h_out[None].astype(h_acc.dtype), (out_idx, 0, 0))
+            buf = jax.lax.ppermute(h_out, stage_axis, perm)
+            return (kc, vc, ksc, vsc, buf, h_acc), None
+
+        (kc, vc, ksc, vsc, buf, h_acc), _ = jax.lax.scan(
+            tick, (kc, vc, ksc, vsc, buf, h_acc),
+            jnp.arange(m + s_ax - 1))
+        mask = (s_id == s_ax - 1).astype(h_acc.dtype)
+        h_final = jax.lax.psum(h_acc * mask, stage_axis)
+        return h_final, kc, vc, ksc, vsc
+
+    cspec = P(stage_axis)
+    sspec = cspec if quantized else None
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(stage_axis), P(), cspec, cspec, sspec, sspec,
+                  P(), P(), P()),
+        out_specs=(P(), cspec, cspec, sspec, sspec),
+        check_vma=False,
+    )
+    h, kc, vc, ksc, vsc = fn(params["layers"], params["embed"],
+                             cache.k, cache.v, cache.k_scale, cache.v_scale,
+                             tables, tokens, lengths)
+    logits = tf._unembed(h.reshape(b, -1), params, cfg, None, None)
+    return logits, tf.PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
 def pp_decode_step(
     params,
     cfg,
@@ -194,6 +344,9 @@ def pp_decode_step(
     The attention/update body runs the XLA path (impl="xla"): per-stage
     microbatches are small and kernel batch-tiling constraints would bind;
     PP's win is HBM capacity, not decode-kernel latency.
+
+    NOTE: the tick/bubble/clamp pipelining scaffolding here is the TWIN of
+    ``pp_decode_step_paged``'s (see its docstring) — keep fixes in sync.
     """
     num_stages = mesh.shape[stage_axis]
     if cfg.num_layers % num_stages != 0:

@@ -67,7 +67,7 @@ def main() -> None:
                    choices=("auto", "slot", "paged"),
                    help="device KV layout: paged = block-table pool with "
                         "on-device prefix sharing (TPU default); slot = "
-                        "contiguous per-slot cache (pp/dp)")
+                        "contiguous per-slot cache (dp)")
     p.add_argument("--prefix-cache-mb", type=int, default=256,
                    help="host-RAM budget for prefix KV reuse (0 disables)")
     p.add_argument("--draft-model", default=None,

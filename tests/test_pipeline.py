@@ -116,3 +116,52 @@ def test_serving_engine_with_pipeline_parallelism():
         return outs
 
     assert run(2) == run(1)
+
+
+def test_serving_engine_pp_paged():
+    """The paged layout composes with pipeline parallelism: the pool
+    shards over 'stage' on its layer dim, admissions insert through the
+    block tables, decode pipelines microbatches against table-mapped
+    pages (pp_decode_step_paged), and greedy output matches the pp=1 slot
+    oracle.  Slot reuse is exercised too: more prompts than slots forces
+    page free/realloc between requests."""
+    from arks_tpu.engine import (
+        EngineConfig, InferenceEngine, Request, SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+
+    cfg = get_config("tiny")
+    prompts = [[int(x) % cfg.vocab_size for x in range(5, 29)],
+               [int(x) % cfg.vocab_size for x in range(40, 50)],
+               [3] * 17,
+               [int(x) % cfg.vocab_size for x in range(7, 38)],
+               [9, 8, 7, 6, 5],
+               [int(x) % cfg.vocab_size for x in range(11, 43)]]
+
+    def run(pp, layout):
+        ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                            prefill_buckets=(16, 32), steps_per_dispatch=4,
+                            pipeline_parallel=pp, prefix_cache_mb=0,
+                            kv_layout=layout)
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+        eng.start()
+        outs = []
+        try:
+            reqs = [Request(f"p{i}", list(p), SamplingParams(
+                max_tokens=6, temperature=0.0, ignore_eos=True))
+                for i, p in enumerate(prompts)]
+            for r in reqs:
+                eng.add_request(r)
+            for r in reqs:
+                ids = []
+                while True:
+                    out = r.outputs.get(timeout=120)
+                    ids.extend(out.token_ids)
+                    if out.finished:
+                        break
+                outs.append(ids)
+        finally:
+            eng.stop()
+        return outs
+
+    assert run(2, "paged") == run(1, "slot")
