@@ -447,11 +447,7 @@ class InferenceEngine:
                 quantized=engine_cfg.kv_quantized,
                 pad_head=self._pad_head())
             if mesh is not None:
-                if self._pp > 1:
-                    from arks_tpu.parallel.pipeline import shard_paged_cache_pp
-                    self._cache = shard_paged_cache_pp(self._cache, mesh)
-                else:
-                    self._cache = tf.shard_paged_cache(self._cache, cfg, mesh)
+                self._cache = self._shard_paged(self._cache)
             self._alloc = PageAllocator(num_pages, page)
             self._tables = np.zeros((engine_cfg.num_slots, max_pages),
                                     np.int32)
@@ -527,6 +523,16 @@ class InferenceEngine:
         self._running = False
         self._thread: threading.Thread | None = None
         self._request_seed = engine_cfg.seed
+        # Deferred admissions: issued batches whose first tokens haven't
+        # been fetched yet (FIFO).  Resolving lazily (is_ready polling in
+        # step) keeps the engine thread issuing decode dispatches instead
+        # of blocking on every admit program's round-trip — the r04 bench
+        # measured 92% of engine wall in blocking admit resolves at
+        # saturation.  Spec engines stay synchronous (their dispatch
+        # eligibility logic assumes registered slots).
+        from collections import deque
+        self._pending_admits: "deque" = deque()
+        self._defer_admits = engine_cfg.draft_model is None
         # Decode/admission overlap: issue the decode dispatch async and do
         # admission host work while the device computes.  Pays off where
         # device compute and host logistics are truly parallel (TPU);
@@ -736,16 +742,25 @@ class InferenceEngine:
         self._clear_pen_fn = jax.jit(sampler_mod.clear_slot_penalties,
                                      donate_argnums=(0,))
 
+        # Free/pending slots park their lengths at this write-drop value;
+        # the fused loop derives the active mask from it so PRNG keys and
+        # penalty counts only advance for REGISTERED slots (deferred
+        # admissions put decode dispatches between a slot's admit program
+        # and its registration — see _drain_ready_admits).
+        sentinel = (self._max_pages * self._page_size() if self._paged
+                    else self.ecfg.max_cache_len)
+
         def decode_loop(params, cache, tokens, lengths, sstate, tables):
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
+                active = lengths < sentinel
                 # Feed-time counting: every generated token is fed exactly
                 # once, which keeps the presence/frequency counts right
                 # across the one-shot, chunked, and disagg admission paths.
-                sstate = sampler_mod.count_tokens(sstate, tokens)
+                sstate = sampler_mod.count_tokens(sstate, tokens, active)
                 logits, cache = model_decode(params, cache, tokens, lengths,
                                              tables)
-                nxt, sstate = sampler_mod.sample(logits, sstate)
+                nxt, sstate = sampler_mod.sample(logits, sstate, active)
                 return (cache, nxt, lengths + 1, sstate), nxt
 
             (cache, tokens, lengths, sstate), toks = jax.lax.scan(
@@ -760,10 +775,11 @@ class InferenceEngine:
             # case never pays the full-vocab log-softmax).
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
-                sstate = sampler_mod.count_tokens(sstate, tokens)
+                active = lengths < sentinel
+                sstate = sampler_mod.count_tokens(sstate, tokens, active)
                 logits, cache = model_decode(params, cache, tokens, lengths,
                                              tables)
-                nxt, sstate = sampler_mod.sample(logits, sstate)
+                nxt, sstate = sampler_mod.sample(logits, sstate, active)
                 clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
                 return (cache, nxt, lengths + 1, sstate), (nxt, clp, vals, lids)
 
@@ -870,17 +886,25 @@ class InferenceEngine:
         self._running = False
         if self._thread is not None:
             self._thread.join(timeout=30)
+        # Deferred admissions left by a mid-flight stop: their clients
+        # would otherwise block forever (no scheduler remains to resolve).
+        self._abort_pending_admits()
 
     @property
     def num_running(self) -> int:
-        return len(self._slots)
+        # Deferred admit batches hold slots too — external drivers poll
+        # this to detect completion, and a pending admission is running
+        # work in every sense that matters to them.
+        return len(self._slots) + sum(len(rec[0])
+                                      for rec in self._pending_admits)
 
     @property
     def idle(self) -> bool:
-        """No decoding slots, no queued admissions, no chunked prefills in
-        flight — the drain gate (servers must not poke at privates)."""
+        """No decoding slots, no queued admissions, no chunked prefills or
+        deferred admit batches in flight — the drain gate (servers must
+        not poke at privates)."""
         return (not self._slots and self._queue.empty()
-                and not self._prefilling)
+                and not self._prefilling and not self._pending_admits)
 
     # ------------------------------------------------------------------
     # Scheduler loop
@@ -976,6 +1000,15 @@ class InferenceEngine:
             return shard_cache_pp(cache, self.mesh)
         return tf.shard_cache(cache, self.cfg, self.mesh)
 
+    def _shard_paged(self, cache):
+        """Paged-pool sharding, pp-aware — used by BOTH engine init and
+        _reset_device_state (a reset that replicated a stage-sized pool
+        onto every stage device would OOM inside the recovery path)."""
+        if self._pp > 1:
+            from arks_tpu.parallel.pipeline import shard_paged_cache_pp
+            return shard_paged_cache_pp(cache, self.mesh)
+        return tf.shard_paged_cache(cache, self.cfg, self.mesh)
+
     def _emit(self, op: str, **payload) -> None:
         """Broadcast a device dispatch to follower processes (multi-host);
         no-op single-host.  MUST precede the local dispatch at every site —
@@ -1015,6 +1048,7 @@ class InferenceEngine:
                         finished=True, finish_reason="abort",
                         num_prompt_tokens=len(st.ids)))
                 self._prefilling.clear()
+                self._abort_pending_admits()
                 self._reset_device_state()
                 progressed = True
             if not progressed:
@@ -1033,8 +1067,7 @@ class InferenceEngine:
                 self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized,
                 pad_head=self._pad_head())
             if self.mesh is not None:
-                self._cache = tf.shard_paged_cache(self._cache, self.cfg,
-                                                   self.mesh)
+                self._cache = self._shard_paged(self._cache)
             self._alloc = PageAllocator(self._alloc.num_pages, page)
             self._tables[:] = 0
             self._slot_pages.clear()
@@ -1111,6 +1144,16 @@ class InferenceEngine:
             self.metrics.scheduler_seconds_total.inc(
                 time.monotonic() - t2, phase="decode")
             worked = True
+        if self._pending_admits:
+            # Deferred admissions: resolve whatever the device finished
+            # while this step ran (the decode resolve above usually means
+            # earlier admit programs are done too).  When nothing else
+            # made progress, BLOCK on the oldest — a pending admission
+            # must never starve behind an empty queue.
+            t4 = time.monotonic()
+            worked = self._drain_ready_admits(force_one=not worked) or worked
+            self.metrics.scheduler_seconds_total.inc(
+                time.monotonic() - t4, phase="admit")
         if not worked:
             # Idle: wait briefly for a request, then try admission again.
             try:
@@ -1149,9 +1192,12 @@ class InferenceEngine:
     def _admit(self) -> bool:
         """Admit waiting requests.  One-shot prompts are GROUPED by
         (prefill bucket, logprobs) and issued as fused batch dispatches —
-        all batches go out back-to-back (async), THEN first tokens are
-        fetched (issue-then-resolve; a blocking fetch between issues would
-        serialize every admission on the full device round-trip)."""
+        all batches go out back-to-back (async); first tokens are fetched
+        DEFERRED (self._pending_admits, resolved by step() as they become
+        ready) so the engine thread never blocks on an admit program's
+        device round-trip while decode work is available.  Spec engines
+        resolve inline (their eligibility logic assumes registered
+        slots)."""
         admitted = False
         groups: dict[tuple[int, bool], list] = {}
         recs = []
@@ -1186,8 +1232,18 @@ class InferenceEngine:
                     batch = items[:m]
                     del items[:m]
                     recs.append(self._issue_admit_batch(batch, want_lp))
-            while recs:
-                self._resolve_admit_batch(recs.pop(0))
+            if self._defer_admits:
+                # Hand the issued batches to the deferred queue; step()
+                # resolves them as their first tokens become ready, so the
+                # engine thread goes back to issuing decode dispatches
+                # instead of blocking here.  (Anything already computed
+                # resolves immediately — the no-load TTFT path.)
+                self._pending_admits.extend(recs)
+                recs = []
+                self._drain_ready_admits()
+            else:
+                while recs:
+                    self._resolve_admit_batch(recs.pop(0))
         except Exception:
             # A failing batch must not strand its SIBLINGS: un-issued items
             # and unresolved already-issued batches hold no registered slot
@@ -1210,6 +1266,36 @@ class InferenceEngine:
                         num_prompt_tokens=len(ids)))
             raise
         return admitted
+
+    def _drain_ready_admits(self, force_one: bool = False) -> bool:
+        """Resolve deferred admission batches whose first tokens are ready
+        (FIFO — emission order matches issue order).  ``force_one`` blocks
+        on the oldest batch even if unready: the idle path uses it so a
+        pending admission can never starve behind an empty queue.  Returns
+        True if anything resolved."""
+        did = False
+        while self._pending_admits:
+            rec = self._pending_admits[0]
+            if not (force_one and not did) and not rec[2].is_ready():
+                break
+            self._pending_admits.popleft()
+            self._resolve_admit_batch(rec)
+            did = True
+        return did
+
+    def _abort_pending_admits(self) -> None:
+        """Fail every deferred admission batch (fault/stop paths): their
+        requests hold popped slots but are registered nowhere, so no other
+        recovery can reach them."""
+        while self._pending_admits:
+            items, slots_l = self._pending_admits.popleft()[:2]
+            for (req, ids, _), slot in zip(items, slots_l):
+                if slot not in self._slots:
+                    self._release_slot_pages(slot)
+                    self._free.append(slot)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(ids)))
 
     def _preadmit(self, req: Request):
         """Admission front half: aborts, disagg-transferred KV, rejects,
@@ -1296,6 +1382,14 @@ class InferenceEngine:
                 keys.append(sampler_mod.np_prng_key(seed))
                 slot = self._free.pop()
                 slots_l.append(slot)
+                # Park the slot at the write-drop sentinel until its
+                # registration: with deferred resolution, decode dispatches
+                # can land between this admit program (which inserts the
+                # prompt KV) and _register_slot — a stale length here would
+                # let those dispatches overwrite the inserted rows.
+                self._lengths[slot] = (
+                    self._max_pages * self._page_size() if self._paged
+                    else self.ecfg.max_cache_len)
                 if self._paged:
                     n_alloc = -(-len(ids) // page)
                     pages_rows[i] = self._assign_slot_pages(slot, n_alloc)
@@ -1344,6 +1438,11 @@ class InferenceEngine:
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
             raise
+        # Only the slot-layout single-prompt prefix harvest reads ks/vs at
+        # resolve; everywhere else, keeping them in the record would pin
+        # the batch's full prompt KV in HBM for the deferral window.
+        if self._paged or self._prefix is None or m > 1:
+            ks = vs = None
         return (items, slots_l, first_ids, lp_out, ks, vs)
 
     def _resolve_admit_batch(self, rec) -> None:
@@ -1368,6 +1467,19 @@ class InferenceEngine:
                     finish_reason="abort", num_prompt_tokens=len(ids)))
             raise
         for i, ((req, ids, _), slot) in enumerate(zip(items, slots_l)):
+            # Aborts raised between issue and this (deferred) resolve:
+            # honor them here instead of registering a dead slot for one
+            # more dispatch cycle.
+            with self._abort_lock:
+                was_aborted = req.request_id in self._aborted
+                self._aborted.discard(req.request_id)
+            if was_aborted:
+                self._release_slot_pages(slot)
+                self._free.append(slot)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(ids)))
+                continue
             first_lp = None
             if lp_out is not None and req.params.logprobs is not None:
                 first_lp = self._lp_entry(clps[i], valss[i], lidss[i],
@@ -1383,8 +1495,10 @@ class InferenceEngine:
                                             self._slot_pages.get(slot, []))
             # Slot layout: harvest into the host prefix cache — but NOT
             # under admission pressure: the device->host KV copy (tens of
-            # MB per prompt) would starve waiting admissions.
+            # MB per prompt) would starve waiting admissions.  (ks is None
+            # whenever the issue path decided no harvest could apply.)
             elif (self._prefix is not None and self.dispatcher is None
+                    and ks is not None
                     and len(items) == 1 and self._queue.empty()):
                 nfull = len(ids) // self._chunk * self._chunk
                 if nfull and self._prefix.missing_blocks(ids, nfull):
@@ -1851,6 +1965,10 @@ class InferenceEngine:
         # set can't grow without bound.
         active = {st.request.request_id for st in self._slots.values()}
         active |= {st.request.request_id for st in self._prefilling.values()}
+        # Deferred admits are live too: purging their abort flags here
+        # would lose aborts raised between issue and registration.
+        active |= {req.request_id for rec in self._pending_admits
+                   for req, _, _ in rec[0]}
         with self._abort_lock:
             self._aborted -= consumed
             self._aborted &= active | self._queued_rids

@@ -157,14 +157,21 @@ def clear_slot_penalties(state: SamplingState,
         frequency=state.frequency.at[slot].set(0.0))
 
 
-def count_tokens(state: SamplingState, tokens: jnp.ndarray) -> SamplingState:
+def count_tokens(state: SamplingState, tokens: jnp.ndarray,
+                 active: jnp.ndarray | None = None) -> SamplingState:
     """Record one emitted token per slot (called on the tokens FED to a
     decode step — every generated token is fed exactly once, so feed-time
     counting covers the one-shot, chunked, and disagg admission paths
-    uniformly; free slots' garbage rows are reset at set_slot)."""
+    uniformly; free slots' garbage rows are reset at set_slot).
+
+    ``active`` (bool [B]) masks the update to live slots: with deferred
+    admissions a slot's set_slots (in the admit program) may precede
+    intervening decode dispatches, and counting its garbage feed rows
+    there would poison the new request's penalties."""
     b = tokens.shape[0]
+    inc = 1 if active is None else active.astype(jnp.int32)
     return state._replace(
-        counts=state.counts.at[jnp.arange(b), tokens].add(1))
+        counts=state.counts.at[jnp.arange(b), tokens].add(inc))
 
 
 def penalized(logits: jnp.ndarray, state: SamplingState) -> jnp.ndarray:
@@ -214,12 +221,19 @@ def filtered_probs(logits: jnp.ndarray, state: SamplingState
     return jax.nn.softmax(scaled, axis=-1), idx, scaled
 
 
-def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, SamplingState]:
+def sample(logits: jnp.ndarray, state: SamplingState,
+           active: jnp.ndarray | None = None
+           ) -> tuple[jnp.ndarray, SamplingState]:
     """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
 
     Greedy where temperature <= 0; otherwise temperature + top-k + top-p over
     the TOP_K_MAX highest-logit candidates.  Presence/frequency penalties
     apply BEFORE greedy/filtering (identity at the 0 defaults).
+
+    ``active`` (bool [B]) freezes INACTIVE slots' PRNG keys: with deferred
+    admissions, decode dispatches can land between a slot's set_slots (in
+    the admit program) and its registration — advancing its fresh key
+    stream there would make seeded sampling depend on scheduler timing.
     """
     logits = penalized(logits, state)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -231,6 +245,8 @@ def sample(logits: jnp.ndarray, state: SamplingState) -> tuple[jnp.ndarray, Samp
     sampled_ids = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
     ids = jnp.where(state.temperature <= 0.0, greedy_ids, sampled_ids)
+    if active is not None:
+        carry_keys = jnp.where(active[:, None], carry_keys, state.key)
     return ids, state._replace(key=carry_keys)
 
 

@@ -196,6 +196,59 @@ def _scrape(port: int, names: tuple[str, ...]) -> dict[str, float]:
     return out
 
 
+def _run_moderate_phase(port: int, slots: int, seconds: float,
+                        max_tokens: int, prompt_len: int, probe_len: int,
+                        n_chips: int, names: tuple[str, ...]) -> dict:
+    """Second load phase at clients ~= slots/4: the north star's
+    "p50 TTFT < 200ms under RPM load" is a moderate-load contract — the
+    saturation phase answers a different question (TTFT at 100% slot
+    occupancy).  The measurement window starts AFTER a ramp sleep so
+    tokens draining phase 1's saturated queue are not attributed to the
+    moderate load."""
+    import numpy as np
+
+    ramp = 5.0
+    mclients = max(slots // 4, 1)
+    mtotal = ramp + seconds + 5
+    print(f"# moderate phase: {mclients} clients", file=sys.stderr,
+          flush=True)
+    mproc = subprocess.Popen(
+        [sys.executable, "-S", os.path.abspath(__file__), "--client",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--clients", str(mclients), "--seconds", str(mtotal),
+         "--max-tokens", str(max_tokens),
+         "--prompt-len", str(prompt_len),
+         "--probe-prompt-len", str(probe_len)],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        time.sleep(ramp)
+        m0 = _scrape(port, names)
+        tm0 = time.monotonic()
+        time.sleep(seconds)
+        m1 = _scrape(port, names)
+        tm1 = time.monotonic()
+        mout, _ = mproc.communicate(timeout=mtotal + 600)
+    finally:
+        if mproc.poll() is None:
+            mproc.kill()
+    mclient = json.loads(mout.strip().splitlines()[-1])
+    # TTFT probes from the ramp window are dropped for the same reason
+    # the token window starts after it.
+    mttfts = [v for ts, v in mclient["ttfts"] if ts >= ramp]
+    return {
+        "serving_moderate_clients": mclients,
+        "serving_moderate_tok_s_chip": round(
+            (m1.get("generation_tokens_total", 0.0)
+             - m0.get("generation_tokens_total", 0.0))
+            / (tm1 - tm0) / n_chips, 1),
+        "serving_moderate_ttft_p50_ms": round(
+            float(np.percentile(mttfts, 50)) * 1e3, 1) if mttfts else None,
+        "serving_moderate_ttft_p99_ms": round(
+            float(np.percentile(mttfts, 99)) * 1e3, 1) if mttfts else None,
+        "serving_moderate_ttft_samples": len(mttfts),
+    }
+
+
 def run_serving_bench(model: str | None = None) -> dict:
     """Build the production engine+server, run the load, return results.
     Importable so bench.py can fold the numbers into its JSON line."""
@@ -288,6 +341,7 @@ def run_serving_bench(model: str | None = None) -> dict:
     names = ("generation_tokens_total", "scheduler_seconds_total",
              "prefix_cache_hit_tokens_total",
              "decode_resolve_wait_seconds_total")
+    moderate = None
     try:
         t_launch = time.monotonic()
         print("# client launched; warming up", file=sys.stderr, flush=True)
@@ -298,6 +352,22 @@ def run_serving_bench(model: str | None = None) -> dict:
         s1 = _scrape(server.port, names)
         t1 = time.monotonic()
         out, _ = proc.communicate(timeout=total_s + 600)
+        # Second phase: MODERATE load (clients ~= slots/4).  The north
+        # star's "p50 TTFT < 200ms under RPM load" is a moderate-load
+        # contract — the saturation probe above answers a different
+        # question (TTFT at 100% slot occupancy).  Skippable for quick
+        # runs (ARKS_BENCH_SERVE_MODERATE=0).
+        if os.environ.get("ARKS_BENCH_SERVE_MODERATE", "1") != "0":
+            # Failure-isolated: a dead moderate phase must not discard the
+            # saturation numbers already measured above.
+            try:
+                moderate = _run_moderate_phase(
+                    server.port, slots, seconds, max_tokens, prompt_len,
+                    probe_len, n_chips, names)
+            except Exception as e:
+                import traceback
+                traceback.print_exc()
+                moderate = {"serving_moderate_error": f"{type(e).__name__}: {e}"}
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -347,6 +417,7 @@ def run_serving_bench(model: str | None = None) -> dict:
         "serving_ttft_samples": len(ttfts),
         "serving_phase_fractions": phases,
         "serving_device_wait_fraction": device_wait,
+        **(moderate or {}),
     }
 
 

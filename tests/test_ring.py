@@ -193,10 +193,13 @@ def test_cp_extends_one_shot_window_for_long_prompts():
     req = Request("long", prompt, SamplingParams(max_tokens=3, temperature=0.0,
                                                  ignore_eos=True))
     eng.add_request(req)
-    eng.step(block_s=0.01)
-    # One-shot admission: never chunk-queued (and with max_tokens=3 < K the
-    # whole request already finished inside this first step).
-    assert not eng._prefilling
+    # One-shot admission: never chunk-queued at any point (admission may
+    # resolve deferred, so drive steps until the request completes).
+    for _ in range(100):
+        eng.step(block_s=0.01)
+        assert not eng._prefilling
+        if eng.num_running == 0 and eng._queue.empty():
+            break
     ids = []
     while True:
         out = req.outputs.get(timeout=60)
