@@ -454,7 +454,7 @@ class InferenceEngine:
             # Free slots park at the coverage sentinel: their garbage
             # dispatch rows are dropped by the kernels instead of landing
             # in (possibly shared) pages.
-            self._lengths[:] = max_pages * page
+            self._lengths[:] = self._park_sentinel()
             log.info("paged KV: %d pages x %d tokens (%d retention extra)",
                      num_pages, page, extra)
         else:
@@ -532,6 +532,10 @@ class InferenceEngine:
         # eligibility logic assumes registered slots).
         from collections import deque
         self._pending_admits: "deque" = deque()
+        # Request count across the deque, maintained by the engine thread
+        # at every mutation: num_running reads it cross-thread (iterating
+        # the deque there would race popleft/extend).
+        self._pending_n = 0
         self._defer_admits = engine_cfg.draft_model is None
         # Decode/admission overlap: issue the decode dispatch async and do
         # admission host work while the device computes.  Pays off where
@@ -747,8 +751,7 @@ class InferenceEngine:
         # penalty counts only advance for REGISTERED slots (deferred
         # admissions put decode dispatches between a slot's admit program
         # and its registration — see _drain_ready_admits).
-        sentinel = (self._max_pages * self._page_size() if self._paged
-                    else self.ecfg.max_cache_len)
+        sentinel = self._park_sentinel()
 
         def decode_loop(params, cache, tokens, lengths, sstate, tables):
             def body(carry, _):
@@ -895,8 +898,7 @@ class InferenceEngine:
         # Deferred admit batches hold slots too — external drivers poll
         # this to detect completion, and a pending admission is running
         # work in every sense that matters to them.
-        return len(self._slots) + sum(len(rec[0])
-                                      for rec in self._pending_admits)
+        return len(self._slots) + self._pending_n
 
     @property
     def idle(self) -> bool:
@@ -927,6 +929,14 @@ class InferenceEngine:
                 and default_decode_impl() == "pallas"
                 and self.cfg.head_dim % 128 != 0
                 and self._pp == 1)
+
+    def _park_sentinel(self) -> int:
+        """Write-drop length for parked (free/pending) slots: cache ops
+        drop KV writes at/beyond it, and the fused decode loop's active
+        mask freezes PRNG keys + penalty counts there.  ONE definition —
+        the mask is only correct while every parking site agrees."""
+        return (self._max_pages * self._page_size() if self._paged
+                else self.ecfg.max_cache_len)
 
     def _page_size(self) -> int:
         """Page size = chunk size (a reused prefix then ends exactly where
@@ -1090,8 +1100,9 @@ class InferenceEngine:
             if self.mesh is not None:
                 self._draft_cache = tf.shard_cache(
                     self._draft_cache, self._draft_cfg, self.mesh)
-        self._lengths[:] = (self._max_pages * self._page_size()
-                            if self._paged else 0)
+        # Paged: park every slot at the sentinel.  Slot layout: empty
+        # slots start at 0 (their pre-insert garbage rows are private).
+        self._lengths[:] = self._park_sentinel() if self._paged else 0
         self._last_token[:] = 0
         # A fault between _free.pop() and slot registration would otherwise
         # leak the slot index permanently.
@@ -1238,6 +1249,7 @@ class InferenceEngine:
                 # engine thread goes back to issuing decode dispatches
                 # instead of blocking here.  (Anything already computed
                 # resolves immediately — the no-load TTFT path.)
+                self._pending_n += sum(len(r[0]) for r in recs)
                 self._pending_admits.extend(recs)
                 recs = []
                 self._drain_ready_admits()
@@ -1279,6 +1291,7 @@ class InferenceEngine:
             if not (force_one and not did) and not rec[2].is_ready():
                 break
             self._pending_admits.popleft()
+            self._pending_n -= len(rec[0])
             self._resolve_admit_batch(rec)
             did = True
         return did
@@ -1289,6 +1302,7 @@ class InferenceEngine:
         recovery can reach them."""
         while self._pending_admits:
             items, slots_l = self._pending_admits.popleft()[:2]
+            self._pending_n -= len(items)
             for (req, ids, _), slot in zip(items, slots_l):
                 if slot not in self._slots:
                     self._release_slot_pages(slot)
@@ -1387,9 +1401,7 @@ class InferenceEngine:
                 # can land between this admit program (which inserts the
                 # prompt KV) and _register_slot — a stale length here would
                 # let those dispatches overwrite the inserted rows.
-                self._lengths[slot] = (
-                    self._max_pages * self._page_size() if self._paged
-                    else self.ecfg.max_cache_len)
+                self._lengths[slot] = self._park_sentinel()
                 if self._paged:
                     n_alloc = -(-len(ids) // page)
                     pages_rows[i] = self._assign_slot_pages(slot, n_alloc)
@@ -1476,6 +1488,13 @@ class InferenceEngine:
             if was_aborted:
                 self._release_slot_pages(slot)
                 self._free.append(slot)
+                p = req.params
+                if p.presence_penalty or p.frequency_penalty:
+                    # Re-arm penalized()'s fast path (same as _finish): the
+                    # admit program already wrote this slot's penalty row.
+                    self._emit("clear_penalties", slot=slot)
+                    self._sampling = self._clear_pen_fn(
+                        self._sampling, jnp.asarray(slot, jnp.int32))
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
@@ -2217,7 +2236,7 @@ class InferenceEngine:
         pages = self._slot_pages.pop(slot, [])
         if pages:
             self._alloc.decref(pages)
-        self._lengths[slot] = self._max_pages * self._page_size()
+        self._lengths[slot] = self._park_sentinel()
 
     def _finish(self, slot: int, reason: str) -> None:
         st = self._slots.pop(slot)

@@ -108,6 +108,61 @@ def test_sampled_request_valid(engine):
     assert ids2 == ids
 
 
+def test_seeded_sampling_independent_of_scheduler_timing(monkeypatch):
+    """A seeded sampled request produces the same tokens whether it is
+    admitted alone or while another request is mid-decode with its
+    admission resolution DELAYED.  With deferred resolution, decode
+    dispatches land between a slot's admit program (which seeds its PRNG
+    key) and its registration — the fused loop's active mask must freeze
+    pending/free slots' keys or the stream would depend on scheduler
+    timing.  (CPU resolves admissions near-instantly, so the deferral
+    window is forced by holding back the drain for a few steps — the
+    shape a slow tunneled device produces naturally.)"""
+    from arks_tpu.engine.engine import InferenceEngine as IE
+    cfg = get_config("tiny")
+
+    orig_drain = IE._drain_ready_admits
+
+    def run(with_load, delay_steps):
+        calls = {"n": 0}
+
+        def delayed(self, force_one=False):
+            # Pretend the admit program is still in flight for a few
+            # scheduler steps; decode dispatches keep flowing meanwhile.
+            calls["n"] += 1
+            if calls["n"] <= delay_steps and self._slots:
+                return False
+            return orig_drain(self, force_one=force_one)
+
+        monkeypatch.setattr(IE, "_drain_ready_admits", delayed)
+        ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                            prefill_buckets=(8, 16, 32),
+                            steps_per_dispatch=4)
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+        eng.start()
+        try:
+            if with_load:
+                # A long-running greedy request keeps decode dispatches
+                # flowing while the sampled request's admission pends.
+                load = Request("load", [9, 9, 9], SamplingParams(
+                    max_tokens=40, temperature=0.0, ignore_eos=True))
+                eng.add_request(load)
+                load.outputs.get(timeout=60)  # wait until it is decoding
+                calls["n"] = 0  # arm the delay for the sampled admission
+            req = Request("s", [1, 2, 3], SamplingParams(
+                max_tokens=6, temperature=0.8, top_p=0.9, top_k=40,
+                seed=42, ignore_eos=True))
+            eng.add_request(req)
+            ids, _ = _collect(req)
+            if with_load:
+                _collect(load)
+            return ids
+        finally:
+            eng.stop()
+
+    assert run(True, delay_steps=6) == run(False, delay_steps=0)
+
+
 def test_long_prompt_chunked_prefill(engine):
     # 57 tokens exceeds the largest one-shot bucket (32) but fits the cache
     # (64 - 4 - 1 = 59 usable): served via chunked prefill.

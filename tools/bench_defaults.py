@@ -141,14 +141,30 @@ def bench_moe_kernel(trials: int = 5) -> None:
     T = int(os.environ.get("MB_TOKENS", "256" if smoke else "4096"))
     if smoke:
         trials = 1
+    t_start = time.perf_counter()
+
+    def stage(msg: str) -> None:
+        # Stage evidence on stderr: a tunnel that dies mid-run leaves a
+        # trail of WHERE instead of a bare timeout.
+        print(f"# moe: {msg} at {time.perf_counter() - t_start:.0f}s",
+              file=sys.stderr, flush=True)
+
     key = jax.random.PRNGKey(1)
     ks = jax.random.split(key, 6)
     scale = 0.02
-    x = jax.random.normal(ks[0], (T, E), jnp.bfloat16) * scale
-    router = jax.random.normal(ks[1], (E, X), jnp.bfloat16) * scale
-    w_gate = jax.random.normal(ks[2], (X, E, I), jnp.bfloat16) * scale
-    w_up = jax.random.normal(ks[3], (X, E, I), jnp.bfloat16) * scale
-    w_down = jax.random.normal(ks[4], (X, I, E), jnp.bfloat16) * scale
+    # One jitted program materializes all ~2.8GB of weights: eager op-by-op
+    # generation makes many round trips on a tunneled device.
+    @jax.jit
+    def init(ks):
+        return (jax.random.normal(ks[0], (T, E), jnp.bfloat16) * scale,
+                jax.random.normal(ks[1], (E, X), jnp.bfloat16) * scale,
+                jax.random.normal(ks[2], (X, E, I), jnp.bfloat16) * scale,
+                jax.random.normal(ks[3], (X, E, I), jnp.bfloat16) * scale,
+                jax.random.normal(ks[4], (X, I, E), jnp.bfloat16) * scale)
+
+    x, router, w_gate, w_up, w_down = init(ks)
+    jax.block_until_ready(w_down)
+    stage("weights ready")
 
     # Weights are jit ARGUMENTS, not closure captures: captured they bake
     # ~2.8GB of constants into the HLO, which the tunneled compile path
@@ -178,6 +194,7 @@ def bench_moe_kernel(trials: int = 5) -> None:
         jf = jax.jit(fn)
         res[f"{name}_s"] = round(
             _best(lambda: jf(x, router, w_gate, w_up, w_down), trials), 4)
+        stage(f"{name} measured")
     res.update({
         "metric": f"moe_grouped_ffn_mixtral8x7b_T{T}_bf16",
         "unit": "s per grouped FFN",
