@@ -99,9 +99,12 @@ class EngineConfig:
     # auto = int8 on real TPU (the production default bench.py measures),
     # engine dtype elsewhere (CPU tests stay full-width).
     kv_cache_dtype: str = "auto"
-    # "bf16"|"int8": int8 = weight-only quantization (w8a16, per-output-
-    # channel scales, dequant fused into the matmuls — models.quant). How
-    # 7B-class models fit a 16GB v5e chip; also halves decode weight reads.
+    # "bf16"|"int8"|"int4": weight-only quantization (models.quant).
+    # int8 = w8a16 (per-output-channel scales, dequant fused into the
+    # matmuls) — how 7B-class models fit a 16GB v5e chip, and it halves
+    # decode weight reads.  int4 = w4a16 (per-128-row-group scales,
+    # embedding stays int8) — halves weight bytes again: 13B-class
+    # single-chip, or the freed HBM becomes KV pages.
     weight_dtype: str = "bf16"
     # "auto"|"slot"|"paged": device KV layout.  "paged" = block-table pool
     # (ops.paged_attention) with zero-copy on-device prefix sharing —
@@ -362,21 +365,24 @@ class InferenceEngine:
             self._buckets = kept
         dtype = jnp.dtype(engine_cfg.dtype or cfg.dtype)
 
-        if engine_cfg.weight_dtype not in ("bf16", "int8"):
-            raise ValueError(f"weight_dtype={engine_cfg.weight_dtype!r}")
+        from arks_tpu.models.quant import weight_bits
+        wbits = weight_bits(engine_cfg.weight_dtype)
+        tp_shards = mesh.shape.get(tf.AXIS_MODEL, 1) if mesh is not None else 1
         if params is None:
-            if engine_cfg.weight_dtype == "int8":
+            if wbits:
                 # Direct quantized init: a full-width init of an HBM-limited
                 # model would OOM before quantization could shrink it.
                 from arks_tpu.models import quant
                 params = quant.init_params_quantized(
-                    cfg, jax.random.PRNGKey(engine_cfg.seed), dtype)
+                    cfg, jax.random.PRNGKey(engine_cfg.seed), dtype,
+                    bits=wbits, shards=tp_shards)
             else:
                 params = tf.init_params(cfg, jax.random.PRNGKey(engine_cfg.seed), dtype)
-        elif engine_cfg.weight_dtype == "int8":
+        elif wbits:
             from arks_tpu.models import quant
             if not quant.is_quantized(params["layers"].get("wq")):
-                params = quant.quantize_params(params)
+                params = quant.quantize_params(params, bits=wbits,
+                                               shards=tp_shards)
         if mesh is not None:
             if self._pp > 1:
                 from arks_tpu.parallel.pipeline import shard_params_pp

@@ -136,7 +136,10 @@ def moe_ffn_grouped(x: jnp.ndarray, mp: Params, cfg) -> jnp.ndarray:
     group_sizes = jnp.bincount(flat_expert, length=nx)
 
     from arks_tpu.ops.moe_kernel import grouped_ffn, moe_impl
-    if moe_impl() == "pallas":
+    int4 = isinstance(mp["w_gate"], dict) and "gs" in mp["w_gate"]
+    if moe_impl() == "pallas" and not int4:
+        # (int4 experts take the ragged path below: the kernel's fused
+        # dequant understands per-channel int8 scales, not group scales.)
         # Block-sparse Pallas grouped matmul: int8 expert dequant stays
         # FUSED (per-channel scales on the accumulator) instead of
         # materializing full-width weights for ragged_dot.

@@ -1,30 +1,53 @@
-"""Weight-only int8 quantization (w8a16) for serving.
+"""Weight-only quantization for serving: int8 (w8a16) and int4 (w4a16).
 
 Why: a 7B-class model in bf16 (~15 GB) does not fit a single v5e chip's
 16 GB HBM next to its KV cache — and decode is HBM-bandwidth-bound, so
-halving the bytes read per step is also the single biggest decode-throughput
-lever.  Weights are stored int8 with per-output-channel float scales;
-activations stay bf16.  The dequant is expressed as ``int8 -> bf16 convert
-feeding the einsum`` plus a per-channel scale on the OUTPUT, so XLA fuses
-the convert into the matmul's operand read and the full-width weight never
-materializes in HBM.  MXU FLOPs are unchanged (bf16); only weight bytes
-halve.
+shrinking the bytes read per step is also the single biggest
+decode-throughput lever.  Activations stay bf16 in both modes; MXU FLOPs
+are unchanged.
+
+- **int8**: per-output-channel float scales.  The dequant is expressed as
+  ``int8 -> bf16 convert feeding the einsum`` plus a per-channel scale on
+  the OUTPUT (valid because the scale is constant along the contraction
+  dim), so XLA fuses the convert into the matmul's operand read and the
+  full-width weight never materializes in HBM.
+- **int4**: per-(128-row group x output channel) scales — per-channel
+  int4 loses too much fidelity, groupwise is the standard recipe (GPTQ/
+  AWQ-style).  Scales vary ALONG the contraction dim, so the dequant is
+  an elementwise producer of the dot's weight operand (int4 -> bf16
+  convert * broadcast group scale); XLA fuses elementwise producers into
+  the dot read, so HBM still sees ~K*N/2 bytes + K/128*N scale bytes.
+  The embedding table stays int8 in int4 mode (row-gathered, small, and
+  quality-critical).
 
 The reference has no quantization of its own (it forwards dtype flags to
 vLLM/SGLang via runtimeCommonArgs, /root/reference/api/v1/
 arksapplication_types.go:292); this module is the TPU-native counterpart.
 
-A quantized leaf is a dict ``{"q": int8 array, "s": float32 scale}`` —
-pytree-compatible, so sharding/tree-mapping compose without special cases.
-Scale layout: matmul weights [.., K, N] carry s = [.., 1, N] (per output
-channel); the embedding table [V, E] carries s = [V, 1] (per row — the same
-orientation serves both the lookup and the tied unembed).
+A quantized leaf is a pytree-compatible dict: int8 = ``{"q": int8,
+"s": f32}`` with s = [.., 1, N] for matmul weights [.., K, N] (the
+embedding [V, E] carries s = [V, 1]); int4 = ``{"q": int4 [.., K, N],
+"gs": f32 [.., K/G, N]}``.
 """
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+
+INT4_GROUP = 128
+
+
+def _int4_group(group: int | None) -> int:
+    """Resolve the int4 group size: explicit arg > ARKS_INT4_GROUP env >
+    128.  Sharded deployments need the group to divide each shard of the
+    contraction dim (e.g. q_dim 3584 at tp=8 -> local K 448 -> group 64);
+    the env knob avoids replumbing every load path for that case."""
+    if group is not None:
+        return group
+    return int(os.environ.get("ARKS_INT4_GROUP", str(INT4_GROUP)))
 
 # Weights quantized per-output-channel along reduction dim -2 ([.., K, N]).
 MATMUL_KEYS = frozenset({
@@ -39,8 +62,17 @@ SKIP_KEYS = frozenset({
 })
 
 
+def weight_bits(weight_dtype: str) -> int:
+    """'bf16' -> 0 (no quantization), 'int8' -> 8, 'int4' -> 4 — the ONE
+    mapping every weight_dtype consumer shares."""
+    try:
+        return {"bf16": 0, "int8": 8, "int4": 4}[weight_dtype]
+    except KeyError:
+        raise ValueError(f"weight_dtype={weight_dtype!r}") from None
+
+
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and "q" in w and ("s" in w or "gs" in w)
 
 
 def quantize_tensor(w: jnp.ndarray, axis: int = -2) -> dict:
@@ -51,15 +83,54 @@ def quantize_tensor(w: jnp.ndarray, axis: int = -2) -> dict:
     return {"q": q, "s": s}
 
 
+def quantize_tensor_int4(w: jnp.ndarray, group: int | None = None,
+                         shards: int = 1) -> dict:
+    """Symmetric int4 quantization of a matmul weight [.., K, N] with one
+    scale per (``group`` reduction rows x output channel).
+
+    ``shards``: the mesh's model-axis size.  A row-parallel leaf shards
+    its contraction dim K, and group scales shard with it, so the group
+    must divide K/shards (whole groups per shard).  The group clamps down
+    to the largest divisor that fits — also covers small test-sized
+    weights (group <= K).
+    """
+    w32 = w.astype(jnp.float32)
+    k = w32.shape[-2]
+    local = max(k // max(shards, 1), 1)
+    group = min(_int4_group(group), local)
+    while local % group:
+        group -= 1
+    if k % group:
+        raise ValueError(
+            f"int4 reduction dim {k} not a multiple of group {group}")
+    grp = w32.reshape(*w32.shape[:-2], k // group, group, w32.shape[-1])
+    amax = jnp.max(jnp.abs(grp), axis=-2, keepdims=True)  # [.., K/G, 1, N]
+    s = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(grp / s), -7, 7).astype(jnp.int4)
+    return {"q": q.reshape(w32.shape), "gs": jnp.squeeze(s, -2)}
+
+
+def _dequant_int4(w, dtype: jnp.dtype) -> jnp.ndarray:
+    q, gs = w["q"], w["gs"]
+    ngroups = gs.shape[-2]
+    g = q.shape[-2] // ngroups
+    grp = q.astype(dtype).reshape(*q.shape[:-2], ngroups, g, q.shape[-1])
+    return (grp * gs[..., :, None, :].astype(dtype)).reshape(q.shape)
+
+
 def qeinsum(eq: str, x: jnp.ndarray, w) -> jnp.ndarray:
     """``jnp.einsum`` where ``w`` may be a quantized leaf.
 
-    The convert int8->x.dtype fuses into the dot's operand read; the
+    int8: the convert int8->x.dtype fuses into the dot's operand read; the
     per-output-channel scale applies to the OUTPUT (valid because the scale
     is constant along the contraction dim), broadcasting over trailing dims.
+    int4: groupwise scales vary along the contraction dim, so the dequant
+    is an elementwise producer of the weight operand (fused by XLA).
     """
     if not is_quantized(w):
         return jnp.einsum(eq, x, w)
+    if "gs" in w:
+        return jnp.einsum(eq, x, _dequant_int4(w, x.dtype))
     y = jnp.einsum(eq, x, w["q"].astype(x.dtype))
     return y * jnp.squeeze(w["s"], axis=-2).astype(y.dtype)
 
@@ -69,6 +140,8 @@ def dequantize(w, dtype: jnp.dtype) -> jnp.ndarray:
     everywhere else use qeinsum so the dequant stays fused)."""
     if not is_quantized(w):
         return w
+    if "gs" in w:
+        return _dequant_int4(w, dtype)
     return (w["q"].astype(dtype) * w["s"].astype(dtype))
 
 
@@ -87,6 +160,9 @@ def unembed_logits(h: jnp.ndarray, table, tied: bool) -> jnp.ndarray:
     if not is_quantized(table):
         t = table.T if tied else table
         return jnp.einsum("be,ev->bv", h, t).astype(jnp.float32)
+    if "gs" in table:  # int4 lm_head [E, V] (the embedding stays int8)
+        return jnp.einsum("be,ev->bv", h,
+                          _dequant_int4(table, h.dtype)).astype(jnp.float32)
     if tied:  # table [V, E], s [V, 1]
         logits = jnp.einsum("be,ve->bv", h, table["q"].astype(h.dtype))
         return logits.astype(jnp.float32) * jnp.squeeze(table["s"], -1)
@@ -95,24 +171,31 @@ def unembed_logits(h: jnp.ndarray, table, tied: bool) -> jnp.ndarray:
     return logits.astype(jnp.float32) * jnp.squeeze(table["s"], -2)
 
 
-def quantize_params(params: dict) -> dict:
+def quantize_params(params: dict, bits: int = 8,
+                    group: int | None = None, shards: int = 1) -> dict:
     """Quantize an already-materialized transformer Params tree.
 
     NOTE: the caller's full-width tree stays alive while this runs, so peak
-    device memory is full tree + int8 tree.  Fine for small models and
+    device memory is full tree + quantized tree.  Fine for small models and
     trees already sharded across a mesh; for HBM-limited single-chip loads
     use the bounded-peak paths instead — init_params_quantized (random
-    init) or weights.params_from_hf(weight_dtype='int8') (checkpoints),
-    both of which quantize leaf-by-leaf as leaves are created.
+    init) or weights.params_from_hf(weight_dtype='int8'|'int4')
+    (checkpoints), both of which quantize leaf-by-leaf as leaves are
+    created.  ``bits=4`` stores matmul weights int4 groupwise; the
+    embedding stays int8 either way.
     """
+    if bits not in (4, 8):
+        raise ValueError(f"bits={bits}")
     out: dict = {}
     for name, leaf in params.items():
         if isinstance(leaf, dict):
-            out[name] = quantize_params(leaf)
+            out[name] = quantize_params(leaf, bits, group, shards)
         elif name == "embed":
             out[name] = quantize_tensor(leaf, axis=-1)
         elif name in MATMUL_KEYS:
-            out[name] = quantize_tensor(leaf, axis=-2)
+            out[name] = (quantize_tensor_int4(leaf, group, shards)
+                         if bits == 4
+                         else quantize_tensor(leaf, axis=-2))
         else:
             assert name in SKIP_KEYS, (
                 f"param leaf {name!r} is in neither MATMUL_KEYS nor "
@@ -122,20 +205,24 @@ def quantize_params(params: dict) -> dict:
     return out
 
 
-def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> dict:
+def init_params_quantized(cfg, key, dtype=jnp.bfloat16, bits: int = 8,
+                          shards: int = 1) -> dict:
     """Random-init a transformer Params tree directly in quantized form.
 
     Mirrors transformer.init_params' distributions (normal*0.02 weights,
     ones norms, zeros biases) but generates + quantizes each leaf inside its
-    own jit, so peak device memory is the int8 tree plus ONE full-width leaf
-    — a bf16 init of a 7B model (~15 GB) would not even fit the chip that
-    the quantized model is for.  Used by bench.py and anywhere random
-    weights of an HBM-limited model are needed.
+    own jit, so peak device memory is the quantized tree plus ONE
+    full-width leaf — a bf16 init of a 7B model (~15 GB) would not even fit
+    the chip that the quantized model is for.  Used by bench.py and
+    anywhere random weights of an HBM-limited model are needed.
+    ``bits=4`` = w4a16 (matmul weights int4 groupwise, embedding int8).
     """
     import functools
 
     from arks_tpu.models import transformer as tf
 
+    if bits not in (4, 8):
+        raise ValueError(f"bits={bits}")
     shapes = jax.eval_shape(
         functools.partial(tf.init_params, cfg, dtype=dtype), key)
 
@@ -147,6 +234,8 @@ def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> dict:
             return jnp.zeros(shape, dtype)
         w = jax.random.normal(k, shape, jnp.float32) * 0.02
         if kind == "quant":
+            if bits == 4 and axis == -2:  # matmul weights; embed stays int8
+                return quantize_tensor_int4(w.astype(dtype), shards=shards)
             return quantize_tensor(w.astype(dtype), axis=axis)
         return w.astype(dtype)
 
@@ -176,19 +265,25 @@ def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> dict:
     return build(shapes)
 
 
-def quantize_pspecs(specs: dict) -> dict:
+def quantize_pspecs(specs: dict, bits: int = 8) -> dict:
     """PartitionSpec tree matching quantize_params' output structure: the
-    int8 payload keeps the original spec; the scale keeps the spec with the
-    reduced dim's axis dropped (scales are [.., 1, N] there)."""
+    quantized payload keeps the original spec.  int8 scales keep the spec
+    with the reduced dim's axis dropped (scales are [.., 1, N] there);
+    int4 group scales [.., K/G, N] keep the FULL spec — the group dim
+    shards exactly like the contraction dim it tiles (whole groups per
+    shard, since shard sizes are multiples of the group)."""
     from jax.sharding import PartitionSpec as P
 
     out: dict = {}
     for name, leaf in specs.items():
         if isinstance(leaf, dict):
-            out[name] = quantize_pspecs(leaf)
+            out[name] = quantize_pspecs(leaf, bits)
         elif name == "embed":
             out[name] = {"q": leaf, "s": P(leaf[0], None)}
         elif name in MATMUL_KEYS:
+            if bits == 4:
+                out[name] = {"q": leaf, "gs": leaf}
+                continue
             # All matmul specs are full-rank (param_pspecs/moe_pspecs emit
             # one entry per dim), so the scale spec is the weight spec with
             # the contraction dim (always -2) replicated.

@@ -277,8 +277,9 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     tp = mesh.shape.get(AXIS_MODEL, 1)
     specs = param_pspecs(cfg, tp)
     from arks_tpu.models.quant import is_quantized, quantize_pspecs
-    if is_quantized(params["layers"].get("wq")):
-        specs = quantize_pspecs(specs)
+    wq = params["layers"].get("wq")
+    if is_quantized(wq):
+        specs = quantize_pspecs(specs, bits=4 if "gs" in wq else 8)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
 

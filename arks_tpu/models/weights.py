@@ -24,6 +24,7 @@ import numpy as np
 
 from arks_tpu.models.config import ModelConfig
 from arks_tpu.models import transformer as tf
+from arks_tpu.models.quant import weight_bits as _weight_bits
 
 log = logging.getLogger("arks_tpu.weights")
 
@@ -50,7 +51,7 @@ def _hf_tensors(path: str) -> dict[str, np.ndarray]:
 
 
 def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None,
-                   weight_dtype: str = "bf16") -> tf.Params:
+                   weight_dtype: str = "bf16", shards: int = 1) -> tf.Params:
     """Convert a HuggingFace Qwen2/Llama checkpoint directory to arks params.
 
     Leaves are assembled on the HOST (numpy) and moved to device one at a
@@ -98,25 +99,33 @@ def params_from_hf(cfg: ModelConfig, path: str, dtype: Any = None,
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = get("lm_head.weight", True)
-    return _leaves_to_device(params, quantize=weight_dtype == "int8")
+    return _leaves_to_device(params, _weight_bits(weight_dtype),
+                             shards=shards)
 
 
-def _quantize_leaf(leaf, axis: int):
+def _quantize_leaf(leaf, axis: int, bits: int = 8, shards: int = 1):
     import functools
 
-    from arks_tpu.models.quant import quantize_tensor
+    from arks_tpu.models.quant import quantize_tensor, quantize_tensor_int4
 
     x = jnp.asarray(leaf)
-    # donate: the full-width device copy is freed as soon as the int8+scale
+    # donate: the full-width device copy is freed as soon as the quantized
     # outputs exist, bounding the transient to one leaf.
-    fn = jax.jit(functools.partial(quantize_tensor, axis=axis),
-                 donate_argnums=(0,))
+    if bits == 4 and axis == -2:  # matmul weights; the embedding stays int8
+        fn = jax.jit(functools.partial(quantize_tensor_int4, shards=shards),
+                     donate_argnums=(0,))
+    else:
+        fn = jax.jit(functools.partial(quantize_tensor, axis=axis),
+                     donate_argnums=(0,))
     return fn(x)
 
 
-def _leaves_to_device(host_params: dict, quantize: bool) -> tf.Params:
+def _leaves_to_device(host_params: dict, bits: int,
+                      shards: int = 1) -> tf.Params:
     """Move a host-side (numpy) params tree to device leaf-by-leaf,
-    quantizing matmul leaves on arrival when requested."""
+    quantizing matmul leaves on arrival when requested (``bits`` =
+    0 = no quantization | 8 | 4).  ``shards`` = mesh model-axis size
+    (int4 groups align to shards)."""
     from arks_tpu.models.quant import MATMUL_KEYS
 
     def walk(sub: dict) -> dict:
@@ -124,10 +133,10 @@ def _leaves_to_device(host_params: dict, quantize: bool) -> tf.Params:
         for name, leaf in sub.items():
             if isinstance(leaf, dict):
                 out[name] = walk(leaf)
-            elif quantize and name == "embed":
-                out[name] = _quantize_leaf(leaf, -1)
-            elif quantize and name in MATMUL_KEYS:
-                out[name] = _quantize_leaf(leaf, -2)
+            elif bits and name == "embed":
+                out[name] = _quantize_leaf(leaf, -1, bits)
+            elif bits and name in MATMUL_KEYS:
+                out[name] = _quantize_leaf(leaf, -2, bits, shards)
             else:
                 out[name] = jnp.asarray(leaf)
         return out
@@ -218,7 +227,7 @@ def load_orbax(cfg: ModelConfig, model_path: str, mesh=None,
     import orbax.checkpoint as ocp
 
     dtype = jnp.dtype(dtype or cfg.dtype)
-    quantize = weight_dtype == "int8"
+    quantize = _weight_bits(weight_dtype)
     path = os.path.abspath(orbax_path(model_path))
     template = jax.eval_shape(
         lambda: tf.init_params(cfg, jax.random.PRNGKey(0), dtype))
@@ -240,11 +249,12 @@ def load_orbax(cfg: ModelConfig, model_path: str, mesh=None,
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(path, template)
     if quantize:
+        shards = mesh.shape.get(tf.AXIS_MODEL, 1) if mesh is not None else 1
         if mesh is not None:
             from arks_tpu.models.quant import quantize_params
-            return quantize_params(params)
+            return quantize_params(params, bits=quantize, shards=shards)
         return _leaves_to_device(
-            jax.tree.map(np.asarray, params), quantize=True)
+            jax.tree.map(np.asarray, params), quantize)
     return params
 
 
@@ -280,7 +290,7 @@ def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
     (see params_from_hf / load_orbax) — quantizing after a full-width load
     would OOM exactly the HBM-limited configs the flag exists for."""
     dtype = jnp.dtype(dtype or cfg.dtype)
-    quantize = weight_dtype == "int8"
+    quantize = _weight_bits(weight_dtype)
     if model_path:
         if os.path.isdir(orbax_path(model_path)):
             log.info("loading Orbax checkpoint from %s", orbax_path(model_path))
@@ -288,14 +298,19 @@ def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
         if os.path.isdir(model_path) and any(
                 f.endswith(".safetensors") for f in os.listdir(model_path)):
             log.info("loading HF safetensors from %s", model_path)
-            params = params_from_hf(cfg, model_path, dtype, weight_dtype)
+            params = params_from_hf(
+                cfg, model_path, dtype, weight_dtype,
+                shards=mesh.shape.get(tf.AXIS_MODEL, 1)
+                if mesh is not None else 1)
             if mesh is not None:
                 params = tf.shard_params(params, cfg, mesh)
             return params
         log.warning("no weights found under %s; using random init", model_path)
     if quantize:
         from arks_tpu.models.quant import init_params_quantized
-        params = init_params_quantized(cfg, jax.random.PRNGKey(0), dtype)
+        params = init_params_quantized(
+            cfg, jax.random.PRNGKey(0), dtype, bits=quantize,
+            shards=mesh.shape.get(tf.AXIS_MODEL, 1) if mesh is not None else 1)
     else:
         params = tf.init_params(cfg, jax.random.PRNGKey(0), dtype)
     if mesh is not None:

@@ -59,10 +59,12 @@ def main() -> None:
                    choices=("auto", "bf16", "int8"),
                    help="int8 halves KV HBM traffic and doubles cache capacity")
     p.add_argument("--weight-dtype", default="bf16",
-                   choices=("bf16", "int8"),
-                   help="int8 = weight-only quantization (w8a16): fits "
-                        "7B-class models on one 16GB chip, halves decode "
-                        "weight reads")
+                   choices=("bf16", "int8", "int4"),
+                   help="weight-only quantization: int8 (w8a16, per-channel "
+                        "scales) fits 7B-class models on one 16GB chip and "
+                        "halves decode weight reads; int4 (w4a16, groupwise "
+                        "scales) halves them again — 13B-class single-chip, "
+                        "or more HBM left for KV pages")
     p.add_argument("--kv-layout", default="auto",
                    choices=("auto", "slot", "paged"),
                    help="device KV layout: paged = block-table pool with "

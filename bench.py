@@ -211,8 +211,11 @@ def main() -> None:
             from arks_tpu.parallel.mesh import make_mesh
             mesh = make_mesh(tensor_parallel=n_chips)
 
-        if weight_dtype == "int8":
-            params = quant.init_params_quantized(cfg, jax.random.PRNGKey(0))
+        wbits = quant.weight_bits(weight_dtype)
+        if wbits:
+            params = quant.init_params_quantized(
+                cfg, jax.random.PRNGKey(0), bits=wbits,
+                shards=n_chips if n_chips > 1 else 1)
         else:
             params = tf.init_params(cfg, jax.random.PRNGKey(0))
         if mesh is not None:
