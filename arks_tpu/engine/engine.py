@@ -651,10 +651,12 @@ class InferenceEngine:
                 x, NamedSharding(mesh, PartitionSpec()))
 
         def prefill_detached_prog(params, tokens, length, temperature,
-                                  top_p, top_k, key, want_lp: bool):
+                                  top_p, top_k, key, bias_ids, bias_vals,
+                                  sup_ids, min_first, want_lp: bool):
             logits, ks, vs = model_prefill(params, tokens, length)
-            state = sampler_mod.transient_state(temperature, top_p, top_k,
-                                                key, cfg.vocab_size)
+            state = sampler_mod.transient_state(
+                temperature, top_p, top_k, key, cfg.vocab_size,
+                bias_ids, bias_vals, sup_ids, min_first)
             ids, _ = sampler_mod.sample(logits, state)
             ks, vs = _replicate(ks), _replicate(vs)
             if want_lp:
@@ -677,10 +679,12 @@ class InferenceEngine:
         # from _admit_batch_sizes() so the variant count stays bounded.
         def admit_batch(params, cache, sampling, tokens, lengths, slots,
                         pages, n_pages, temps, top_ps, top_ks, keys, pres,
-                        freqs, want_lp: bool):
+                        freqs, bias_ids, bias_vals, sup_ids, min_first,
+                        min_until, want_lp: bool):
             logits, ks, vs = model_prefill(params, tokens, lengths)
             tstate = sampler_mod.transient_state_batch(
-                temps, top_ps, top_ks, keys, cfg.vocab_size)
+                temps, top_ps, top_ks, keys, cfg.vocab_size,
+                bias_ids, bias_vals, sup_ids, min_first)
             ids, _ = sampler_mod.sample(logits, tstate)
             if self._paged:
                 # Buckets smaller than a page: pad T up so the page-insert
@@ -698,7 +702,8 @@ class InferenceEngine:
                 cache = tf.insert_batch(cache, ks, vs, slots)
             fold = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
             sampling = sampler_mod.set_slots(
-                sampling, slots, temps, top_ps, top_ks, fold, pres, freqs)
+                sampling, slots, temps, top_ps, top_ks, fold, pres, freqs,
+                bias_ids, bias_vals, sup_ids, min_until)
             if want_lp:
                 clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
                 return ids, clp, vals, lids, cache, sampling, ks, vs
@@ -723,17 +728,21 @@ class InferenceEngine:
             self._insert_pages_fn = jax.jit(tf.insert_pages,
                                             donate_argnums=(0,))
 
-        def sample_one(logits, temperature, top_p, top_k, key):
-            state = sampler_mod.transient_state(temperature, top_p, top_k,
-                                                key, cfg.vocab_size)
+        def sample_one(logits, temperature, top_p, top_k, key,
+                       bias_ids, bias_vals, sup_ids, min_first):
+            state = sampler_mod.transient_state(
+                temperature, top_p, top_k, key, cfg.vocab_size,
+                bias_ids, bias_vals, sup_ids, min_first)
             ids, _ = sampler_mod.sample(logits, state)
             return ids[0]
 
         self._sample_one_fn = jax.jit(sample_one)
 
-        def sample_one_lp(logits, temperature, top_p, top_k, key):
-            state = sampler_mod.transient_state(temperature, top_p, top_k,
-                                                key, cfg.vocab_size)
+        def sample_one_lp(logits, temperature, top_p, top_k, key,
+                          bias_ids, bias_vals, sup_ids, min_first):
+            state = sampler_mod.transient_state(
+                temperature, top_p, top_k, key, cfg.vocab_size,
+                bias_ids, bias_vals, sup_ids, min_first)
             ids, _ = sampler_mod.sample(logits, state)
             clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
             return ids[0], clp[0], vals[0], lids[0]
@@ -769,7 +778,8 @@ class InferenceEngine:
                 sstate = sampler_mod.count_tokens(sstate, tokens, active)
                 logits, cache = model_decode(params, cache, tokens, lengths,
                                              tables)
-                nxt, sstate = sampler_mod.sample(logits, sstate, active)
+                nxt, sstate = sampler_mod.sample(logits, sstate, active,
+                                                 lengths)
                 return (cache, nxt, lengths + 1, sstate), nxt
 
             (cache, tokens, lengths, sstate), toks = jax.lax.scan(
@@ -788,7 +798,8 @@ class InferenceEngine:
                 sstate = sampler_mod.count_tokens(sstate, tokens, active)
                 logits, cache = model_decode(params, cache, tokens, lengths,
                                              tables)
-                nxt, sstate = sampler_mod.sample(logits, sstate, active)
+                nxt, sstate = sampler_mod.sample(logits, sstate, active,
+                                                 lengths)
                 clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
                 return (cache, nxt, lengths + 1, sstate), (nxt, clp, vals, lids)
 
@@ -852,7 +863,7 @@ class InferenceEngine:
                                                 lengths, mesh, tables=tables)
                 out, counts, keys = sampler_mod.speculative_accept(
                     drafts, q_sel, q_probs, q_idx, vlogits, sstate, keys,
-                    enable=enable)
+                    enable=enable, lengths=lengths)
                 if want_lp:
                     # Raw-distribution logprobs for the ONE token each
                     # disabled lp slot advanced (enabled slots never carry
@@ -1393,6 +1404,11 @@ class InferenceEngine:
         params_cols = {f: np.zeros((m,), np.float32)
                        for f in ("temperature", "top_p", "presence", "frequency")}
         top_ks = np.zeros((m,), np.int32)
+        bias_ids = np.full((m, sampler_mod.LOGIT_BIAS_MAX), -1, np.int32)
+        bias_vals = np.zeros((m, sampler_mod.LOGIT_BIAS_MAX), np.float32)
+        sup_ids = np.full((m, sampler_mod.SUPPRESS_MAX), -1, np.int32)
+        min_first = np.zeros((m,), np.int32)
+        min_until = np.zeros((m,), np.int32)
         try:
             for i, (req, ids, _) in enumerate(items):
                 p = req.params
@@ -1417,6 +1433,9 @@ class InferenceEngine:
                 params_cols["presence"][i] = p.presence_penalty
                 params_cols["frequency"][i] = p.frequency_penalty
                 top_ks[i] = p.top_k
+                if p.logit_bias or p.min_tokens:
+                    (bias_ids[i], bias_vals[i], sup_ids[i], min_first[i],
+                     min_until[i]) = self._shape_cols(p, len(ids))
             slots = np.asarray(slots_l, np.int32)
             self._emit("admit_batch_lp" if want_lp else "admit_batch",
                        tokens=tokens, lengths=lengths, slots=slots,
@@ -1426,7 +1445,10 @@ class InferenceEngine:
                        temperature=params_cols["temperature"],
                        top_p=params_cols["top_p"], top_k=top_ks,
                        presence=params_cols["presence"],
-                       frequency=params_cols["frequency"])
+                       frequency=params_cols["frequency"],
+                       bias_ids=bias_ids, bias_vals=bias_vals,
+                       sup_ids=sup_ids, min_first=min_first,
+                       min_until=min_until)
             args = (self.params, self._cache, self._sampling,
                     jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(slots),
@@ -1437,7 +1459,10 @@ class InferenceEngine:
                     jnp.asarray(top_ks),
                     jnp.asarray(np.stack(keys)),
                     jnp.asarray(params_cols["presence"]),
-                    jnp.asarray(params_cols["frequency"]))
+                    jnp.asarray(params_cols["frequency"]),
+                    jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+                    jnp.asarray(sup_ids), jnp.asarray(min_first),
+                    jnp.asarray(min_until))
             if want_lp:
                 (first_ids, clps, valss, lidss, self._cache, self._sampling,
                  ks, vs) = self._admit_lp_fn(*args)
@@ -1495,9 +1520,10 @@ class InferenceEngine:
                 self._release_slot_pages(slot)
                 self._free.append(slot)
                 p = req.params
-                if p.presence_penalty or p.frequency_penalty:
-                    # Re-arm penalized()'s fast path (same as _finish): the
-                    # admit program already wrote this slot's penalty row.
+                if (p.presence_penalty or p.frequency_penalty
+                        or p.logit_bias or p.min_tokens):
+                    # Re-arm shaped()'s fast paths (same as _finish): the
+                    # admit program already wrote this slot's shaping rows.
                     self._emit("clear_penalties", slot=slot)
                     self._sampling = self._clear_pen_fn(
                         self._sampling, jnp.asarray(slot, jnp.int32))
@@ -1608,8 +1634,15 @@ class InferenceEngine:
                                               jnp.asarray(slot))
             self._emit("set_slot", slot=slot, temperature=p.temperature,
                        top_p=p.top_p, top_k=p.top_k, seed=pf.seed,
-                       presence=p.presence_penalty, frequency=p.frequency_penalty)
-            self._apply_set_slot(slot, p, jax.random.fold_in(key, 1))
+                       presence=p.presence_penalty,
+                       frequency=p.frequency_penalty,
+                       logit_bias=list(p.logit_bias),
+                       min_tokens=p.min_tokens,
+                       stop_ids=list(p.stop_token_ids),
+                       ignore_eos=p.ignore_eos,
+                       num_prompt=pf.num_prompt)
+            self._apply_set_slot(slot, p, jax.random.fold_in(key, 1),
+                                 num_prompt=pf.num_prompt)
         except Exception:
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
@@ -1629,17 +1662,39 @@ class InferenceEngine:
         return (float(clp),
                 [(int(lids[i]), float(vals[i])) for i in range(n)])
 
-    def _apply_set_slot(self, slot: int, p, key) -> None:
+    def _shape_cols(self, p, num_prompt: int):
+        """Host-side logit_bias / min_tokens columns for one request:
+        (bias_ids [NB], bias_vals [NB], suppress [NS], min_first,
+        min_until).  min_until is the ABSOLUTE sequence length below which
+        suppression holds in the fused loop (the new token at carry length
+        L is generated-token number L - num_prompt + 2); min_first is the
+        transient first-token flag (sample's lengths=None reading)."""
+        bias_ids, bias_vals = sampler_mod.np_bias_cols(p, self.cfg.vocab_size)
+        stop: list[int] = []
+        if p.min_tokens > 0:
+            if not p.ignore_eos:
+                stop += list(self.cfg.eos_token_ids)
+                stop += list(self.tokenizer.eos_token_ids)
+            stop += list(p.stop_token_ids)
+        sup = sampler_mod.np_suppress_col(dict.fromkeys(stop))
+        min_first = 1 if p.min_tokens >= 1 else 0
+        min_until = num_prompt + p.min_tokens - 1 if p.min_tokens > 0 else 0
+        return bias_ids, bias_vals, sup, min_first, min_until
+
+    def _apply_set_slot(self, slot: int, p, key, num_prompt: int = 0) -> None:
         """Write one slot's sampling params through the donated jit (array
         args keep one compiled program across requests; python floats would
         retrace per distinct value)."""
+        bias_ids, bias_vals, sup, _mf, min_until =             self._shape_cols(p, num_prompt)
         self._sampling = self._set_slot_fn(
             self._sampling, jnp.asarray(slot, jnp.int32),
             jnp.asarray(p.temperature, jnp.float32),
             jnp.asarray(p.top_p, jnp.float32),
             jnp.asarray(p.top_k, jnp.int32), key,
             jnp.asarray(p.presence_penalty, jnp.float32),
-            jnp.asarray(p.frequency_penalty, jnp.float32))
+            jnp.asarray(p.frequency_penalty, jnp.float32),
+            jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+            jnp.asarray(sup), jnp.asarray(min_until, jnp.int32))
 
     def _register_slot(self, req: Request, slot: int, first: int,
                        num_prompt: int, first_lp=None) -> None:
@@ -1870,24 +1925,35 @@ class InferenceEngine:
         # Final chunk: sample the first token (same key semantics as the
         # one-shot prefill_and_sample) and promote the slot to decoding.
         p = st.request.params
+        bias_ids, bias_vals, sup, min_first, _mu = self._shape_cols(p, 0)
         args = (logits, jnp.float32(p.temperature), jnp.float32(p.top_p),
-                jnp.int32(p.top_k), st.key)
+                jnp.int32(p.top_k), st.key,
+                jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+                jnp.asarray(sup), jnp.asarray(min_first, jnp.int32))
         first_lp = None
         if p.logprobs is not None:
             self._emit("sample_one_lp", temperature=p.temperature,
-                       top_p=p.top_p, top_k=p.top_k, seed=st.seed)
+                       top_p=p.top_p, top_k=p.top_k, seed=st.seed,
+                       bias_ids=bias_ids, bias_vals=bias_vals,
+                       sup_ids=sup, min_first=min_first)
             fid, clp, vals, lids = self._sample_one_lp_fn(*args)
             first = int(fid)
             first_lp = self._lp_entry(clp, vals, lids, p.logprobs)
         else:
             self._emit("sample_one", temperature=p.temperature, top_p=p.top_p,
-                       top_k=p.top_k, seed=st.seed)
+                       top_k=p.top_k, seed=st.seed,
+                       bias_ids=bias_ids, bias_vals=bias_vals,
+                       sup_ids=sup, min_first=min_first)
             first = int(self._sample_one_fn(*args))
         del self._prefilling[slot]
         self._emit("set_slot", slot=slot, temperature=p.temperature,
                    top_p=p.top_p, top_k=p.top_k, seed=st.seed,
-                   presence=p.presence_penalty, frequency=p.frequency_penalty)
-        self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1))
+                   presence=p.presence_penalty, frequency=p.frequency_penalty,
+                   logit_bias=list(p.logit_bias), min_tokens=p.min_tokens,
+                   stop_ids=list(p.stop_token_ids), ignore_eos=p.ignore_eos,
+                   num_prompt=len(st.ids))
+        self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1),
+                             num_prompt=len(st.ids))
         self._register_slot(st.request, slot, first, len(st.ids),
                             first_lp=first_lp)
         if self._paged and self._chunk:
@@ -1936,22 +2002,30 @@ class InferenceEngine:
             self._request_seed += 1
             seed = params.seed if params.seed is not None else self._request_seed
             key = jnp.asarray(sampler_mod.np_prng_key(seed))
+            bias_ids, bias_vals, sup, min_first, _mu = \
+                self._shape_cols(params, 0)
             args = (self.params, jnp.asarray(padded),
                     jnp.asarray([len(ids)], jnp.int32),
                     jnp.float32(params.temperature),
                     jnp.float32(params.top_p),
-                    jnp.int32(params.top_k), key)
+                    jnp.int32(params.top_k), key,
+                    jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+                    jnp.asarray(sup), jnp.asarray(min_first, jnp.int32))
             if want_lp:
                 self._emit("prefill_detached_lp", tokens=padded,
                            length=len(ids), temperature=params.temperature,
-                           top_p=params.top_p, top_k=params.top_k, seed=seed)
+                           top_p=params.top_p, top_k=params.top_k, seed=seed,
+                           bias_ids=bias_ids, bias_vals=bias_vals,
+                           sup_ids=sup, min_first=min_first)
                 first_id, clp, vals, lids, ks, vs = \
                     self._prefill_detached_lp_fn(*args)
                 first_lp = self._lp_entry(clp, vals, lids, params.logprobs)
             else:
                 self._emit("prefill_detached", tokens=padded,
                            length=len(ids), temperature=params.temperature,
-                           top_p=params.top_p, top_k=params.top_k, seed=seed)
+                           top_p=params.top_p, top_k=params.top_k, seed=seed,
+                           bias_ids=bias_ids, bias_vals=bias_vals,
+                           sup_ids=sup, min_first=min_first)
                 first_id, ks, vs = self._prefill_detached_fn(*args)
             first = int(first_id)
         self.metrics.prompt_tokens_total.inc(len(ids))
@@ -2019,7 +2093,9 @@ class InferenceEngine:
                 slot: (st.draft_synced
                        and st.request.params.presence_penalty == 0
                        and st.request.params.frequency_penalty == 0
-                       and st.request.params.logprobs is None)
+                       and st.request.params.logprobs is None
+                       and not st.request.params.logit_bias
+                       and st.request.params.min_tokens == 0)
                 for slot, st in self._slots.items()}
             if any(eligible.values()):
                 self._spec_dispatch(eligible)
@@ -2249,10 +2325,11 @@ class InferenceEngine:
         self._release_slot_pages(slot)
         self._free.append(slot)
         p = st.request.params
-        if p.presence_penalty or p.frequency_penalty:
-            # Re-arm penalized()'s lax.cond fast path: a stale penalized row
-            # on a FREE slot would keep every future dispatch paying the
-            # [B, V] penalty reads.
+        if (p.presence_penalty or p.frequency_penalty or p.logit_bias
+                or p.min_tokens):
+            # Re-arm shaped()'s lax.cond fast paths: a stale penalty/bias/
+            # suppression row on a FREE slot would keep every future
+            # dispatch paying the shaping reads.
             self._emit("clear_penalties", slot=slot)
             self._sampling = self._clear_pen_fn(self._sampling,
                                                 jnp.asarray(slot, jnp.int32))

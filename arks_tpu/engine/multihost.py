@@ -326,7 +326,12 @@ class DispatchFollower:
                      jnp.asarray(p["top_p"], jnp.float32),
                      jnp.asarray(p["top_k"], jnp.int32), keys,
                      jnp.asarray(p["presence"], jnp.float32),
-                     jnp.asarray(p["frequency"], jnp.float32))
+                     jnp.asarray(p["frequency"], jnp.float32),
+                     jnp.asarray(p["bias_ids"], jnp.int32),
+                     jnp.asarray(p["bias_vals"], jnp.float32),
+                     jnp.asarray(p["sup_ids"], jnp.int32),
+                     jnp.asarray(p["min_first"], jnp.int32),
+                     jnp.asarray(p["min_until"], jnp.int32))
             eng._cache, eng._sampling = out[-4], out[-3]
         elif op == "chunk_paged":
             _logits, eng._cache = eng._chunk_fn(
@@ -344,14 +349,24 @@ class DispatchFollower:
             # Disaggregated prefill on a gang: mirror the replicated-KV
             # prefill program (the leader materializes the full block for
             # the wire transfer; followers just keep collectives aligned).
+            import numpy as _np
             key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             fn = (eng._prefill_detached_lp_fn if op.endswith("_lp")
                   else eng._prefill_detached_fn)
+            nb = sampler_mod.LOGIT_BIAS_MAX
+            ns = sampler_mod.SUPPRESS_MAX
             out = fn(eng.params, jnp.asarray(p["tokens"]),
                      jnp.asarray([p["length"]], jnp.int32),
                      jnp.float32(p["temperature"]),
                      jnp.float32(p["top_p"]),
-                     jnp.int32(p["top_k"]), key)
+                     jnp.int32(p["top_k"]), key,
+                     jnp.asarray(p.get("bias_ids",
+                                       _np.full((nb,), -1, _np.int32))),
+                     jnp.asarray(p.get("bias_vals",
+                                       _np.zeros((nb,), _np.float32))),
+                     jnp.asarray(p.get("sup_ids",
+                                       _np.full((ns,), -1, _np.int32))),
+                     jnp.asarray(p.get("min_first", 0), jnp.int32))
             jax.block_until_ready(out[0])
         elif op in ("prefill", "prefill_lp"):
             key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
@@ -383,9 +398,15 @@ class DispatchFollower:
                 temperature=p["temperature"], top_p=p["top_p"],
                 top_k=p["top_k"],
                 presence_penalty=p.get("presence", 0.0),
-                frequency_penalty=p.get("frequency", 0.0))
+                frequency_penalty=p.get("frequency", 0.0),
+                logit_bias=tuple((int(t), float(b))
+                                 for t, b in p.get("logit_bias", ())),
+                min_tokens=p.get("min_tokens", 0),
+                stop_token_ids=tuple(p.get("stop_ids", ())),
+                ignore_eos=p.get("ignore_eos", False))
             eng._apply_set_slot(p["slot"], params,
-                                self._jax.random.fold_in(key, 1))
+                                self._jax.random.fold_in(key, 1),
+                                num_prompt=p.get("num_prompt", 0))
         elif op == "clear_penalties":
             eng._sampling = eng._clear_pen_fn(
                 eng._sampling, jnp.asarray(p["slot"], jnp.int32))
@@ -400,10 +421,21 @@ class DispatchFollower:
             key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             fn = (eng._sample_one_lp_fn if op == "sample_one_lp"
                   else eng._sample_one_fn)
+            nb = sampler_mod.LOGIT_BIAS_MAX
+            ns = sampler_mod.SUPPRESS_MAX
+            import numpy as _np
+            shape_args = (
+                jnp.asarray(p.get("bias_ids",
+                                  _np.full((nb,), -1, _np.int32))),
+                jnp.asarray(p.get("bias_vals",
+                                  _np.zeros((nb,), _np.float32))),
+                jnp.asarray(p.get("sup_ids",
+                                  _np.full((ns,), -1, _np.int32))),
+                jnp.asarray(p.get("min_first", 0), jnp.int32))
             fn(self._last_logits,
                jnp.float32(p["temperature"]),
                jnp.float32(p["top_p"]),
-               jnp.int32(p["top_k"]), key)
+               jnp.int32(p["top_k"]), key, *shape_args)
         elif op == "decode":
             fn = eng._decode_lp_fn if p.get("lp") else eng._decode_fn
             tables = p.get("tables")

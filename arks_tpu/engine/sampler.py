@@ -65,6 +65,11 @@ def top_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray
     return chosen_lp, vals, ids.astype(jnp.int32)
 
 
+LOGIT_BIAS_MAX = 64   # OpenAI caps logit_bias at 300 keys; 64 covers the
+                      # practical range with a bounded device footprint.
+SUPPRESS_MAX = 8      # eos + stop_token_ids suppressed under min_tokens.
+
+
 class SamplingState(NamedTuple):
     """Per-slot sampling params, stacked into arrays (all [B])."""
 
@@ -77,6 +82,15 @@ class SamplingState(NamedTuple):
     presence: jnp.ndarray     # f32 [B]
     frequency: jnp.ndarray    # f32 [B]
     counts: jnp.ndarray       # i32 [B, V] per-slot generated-token counts
+    # OpenAI logit_bias: up to LOGIT_BIAS_MAX (id, bias) pairs per slot;
+    # id < 0 = empty entry.  Applied before greedy/filtering, like the
+    # penalties (lax.cond-gated so unbiased batches pay nothing).
+    bias_ids: jnp.ndarray     # i32 [B, NB]
+    bias_vals: jnp.ndarray    # f32 [B, NB]
+    # min_tokens: ids in suppress_ids (< 0 = empty) are masked to -inf
+    # while the slot's sequence length is below min_until (0 = off).
+    suppress_ids: jnp.ndarray  # i32 [B, NS]
+    min_until: jnp.ndarray     # i32 [B]
 
 
 def init_sampling_state(batch: int, seed: int = 0,
@@ -90,12 +104,44 @@ def init_sampling_state(batch: int, seed: int = 0,
         presence=jnp.zeros((batch,), jnp.float32),
         frequency=jnp.zeros((batch,), jnp.float32),
         counts=jnp.zeros((batch, vocab_size), jnp.int32),
+        bias_ids=jnp.full((batch, LOGIT_BIAS_MAX), -1, jnp.int32),
+        bias_vals=jnp.zeros((batch, LOGIT_BIAS_MAX), jnp.float32),
+        suppress_ids=jnp.full((batch, SUPPRESS_MAX), -1, jnp.int32),
+        min_until=jnp.zeros((batch,), jnp.int32),
     )
+
+
+def np_bias_cols(params, vocab_size: int):
+    """Host-side [NB] bias columns (ids, vals) for one request's
+    ``logit_bias``; ids < 0 pad empty entries."""
+    import numpy as _np
+
+    ids = _np.full((LOGIT_BIAS_MAX,), -1, _np.int32)
+    vals = _np.zeros((LOGIT_BIAS_MAX,), _np.float32)
+    for i, (tid, b) in enumerate(params.logit_bias[:LOGIT_BIAS_MAX]):
+        if 0 <= tid < vocab_size:
+            ids[i] = tid
+            vals[i] = b
+    return ids, vals
+
+
+def np_suppress_col(stop_ids) -> "object":
+    """Host-side [NS] suppress column for min_tokens; ids < 0 pad."""
+    import numpy as _np
+
+    col = _np.full((SUPPRESS_MAX,), -1, _np.int32)
+    for i, tid in enumerate(list(stop_ids)[:SUPPRESS_MAX]):
+        col[i] = tid
+    return col
 
 
 def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
              top_p: float, top_k: int, key: jnp.ndarray,
-             presence: float = 0.0, frequency: float = 0.0) -> SamplingState:
+             presence: float = 0.0, frequency: float = 0.0,
+             bias_ids=None, bias_vals=None, suppress_ids=None,
+             min_until: int = 0) -> SamplingState:
+    nb = state.bias_ids.shape[1]
+    ns = state.suppress_ids.shape[1]
     return SamplingState(
         temperature=state.temperature.at[slot].set(temperature),
         top_p=state.top_p.at[slot].set(top_p),
@@ -104,24 +150,45 @@ def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
         presence=state.presence.at[slot].set(presence),
         frequency=state.frequency.at[slot].set(frequency),
         counts=state.counts.at[slot].set(0),
+        bias_ids=state.bias_ids.at[slot].set(
+            jnp.full((nb,), -1, jnp.int32) if bias_ids is None else bias_ids),
+        bias_vals=state.bias_vals.at[slot].set(
+            jnp.zeros((nb,), jnp.float32) if bias_vals is None else bias_vals),
+        suppress_ids=state.suppress_ids.at[slot].set(
+            jnp.full((ns,), -1, jnp.int32) if suppress_ids is None
+            else suppress_ids),
+        min_until=state.min_until.at[slot].set(min_until),
     )
 
 
 def transient_state(temperature, top_p, top_k, key,
-                    vocab_size: int) -> SamplingState:
+                    vocab_size: int, bias_ids=None, bias_vals=None,
+                    suppress_ids=None, min_first=None) -> SamplingState:
     """One-row state for first-token sampling (prefill paths): penalties
-    are identity there — the output is empty, so counts are all zero."""
+    are identity there — the output is empty, so counts are all zero.
+    ``min_first`` (i32 scalar, 1 when min_tokens >= 1): the first token
+    must already respect suppression (sample's lengths=None reading of
+    min_until)."""
     return SamplingState(
         temperature=temperature[None], top_p=top_p[None], top_k=top_k[None],
         key=key[None],
         presence=jnp.zeros((1,), jnp.float32),
         frequency=jnp.zeros((1,), jnp.float32),
         counts=jnp.zeros((1, vocab_size), jnp.int32),
+        bias_ids=(jnp.full((1, LOGIT_BIAS_MAX), -1, jnp.int32)
+                  if bias_ids is None else bias_ids[None]),
+        bias_vals=(jnp.zeros((1, LOGIT_BIAS_MAX), jnp.float32)
+                   if bias_vals is None else bias_vals[None]),
+        suppress_ids=(jnp.full((1, SUPPRESS_MAX), -1, jnp.int32)
+                      if suppress_ids is None else suppress_ids[None]),
+        min_until=(jnp.zeros((1,), jnp.int32)
+                   if min_first is None else min_first[None]),
     )
 
 
 def transient_state_batch(temperature, top_p, top_k, keys,
-                          vocab_size: int) -> SamplingState:
+                          vocab_size: int, bias_ids=None, bias_vals=None,
+                          suppress_ids=None, min_first=None) -> SamplingState:
     """M-row transient state for BATCHED first-token sampling (fused
     multi-prompt admissions): all params already [M]-shaped."""
     m = temperature.shape[0]
@@ -130,13 +197,24 @@ def transient_state_batch(temperature, top_p, top_k, keys,
         presence=jnp.zeros((m,), jnp.float32),
         frequency=jnp.zeros((m,), jnp.float32),
         counts=jnp.zeros((m, vocab_size), jnp.int32),
+        bias_ids=(jnp.full((m, LOGIT_BIAS_MAX), -1, jnp.int32)
+                  if bias_ids is None else bias_ids),
+        bias_vals=(jnp.zeros((m, LOGIT_BIAS_MAX), jnp.float32)
+                   if bias_vals is None else bias_vals),
+        suppress_ids=(jnp.full((m, SUPPRESS_MAX), -1, jnp.int32)
+                      if suppress_ids is None else suppress_ids),
+        min_until=(jnp.zeros((m,), jnp.int32)
+                   if min_first is None else min_first),
     )
 
 
 def set_slots(state: SamplingState, slots: jnp.ndarray, temperature,
-              top_p, top_k, keys, presence, frequency) -> SamplingState:
+              top_p, top_k, keys, presence, frequency,
+              bias_ids=None, bias_vals=None, suppress_ids=None,
+              min_until=None) -> SamplingState:
     """Batched set_slot: write M slots' sampling params in one scatter
     (one compiled program per batch size M)."""
+    m = temperature.shape[0]
     return SamplingState(
         temperature=state.temperature.at[slots].set(temperature),
         top_p=state.top_p.at[slots].set(top_p),
@@ -145,16 +223,32 @@ def set_slots(state: SamplingState, slots: jnp.ndarray, temperature,
         presence=state.presence.at[slots].set(presence),
         frequency=state.frequency.at[slots].set(frequency),
         counts=state.counts.at[slots].set(0),
+        bias_ids=state.bias_ids.at[slots].set(
+            jnp.full((m, state.bias_ids.shape[1]), -1, jnp.int32)
+            if bias_ids is None else bias_ids),
+        bias_vals=state.bias_vals.at[slots].set(
+            jnp.zeros((m, state.bias_vals.shape[1]), jnp.float32)
+            if bias_vals is None else bias_vals),
+        suppress_ids=state.suppress_ids.at[slots].set(
+            jnp.full((m, state.suppress_ids.shape[1]), -1, jnp.int32)
+            if suppress_ids is None else suppress_ids),
+        min_until=state.min_until.at[slots].set(
+            jnp.zeros((m,), jnp.int32) if min_until is None else min_until),
     )
 
 
 def clear_slot_penalties(state: SamplingState,
                          slot: jnp.ndarray) -> SamplingState:
-    """Zero a freed slot's penalties so the ``penalized`` fast-path gate
-    (jnp.any over ALL rows) re-arms once no live slot is penalized."""
+    """Zero a freed slot's penalties, bias, and suppression so the
+    shaping fast-path gates (jnp.any over ALL rows) re-arm once no live
+    slot needs them."""
     return state._replace(
         presence=state.presence.at[slot].set(0.0),
-        frequency=state.frequency.at[slot].set(0.0))
+        frequency=state.frequency.at[slot].set(0.0),
+        bias_ids=state.bias_ids.at[slot].set(-1),
+        bias_vals=state.bias_vals.at[slot].set(0.0),
+        suppress_ids=state.suppress_ids.at[slot].set(-1),
+        min_until=state.min_until.at[slot].set(0))
 
 
 def count_tokens(state: SamplingState, tokens: jnp.ndarray,
@@ -186,6 +280,41 @@ def penalized(logits: jnp.ndarray, state: SamplingState) -> jnp.ndarray:
 
     active = jnp.any((state.presence != 0.0) | (state.frequency != 0.0))
     return jax.lax.cond(active, apply, lambda x: x, logits)
+
+
+def shaped(logits: jnp.ndarray, state: SamplingState,
+           lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Penalties + OpenAI logit_bias + min_tokens suppression, each
+    lax.cond-gated so the plain batch pays none of it.
+
+    min_tokens: suppress_ids are masked to -inf while the slot's current
+    sequence length sits below min_until.  Without ``lengths`` (first-token
+    prefill paths), min_until > 0 itself means "still under the minimum"
+    (the engine sets it to 1 only when min_tokens >= 1 there)."""
+    logits = penalized(logits, state)
+    b = logits.shape[0]
+
+    def apply_bias(lg):
+        valid = state.bias_ids >= 0
+        ids = jnp.maximum(state.bias_ids, 0)
+        return lg.at[jnp.arange(b)[:, None], ids].add(
+            jnp.where(valid, state.bias_vals, 0.0))
+
+    logits = jax.lax.cond(jnp.any(state.bias_ids >= 0), apply_bias,
+                          lambda x: x, logits)
+
+    def apply_min(lg):
+        if lengths is None:
+            hold = state.min_until > 0
+        else:
+            hold = lengths < state.min_until
+        valid = (state.suppress_ids >= 0) & hold[:, None]
+        ids = jnp.maximum(state.suppress_ids, 0)
+        return lg.at[jnp.arange(b)[:, None], ids].add(
+            jnp.where(valid, jnp.float32(-1e30), 0.0))
+
+    return jax.lax.cond(jnp.any(state.min_until > 0), apply_min,
+                        lambda x: x, logits)
 
 
 def _filtered_scaled(logits: jnp.ndarray, state: SamplingState
@@ -222,20 +351,22 @@ def filtered_probs(logits: jnp.ndarray, state: SamplingState
 
 
 def sample(logits: jnp.ndarray, state: SamplingState,
-           active: jnp.ndarray | None = None
+           active: jnp.ndarray | None = None,
+           lengths: jnp.ndarray | None = None,
            ) -> tuple[jnp.ndarray, SamplingState]:
     """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
 
     Greedy where temperature <= 0; otherwise temperature + top-k + top-p over
-    the TOP_K_MAX highest-logit candidates.  Presence/frequency penalties
-    apply BEFORE greedy/filtering (identity at the 0 defaults).
+    the TOP_K_MAX highest-logit candidates.  Penalties, logit_bias, and
+    min_tokens suppression apply BEFORE greedy/filtering (identity at the
+    defaults — see ``shaped``).
 
     ``active`` (bool [B]) freezes INACTIVE slots' PRNG keys: with deferred
     admissions, decode dispatches can land between a slot's set_slots (in
     the admit program) and its registration — advancing its fresh key
     stream there would make seeded sampling depend on scheduler timing.
     """
-    logits = penalized(logits, state)
+    logits = shaped(logits, state, lengths)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled, top_idx = _filtered_scaled(logits, state)
 
@@ -279,6 +410,8 @@ def speculative_accept(
     state: SamplingState,
     keys: jnp.ndarray,          # [B, 2]
     enable: jnp.ndarray | None = None,  # [B] bool; False = no speculation
+    lengths: jnp.ndarray | None = None,  # [B] — min_tokens gating for the
+                                         # disabled slots' plain sample
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rejection-sampled acceptance (Leviathan et al.): accept draft i with
     prob min(1, p_i(d_i)/q_i(d_i)); at the first rejection sample from the
@@ -345,8 +478,10 @@ def speculative_accept(
 
     if enable is not None:
         # Disabled slots: one token via the regular sampler (which applies
-        # presence/frequency penalties) from the position-0 target logits.
-        plain, _ = sample(target_logits[:, 0], state._replace(key=r_keys))
+        # penalties / logit_bias / min_tokens shaping) from the position-0
+        # target logits.
+        plain, _ = sample(target_logits[:, 0], state._replace(key=r_keys),
+                          lengths=lengths)
         out = jnp.where(enable[:, None], out, out.at[:, 0].set(plain))
         counts = jnp.where(enable, counts, 1)
     return out, counts, carry_keys

@@ -24,6 +24,12 @@ class SamplingParams:
     # top-N alternatives (clamped to sampler.TOP_LOGPROBS_MAX).
     # Logprob-bearing slots ride the fused loop.
     logprobs: int | None = None
+    # OpenAI logit_bias as (token_id, bias) pairs (bias in [-100, 100];
+    # at most sampler.LOGIT_BIAS_MAX entries — the server rejects more).
+    logit_bias: tuple[tuple[int, float], ...] = ()
+    # vLLM-style min_tokens: eos/stop token ids are suppressed on device
+    # until at least this many tokens have been generated.
+    min_tokens: int = 0
 
 
 @dataclasses.dataclass

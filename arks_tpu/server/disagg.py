@@ -61,7 +61,12 @@ class PrefillServer(OpenAIServer):
         if len(batch) > 1:
             h._error(400, "disaggregated serving takes one prompt per request")
             return True
-        params, _ = _sampling_from_body(body, self.engine.tokenizer)
+        try:
+            params, _ = _sampling_from_body(body, self.engine.tokenizer,
+                                            self.engine)
+        except ValueError as e:
+            h._error(400, str(e))
+            return True
         from arks_tpu.engine.engine import ContextLengthExceededError
         try:
             pf = self.engine.prefill_detached(batch[0], params)
@@ -117,7 +122,11 @@ class DecodeServer(OpenAIServer):
         except Exception as e:
             return h._error(502, f"prefill pull failed: {e}")
 
-        params, stop_strings = _sampling_from_body(body, self.engine.tokenizer)
+        try:
+            params, stop_strings = _sampling_from_body(
+                body, self.engine.tokenizer, self.engine)
+        except ValueError as e:
+            return h._error(400, str(e))
         # JSON round-trips the logprob entry as nested lists; restore the
         # engine's (chosen, [(id, lp), ...]) tuple shape.
         first_lp = meta.get("first_lp")

@@ -40,13 +40,17 @@ def _find_stop(text: str, stop_strings: list[str]) -> int | None:
     return best
 
 
-def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str]]:
+def _sampling_from_body(body: dict, tokenizer,
+                        engine=None) -> tuple[SamplingParams, list[str]]:
     """Build engine sampling params; returns (params, stop_strings).
 
     ``stop_token_ids`` go to the engine directly.  ``stop`` strings that
     encode to a single token also become stop ids; multi-token stop strings
     are matched against streamed text by the server (which then aborts the
-    engine request)."""
+    engine request).  With ``engine``, logit_bias token ids are validated
+    against the vocab and min_tokens' suppress set against the device
+    column budget — raising ValueError (HTTP 400) instead of silently
+    ignoring entries."""
     stop = body.get("stop") or []
     if isinstance(stop, str):
         stop = [stop]
@@ -68,7 +72,37 @@ def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str
         n_lp = None
     else:
         n_lp = int(lp)
-    from arks_tpu.engine.sampler import TOP_LOGPROBS_MAX
+    from arks_tpu.engine.sampler import LOGIT_BIAS_MAX, TOP_LOGPROBS_MAX
+    # OpenAI logit_bias: {"token_id": bias in [-100, 100]}.  Rejected when
+    # it exceeds the device column budget (silently dropping entries would
+    # bias the WRONG subset).
+    raw_bias = body.get("logit_bias") or {}
+    if not isinstance(raw_bias, dict):
+        raise ValueError("logit_bias must be an object of token_id -> bias")
+    if len(raw_bias) > LOGIT_BIAS_MAX:
+        raise ValueError(
+            f"logit_bias supports at most {LOGIT_BIAS_MAX} entries")
+    logit_bias = tuple(
+        (int(t), max(-100.0, min(100.0, float(b))))
+        for t, b in raw_bias.items())
+    if engine is not None and logit_bias:
+        vocab = engine.cfg.vocab_size
+        bad = [t for t, _ in logit_bias if not 0 <= t < vocab]
+        if bad:
+            raise ValueError(
+                f"logit_bias token ids out of range [0, {vocab}): {bad[:5]}")
+    min_tokens = max(int(body.get("min_tokens", 0)), 0)
+    if engine is not None and min_tokens:
+        from arks_tpu.engine.sampler import SUPPRESS_MAX
+        sup = [] if body.get("ignore_eos") else (
+            list(engine.cfg.eos_token_ids)
+            + list(engine.tokenizer.eos_token_ids))
+        sup += stop_ids
+        if len(dict.fromkeys(sup)) > SUPPRESS_MAX:
+            raise ValueError(
+                f"min_tokens supports at most {SUPPRESS_MAX} eos/stop "
+                "token ids to suppress (silently dropping one could end "
+                "the stream before the minimum)")
     params = SamplingParams(
         max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 256),
         temperature=float(body.get("temperature", 1.0)),
@@ -80,6 +114,8 @@ def _sampling_from_body(body: dict, tokenizer) -> tuple[SamplingParams, list[str
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         logprobs=None if n_lp is None else min(max(n_lp, 0), TOP_LOGPROBS_MAX),
+        logit_bias=logit_bias,
+        min_tokens=min_tokens,
     )
     return params, stop_strings
 
@@ -310,10 +346,10 @@ class OpenAIServer:
             return h._error(404, f"model {model!r} not found")
         try:
             batch = self._prompt_ids_batch(body, chat)
+            params, stop_strings = _sampling_from_body(
+                body, self.engine.tokenizer, self.engine)
         except ValueError as e:
             return h._error(400, str(e))
-
-        params, stop_strings = _sampling_from_body(body, self.engine.tokenizer)
         stream = bool(body.get("stream", False))
         if stream and len(batch) > 1:
             return h._error(400, "streaming is not supported for batched prompts")

@@ -104,3 +104,85 @@ def test_np_prng_key_matches_jax():
         np.testing.assert_array_equal(
             np_prng_key(seed), np.asarray(jax.random.PRNGKey(seed)),
             err_msg=f"seed={seed}")
+
+
+def test_logit_bias_forces_and_blocks_tokens():
+    """OpenAI logit_bias: +100 forces a token, -100 (or -inf-ish) removes
+    it — greedy and sampled alike, through the engine end to end."""
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.engine.types import Request, SamplingParams
+    from arks_tpu.models import get_config
+
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    try:
+        def run(bias):
+            r = Request(f"b{bias}", [5, 6, 7], SamplingParams(
+                max_tokens=5, temperature=0.0, ignore_eos=True,
+                logit_bias=bias))
+            eng.add_request(r)
+            ids = []
+            while True:
+                out = r.outputs.get(timeout=60)
+                ids.extend(out.token_ids)
+                if out.finished:
+                    return ids
+
+        base = run(())
+        # +100 on an arbitrary token dominates every real logit (tiny
+        # random models have |logits| << 100): the whole stream pins to it.
+        forced = run(((123, 100.0),))
+        assert forced == [123] * 5
+        # -100 on the baseline's first token evicts it everywhere.
+        banned = run(((base[0], -100.0),))
+        assert base[0] not in banned
+    finally:
+        eng.stop()
+
+
+def test_min_tokens_suppresses_stop_until_minimum():
+    """min_tokens holds eos/stop ids out of the distribution until the
+    minimum is generated: a stop id that greedy decoding would emit early
+    cannot terminate the stream before min_tokens."""
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.engine.types import Request, SamplingParams
+    from arks_tpu.models import get_config
+
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    try:
+        def run(params):
+            r = Request(f"m{params.min_tokens}{params.stop_token_ids}",
+                        [5, 6, 7], params)
+            eng.add_request(r)
+            ids = []
+            while True:
+                out = r.outputs.get(timeout=60)
+                ids.extend(out.token_ids)
+                if out.finished:
+                    return ids, out
+
+        base, _ = run(SamplingParams(max_tokens=8, temperature=0.0,
+                                     ignore_eos=True))
+        stop = base[1]  # greedy would emit this as token #2
+        # Without min_tokens the stream stops right there.
+        early, fin = run(SamplingParams(max_tokens=8, temperature=0.0,
+                                        ignore_eos=True,
+                                        stop_token_ids=(stop,)))
+        assert fin.finish_reason == "stop" and len(early) <= 2
+        # With min_tokens=5 the stop id is suppressed until 5 tokens exist.
+        late, fin5 = run(SamplingParams(max_tokens=8, temperature=0.0,
+                                        ignore_eos=True, min_tokens=5,
+                                        stop_token_ids=(stop,)))
+        assert len(late) >= 5
+        assert stop not in late[:4]
+    finally:
+        eng.stop()

@@ -465,3 +465,34 @@ def test_logprobs_streaming_stop_cut_parity(server):
         assert n_entries == len(ref["logprobs"]["tokens"])
         # The cut kept the visible-prefix tokens and dropped the rest.
         assert 0 < n_entries < 8
+
+
+def test_logit_bias_and_min_tokens_api(server):
+    """OpenAI logit_bias flows through the HTTP surface (+100 forces a
+    token id across the stream) and oversized bias objects 400 instead
+    of silently truncating; min_tokens passes through."""
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 4,
+        "temperature": 0, "ignore_eos": True,
+        "logit_bias": {"123": 100},
+    }) as r:
+        data = json.load(r)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    assert data["choices"][0]["text"] == ByteTokenizer().decode([123] * 4)
+
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 4,
+        "temperature": 0, "ignore_eos": True, "min_tokens": 3,
+    }) as r:
+        assert json.load(r)["usage"]["completion_tokens"] == 4
+
+    from arks_tpu.engine.sampler import LOGIT_BIAS_MAX
+    too_many = {str(i): 1 for i in range(LOGIT_BIAS_MAX + 1)}
+    try:
+        _post(server, "/v1/completions", {
+            "model": "tiny-serve", "prompt": "hi", "max_tokens": 2,
+            "logit_bias": too_many,
+        })
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
