@@ -618,26 +618,6 @@ class InferenceEngine:
                 return tf.decode_step(params, cfg, cache, tokens, lengths,
                                       mesh, batch_axis, tables=tables)
 
-        def prefill_and_sample(params, tokens, length, temperature, top_p, top_k, key):
-            logits, ks, vs = model_prefill(params, tokens, length)
-            state = sampler_mod.transient_state(temperature, top_p, top_k,
-                                                key, cfg.vocab_size)
-            ids, _ = sampler_mod.sample(logits, state)
-            return ids[0], ks, vs
-
-        self._prefill_fn = jax.jit(prefill_and_sample)
-
-        def prefill_and_sample_lp(params, tokens, length, temperature, top_p,
-                                  top_k, key):
-            logits, ks, vs = model_prefill(params, tokens, length)
-            state = sampler_mod.transient_state(temperature, top_p, top_k,
-                                                key, cfg.vocab_size)
-            ids, _ = sampler_mod.sample(logits, state)
-            clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
-            return ids[0], clp[0], vals[0], lids[0], ks, vs
-
-        self._prefill_lp_fn = jax.jit(prefill_and_sample_lp)
-
         # Detached (disaggregated) prefill: same math, but the KV comes
         # back REPLICATED over the mesh — on a multi-host gang the leader
         # must materialize the full [L,1,T,Hkv,D] block for the wire

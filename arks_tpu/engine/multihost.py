@@ -216,7 +216,6 @@ class DispatchFollower:
 
         self.engine = engine
         self._jax = jax
-        self._last_kv = None  # (ks, vs) from the most recent prefill
         secret = _secret()
         deadline = time.monotonic() + connect_timeout_s
         while True:
@@ -301,6 +300,20 @@ class DispatchFollower:
                 # kills the gang, which the driver restarts.)
                 log.exception("dispatch op %r failed; awaiting reset", op)
 
+    @staticmethod
+    def _shape_args(p: dict, jnp, sampler_mod):
+        """Follower-side (bias_ids, bias_vals, sup_ids, min_first) jnp args
+        from an emit payload, defaulting to the empty columns — ONE
+        definition, or leader/follower replay diverges per op."""
+        import numpy as _np
+        nb = sampler_mod.LOGIT_BIAS_MAX
+        ns = sampler_mod.SUPPRESS_MAX
+        return (
+            jnp.asarray(p.get("bias_ids", _np.full((nb,), -1, _np.int32))),
+            jnp.asarray(p.get("bias_vals", _np.zeros((nb,), _np.float32))),
+            jnp.asarray(p.get("sup_ids", _np.full((ns,), -1, _np.int32))),
+            jnp.asarray(p.get("min_first", 0), jnp.int32))
+
     def _apply(self, eng, jax, jnp, op: str, p: dict) -> None:
         from arks_tpu.engine import sampler as sampler_mod
 
@@ -349,41 +362,16 @@ class DispatchFollower:
             # Disaggregated prefill on a gang: mirror the replicated-KV
             # prefill program (the leader materializes the full block for
             # the wire transfer; followers just keep collectives aligned).
-            import numpy as _np
             key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             fn = (eng._prefill_detached_lp_fn if op.endswith("_lp")
                   else eng._prefill_detached_fn)
-            nb = sampler_mod.LOGIT_BIAS_MAX
-            ns = sampler_mod.SUPPRESS_MAX
             out = fn(eng.params, jnp.asarray(p["tokens"]),
                      jnp.asarray([p["length"]], jnp.int32),
                      jnp.float32(p["temperature"]),
                      jnp.float32(p["top_p"]),
                      jnp.int32(p["top_k"]), key,
-                     jnp.asarray(p.get("bias_ids",
-                                       _np.full((nb,), -1, _np.int32))),
-                     jnp.asarray(p.get("bias_vals",
-                                       _np.zeros((nb,), _np.float32))),
-                     jnp.asarray(p.get("sup_ids",
-                                       _np.full((ns,), -1, _np.int32))),
-                     jnp.asarray(p.get("min_first", 0), jnp.int32))
+                     *self._shape_args(p, jnp, sampler_mod))
             jax.block_until_ready(out[0])
-        elif op in ("prefill", "prefill_lp"):
-            key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
-            args = (eng.params, jnp.asarray(p["tokens"]),
-                    jnp.asarray([p["length"]], jnp.int32),
-                    jnp.float32(p["temperature"]), jnp.float32(p["top_p"]),
-                    jnp.int32(p["top_k"]), key)
-            if op == "prefill_lp":
-                *_rest, ks, vs = eng._prefill_lp_fn(*args)
-            else:
-                _first, ks, vs = eng._prefill_fn(*args)
-            self._last_kv = (ks, vs)
-        elif op == "insert":
-            ks, vs = self._last_kv
-            eng._cache = eng._insert_fn(eng._cache, ks, vs,
-                                        jnp.asarray(p["slot"]))
-            self._last_kv = None
         elif op == "insert_kv":
             # Disaggregated decode: KV arrives by value (the leader got
             # it over the wire, not from a local prefill).
@@ -421,21 +409,11 @@ class DispatchFollower:
             key = jnp.asarray(sampler_mod.np_prng_key(p["seed"]))
             fn = (eng._sample_one_lp_fn if op == "sample_one_lp"
                   else eng._sample_one_fn)
-            nb = sampler_mod.LOGIT_BIAS_MAX
-            ns = sampler_mod.SUPPRESS_MAX
-            import numpy as _np
-            shape_args = (
-                jnp.asarray(p.get("bias_ids",
-                                  _np.full((nb,), -1, _np.int32))),
-                jnp.asarray(p.get("bias_vals",
-                                  _np.zeros((nb,), _np.float32))),
-                jnp.asarray(p.get("sup_ids",
-                                  _np.full((ns,), -1, _np.int32))),
-                jnp.asarray(p.get("min_first", 0), jnp.int32))
             fn(self._last_logits,
                jnp.float32(p["temperature"]),
                jnp.float32(p["top_p"]),
-               jnp.int32(p["top_k"]), key, *shape_args)
+               jnp.int32(p["top_k"]), key,
+               *self._shape_args(p, jnp, sampler_mod))
         elif op == "decode":
             fn = eng._decode_lp_fn if p.get("lp") else eng._decode_fn
             tables = p.get("tables")

@@ -114,10 +114,8 @@ def init_sampling_state(batch: int, seed: int = 0,
 def np_bias_cols(params, vocab_size: int):
     """Host-side [NB] bias columns (ids, vals) for one request's
     ``logit_bias``; ids < 0 pad empty entries."""
-    import numpy as _np
-
-    ids = _np.full((LOGIT_BIAS_MAX,), -1, _np.int32)
-    vals = _np.zeros((LOGIT_BIAS_MAX,), _np.float32)
+    ids = np.full((LOGIT_BIAS_MAX,), -1, np.int32)
+    vals = np.zeros((LOGIT_BIAS_MAX,), np.float32)
     for i, (tid, b) in enumerate(params.logit_bias[:LOGIT_BIAS_MAX]):
         if 0 <= tid < vocab_size:
             ids[i] = tid
@@ -125,11 +123,9 @@ def np_bias_cols(params, vocab_size: int):
     return ids, vals
 
 
-def np_suppress_col(stop_ids) -> "object":
+def np_suppress_col(stop_ids) -> np.ndarray:
     """Host-side [NS] suppress column for min_tokens; ids < 0 pad."""
-    import numpy as _np
-
-    col = _np.full((SUPPRESS_MAX,), -1, _np.int32)
+    col = np.full((SUPPRESS_MAX,), -1, np.int32)
     for i, tid in enumerate(list(stop_ids)[:SUPPRESS_MAX]):
         col[i] = tid
     return col

@@ -67,6 +67,9 @@ class PrefillServer(OpenAIServer):
         except ValueError as e:
             h._error(400, str(e))
             return True
+        if (body.get("n") or 1) != 1:
+            h._error(400, "disaggregated serving does not support n > 1")
+            return True
         from arks_tpu.engine.engine import ContextLengthExceededError
         try:
             pf = self.engine.prefill_detached(batch[0], params)
@@ -127,6 +130,9 @@ class DecodeServer(OpenAIServer):
                 body, self.engine.tokenizer, self.engine)
         except ValueError as e:
             return h._error(400, str(e))
+        if (body.get("n") or 1) != 1:
+            return h._error(400,
+                            "disaggregated serving does not support n > 1")
         # JSON round-trips the logprob entry as nested lists; restore the
         # engine's (chosen, [(id, lp), ...]) tuple shape.
         first_lp = meta.get("first_lp")

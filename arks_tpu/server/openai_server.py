@@ -348,11 +348,23 @@ class OpenAIServer:
             batch = self._prompt_ids_batch(body, chat)
             params, stop_strings = _sampling_from_body(
                 body, self.engine.tokenizer, self.engine)
+            # OpenAI n: independent samples per prompt (choices are
+            # prompt-major).  Seeded requests derive child seeds seed+j so
+            # the choices differ while staying reproducible.
+            n_raw = body.get("n", 1)
+            if n_raw is None:
+                n_raw = 1
+            if isinstance(n_raw, bool) or not isinstance(n_raw, int):
+                raise ValueError("n must be an integer")
+            n = n_raw
+            if not 1 <= n <= 16:
+                raise ValueError("n must be between 1 and 16")
         except ValueError as e:
             return h._error(400, str(e))
         stream = bool(body.get("stream", False))
-        if stream and len(batch) > 1:
-            return h._error(400, "streaming is not supported for batched prompts")
+        if stream and (len(batch) > 1 or n > 1):
+            return h._error(
+                400, "streaming is not supported for batched prompts or n > 1")
 
         # Reject oversize prompts BEFORE queueing (OpenAI semantics: 400
         # context_length_exceeded — never silent truncation, which would
@@ -362,15 +374,20 @@ class OpenAIServer:
             if len(prompt_ids) > limit:
                 return self._context_length_error(h, len(prompt_ids), limit)
 
+        import dataclasses as _dc
         reqs = []
         for prompt_ids in batch:
-            req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
-                          prompt_ids=prompt_ids, params=params)
-            self.engine.add_request(req)
-            reqs.append(req)
+            for j in range(n):
+                p = params
+                if n > 1 and params.seed is not None:
+                    p = _dc.replace(params, seed=params.seed + j)
+                req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
+                              prompt_ids=list(prompt_ids), params=p)
+                self.engine.add_request(req)
+                reqs.append(req)
 
         if len(reqs) > 1:
-            self._batch_response(h, reqs, model, stop_strings)
+            self._batch_response(h, reqs, model, stop_strings, chat=chat)
         else:
             self._respond(h, reqs[0], chat, model, body, stop_strings)
 
@@ -509,24 +526,34 @@ class OpenAIServer:
         return out
 
     def _batch_response(self, h, reqs: list[Request], model: str,
-                        stop_strings: list[str]) -> None:
-        """OpenAI batched-prompt completions: one choice per prompt."""
+                        stop_strings: list[str], chat: bool = False) -> None:
+        """Multi-choice responses: batched prompts and/or n > 1 (one
+        engine request per choice, prompt-major indexes)."""
         choices, usage = [], {"prompt_tokens": 0, "completion_tokens": 0,
                               "total_tokens": 0}
         for i, req in enumerate(reqs):
             text, finish_reason, fin, toks, lps, pieces = self._collect_text(
                 req, stop_strings)
-            choice = {"index": i, "text": text,
-                      "finish_reason": finish_reason}
-            if req.params.logprobs is not None and lps:
-                choice["logprobs"] = self._lp_completions_obj(
-                    toks, lps, req.params.logprobs, pieces)
+            if chat:
+                choice = {"index": i,
+                          "message": {"role": "assistant", "content": text},
+                          "finish_reason": finish_reason}
+                if req.params.logprobs is not None and lps:
+                    choice["logprobs"] = {"content": self._lp_chat_content(
+                        toks, lps, req.params.logprobs, pieces)}
+            else:
+                choice = {"index": i, "text": text,
+                          "finish_reason": finish_reason}
+                if req.params.logprobs is not None and lps:
+                    choice["logprobs"] = self._lp_completions_obj(
+                        toks, lps, req.params.logprobs, pieces)
             choices.append(choice)
             usage["prompt_tokens"] += fin.num_prompt_tokens
             usage["completion_tokens"] += fin.num_generated_tokens
         usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
         h._json(200, {
-            "id": reqs[0].request_id, "object": "text_completion",
+            "id": reqs[0].request_id,
+            "object": "chat.completion" if chat else "text_completion",
             "created": int(time.time()), "model": model,
             "choices": choices, "usage": usage,
         })

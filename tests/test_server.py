@@ -496,3 +496,49 @@ def test_logit_bias_and_min_tokens_api(server):
         raise AssertionError("expected HTTP 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_n_choices(server):
+    """OpenAI n: one independent sample per choice.  Greedy choices are
+    identical; seeded sampled choices differ (child seeds seed+j) while
+    the whole request stays reproducible."""
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 4,
+        "temperature": 0, "ignore_eos": True, "n": 3,
+    }) as r:
+        data = json.load(r)
+    texts = [c["text"] for c in data["choices"]]
+    assert len(texts) == 3 and len(set(texts)) == 1  # greedy: identical
+    assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+    assert data["usage"]["completion_tokens"] == 12
+
+    def sampled():
+        with _post(server, "/v1/completions", {
+            "model": "tiny-serve", "prompt": "hi", "max_tokens": 6,
+            "temperature": 1.0, "seed": 11, "ignore_eos": True, "n": 3,
+        }) as r:
+            return [c["text"] for c in json.load(r)["choices"]]
+
+    a = sampled()
+    assert len(set(a)) > 1          # distinct child seeds -> diverse
+    assert a == sampled()           # but reproducible end to end
+
+    # Chat n: message choices.
+    with _post(server, "/v1/chat/completions", {
+        "model": "tiny-serve",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 3, "temperature": 0, "ignore_eos": True, "n": 2,
+    }) as r:
+        data = json.load(r)
+    assert data["object"] == "chat.completion"
+    assert [c["message"]["role"] for c in data["choices"]] == ["assistant"] * 2
+
+    # Streaming with n > 1 is rejected, not silently single-choice.
+    try:
+        _post(server, "/v1/completions", {
+            "model": "tiny-serve", "prompt": "hi", "max_tokens": 2,
+            "stream": True, "n": 2,
+        })
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
