@@ -44,6 +44,14 @@ BASELINE_TOK_S_CHIP = 2000.0
 # ---------------------------------------------------------------------------
 
 
+def _shared_prefix(prefix_len: int) -> list:
+    """The one fixed pseudo-system-prompt every client shares — seeded so
+    the server-side primer and the client subprocess build the SAME ids."""
+    import random
+    return [random.Random(1234).randint(3, 200)
+            for _ in range(max(prefix_len, 0))]
+
+
 def _client_main(argv: list[str]) -> None:
     import argparse
     import http.client
@@ -57,6 +65,7 @@ def _client_main(argv: list[str]) -> None:
     ap.add_argument("--seconds", type=float, default=30.0)
     ap.add_argument("--max-tokens", type=int, default=256)
     ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--prefix-len", type=int, default=0)
     ap.add_argument("--probes", type=int, default=2)
     ap.add_argument("--probe-prompt-len", type=int, default=512)
     ap.add_argument("--probe-interval", type=float, default=0.5)
@@ -103,11 +112,16 @@ def _client_main(argv: list[str]) -> None:
                         toks = int(u.get("completion_tokens", 0))
         return toks, first
 
-    # Distinct random prompts defeat the prefix cache on purpose: this
-    # measures the no-reuse worst case (the prefix-cache win is measured
-    # separately where it can be controlled).
+    # Distinct random prompts defeat the prefix cache on purpose: the
+    # default measures the no-reuse worst case.  --prefix-len > 0 prepends
+    # a SHARED prefix (one fixed pseudo-system-prompt across every client)
+    # so the paged engine's on-device prefix sharing is exercised — the
+    # multi-turn / shared-system-prompt serving shape.
+    shared_prefix = _shared_prefix(args.prefix_len)
+
     def make_prompt(n: int) -> list[int]:
-        return [random.randint(3, 200) for _ in range(n)]
+        tail = [random.randint(3, 200) for _ in range(max(n - len(shared_prefix), 1))]
+        return shared_prefix + tail
 
     def worker() -> None:
         conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
@@ -198,7 +212,8 @@ def _scrape(port: int, names: tuple[str, ...]) -> dict[str, float]:
 
 def _run_moderate_phase(port: int, slots: int, seconds: float,
                         max_tokens: int, prompt_len: int, probe_len: int,
-                        n_chips: int, names: tuple[str, ...]) -> dict:
+                        n_chips: int, names: tuple[str, ...],
+                        prefix_len: int = 0) -> dict:
     """Second load phase at clients ~= slots/4: the north star's
     "p50 TTFT < 200ms under RPM load" is a moderate-load contract — the
     saturation phase answers a different question (TTFT at 100% slot
@@ -218,7 +233,8 @@ def _run_moderate_phase(port: int, slots: int, seconds: float,
          "--clients", str(mclients), "--seconds", str(mtotal),
          "--max-tokens", str(max_tokens),
          "--prompt-len", str(prompt_len),
-         "--probe-prompt-len", str(probe_len)],
+         "--probe-prompt-len", str(probe_len),
+         "--prefix-len", str(prefix_len)],
         stdout=subprocess.PIPE, text=True)
     try:
         time.sleep(ramp)
@@ -268,6 +284,15 @@ def run_serving_bench(model: str | None = None) -> dict:
     max_tokens = int(os.environ.get("ARKS_BENCH_SERVE_MAX_TOKENS", "256"))
     prompt_len = int(os.environ.get("ARKS_BENCH_SERVE_PROMPT_LEN", "128"))
     probe_len = int(os.environ.get("ARKS_BENCH_SERVE_PROBE_PROMPT_LEN", "512"))
+    # Shared-prefix length across all client prompts (0 = worst case, no
+    # reuse).  With the paged layout, hits skip the shared head's prefill
+    # entirely (table pointers at already-resident pages).
+    prefix_len = int(os.environ.get("ARKS_BENCH_SERVE_PREFIX_LEN", "0"))
+    if prefix_len and prefix_len >= prompt_len:
+        raise ValueError(
+            f"ARKS_BENCH_SERVE_PREFIX_LEN={prefix_len} must be smaller "
+            f"than the prompt length {prompt_len} (the prefix is part of "
+            "the prompt, not an addition to it)")
     weight_dtype = os.environ.get("ARKS_BENCH_WEIGHT_DTYPE", "int8")
     # Clients sit just under the slot count: probes then measure loaded
     # TTFT (decode saturated) without conflating it with slot queueing.
@@ -317,6 +342,27 @@ def run_serving_bench(model: str | None = None) -> dict:
         _one(plen, 0)
         print(f"# primed bucket {plen} at {time.monotonic()-t_prime:.0f}s",
               file=sys.stderr, flush=True)
+    if prefix_len:
+        # Two sequential shared-prefix prompts: the second takes the
+        # prefix-HIT path (digest match -> chunked tail prefill), whose
+        # jitted chunk/insert programs must not compile inside the
+        # measured window.
+        import random as _r
+        pre = _shared_prefix(prefix_len)
+        for seed in (51, 52):
+            rng = _r.Random(seed)
+            body = json.dumps({
+                "model": "bench",
+                "prompt": pre + [rng.randint(3, 200)
+                                 for _ in range(prompt_len - prefix_len)],
+                "max_tokens": steps + 1, "temperature": 0.0,
+                "ignore_eos": True}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=600).read()
+        print(f"# primed prefix path at {time.monotonic()-t_prime:.0f}s",
+              file=sys.stderr, flush=True)
     # Prime every admission-batch variant the ENGINE resolved (the ladder
     # is env-tunable — a swept M=16 program must not compile inside the
     # measurement window).
@@ -336,7 +382,8 @@ def run_serving_bench(model: str | None = None) -> dict:
          "--host", "127.0.0.1", "--port", str(server.port),
          "--clients", str(clients), "--seconds", str(total_s),
          "--max-tokens", str(max_tokens), "--prompt-len", str(prompt_len),
-         "--probe-prompt-len", str(probe_len)],
+         "--probe-prompt-len", str(probe_len),
+         "--prefix-len", str(prefix_len)],
         stdout=subprocess.PIPE, text=True)
     names = ("generation_tokens_total", "scheduler_seconds_total",
              "prefix_cache_hit_tokens_total",
@@ -363,7 +410,7 @@ def run_serving_bench(model: str | None = None) -> dict:
             try:
                 moderate = _run_moderate_phase(
                     server.port, slots, seconds, max_tokens, prompt_len,
-                    probe_len, n_chips, names)
+                    probe_len, n_chips, names, prefix_len)
             except Exception as e:
                 import traceback
                 traceback.print_exc()
@@ -396,10 +443,14 @@ def run_serving_bench(model: str | None = None) -> dict:
     dw_key = "decode_resolve_wait_seconds_total"
     device_wait = round((s1.get(dw_key, 0.0) - s0.get(dw_key, 0.0))
                         / (t1 - t0), 3)
+    hit0 = s0.get("prefix_cache_hit_tokens_total", 0.0)
+    hit1 = s1.get("prefix_cache_hit_tokens_total", 0.0)
     return {
         # Which engine path produced these numbers (kv layout, decode
         # impl, overlap...) — the resolved config, not the requested one.
         "serving_engine_config": engine.resolved_config,
+        "serving_prefix_len": prefix_len,
+        "serving_prefix_hit_tok_s": round((hit1 - hit0) / (t1 - t0), 1),
         "serving_tok_s_chip": round(tok_s_chip, 1),
         "serving_vs_baseline": round(tok_s_chip / BASELINE_TOK_S_CHIP, 3),
         "serving_ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 1)
