@@ -139,10 +139,11 @@ def test_per_kind_samples_parse_and_render():
 
 
 def test_flagship_examples_render():
-    """BASELINE.json configs #3 and #5 as checked-in examples: Llama-3-8B
-    TP over v5e-8, and Qwen2.5-72B on multi-host v5p-16 with an
-    Orbax-converting Model — both must load and render to gangs with the
-    right topology, size, and rendezvous env."""
+    """BASELINE.json configs #2, #3 and #5 as checked-in examples: the
+    north-star Qwen2.5-7B on one v5e chip, Llama-3-8B TP over v5e-8, and
+    Qwen2.5-72B on multi-host v5p-16 with an Orbax-converting Model — all
+    must load and render to gangs with the right topology, size, and
+    rendezvous env."""
     import glob
 
     from arks_tpu.control.__main__ import apply_manifests
@@ -151,12 +152,21 @@ def test_flagship_examples_render():
 
     store = Store()
     files = sorted(glob.glob("examples/flagship/*.yaml"))
-    assert len(files) == 2
+    assert len(files) == 3
     for f in files:
         apply_manifests(store, f)
     docs = render_store(store)
     sts = {d["metadata"]["name"]: d for d in docs
            if d["kind"] == "StatefulSet"}
+
+    # #2: the north-star perf config — one chip, one host, w-int8.
+    v5e1 = sts["arks-qwen25-7b-0"]
+    assert v5e1["spec"]["replicas"] == 1
+    pod1 = v5e1["spec"]["template"]["spec"]
+    c1 = pod1["containers"][0]
+    assert c1["resources"]["limits"]["google.com/tpu"] == "1"
+    assert "--weight-dtype" in c1["args"]
+    assert c1["args"][c1["args"].index("--weight-dtype") + 1] == "int8"
 
     # #3: v5e-8 = one host, 8 chips, tp=8; real-tokenizer weights arrive
     # via the Model's HF download (a Job in the render).
@@ -179,9 +189,11 @@ def test_flagship_examples_render():
     assert env["ARKS_NUM_PROCESSES"]["value"] == "2"
     assert "ARKS_COORDINATOR_ADDRESS" in env
 
-    # Both Models download from HF and convert to Orbax shards.
+    # All three Models download from HF and convert to Orbax shards.
     jobs = [d for d in docs if d["kind"] == "Job"]
-    assert len(jobs) == 2
+    assert len(jobs) == 3
+    assert any(j["metadata"]["name"] == "arks-worker-qwen25-7b"
+               for j in jobs)
     for j in jobs:
         jenv = {e["name"]: e.get("value") for e in
                 j["spec"]["template"]["spec"]["containers"][0]["env"]}
