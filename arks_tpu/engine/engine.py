@@ -519,7 +519,12 @@ class InferenceEngine:
 
         self._spec_proposed = 0
         self._spec_accepted = 0
-        self._queue: "queue.Queue[Request]" = queue.Queue()
+        # Admission queue: priority-ordered (lower value first), FIFO
+        # within a priority via a monotonic tiebreak — Request objects are
+        # never compared.
+        self._queue: "queue.PriorityQueue[tuple[int, int, Request]]" = \
+            queue.PriorityQueue()
+        self._queue_seq = 0
         self._queued_rids: set[str] = set()
         self._aborted: set[str] = set()
         self._abort_lock = threading.Lock()
@@ -869,7 +874,9 @@ class InferenceEngine:
         self.metrics.num_requests_waiting.inc(1)
         with self._abort_lock:
             self._queued_rids.add(request.request_id)
-        self._queue.put(request)
+            self._queue_seq += 1
+            seq = self._queue_seq
+        self._queue.put((request.params.priority, seq, request))
 
     def abort(self, request_id: str) -> None:
         """Free the request's slot at the next scheduler boundary (client
@@ -1165,7 +1172,7 @@ class InferenceEngine:
         if not worked:
             # Idle: wait briefly for a request, then try admission again.
             try:
-                req = self._queue.get(timeout=block_s)
+                _, _, req = self._queue.get(timeout=block_s)
             except queue.Empty:
                 return False
             pre = self._preadmit(req)
@@ -1221,7 +1228,7 @@ class InferenceEngine:
                 if n_grouped >= len(self._free):
                     break
                 try:
-                    req = self._queue.get_nowait()
+                    _, _, req = self._queue.get_nowait()
                 except queue.Empty:
                     break
                 admitted = True

@@ -30,6 +30,10 @@ class SamplingParams:
     # vLLM-style min_tokens: eos/stop token ids are suppressed on device
     # until at least this many tokens have been generated.
     min_tokens: int = 0
+    # Admission priority (vLLM semantics: LOWER value admits first; equal
+    # priorities stay FIFO).  Only ordering in the waiting queue changes —
+    # running slots are never preempted.
+    priority: int = 0
 
 
 @dataclasses.dataclass

@@ -116,6 +116,7 @@ def _sampling_from_body(body: dict, tokenizer,
         logprobs=None if n_lp is None else min(max(n_lp, 0), TOP_LOGPROBS_MAX),
         logit_bias=logit_bias,
         min_tokens=min_tokens,
+        priority=int(body.get("priority") or 0),
     )
     return params, stop_strings
 

@@ -415,3 +415,48 @@ def test_admit_batch_sizes_env_override(monkeypatch):
     for r in reqs:
         ids, fin = _collect(r)
         assert len(ids) == 4 and fin.finished
+
+
+def test_priority_admission_order():
+    """Waiting requests admit in priority order (lower value first, FIFO
+    within a priority); running slots are never preempted.  Driven
+    manually (no engine thread) so all three contenders are queued before
+    any admission step — the ordering is then purely the queue's."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=1, max_cache_len=64,
+                        prefill_buckets=(8,), steps_per_dispatch=2)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    hold = Request("hold", [3, 4], SamplingParams(
+        max_tokens=30, temperature=0.0, ignore_eos=True))
+    eng.add_request(hold)
+    for _ in range(50):
+        eng.step(block_s=0.01)
+        if eng.num_running == 1 and eng._queue.empty():
+            break
+    assert eng.num_running == 1  # hold occupies the single slot
+
+    def submit(rid, prio):
+        r = Request(rid, [5, 6], SamplingParams(
+            max_tokens=2, temperature=0.0, ignore_eos=True, priority=prio))
+        eng.add_request(r)
+        return r
+
+    low1 = submit("low-1", 5)
+    low2 = submit("low-2", 5)
+    high = submit("high", -1)
+    reqs = {"low-1": low1, "low-2": low2, "high": high}
+    order, pending = [], set(reqs)
+    for _ in range(600):
+        eng.step(block_s=0.01)
+        for name in list(pending):
+            try:
+                out = reqs[name].outputs.get_nowait()
+            except queue.Empty:
+                continue
+            if out.finished:
+                order.append(name)
+                pending.discard(name)
+        if not pending:
+            break
+    # One slot: completions happen in admission order.
+    assert order == ["high", "low-1", "low-2"]
