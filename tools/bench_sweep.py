@@ -49,6 +49,9 @@ def run_config(overrides: dict[str, str], timeout_s: float) -> dict:
     # config label must describe what actually ran).
     for key in SWEPT_KEYS:
         env.pop(key, None)
+    # Sweeps rank configs by saturation throughput; the moderate-load TTFT
+    # phase (~40s/config) belongs to the final bench, not the grid.
+    env["ARKS_BENCH_SERVE_MODERATE"] = "0"
     env.update(overrides)
     code = ("import json\n"
             "from bench_serving import run_serving_bench\n"
