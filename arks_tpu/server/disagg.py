@@ -133,6 +133,9 @@ class DecodeServer(OpenAIServer):
         if (body.get("n") or 1) != 1:
             return h._error(400,
                             "disaggregated serving does not support n > 1")
+        if body.get("echo"):
+            return h._error(400,
+                            "disaggregated serving does not support echo")
         # JSON round-trips the logprob entry as nested lists; restore the
         # engine's (chosen, [(id, lp), ...]) tuple shape.
         first_lp = meta.get("first_lp")

@@ -366,6 +366,11 @@ class OpenAIServer:
         if stream and (len(batch) > 1 or n > 1):
             return h._error(
                 400, "streaming is not supported for batched prompts or n > 1")
+        echo = bool(body.get("echo", False))
+        if echo and chat:
+            return h._error(400, "echo is a completions-only parameter")
+        if echo and stream:
+            return h._error(400, "echo is not supported with streaming")
 
         # Reject oversize prompts BEFORE queueing (OpenAI semantics: 400
         # context_length_exceeded — never silent truncation, which would
@@ -388,9 +393,11 @@ class OpenAIServer:
                 reqs.append(req)
 
         if len(reqs) > 1:
-            self._batch_response(h, reqs, model, stop_strings, chat=chat)
+            self._batch_response(h, reqs, model, stop_strings, chat=chat,
+                                 echo=echo)
         else:
-            self._respond(h, reqs[0], chat, model, body, stop_strings)
+            self._respond(h, reqs[0], chat, model, body, stop_strings,
+                          echo=echo)
 
     def _context_length_error(self, h, got: int, limit: int) -> None:
         h._json(400, {"error": {
@@ -401,7 +408,7 @@ class OpenAIServer:
         }})
 
     def _respond(self, h, req: Request, chat: bool, model: str, body: dict,
-                 stop_strings: list[str]) -> None:
+                 stop_strings: list[str], echo: bool = False) -> None:
         """Stream-or-full dispatch tail, shared with the disaggregated path."""
         if bool(body.get("stream", False)):
             include_usage = bool(
@@ -409,7 +416,7 @@ class OpenAIServer:
             self._stream_response(h, req, chat, model, include_usage,
                                   stop_strings)
         else:
-            self._full_response(h, req, chat, model, stop_strings)
+            self._full_response(h, req, chat, model, stop_strings, echo=echo)
 
     # ------------------------------------------------------------------
 
@@ -485,15 +492,17 @@ class OpenAIServer:
         return tokens[:keep], lps[:keep], kept
 
     def _lp_completions_obj(self, token_ids: list[int], lps: list,
-                            top_n: int, pieces: list[str] | None = None) -> dict:
+                            top_n: int, pieces: list[str] | None = None,
+                            offset_base: int = 0) -> dict:
         """Legacy completions logprobs object (tokens / token_logprobs /
         top_logprobs / text_offset).  ``pieces`` (per-token text from the
         response's own incremental stream) keeps text_offset aligned with
         the returned text; alternatives in top_logprobs are hypothetical
-        tokens with no stream context, so they decode in isolation."""
+        tokens with no stream context, so they decode in isolation.
+        ``offset_base`` shifts text_offset past echoed prompt text."""
         tok = self.engine.tokenizer
         tokens, token_lps, tops, offsets = [], [], [], []
-        off = 0
+        off = offset_base
         for i, (tid, (clp, top)) in enumerate(zip(token_ids, lps)):
             s = pieces[i] if pieces is not None and i < len(pieces) \
                 else tok.decode([tid])
@@ -527,11 +536,13 @@ class OpenAIServer:
         return out
 
     def _batch_response(self, h, reqs: list[Request], model: str,
-                        stop_strings: list[str], chat: bool = False) -> None:
+                        stop_strings: list[str], chat: bool = False,
+                        echo: bool = False) -> None:
         """Multi-choice responses: batched prompts and/or n > 1 (one
         engine request per choice, prompt-major indexes)."""
         choices, usage = [], {"prompt_tokens": 0, "completion_tokens": 0,
                               "total_tokens": 0}
+        echo_cache: dict = {}
         for i, req in enumerate(reqs):
             text, finish_reason, fin, toks, lps, pieces = self._collect_text(
                 req, stop_strings)
@@ -543,11 +554,20 @@ class OpenAIServer:
                     choice["logprobs"] = {"content": self._lp_chat_content(
                         toks, lps, req.params.logprobs, pieces)}
             else:
+                prefix = ""
+                if echo:
+                    key = tuple(req.prompt_ids)
+                    if key not in echo_cache:  # n children share one prompt
+                        echo_cache[key] = self.engine.tokenizer.decode(
+                            req.prompt_ids)
+                    prefix = echo_cache[key]
+                    text = prefix + text
                 choice = {"index": i, "text": text,
                           "finish_reason": finish_reason}
                 if req.params.logprobs is not None and lps:
                     choice["logprobs"] = self._lp_completions_obj(
-                        toks, lps, req.params.logprobs, pieces)
+                        toks, lps, req.params.logprobs, pieces,
+                        offset_base=len(prefix))
             choices.append(choice)
             usage["prompt_tokens"] += fin.num_prompt_tokens
             usage["completion_tokens"] += fin.num_generated_tokens
@@ -560,9 +580,15 @@ class OpenAIServer:
         })
 
     def _full_response(self, h, req: Request, chat: bool, model: str,
-                       stop_strings: list[str]) -> None:
+                       stop_strings: list[str], echo: bool = False) -> None:
         text, finish_reason, fin, toks, lps, pieces = self._collect_text(
             req, stop_strings)
+        echo_prefix = ""
+        if echo and not chat:
+            # OpenAI completions echo: the prompt text precedes the
+            # generated text in the same choice (non-stream only).
+            echo_prefix = self.engine.tokenizer.decode(req.prompt_ids)
+            text = echo_prefix + text
         if finish_reason == "error":
             # Engine-level rejection (defense for direct add_request users;
             # the HTTP path normally pre-checks).
@@ -593,7 +619,8 @@ class OpenAIServer:
                       "finish_reason": finish_reason}
             if n_lp is not None and lps:
                 choice["logprobs"] = self._lp_completions_obj(
-                    toks, lps, n_lp, pieces)
+                    toks, lps, n_lp, pieces,
+                    offset_base=len(echo_prefix))
             payload = {
                 "id": rid, "object": "text_completion", "created": int(time.time()),
                 "model": model, "choices": [choice], "usage": usage,

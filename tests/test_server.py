@@ -542,3 +542,39 @@ def test_n_choices(server):
         raise AssertionError("expected HTTP 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_echo_parameter(server):
+    """Completions echo=true prepends the prompt text to the choice
+    (non-stream only; chat and streaming reject it)."""
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 3,
+        "temperature": 0, "ignore_eos": True, "echo": True,
+    }) as r:
+        data = json.load(r)
+    text = data["choices"][0]["text"]
+    assert text.startswith("hi") and len(text) > 2
+    assert data["usage"]["completion_tokens"] == 3
+
+    # echo + logprobs: text_offset starts past the echoed prompt, so
+    # clients slicing choice.text by offset get the right substrings.
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 3,
+        "temperature": 0, "ignore_eos": True, "echo": True, "logprobs": 0,
+    }) as r:
+        lp = json.load(r)["choices"][0]["logprobs"]
+    assert lp["text_offset"][0] == len("hi")
+
+    for bad in ({"stream": True}, {"_chat_probe": True}):
+        body = {"model": "tiny-serve", "prompt": "hi", "max_tokens": 2,
+                "echo": True, **bad}
+        path = "/v1/completions"
+        if bad.get("_chat_probe"):
+            body = {"model": "tiny-serve", "max_tokens": 2, "echo": True,
+                    "messages": [{"role": "user", "content": "x"}]}
+            path = "/v1/chat/completions"
+        try:
+            _post(server, path, body)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
