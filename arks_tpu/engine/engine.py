@@ -870,7 +870,29 @@ class InferenceEngine:
     # Public API
     # ------------------------------------------------------------------
 
+    def min_tokens_suppress_ids(self, p) -> list[int]:
+        """Deduped token ids suppressed on device while a request is below
+        min_tokens (eos unless ignore_eos, plus stop_token_ids).  The ONE
+        definition shared by admission validation, _shape_cols, and the
+        HTTP validator — divergence would let np_suppress_col raise on the
+        engine thread, tripping _run's blanket fault handler."""
+        if p.min_tokens <= 0:
+            return []
+        stop: list[int] = []
+        if not p.ignore_eos:
+            stop += list(self.cfg.eos_token_ids)
+            stop += list(self.tokenizer.eos_token_ids)
+        stop += list(p.stop_token_ids)
+        return list(dict.fromkeys(stop))
+
     def add_request(self, request: Request) -> None:
+        # Validate the min_tokens suppress set HERE, on the caller's
+        # thread: np_suppress_col raising inside the scheduler would trip
+        # _run's blanket fault handler and abort every in-flight request,
+        # while a ValueError here fails only the offender (the HTTP layer
+        # 400s the same condition before it ever reaches the engine).
+        sampler_mod.np_suppress_col(
+            self.min_tokens_suppress_ids(request.params))
         self.metrics.num_requests_waiting.inc(1)
         with self._abort_lock:
             self._queued_rids.add(request.request_id)
@@ -892,10 +914,18 @@ class InferenceEngine:
     def stop(self) -> None:
         self._running = False
         if self._thread is not None:
-            self._thread.join(timeout=30)
-        # Deferred admissions left by a mid-flight stop: their clients
-        # would otherwise block forever (no scheduler remains to resolve).
-        self._abort_pending_admits()
+            self._thread.join(timeout=120.0)
+            if self._thread.is_alive():
+                # Engine thread wedged (e.g. a hung device call inside
+                # _resolve_admit_batch): _pending_admits/_pending_n/_free
+                # are engine-thread-owned, so touching them here would
+                # race a thread that may still wake up.  _run()'s finally
+                # aborts the deferred admissions itself if it ever exits.
+                log.warning(
+                    "engine thread did not exit within 120s; it aborts "
+                    "deferred admissions itself on exit")
+        # Deferred admissions are drained by _run()'s finally on the
+        # engine thread itself; a never-started engine has none.
 
     @property
     def num_running(self) -> int:
@@ -1045,6 +1075,17 @@ class InferenceEngine:
             os._exit(70)
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            # Loop exit (stop(), or a late wake-up after a wedged device
+            # call outlived stop()'s join window): no scheduler remains to
+            # resolve deferred admissions, so fail their clients here ON
+            # the engine thread — the only thread allowed to touch
+            # _pending_admits/_pending_n/_free.
+            self._abort_pending_admits()
+
+    def _run_loop(self) -> None:
         while self._running:
             try:
                 progressed = self.step()
@@ -1657,13 +1698,7 @@ class InferenceEngine:
         L is generated-token number L - num_prompt + 2); min_first is the
         transient first-token flag (sample's lengths=None reading)."""
         bias_ids, bias_vals = sampler_mod.np_bias_cols(p, self.cfg.vocab_size)
-        stop: list[int] = []
-        if p.min_tokens > 0:
-            if not p.ignore_eos:
-                stop += list(self.cfg.eos_token_ids)
-                stop += list(self.tokenizer.eos_token_ids)
-            stop += list(p.stop_token_ids)
-        sup = sampler_mod.np_suppress_col(dict.fromkeys(stop))
+        sup = sampler_mod.np_suppress_col(self.min_tokens_suppress_ids(p))
         min_first = 1 if p.min_tokens >= 1 else 0
         min_until = num_prompt + p.min_tokens - 1 if p.min_tokens > 0 else 0
         return bias_ids, bias_vals, sup, min_first, min_until

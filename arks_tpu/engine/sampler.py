@@ -65,8 +65,8 @@ def top_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray
     return chosen_lp, vals, ids.astype(jnp.int32)
 
 
-LOGIT_BIAS_MAX = 64   # OpenAI caps logit_bias at 300 keys; 64 covers the
-                      # practical range with a bounded device footprint.
+LOGIT_BIAS_MAX = 300  # full OpenAI logit_bias key budget; the bias pass
+                      # is lax.cond-gated so unbiased batches pay nothing.
 SUPPRESS_MAX = 8      # eos + stop_token_ids suppressed under min_tokens.
 
 
@@ -124,9 +124,18 @@ def np_bias_cols(params, vocab_size: int):
 
 
 def np_suppress_col(stop_ids) -> np.ndarray:
-    """Host-side [NS] suppress column for min_tokens; ids < 0 pad."""
+    """Host-side [NS] suppress column for min_tokens; ids < 0 pad.
+
+    Overflow raises instead of truncating: a silently-dropped id would let
+    that token end the stream before min_tokens (the HTTP layer 400s the
+    same condition; direct engine callers must fail just as loudly)."""
+    ids = list(dict.fromkeys(stop_ids))
+    if len(ids) > SUPPRESS_MAX:
+        raise ValueError(
+            f"min_tokens suppress set has {len(ids)} ids; at most "
+            f"{SUPPRESS_MAX} eos/stop token ids are supported")
     col = np.full((SUPPRESS_MAX,), -1, np.int32)
-    for i, tid in enumerate(list(stop_ids)[:SUPPRESS_MAX]):
+    for i, tid in enumerate(ids):
         col[i] = tid
     return col
 

@@ -30,13 +30,25 @@ from arks_tpu.engine.tokenizer import IncrementalDetokenizer
 from arks_tpu.engine.types import Request, SamplingParams
 
 
-def _find_stop(text: str, stop_strings: list[str]) -> int | None:
-    """Earliest index at which any stop string begins, else None."""
+def _find_stop(text: str, stop_strings: list[str], min_end: int = 0
+               ) -> int | None:
+    """Earliest index at which any stop string begins, else None.
+
+    A match whose END falls at or before ``min_end`` is ignored: text
+    before that boundary was generated under min_tokens and is exempt
+    from stopping, but a stop straddling the boundary still counts."""
     best = None
     for s in stop_strings:
-        i = text.find(s)
-        if i >= 0 and (best is None or i < best):
-            best = i
+        start = 0
+        while True:
+            i = text.find(s, start)
+            if i < 0:
+                break
+            if i + len(s) > min_end:
+                if best is None or i < best:
+                    best = i
+                break
+            start = i + 1
     return best
 
 
@@ -92,17 +104,6 @@ def _sampling_from_body(body: dict, tokenizer,
             raise ValueError(
                 f"logit_bias token ids out of range [0, {vocab}): {bad[:5]}")
     min_tokens = max(int(body.get("min_tokens", 0)), 0)
-    if engine is not None and min_tokens:
-        from arks_tpu.engine.sampler import SUPPRESS_MAX
-        sup = [] if body.get("ignore_eos") else (
-            list(engine.cfg.eos_token_ids)
-            + list(engine.tokenizer.eos_token_ids))
-        sup += stop_ids
-        if len(dict.fromkeys(sup)) > SUPPRESS_MAX:
-            raise ValueError(
-                f"min_tokens supports at most {SUPPRESS_MAX} eos/stop "
-                "token ids to suppress (silently dropping one could end "
-                "the stream before the minimum)")
     params = SamplingParams(
         max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 256),
         temperature=float(body.get("temperature", 1.0)),
@@ -118,6 +119,16 @@ def _sampling_from_body(body: dict, tokenizer,
         min_tokens=min_tokens,
         priority=int(body.get("priority") or 0),
     )
+    if engine is not None and min_tokens:
+        # Same composition the engine admits with (min_tokens_suppress_ids
+        # is the single source of truth): reject oversized suppress sets
+        # with a 400 here instead of a late engine-side ValueError.
+        from arks_tpu.engine.sampler import SUPPRESS_MAX
+        if len(engine.min_tokens_suppress_ids(params)) > SUPPRESS_MAX:
+            raise ValueError(
+                f"min_tokens supports at most {SUPPRESS_MAX} eos/stop "
+                "token ids to suppress (silently dropping one could end "
+                "the stream before the minimum)")
     return params, stop_strings
 
 
@@ -436,13 +447,28 @@ class OpenAIServer:
         tokens: list[int] = []
         lps: list = []
         pieces: list[str] = []
+        # min_tokens defers ALL stops (vLLM semantics): text generated
+        # before the minimum (length ``exempt``) is exempt from stop
+        # matching; _find_stop still cuts a stop straddling the boundary.
+        min_tok = int(getattr(req.params, "min_tokens", 0) or 0)
+        exempt = 0
         while True:
             out = req.outputs.get()
+            start_len = len(tokens)
             if track:
-                for t in out.token_ids:
+                for j, t in enumerate(out.token_ids):
                     piece = detok.push([t])
                     text += piece
                     pieces.append(piece)
+                    if stop_strings and start_len + j + 1 < min_tok:
+                        exempt = len(text)
+            elif stop_strings and start_len < min_tok:
+                # Token-wise pushes while below min_tokens so the exemption
+                # boundary lands on the exact token, not the chunk.
+                for j, t in enumerate(out.token_ids):
+                    text += detok.push([t])
+                    if start_len + j + 1 < min_tok:
+                        exempt = len(text)
             else:
                 text += detok.push(out.token_ids)
             tokens.extend(out.token_ids)
@@ -455,8 +481,8 @@ class OpenAIServer:
                     # Window residue resolves after the last token; for
                     # offset/trim purposes it belongs to that token.
                     pieces[-1] += tail
-            if stop_strings:
-                cut = _find_stop(text, stop_strings)
+            if stop_strings and len(tokens) >= min_tok:
+                cut = _find_stop(text, stop_strings, min_end=exempt)
                 if cut is not None:
                     text = text[:cut]
                     if not out.finished:
@@ -715,24 +741,42 @@ class OpenAIServer:
         # across chunk boundaries (a stop string can straddle two deltas).
         pending = ""
         hold = max((len(s) for s in stop_strings), default=1) - 1
+        # min_tokens defers ALL stops (vLLM semantics); ``exempt`` is the
+        # pending-relative boundary below which text is exempt from
+        # stop-string matching (_find_stop still cuts a stop whose end
+        # crosses the boundary).
+        min_tok = int(getattr(req.params, "min_tokens", 0) or 0)
+        ntok = 0
+        exempt = 0
         try:
             if chat:
                 send_frame(chunk(None, role="assistant"))
             while True:
                 out = req.outputs.get()
+                prev_ntok = ntok
+                ntok += len(out.token_ids)
                 if n_lp is not None:
                     # Per-token pushes through the same stream keep logprob
                     # entries aligned with real text boundaries (see
                     # _collect_text); chunk-wise push stays the no-logprobs
                     # hot path.
-                    for t in out.token_ids:
+                    for j, t in enumerate(out.token_ids):
                         piece = detok.push([t])
                         pending += piece
                         if out.logprobs:
                             pend_pieces.append(piece)
+                        if stop_strings and prev_ntok + j + 1 < min_tok:
+                            exempt = len(pending)
                     if out.logprobs:
                         pend_lp_toks.extend(out.token_ids)
                         pend_lps.extend(out.logprobs)
+                elif stop_strings and prev_ntok < min_tok:
+                    # Token-wise pushes while below min_tokens so the
+                    # stop-exemption boundary lands on the exact token.
+                    for j, t in enumerate(out.token_ids):
+                        pending += detok.push([t])
+                        if prev_ntok + j + 1 < min_tok:
+                            exempt = len(pending)
                 else:
                     pending += detok.push(out.token_ids)
                 if out.finished:
@@ -743,8 +787,8 @@ class OpenAIServer:
                     pending += tail
                     if pend_pieces and tail:
                         pend_pieces[-1] += tail
-                if stop_strings:
-                    cut = _find_stop(pending, stop_strings)
+                if stop_strings and ntok >= min_tok:
+                    cut = _find_stop(pending, stop_strings, min_end=exempt)
                     if cut is not None:
                         # Drop only the logprob entries whose text falls
                         # PAST the cut; kept entries flush with the cut
@@ -778,6 +822,7 @@ class OpenAIServer:
                     send_frame(chunk(pending[:safe]))
                     lp_flush_n[0] = None
                     pending = pending[safe:]
+                    exempt = max(0, exempt - safe)
             if include_usage and fin is not None:
                 usage = {
                     "prompt_tokens": fin.num_prompt_tokens,

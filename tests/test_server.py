@@ -498,6 +498,74 @@ def test_logit_bias_and_min_tokens_api(server):
         assert e.code == 400
 
 
+def test_min_tokens_defers_stop_strings(server):
+    """vLLM semantics: stop strings do not terminate or cut the stream
+    until min_tokens completion tokens exist; text generated before the
+    minimum is exempt from matching (the min-th token itself can stop)."""
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    ch = ByteTokenizer().decode([123])
+    # Two-char stop -> multi-token, so it is matched server-side as a
+    # string (single-token stops become device stop ids instead).
+    body = {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 8,
+        "temperature": 0, "ignore_eos": True, "min_tokens": 4,
+        "logit_bias": {"123": 100}, "stop": [ch * 2],
+    }
+    with _post(server, "/v1/completions", body) as r:
+        data = json.load(r)
+    # Tokens 1-3 are exempt; the stop spanning tokens 3-4 matches (the
+    # min-th token may complete a stop) and cuts at position 2.
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert data["choices"][0]["text"] == ch * 2
+
+    frames = []
+    with _post(server, "/v1/completions", {**body, "stream": True}) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[len("data: "):])
+    chunks = [json.loads(f) for f in frames[:-1]]
+    text = "".join(c["choices"][0]["text"] for c in chunks if c["choices"])
+    finishes = [c["choices"][0]["finish_reason"] for c in chunks if c["choices"]]
+    assert text == ch * 2
+    assert "stop" in finishes
+
+
+def test_find_stop_min_end_exemption():
+    """A stop match ending at or before min_end is exempt, regardless of
+    OTHER (longer) stop strings in the set; a straddling match cuts."""
+    from arks_tpu.server.openai_server import _find_stop
+    # "ab" lies wholly inside the exempt region: a longer stop in the set
+    # must not widen the window and resurrect it.
+    assert _find_stop("xxabyy", ["ab", "xxxxx"], min_end=4) is None
+    # Straddle: the match's end crosses the boundary.
+    assert _find_stop("xxabyy", ["ab"], min_end=3) == 2
+    # A later, non-exempt occurrence is still found.
+    assert _find_stop("abzzab", ["ab"], min_end=4) == 4
+    # min_end=0 keeps the plain earliest-match behavior.
+    assert _find_stop("zab", ["ab"], min_end=0) == 1
+
+
+def test_engine_rejects_oversized_suppress_set():
+    """add_request validates the min_tokens suppress budget on the CALLER's
+    thread; overflowing inside the scheduler would abort every in-flight
+    request (engine._run's blanket fault handler)."""
+    from arks_tpu.engine.sampler import SUPPRESS_MAX, np_suppress_col
+    from arks_tpu.engine.types import Request, SamplingParams
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8,), steps_per_dispatch=2)
+    engine = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    params = SamplingParams(
+        max_tokens=4, min_tokens=2, ignore_eos=True,
+        stop_token_ids=tuple(range(SUPPRESS_MAX + 1)))
+    req = Request(request_id="over", prompt_ids=[1, 2], params=params)
+    with pytest.raises(ValueError, match="suppress set"):
+        engine.add_request(req)
+    with pytest.raises(ValueError, match="suppress set"):
+        np_suppress_col(range(SUPPRESS_MAX + 1))
+
+
 def test_n_choices(server):
     """OpenAI n: one independent sample per choice.  Greedy choices are
     identical; seeded sampled choices differ (child seeds seed+j) while
