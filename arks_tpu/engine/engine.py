@@ -397,6 +397,18 @@ class InferenceEngine:
             engine_cfg.num_slots, engine_cfg.seed,
             vocab_size=cfg.vocab_size)
 
+        # Guided decoding: compiler owns the host tables; fixed-budget
+        # device copies are allocated up front so compiling a guide later
+        # never changes program shapes (no mid-serving retrace).  The
+        # engine thread re-uploads CONTENTS when the version bumps.
+        from arks_tpu.engine.guides import GuideCompiler
+        eos_all = tuple(dict.fromkeys(
+            list(cfg.eos_token_ids) + list(tokenizer.eos_token_ids)))
+        self.guides = GuideCompiler(tokenizer, cfg.vocab_size, eos_all)
+        self._guide_dev = (jnp.asarray(self.guides.class_ids),
+                           jnp.asarray(self.guides.trans))
+        self._guide_ver = self.guides.version
+
         # Host-authoritative mirrors.
         self._lengths = np.zeros((engine_cfg.num_slots,), np.int32)
         self._last_token = np.zeros((engine_cfg.num_slots,), np.int32)
@@ -637,12 +649,14 @@ class InferenceEngine:
 
         def prefill_detached_prog(params, tokens, length, temperature,
                                   top_p, top_k, key, bias_ids, bias_vals,
-                                  sup_ids, min_first, want_lp: bool):
+                                  sup_ids, min_first, guide, guide_row,
+                                  gtables, want_lp: bool):
             logits, ks, vs = model_prefill(params, tokens, length)
             state = sampler_mod.transient_state(
                 temperature, top_p, top_k, key, cfg.vocab_size,
-                bias_ids, bias_vals, sup_ids, min_first)
-            ids, _ = sampler_mod.sample(logits, state)
+                bias_ids, bias_vals, sup_ids, min_first,
+                guide=guide, guide_row=guide_row)
+            ids, _ = sampler_mod.sample(logits, state, guide_tables=gtables)
             ks, vs = _replicate(ks), _replicate(vs)
             if want_lp:
                 clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
@@ -665,12 +679,14 @@ class InferenceEngine:
         def admit_batch(params, cache, sampling, tokens, lengths, slots,
                         pages, n_pages, temps, top_ps, top_ks, keys, pres,
                         freqs, bias_ids, bias_vals, sup_ids, min_first,
-                        min_until, want_lp: bool):
+                        min_until, guide, guide_row, gtables, want_lp: bool):
             logits, ks, vs = model_prefill(params, tokens, lengths)
             tstate = sampler_mod.transient_state_batch(
                 temps, top_ps, top_ks, keys, cfg.vocab_size,
-                bias_ids, bias_vals, sup_ids, min_first)
-            ids, _ = sampler_mod.sample(logits, tstate)
+                bias_ids, bias_vals, sup_ids, min_first,
+                guide=guide, guide_row=guide_row)
+            ids, tstate = sampler_mod.sample(logits, tstate,
+                                             guide_tables=gtables)
             if self._paged:
                 # Buckets smaller than a page: pad T up so the page-insert
                 # loop can slice whole pages (tail rows masked by length).
@@ -686,9 +702,12 @@ class InferenceEngine:
             else:
                 cache = tf.insert_batch(cache, ks, vs, slots)
             fold = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+            # tstate's guide_row was advanced by the first sampled token —
+            # the decode loop continues the DFA from there.
             sampling = sampler_mod.set_slots(
                 sampling, slots, temps, top_ps, top_ks, fold, pres, freqs,
-                bias_ids, bias_vals, sup_ids, min_until)
+                bias_ids, bias_vals, sup_ids, min_until,
+                guide=guide, guide_row=tstate.guide_row)
             if want_lp:
                 clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
                 return ids, clp, vals, lids, cache, sampling, ks, vs
@@ -714,21 +733,25 @@ class InferenceEngine:
                                             donate_argnums=(0,))
 
         def sample_one(logits, temperature, top_p, top_k, key,
-                       bias_ids, bias_vals, sup_ids, min_first):
+                       bias_ids, bias_vals, sup_ids, min_first,
+                       guide, guide_row, gtables):
             state = sampler_mod.transient_state(
                 temperature, top_p, top_k, key, cfg.vocab_size,
-                bias_ids, bias_vals, sup_ids, min_first)
-            ids, _ = sampler_mod.sample(logits, state)
+                bias_ids, bias_vals, sup_ids, min_first,
+                guide=guide, guide_row=guide_row)
+            ids, _ = sampler_mod.sample(logits, state, guide_tables=gtables)
             return ids[0]
 
         self._sample_one_fn = jax.jit(sample_one)
 
         def sample_one_lp(logits, temperature, top_p, top_k, key,
-                          bias_ids, bias_vals, sup_ids, min_first):
+                          bias_ids, bias_vals, sup_ids, min_first,
+                          guide, guide_row, gtables):
             state = sampler_mod.transient_state(
                 temperature, top_p, top_k, key, cfg.vocab_size,
-                bias_ids, bias_vals, sup_ids, min_first)
-            ids, _ = sampler_mod.sample(logits, state)
+                bias_ids, bias_vals, sup_ids, min_first,
+                guide=guide, guide_row=guide_row)
+            ids, _ = sampler_mod.sample(logits, state, guide_tables=gtables)
             clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
             return ids[0], clp[0], vals[0], lids[0]
 
@@ -753,7 +776,8 @@ class InferenceEngine:
         # and its registration — see _drain_ready_admits).
         sentinel = self._park_sentinel()
 
-        def decode_loop(params, cache, tokens, lengths, sstate, tables):
+        def decode_loop(params, cache, tokens, lengths, sstate, tables,
+                        gtables):
             def body(carry, _):
                 cache, tokens, lengths, sstate = carry
                 active = lengths < sentinel
@@ -764,7 +788,8 @@ class InferenceEngine:
                 logits, cache = model_decode(params, cache, tokens, lengths,
                                              tables)
                 nxt, sstate = sampler_mod.sample(logits, sstate, active,
-                                                 lengths)
+                                                 lengths,
+                                                 guide_tables=gtables)
                 return (cache, nxt, lengths + 1, sstate), nxt
 
             (cache, tokens, lengths, sstate), toks = jax.lax.scan(
@@ -773,7 +798,8 @@ class InferenceEngine:
 
         self._decode_fn = jax.jit(decode_loop, donate_argnums=(1, 4))
 
-        def decode_loop_lp(params, cache, tokens, lengths, sstate, tables):
+        def decode_loop_lp(params, cache, tokens, lengths, sstate, tables,
+                           gtables):
             # The logprob variant: selected per dispatch when any live slot
             # asked for logprobs (separate compiled program — the common
             # case never pays the full-vocab log-softmax).
@@ -784,7 +810,8 @@ class InferenceEngine:
                 logits, cache = model_decode(params, cache, tokens, lengths,
                                              tables)
                 nxt, sstate = sampler_mod.sample(logits, sstate, active,
-                                                 lengths)
+                                                 lengths,
+                                                 guide_tables=gtables)
                 clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
                 return (cache, nxt, lengths + 1, sstate), (nxt, clp, vals, lids)
 
@@ -806,7 +833,7 @@ class InferenceEngine:
                                              donate_argnums=(1,))
 
             def spec_loop(params, dparams, cache, dcache, tokens, lengths,
-                          sstate, enable, tables, want_lp: bool):
+                          sstate, enable, tables, gtables, want_lp: bool):
                 # Feed-time counting (as in the fused loop): spec-DISABLED
                 # penalized slots advance one normally-sampled token per
                 # dispatch, so their counts must evolve; eligible slots are
@@ -846,9 +873,10 @@ class InferenceEngine:
                 # sized, where paging buys nothing.
                 vlogits, cache = tf.verify_step(params, cfg, cache, block,
                                                 lengths, mesh, tables=tables)
-                out, counts, keys = sampler_mod.speculative_accept(
+                out, counts, keys, grow = sampler_mod.speculative_accept(
                     drafts, q_sel, q_probs, q_idx, vlogits, sstate, keys,
-                    enable=enable, lengths=lengths)
+                    enable=enable, lengths=lengths, guide_tables=gtables)
+                sstate = sstate._replace(key=keys, guide_row=grow)
                 if want_lp:
                     # Raw-distribution logprobs for the ONE token each
                     # disabled lp slot advanced (enabled slots never carry
@@ -856,8 +884,8 @@ class InferenceEngine:
                     clp, vals, lids = sampler_mod.top_logprobs(
                         vlogits[:, 0], out[:, 0])
                     return (cache, dcache, out, counts,
-                            sstate._replace(key=keys), clp, vals, lids)
-                return cache, dcache, out, counts, sstate._replace(key=keys)
+                            sstate, clp, vals, lids)
+                return cache, dcache, out, counts, sstate
 
             self._spec_fn = jax.jit(
                 functools.partial(spec_loop, want_lp=False),
@@ -893,6 +921,12 @@ class InferenceEngine:
         # 400s the same condition before it ever reaches the engine).
         sampler_mod.np_suppress_col(
             self.min_tokens_suppress_ids(request.params))
+        if request.params.guide is not None:
+            # Compile on the CALLER's thread: guide compilation is
+            # seconds-scale for a cold pattern (cached after), which must
+            # never stall the scheduler; bad patterns raise GuideError
+            # (ValueError) here instead of faulting the engine.
+            self.guides.compile(*request.params.guide)
         self.metrics.num_requests_waiting.inc(1)
         with self._abort_lock:
             self._queued_rids.add(request.request_id)
@@ -1053,6 +1087,24 @@ class InferenceEngine:
             return shard_paged_cache_pp(cache, self.mesh)
         return tf.shard_paged_cache(cache, self.cfg, self.mesh)
 
+    def _ensure_guides_uploaded(self) -> None:
+        """Refresh the device guide tables when the compiler's version
+        bumped (server threads compile guides on THEIR threads; only the
+        upload happens here, on the engine thread, between dispatches).
+        Multi-host: the leader replicates the host tables first so
+        followers re-upload the same contents before mirroring the next
+        dispatch."""
+        if self._guide_ver == self.guides.version:
+            return
+        with self.guides._lock:
+            cls_host = self.guides.class_ids.copy()
+            trans_host = self.guides.trans.copy()
+            ver = self.guides.version
+        self._emit("guides", class_ids=cls_host, trans=trans_host,
+                   version=ver)
+        self._guide_dev = (jnp.asarray(cls_host), jnp.asarray(trans_host))
+        self._guide_ver = ver
+
     def _emit(self, op: str, **payload) -> None:
         """Broadcast a device dispatch to follower processes (multi-host);
         no-op single-host.  MUST precede the local dispatch at every site —
@@ -1170,6 +1222,7 @@ class InferenceEngine:
         the shared device stream land in whichever phase fetches first —
         the breakdown attributes WALL time, not device time."""
         t0 = time.monotonic()
+        self._ensure_guides_uploaded()
         pending = None
         worked = False
         if self._slots and self._draft_cfg is None and self._overlap:
@@ -1422,6 +1475,10 @@ class InferenceEngine:
         """Issue ONE fused dispatch admitting ``len(items)`` one-shot
         prompts (same bucket).  Returns the pending record for
         _resolve_admit_batch."""
+        # Guides compile on SERVER threads: a request added after this
+        # step's top-of-loop table refresh would otherwise run its admit
+        # with the pre-compile tables (everything masked -> instant eos).
+        self._ensure_guides_uploaded()
         m = len(items)
         page = self._page_size() if self._paged else 0
         tokens = np.concatenate([padded for _, _, padded in items], axis=0)
@@ -1437,6 +1494,8 @@ class InferenceEngine:
         sup_ids = np.full((m, sampler_mod.SUPPRESS_MAX), -1, np.int32)
         min_first = np.zeros((m,), np.int32)
         min_until = np.zeros((m,), np.int32)
+        guide_col = np.full((m,), -1, np.int32)
+        guide_row_col = np.zeros((m,), np.int32)
         try:
             for i, (req, ids, _) in enumerate(items):
                 p = req.params
@@ -1464,6 +1523,7 @@ class InferenceEngine:
                 if p.logit_bias or p.min_tokens:
                     (bias_ids[i], bias_vals[i], sup_ids[i], min_first[i],
                      min_until[i]) = self._shape_cols(p, len(ids))
+                guide_col[i], guide_row_col[i] = self._guide_cols(p)
             slots = np.asarray(slots_l, np.int32)
             self._emit("admit_batch_lp" if want_lp else "admit_batch",
                        tokens=tokens, lengths=lengths, slots=slots,
@@ -1476,7 +1536,8 @@ class InferenceEngine:
                        frequency=params_cols["frequency"],
                        bias_ids=bias_ids, bias_vals=bias_vals,
                        sup_ids=sup_ids, min_first=min_first,
-                       min_until=min_until)
+                       min_until=min_until, guide=guide_col,
+                       guide_row=guide_row_col)
             args = (self.params, self._cache, self._sampling,
                     jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(slots),
@@ -1490,7 +1551,8 @@ class InferenceEngine:
                     jnp.asarray(params_cols["frequency"]),
                     jnp.asarray(bias_ids), jnp.asarray(bias_vals),
                     jnp.asarray(sup_ids), jnp.asarray(min_first),
-                    jnp.asarray(min_until))
+                    jnp.asarray(min_until), jnp.asarray(guide_col),
+                    jnp.asarray(guide_row_col), self._guide_dev)
             if want_lp:
                 (first_ids, clps, valss, lidss, self._cache, self._sampling,
                  ks, vs) = self._admit_lp_fn(*args)
@@ -1549,7 +1611,8 @@ class InferenceEngine:
                 self._free.append(slot)
                 p = req.params
                 if (p.presence_penalty or p.frequency_penalty
-                        or p.logit_bias or p.min_tokens):
+                        or p.logit_bias or p.min_tokens
+                        or p.guide is not None):
                     # Re-arm shaped()'s fast paths (same as _finish): the
                     # admit program already wrote this slot's shaping rows.
                     self._emit("clear_penalties", slot=slot)
@@ -1660,6 +1723,10 @@ class InferenceEngine:
                            v=np.asarray(v))
                 self._cache = self._insert_fn(self._cache, k, v,
                                               jnp.asarray(slot))
+            gid, start = self._guide_cols(p)
+            # pf.guide_row is RELATIVE to the guide's start state; rebase
+            # onto THIS engine's table (compile orders may differ).
+            grow = start + pf.guide_row if gid >= 0 else 0
             self._emit("set_slot", slot=slot, temperature=p.temperature,
                        top_p=p.top_p, top_k=p.top_k, seed=pf.seed,
                        presence=p.presence_penalty,
@@ -1668,9 +1735,10 @@ class InferenceEngine:
                        min_tokens=p.min_tokens,
                        stop_ids=list(p.stop_token_ids),
                        ignore_eos=p.ignore_eos,
-                       num_prompt=pf.num_prompt)
+                       num_prompt=pf.num_prompt, guide=gid, guide_row=grow)
             self._apply_set_slot(slot, p, jax.random.fold_in(key, 1),
-                                 num_prompt=pf.num_prompt)
+                                 num_prompt=pf.num_prompt, guide=gid,
+                                 guide_row=grow)
         except Exception:
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
@@ -1703,10 +1771,25 @@ class InferenceEngine:
         min_until = num_prompt + p.min_tokens - 1 if p.min_tokens > 0 else 0
         return bias_ids, bias_vals, sup, min_first, min_until
 
-    def _apply_set_slot(self, slot: int, p, key, num_prompt: int = 0) -> None:
+    def _guide_cols(self, p) -> tuple[int, int]:
+        """(guide_id, start_row) for a request's guide spec, (-1, 0) when
+        unguided.  Resolves through the local compiler registry — the
+        HTTP layer compiles at add_request on ITS thread, so this is a
+        dict hit; compile() here covers direct engine callers (idempotent,
+        caller-thread-safe, raises GuideError -> the admission fault path
+        fails just this request)."""
+        if p.guide is None:
+            return -1, 0
+        g = self.guides.compile(*p.guide)
+        return g.guide_id, g.start_row
+
+    def _apply_set_slot(self, slot: int, p, key, num_prompt: int = 0,
+                        guide: int = -1, guide_row: int = 0) -> None:
         """Write one slot's sampling params through the donated jit (array
         args keep one compiled program across requests; python floats would
-        retrace per distinct value)."""
+        retrace per distinct value).  ``guide_row`` is the POST-first-token
+        DFA row (resolved by the caller — followers receive it by value, so
+        they never need the leader's guide registry)."""
         bias_ids, bias_vals, sup, _mf, min_until =             self._shape_cols(p, num_prompt)
         self._sampling = self._set_slot_fn(
             self._sampling, jnp.asarray(slot, jnp.int32),
@@ -1716,7 +1799,8 @@ class InferenceEngine:
             jnp.asarray(p.presence_penalty, jnp.float32),
             jnp.asarray(p.frequency_penalty, jnp.float32),
             jnp.asarray(bias_ids), jnp.asarray(bias_vals),
-            jnp.asarray(sup), jnp.asarray(min_until, jnp.int32))
+            jnp.asarray(sup), jnp.asarray(min_until, jnp.int32),
+            jnp.asarray(guide, jnp.int32), jnp.asarray(guide_row, jnp.int32))
 
     def _register_slot(self, req: Request, slot: int, first: int,
                        num_prompt: int, first_lp=None) -> None:
@@ -1948,16 +2032,21 @@ class InferenceEngine:
         # one-shot prefill_and_sample) and promote the slot to decoding.
         p = st.request.params
         bias_ids, bias_vals, sup, min_first, _mu = self._shape_cols(p, 0)
+        gid, grow0 = self._guide_cols(p)
+        self._ensure_guides_uploaded()  # see _issue_admit_batch
         args = (logits, jnp.float32(p.temperature), jnp.float32(p.top_p),
                 jnp.int32(p.top_k), st.key,
                 jnp.asarray(bias_ids), jnp.asarray(bias_vals),
-                jnp.asarray(sup), jnp.asarray(min_first, jnp.int32))
+                jnp.asarray(sup), jnp.asarray(min_first, jnp.int32),
+                jnp.asarray(gid, jnp.int32), jnp.asarray(grow0, jnp.int32),
+                self._guide_dev)
         first_lp = None
         if p.logprobs is not None:
             self._emit("sample_one_lp", temperature=p.temperature,
                        top_p=p.top_p, top_k=p.top_k, seed=st.seed,
                        bias_ids=bias_ids, bias_vals=bias_vals,
-                       sup_ids=sup, min_first=min_first)
+                       sup_ids=sup, min_first=min_first,
+                       guide=gid, guide_row=grow0)
             fid, clp, vals, lids = self._sample_one_lp_fn(*args)
             first = int(fid)
             first_lp = self._lp_entry(clp, vals, lids, p.logprobs)
@@ -1965,17 +2054,20 @@ class InferenceEngine:
             self._emit("sample_one", temperature=p.temperature, top_p=p.top_p,
                        top_k=p.top_k, seed=st.seed,
                        bias_ids=bias_ids, bias_vals=bias_vals,
-                       sup_ids=sup, min_first=min_first)
+                       sup_ids=sup, min_first=min_first,
+                       guide=gid, guide_row=grow0)
             first = int(self._sample_one_fn(*args))
         del self._prefilling[slot]
+        grow1 = self.guides.next_row(grow0, first) if gid >= 0 else 0
         self._emit("set_slot", slot=slot, temperature=p.temperature,
                    top_p=p.top_p, top_k=p.top_k, seed=st.seed,
                    presence=p.presence_penalty, frequency=p.frequency_penalty,
                    logit_bias=list(p.logit_bias), min_tokens=p.min_tokens,
                    stop_ids=list(p.stop_token_ids), ignore_eos=p.ignore_eos,
-                   num_prompt=len(st.ids))
+                   num_prompt=len(st.ids), guide=gid, guide_row=grow1)
         self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1),
-                             num_prompt=len(st.ids))
+                             num_prompt=len(st.ids), guide=gid,
+                             guide_row=grow1)
         self._register_slot(st.request, slot, first, len(st.ids),
                             first_lp=first_lp)
         if self._paged and self._chunk:
@@ -2026,19 +2118,24 @@ class InferenceEngine:
             key = jnp.asarray(sampler_mod.np_prng_key(seed))
             bias_ids, bias_vals, sup, min_first, _mu = \
                 self._shape_cols(params, 0)
+            gid, grow0 = self._guide_cols(params)
+            self._ensure_guides_uploaded()
             args = (self.params, jnp.asarray(padded),
                     jnp.asarray([len(ids)], jnp.int32),
                     jnp.float32(params.temperature),
                     jnp.float32(params.top_p),
                     jnp.int32(params.top_k), key,
                     jnp.asarray(bias_ids), jnp.asarray(bias_vals),
-                    jnp.asarray(sup), jnp.asarray(min_first, jnp.int32))
+                    jnp.asarray(sup), jnp.asarray(min_first, jnp.int32),
+                    jnp.asarray(gid, jnp.int32),
+                    jnp.asarray(grow0, jnp.int32), self._guide_dev)
             if want_lp:
                 self._emit("prefill_detached_lp", tokens=padded,
                            length=len(ids), temperature=params.temperature,
                            top_p=params.top_p, top_k=params.top_k, seed=seed,
                            bias_ids=bias_ids, bias_vals=bias_vals,
-                           sup_ids=sup, min_first=min_first)
+                           sup_ids=sup, min_first=min_first,
+                           guide=gid, guide_row=grow0)
                 first_id, clp, vals, lids, ks, vs = \
                     self._prefill_detached_lp_fn(*args)
                 first_lp = self._lp_entry(clp, vals, lids, params.logprobs)
@@ -2047,13 +2144,16 @@ class InferenceEngine:
                            length=len(ids), temperature=params.temperature,
                            top_p=params.top_p, top_k=params.top_k, seed=seed,
                            bias_ids=bias_ids, bias_vals=bias_vals,
-                           sup_ids=sup, min_first=min_first)
+                           sup_ids=sup, min_first=min_first,
+                           guide=gid, guide_row=grow0)
                 first_id, ks, vs = self._prefill_detached_fn(*args)
             first = int(first_id)
         self.metrics.prompt_tokens_total.inc(len(ids))
         return PrefilledState(first_token=first, num_prompt=len(ids),
                               seed=seed, k=np.asarray(ks), v=np.asarray(vs),
-                              first_lp=first_lp)
+                              first_lp=first_lp,
+                              guide_row=(self.guides.next_row(grow0, first)
+                                         - grow0 if gid >= 0 else 0))
 
     def _decode_dispatch(self) -> None:
         rec = self._issue_decode()
@@ -2117,7 +2217,11 @@ class InferenceEngine:
                        and st.request.params.frequency_penalty == 0
                        and st.request.params.logprobs is None
                        and not st.request.params.logit_bias
-                       and st.request.params.min_tokens == 0)
+                       and st.request.params.min_tokens == 0
+                       # Guided slots ride the plain path: draft proposals
+                       # ignore the DFA mask, and multi-token acceptance
+                       # would need an in-kernel fold of the guide advance.
+                       and st.request.params.guide is None)
                 for slot, st in self._slots.items()}
             if any(eligible.values()):
                 self._spec_dispatch(eligible)
@@ -2145,12 +2249,14 @@ class InferenceEngine:
             self._cache, self._sampling, (toks, clps, lvals, lids) = \
                 self._decode_lp_fn(
                     self.params, self._cache, jnp.asarray(self._last_token),
-                    jnp.asarray(self._lengths), self._sampling, tables_arg)
+                    jnp.asarray(self._lengths), self._sampling, tables_arg,
+                    self._guide_dev)
             lp_devs = (clps, lvals, lids)
         else:
             self._cache, self._sampling, toks = self._decode_fn(
                 self.params, self._cache, jnp.asarray(self._last_token),
-                jnp.asarray(self._lengths), self._sampling, tables_arg)
+                jnp.asarray(self._lengths), self._sampling, tables_arg,
+                self._guide_dev)
         # Snapshot the dispatch's slot set: slots admitted while this
         # dispatch is in flight are NOT part of it (their rows carried the
         # free-slot sentinel at issue).
@@ -2235,7 +2341,7 @@ class InferenceEngine:
         args = (self.params, self._draft_params, self._cache,
                 self._draft_cache, jnp.asarray(self._last_token),
                 jnp.asarray(self._lengths), self._sampling,
-                jnp.asarray(enable), tables_arg)
+                jnp.asarray(enable), tables_arg, self._guide_dev)
         # The wait timer starts AFTER the async dispatch returns but
         # BEFORE the first host fetch — in the lp branch the clps
         # conversion is that first fetch, not np.asarray(a) (a later
@@ -2348,7 +2454,7 @@ class InferenceEngine:
         self._free.append(slot)
         p = st.request.params
         if (p.presence_penalty or p.frequency_penalty or p.logit_bias
-                or p.min_tokens):
+                or p.min_tokens or p.guide is not None):
             # Re-arm shaped()'s lax.cond fast paths: a stale penalty/bias/
             # suppression row on a FREE slot would keep every future
             # dispatch paying the shaping reads.

@@ -1,0 +1,675 @@
+"""Guided decoding: grammars compiled to token-transition tables on device.
+
+Reference parity: the runtimes the reference launches (vLLM/SGLang via
+``internal/controller/arksapplication_controller.go:941-1014``) ship
+JSON-mode and regex-constrained decoding ("guided decoding").  Their
+recipe — a per-step host-side logits processor walking an automaton —
+cannot work here: the engine's fused K-step decode loop never returns
+logits to the host mid-dispatch.  The TPU-native shape is an
+outlines-style token-level DFA carried as per-slot device state:
+
+  1. The pattern (a byte-level regex; JSON mode is a depth-bounded JSON
+     grammar rendered as one) compiles to a character DFA on the host.
+  2. Every vocab token's byte string is walked through the char DFA from
+     every DFA state at once (vectorized numpy), yielding the token-level
+     transition matrix T[state, token] -> next state | dead.
+  3. T factors through token EQUIVALENCE CLASSES (tokens with identical
+     behavior across all states — the columns of T deduplicated), so the
+     device carries only ``class_of_token [V]`` plus a small
+     ``trans [states, classes]`` table instead of a [states, V] matrix:
+     kilobytes-to-megabytes instead of gigabytes at 150k vocab.
+  4. ``sampler.shaped`` masks disallowed tokens to -inf
+     (``trans[row][class[v]] < 0``) and ``sampler.sample`` advances the
+     per-slot row after each step — both inside the fused loop, both
+     lax.cond-gated so unguided batches pay nothing.
+
+All guides live in two fixed-budget arrays (``class_ids [G, V]``,
+``trans [R, C]``) allocated at engine init, so compiling a new guide
+never retraces the decode programs — the engine just re-uploads table
+CONTENTS when the compiler's version bumps.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["GuideError", "GuideCompiler", "compile_regex_dfa",
+           "json_mode_regex"]
+
+
+class GuideError(ValueError):
+    """Invalid pattern or exceeded guide-table budget (HTTP 400 at the
+    server — never an engine-thread fault)."""
+
+
+# ---------------------------------------------------------------------------
+# Byte-level regex -> character DFA
+# ---------------------------------------------------------------------------
+# The pattern language is the practical subset guided-decoding grammars
+# use: literals, '.', classes with ranges/negation, escapes (\d \w \s \n
+# \t \r \xHH and escaped metacharacters), groups, alternation, and the
+# * + ? {m} {m,} {m,n} quantifiers.  Semantics are fullmatch, over BYTES:
+# non-ASCII literals expand to their UTF-8 byte sequence, and negated
+# classes admit continuation bytes (0x80+), so UTF-8 text flows through
+# string-shaped grammars without unicode special-casing.
+
+_ALL = (1 << 256) - 1
+_DIGIT = sum(1 << b for b in range(0x30, 0x3A))
+_WORD = (_DIGIT | sum(1 << b for b in range(0x41, 0x5B))
+         | sum(1 << b for b in range(0x61, 0x7B)) | (1 << 0x5F))
+_SPACE = sum(1 << b for b in b" \t\n\r\f\v")
+_DOT = _ALL & ~(1 << 0x0A)
+
+
+class _Parser:
+    """Recursive-descent parser producing an AST of tuples:
+    ('lit', mask) | ('cat', a, b) | ('alt', a, b) | ('star', a) |
+    ('plus', a) | ('opt', a) | ('eps',)."""
+
+    def __init__(self, pattern: str) -> None:
+        self.p = pattern
+        self.i = 0
+
+    def parse(self):
+        node = self._alt()
+        if self.i != len(self.p):
+            raise GuideError(f"unexpected {self.p[self.i]!r} at {self.i}")
+        return node
+
+    def _alt(self):
+        node = self._concat()
+        while self._peek() == "|":
+            self.i += 1
+            node = ("alt", node, self._concat())
+        return node
+
+    def _concat(self):
+        node = ("eps",)
+        while self._peek() not in ("", "|", ")"):
+            node = ("cat", node, self._rep())
+        return node
+
+    def _rep(self):
+        node = self._atom()
+        c = self._peek()
+        if c == "*":
+            self.i += 1
+            node = ("star", node)
+        elif c == "+":
+            self.i += 1
+            node = ("plus", node)
+        elif c == "?":
+            self.i += 1
+            node = ("opt", node)
+        elif c == "{":
+            node = self._bounded(node)
+        return node
+
+    def _bounded(self, node):
+        j = self.p.find("}", self.i)
+        if j < 0:
+            raise GuideError("unterminated {} quantifier")
+        spec = self.p[self.i + 1: j]
+        self.i = j + 1
+        try:
+            if "," not in spec:
+                lo, hi = int(spec), int(spec)
+            else:
+                lo_s, hi_s = spec.split(",", 1)
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s else None
+        except ValueError:
+            raise GuideError(f"bad quantifier {{{spec}}}") from None
+        if hi is not None and hi < lo:
+            raise GuideError(f"bad quantifier {{{spec}}}")
+        out = ("eps",)
+        for _ in range(lo):
+            out = ("cat", out, node)
+        if hi is None:
+            out = ("cat", out, ("star", node))
+        else:
+            for _ in range(hi - lo):
+                out = ("cat", out, ("opt", node))
+        return out
+
+    def _atom(self):
+        c = self._peek()
+        if c == "(":
+            self.i += 1
+            if self.p[self.i: self.i + 2] == "?:":
+                self.i += 2
+            node = self._alt()
+            if self._peek() != ")":
+                raise GuideError("unbalanced parenthesis")
+            self.i += 1
+            return node
+        if c == "[":
+            return ("lit", self._cls())
+        if c == ".":
+            self.i += 1
+            return ("lit", _DOT)
+        if c == "\\":
+            return ("lit", self._escape())
+        if c in ("*", "+", "?", "{", ""):
+            raise GuideError(f"dangling quantifier or empty atom at {self.i}")
+        self.i += 1
+        mask_bytes = c.encode("utf-8")
+        node = ("lit", 1 << mask_bytes[0])
+        for b in mask_bytes[1:]:  # non-ASCII literal -> UTF-8 byte concat
+            node = ("cat", node, ("lit", 1 << b))
+        return node
+
+    def _escape(self) -> int:
+        self.i += 1  # past backslash
+        if self.i >= len(self.p):
+            raise GuideError("dangling escape")
+        c = self.p[self.i]
+        self.i += 1
+        table = {"d": _DIGIT, "D": _ALL & ~_DIGIT, "w": _WORD,
+                 "W": _ALL & ~_WORD, "s": _SPACE, "S": _ALL & ~_SPACE,
+                 "n": 1 << 0x0A, "t": 1 << 0x09, "r": 1 << 0x0D,
+                 "f": 1 << 0x0C, "v": 1 << 0x0B, "0": 1 << 0x00}
+        if c in table:
+            return table[c]
+        if c == "x":
+            h = self.p[self.i: self.i + 2]
+            if len(h) < 2:
+                raise GuideError("bad \\x escape")
+            self.i += 2
+            return 1 << int(h, 16)
+        if ord(c) > 127:
+            # Non-ASCII is multi-byte in UTF-8; a single-byte mask at
+            # ord(c) would match the wrong raw byte.
+            raise GuideError(
+                f"escaped non-ASCII character {c!r}; use \\xHH bytes")
+        return 1 << ord(c)  # escaped metacharacter / punctuation
+
+    def _cls(self) -> int:
+        self.i += 1  # past '['
+        negate = self._peek() == "^"
+        if negate:
+            self.i += 1
+        mask = 0
+        first = True
+        while True:
+            c = self._peek()
+            if c == "":
+                raise GuideError("unterminated character class")
+            if c == "]" and not first:
+                self.i += 1
+                break
+            first = False
+            if c == "\\":
+                m = self._escape()
+            else:
+                self.i += 1
+                bs = c.encode("utf-8")
+                if len(bs) > 1:
+                    raise GuideError(
+                        "non-ASCII literals are not supported inside "
+                        "character classes (use \\xHH byte ranges)")
+                m = 1 << bs[0]
+            # Range?  Only when both ends are single bytes.
+            if (self._peek() == "-" and self.i + 1 < len(self.p)
+                    and self.p[self.i + 1] != "]"):
+                self.i += 1
+                c2 = self._peek()
+                if c2 == "\\":
+                    m2 = self._escape()
+                else:
+                    self.i += 1
+                    m2 = 1 << ord(c2)
+                lo, hi = m.bit_length() - 1, m2.bit_length() - 1
+                if (m.bit_count() != 1 or m2.bit_count() != 1 or hi < lo
+                        or hi > 255):
+                    raise GuideError("bad character-class range (bounds "
+                                     "must be single bytes)")
+                m = sum(1 << b for b in range(lo, hi + 1))
+            mask |= m
+        return (mask ^ _ALL) if negate else mask
+
+    def _peek(self) -> str:
+        return self.p[self.i] if self.i < len(self.p) else ""
+
+
+def _nfa(ast):
+    """Thompson construction.  Returns (n_states, eps adjacency list,
+    char transitions [(src, mask, dst)], start, accept)."""
+    eps: list[list[int]] = []
+    chars: list[tuple[int, int, int]] = []
+
+    def new() -> int:
+        eps.append([])
+        return len(eps) - 1
+
+    def build(node) -> tuple[int, int]:
+        kind = node[0]
+        if kind == "eps":
+            s = new()
+            return s, s
+        if kind == "lit":
+            s, t = new(), new()
+            chars.append((s, node[1], t))
+            return s, t
+        if kind == "cat":
+            s1, t1 = build(node[1])
+            s2, t2 = build(node[2])
+            eps[t1].append(s2)
+            return s1, t2
+        if kind == "alt":
+            s, t = new(), new()
+            s1, t1 = build(node[1])
+            s2, t2 = build(node[2])
+            eps[s] += [s1, s2]
+            eps[t1].append(t)
+            eps[t2].append(t)
+            return s, t
+        if kind in ("star", "opt", "plus"):
+            s, t = new(), new()
+            s1, t1 = build(node[1])
+            eps[s].append(s1)
+            eps[t1].append(t)
+            if kind in ("star", "opt"):
+                eps[s].append(t)
+            if kind in ("star", "plus"):
+                eps[t1].append(s1)
+            return s, t
+        raise AssertionError(kind)
+
+    start, accept = build(ast)
+    return len(eps), eps, chars, start, accept
+
+
+def compile_regex_dfa(pattern: str) -> tuple[np.ndarray, np.ndarray]:
+    """Byte-level pattern -> minimized character DFA.
+
+    Returns (table [S, 256] int32 with -1 = dead, accept [S] bool);
+    state 0 is the start state.  Fullmatch semantics."""
+    n, eps, chars, start, accept = _nfa(_Parser(pattern).parse())
+
+    # Byte equivalence classes: bytes with identical membership across all
+    # literal masks behave identically; subset-construct over classes.
+    masks = sorted({m for _, m, _ in chars})
+    sig = np.zeros((256, len(masks)), bool)
+    for k, m in enumerate(masks):
+        arr = np.frombuffer(
+            m.to_bytes(32, "little"), np.uint8)
+        sig[:, k] = (np.unpackbits(arr, bitorder="little") != 0)
+    _, byte_cls = np.unique(sig, axis=0, return_inverse=True)
+    ncls = int(byte_cls.max()) + 1
+    cls_rep = np.zeros(ncls, np.int64)  # one representative byte per class
+    for b in range(255, -1, -1):
+        cls_rep[byte_cls[b]] = b
+
+    # Per-NFA-state transitions grouped by byte class (target bitmask).
+    delta: list[dict[int, int]] = [dict() for _ in range(n)]
+    for s, m, t in chars:
+        for c in range(ncls):
+            if (m >> int(cls_rep[c])) & 1:
+                delta[s][c] = delta[s].get(c, 0) | (1 << t)
+
+    # Epsilon closures as bitmask ints, memoized bottom-up.
+    closure = [0] * n
+    done = [False] * n
+    def close(s: int) -> int:
+        if done[s]:
+            return closure[s]
+        seen = {s}
+        stack = [s]
+        acc = 1 << s
+        while stack:
+            u = stack.pop()
+            for v in eps[u]:
+                if v not in seen:
+                    seen.add(v)
+                    acc |= 1 << v
+                    stack.append(v)
+        closure[s] = acc
+        done[s] = True
+        return acc
+
+    def close_set(mask: int) -> int:
+        acc = 0
+        while mask:
+            low = mask & -mask
+            acc |= close(low.bit_length() - 1)
+            mask &= mask - 1
+        return acc
+
+    start_set = close(start)
+    states: dict[int, int] = {start_set: 0}
+    order = [start_set]
+    rows: list[list[int]] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = [-1] * ncls
+        for c in range(ncls):
+            tgt = 0
+            m = cur
+            while m:
+                low = m & -m
+                s = low.bit_length() - 1
+                tgt |= delta[s].get(c, 0)
+                m &= m - 1
+            if tgt:
+                tgt = close_set(tgt)
+                if tgt not in states:
+                    states[tgt] = len(order)
+                    order.append(tgt)
+                row[c] = states[tgt]
+        rows.append(row)
+    S = len(order)
+    cls_table = np.array(rows, np.int32).reshape(S, ncls)
+    acc = np.array([(st >> accept) & 1 for st in order], bool)
+
+    # Moore minimization over the class alphabet.
+    part = acc.astype(np.int64)
+    while True:
+        mapped = np.where(cls_table >= 0, part[np.maximum(cls_table, 0)], -1)
+        key = np.concatenate([part[:, None], mapped], axis=1)
+        _, new_part = np.unique(key, axis=0, return_inverse=True)
+        if (new_part == part).all():
+            break
+        part = new_part
+    # Renumber with the start state's block first.
+    remap = -np.ones(int(part.max()) + 1, np.int64)
+    nxt = 0
+    for s in range(S):
+        if remap[part[s]] < 0:
+            remap[part[s]] = nxt
+            nxt += 1
+    part = remap[part]
+    Sm = nxt
+    min_cls = -np.ones((Sm, ncls), np.int32)
+    min_acc = np.zeros(Sm, bool)
+    for s in range(S):
+        ps = part[s]
+        min_acc[ps] |= acc[s]
+        row = cls_table[s]
+        min_cls[ps] = np.where(row >= 0, part[np.maximum(row, 0)], -1)
+
+    table = min_cls[:, byte_cls]  # [Sm, 256]
+    return np.ascontiguousarray(table), min_acc
+
+
+# ---------------------------------------------------------------------------
+# JSON mode (depth-bounded JSON grammar as a regex)
+# ---------------------------------------------------------------------------
+
+_WS = r"[ \t\n\r]*"
+_STR = r'"([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u[0-9a-fA-F]{4}))*"'
+_NUM = r"\-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][\+\-]?[0-9]+)?"
+
+
+def json_mode_regex(depth: int | None = None) -> str:
+    """A JSON OBJECT with nesting bounded at ``depth`` containers (the one
+    non-regular feature of JSON; vLLM's grammar backend tracks it with a
+    pushdown stack, here it is unrolled into the DFA).  Default depth via
+    ARKS_JSON_DEPTH (3): state count grows ~2x per level."""
+    if depth is None:
+        depth = int(os.environ.get("ARKS_JSON_DEPTH", "3"))
+
+    def value(d: int) -> str:
+        alts = [_STR, _NUM, "true", "false", "null"]
+        if d > 0:
+            alts += [obj(d), arr(d)]
+        return "(" + "|".join(alts) + ")"
+
+    def obj(d: int) -> str:
+        v = value(d - 1)
+        member = f"{_STR}{_WS}:{_WS}{v}"
+        return (r"\{" + _WS + f"({member}({_WS},{_WS}{member})*)?"
+                + _WS + r"\}")
+
+    def arr(d: int) -> str:
+        v = value(d - 1)
+        return r"\[" + _WS + f"({v}({_WS},{_WS}{v})*)?" + _WS + r"\]"
+
+    if depth < 1:
+        raise GuideError("json depth must be >= 1")
+    return _WS + obj(depth) + _WS
+
+
+# ---------------------------------------------------------------------------
+# Token byte table
+# ---------------------------------------------------------------------------
+
+# The standard GPT-2 byte<->unicode mapping used by every byte-level BPE
+# vocab (GPT-2, Llama-3, Qwen2 tiktoken-style tokenizers).
+def _bytes_to_unicode() -> dict[int, str]:
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def token_byte_table(tokenizer, vocab_size: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """(bytes [V, L] uint8, lens [V] int32) for every vocab id.  Ids with
+    no byte representation (specials, padding rows past the tokenizer
+    vocab) get length 0 and are disallowed under every guide."""
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+
+    per: list[bytes] = [b""] * vocab_size
+    if isinstance(tokenizer, ByteTokenizer):
+        off = ByteTokenizer.OFFSET
+        for i in range(off, min(vocab_size, off + 256)):
+            per[i] = bytes([i - off])
+    else:
+        hf = getattr(tokenizer, "_tok", tokenizer)
+        uni2byte = {u: b for b, u in _bytes_to_unicode().items()}
+        special = set(getattr(hf, "all_special_ids", []) or [])
+        n = min(vocab_size, int(getattr(hf, "vocab_size", vocab_size))
+                + len(getattr(hf, "added_tokens_decoder", {}) or {}))
+        toks = hf.convert_ids_to_tokens(list(range(n)))
+        for i, t in enumerate(toks):
+            if t is None or i in special:
+                continue
+            if t.startswith("<0x") and t.endswith(">") and len(t) == 6:
+                try:
+                    per[i] = bytes([int(t[3:5], 16)])  # sentencepiece byte
+                    continue
+                except ValueError:
+                    pass
+            if all(ch in uni2byte for ch in t):
+                per[i] = bytes(uni2byte[ch] for ch in t)  # byte-level BPE
+            else:
+                per[i] = t.replace("▁", " ").encode("utf-8")  # spm
+
+    lens = np.array([len(b) for b in per], np.int32)
+    L = max(1, int(lens.max()))
+    arr = np.zeros((vocab_size, L), np.uint8)
+    for i, b in enumerate(per):
+        arr[i, : len(b)] = np.frombuffer(b, np.uint8)
+    return arr, lens
+
+
+# ---------------------------------------------------------------------------
+# Char DFA -> token-level classes + transition table
+# ---------------------------------------------------------------------------
+
+def token_transition_tables(char_table: np.ndarray, accept: np.ndarray,
+                            tok_bytes: np.ndarray, tok_lens: np.ndarray,
+                            eos_ids: tuple[int, ...]
+                            ) -> tuple[np.ndarray, np.ndarray]:
+    """(class_id [V] int32, trans [S+1, C] int32) — token-level DFA in
+    factored form.  Row S (the last) is the TERMINAL state entered by
+    sampling EOS in an accepting state; it allows everything (the host
+    finishes the request at the next boundary, and an all-masked row
+    would degenerate the sampling distribution for nothing).
+
+    next-state encoding: -1 = token disallowed, else absolute row."""
+    S = char_table.shape[0]
+    V = tok_bytes.shape[0]
+    dead = S + 1  # transient absorbing index during the walk
+    ct = np.where(char_table < 0, dead, char_table).astype(np.int32)
+    ct = np.vstack([ct, np.full((2, 256), dead, np.int32)])  # term+dead rows
+
+    T = np.empty((S, V), np.int32)
+    Lmax = tok_bytes.shape[1]
+    chunk = max(1, int(2e8) // max(V, 1))  # ~800MB transient cap
+    for s0 in range(0, S, chunk):
+        s1 = min(S, s0 + chunk)
+        st = np.repeat(np.arange(s0, s1, dtype=np.int32)[:, None], V, axis=1)
+        for j in range(Lmax):
+            live = (j < tok_lens)[None, :]
+            st = np.where(live, ct[st, tok_bytes[:, j][None, :]], st)
+        T[s0:s1] = np.where(st >= dead, -1, st)
+    T[:, tok_lens == 0] = -1  # specials/padding never advance a guide
+
+    # EOS: allowed exactly in accepting states, entering the terminal row.
+    for e in eos_ids:
+        if 0 <= e < V:
+            T[:, e] = np.where(accept, S, -1)
+    term_row = np.full((1, V), S, np.int32)  # terminal: all tokens self-loop
+    T = np.vstack([T, term_row])
+
+    # Factor through token classes: dedupe the columns of T.
+    _, class_id, inv = np.unique(T.T, axis=0, return_index=True,
+                                 return_inverse=True)
+    trans = T[:, class_id]  # [S+1, C]
+    return inv.astype(np.int32), np.ascontiguousarray(trans.astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# Registry: guides packed into fixed-budget arrays
+# ---------------------------------------------------------------------------
+
+class Guide:
+    __slots__ = ("guide_id", "start_row", "n_states", "n_classes")
+
+    def __init__(self, guide_id: int, start_row: int, n_states: int,
+                 n_classes: int) -> None:
+        self.guide_id = guide_id
+        self.start_row = start_row
+        self.n_states = n_states
+        self.n_classes = n_classes
+
+
+class GuideCompiler:
+    """Compiles and packs guides; owns the HOST tables.  The engine
+    re-uploads device copies when ``version`` bumps (engine thread, between
+    dispatches) and multi-host leaders replicate the same arrays over the
+    dispatch channel, so followers stay bit-identical.
+
+    Budgets are fixed at init so device shapes never change:
+      class_ids [max_guides, V] int32  (class of token v under guide g)
+      trans     [max_rows,  max_classes] int32 (ABSOLUTE next row | -1)
+    """
+
+    def __init__(self, tokenizer, vocab_size: int,
+                 eos_ids: tuple[int, ...] = (),
+                 max_guides: int | None = None,
+                 max_rows: int | None = None,
+                 max_classes: int | None = None) -> None:
+        env = os.environ.get
+        self.vocab_size = vocab_size
+        self.max_guides = max_guides or int(env("ARKS_GUIDE_MAX", "8"))
+        self.max_rows = max_rows or int(env("ARKS_GUIDE_ROWS", "4096"))
+        self.max_classes = max_classes or int(env("ARKS_GUIDE_CLASSES",
+                                                  "2048"))
+        self._tokenizer = tokenizer
+        self._eos_ids = tuple(eos_ids)
+        self._tok_table: tuple[np.ndarray, np.ndarray] | None = None
+        self.class_ids = np.zeros((self.max_guides, vocab_size), np.int32)
+        self.trans = np.full((self.max_rows, self.max_classes), -1, np.int32)
+        self._registry: dict[tuple[str, str], Guide] = {}
+        self._next_guide = 0
+        self._next_row = 0
+        self.version = 0
+        self._lock = threading.Lock()  # server threads compile concurrently
+
+    # -- public ----------------------------------------------------------
+
+    def compile(self, kind: str, pattern: str = "") -> Guide:
+        """('json', '') or ('json', depth-digits) or ('regex', pattern) ->
+        packed Guide.  Idempotent per (kind, pattern); raises GuideError
+        on bad patterns or exhausted budgets."""
+        key = (kind, pattern)
+        with self._lock:
+            got = self._registry.get(key)
+            if got is not None:
+                return got
+            if kind == "json":
+                rx = json_mode_regex(int(pattern) if pattern else None)
+            elif kind == "regex":
+                rx = pattern
+            else:
+                raise GuideError(f"unknown guide kind {kind!r}")
+            char_table, accept = compile_regex_dfa(rx)
+            if self._tok_table is None:
+                self._tok_table = token_byte_table(self._tokenizer,
+                                                   self.vocab_size)
+            cls, trans = token_transition_tables(
+                char_table, accept, *self._tok_table, self._eos_ids)
+            n_states, n_classes = trans.shape
+            if self._next_guide >= self.max_guides:
+                raise GuideError(
+                    f"guide budget exhausted ({self.max_guides} guides)")
+            if self._next_row + n_states > self.max_rows:
+                raise GuideError(
+                    f"guide row budget exhausted ({n_states} states needed, "
+                    f"{self.max_rows - self._next_row} rows free; raise "
+                    "ARKS_GUIDE_ROWS)")
+            if n_classes > self.max_classes:
+                raise GuideError(
+                    f"guide has {n_classes} token classes > budget "
+                    f"{self.max_classes}; raise ARKS_GUIDE_CLASSES")
+            g = Guide(self._next_guide, self._next_row, n_states, n_classes)
+            base = g.start_row
+            self.class_ids[g.guide_id] = cls
+            self.trans[base: base + n_states, :n_classes] = np.where(
+                trans >= 0, trans + base, -1)
+            self._next_guide += 1
+            self._next_row += n_states
+            self._registry[key] = g
+            self.version += 1
+            return g
+
+    def lookup(self, kind: str, pattern: str = "") -> Guide | None:
+        return self._registry.get((kind, pattern))
+
+    def next_row(self, row: int, token: int) -> int:
+        """Host-side single-token advance (absolute row coords) for the
+        first-token paths, where the engine knows the sampled id before
+        writing the slot's sampling state."""
+        gid = self._guide_of_row(row)
+        nxt = int(self.trans[row, int(self.class_ids[gid, token])])
+        return row if nxt < 0 else nxt
+
+    def allowed(self, row: int) -> np.ndarray:
+        """Host-side [V] bool mask (tests / debugging)."""
+        gid = self._guide_of_row(row)
+        return self.trans[row, self.class_ids[gid]] >= 0
+
+    def load_state(self, class_ids: np.ndarray, trans: np.ndarray,
+                   version: int) -> None:
+        """Follower-side table sync from the leader's emit."""
+        with self._lock:
+            self.class_ids = np.asarray(class_ids, np.int32)
+            self.trans = np.asarray(trans, np.int32)
+            self.version = version
+
+    # -- internal --------------------------------------------------------
+
+    def _guide_of_row(self, row: int) -> int:
+        # Snapshot under the lock: server threads compile (insert) while
+        # the engine thread advances rows — iterating the live dict here
+        # could raise mid-scheduler.
+        with self._lock:
+            guides = list(self._registry.values())
+        for g in guides:
+            if g.start_row <= row < g.start_row + g.n_states:
+                return g.guide_id
+        raise GuideError(f"row {row} belongs to no registered guide")

@@ -301,10 +301,11 @@ class DispatchFollower:
                 log.exception("dispatch op %r failed; awaiting reset", op)
 
     @staticmethod
-    def _shape_args(p: dict, jnp, sampler_mod):
-        """Follower-side (bias_ids, bias_vals, sup_ids, min_first) jnp args
-        from an emit payload, defaulting to the empty columns — ONE
-        definition, or leader/follower replay diverges per op."""
+    def _shape_args(p: dict, jnp, sampler_mod, eng):
+        """Follower-side (bias_ids, bias_vals, sup_ids, min_first, guide,
+        guide_row, guide_tables) jnp args from an emit payload, defaulting
+        to the empty columns — ONE definition, or leader/follower replay
+        diverges per op."""
         import numpy as _np
         nb = sampler_mod.LOGIT_BIAS_MAX
         ns = sampler_mod.SUPPRESS_MAX
@@ -312,7 +313,10 @@ class DispatchFollower:
             jnp.asarray(p.get("bias_ids", _np.full((nb,), -1, _np.int32))),
             jnp.asarray(p.get("bias_vals", _np.zeros((nb,), _np.float32))),
             jnp.asarray(p.get("sup_ids", _np.full((ns,), -1, _np.int32))),
-            jnp.asarray(p.get("min_first", 0), jnp.int32))
+            jnp.asarray(p.get("min_first", 0), jnp.int32),
+            jnp.asarray(p.get("guide", -1), jnp.int32),
+            jnp.asarray(p.get("guide_row", 0), jnp.int32),
+            eng._guide_dev)
 
     def _apply(self, eng, jax, jnp, op: str, p: dict) -> None:
         from arks_tpu.engine import sampler as sampler_mod
@@ -344,7 +348,14 @@ class DispatchFollower:
                      jnp.asarray(p["bias_vals"], jnp.float32),
                      jnp.asarray(p["sup_ids"], jnp.int32),
                      jnp.asarray(p["min_first"], jnp.int32),
-                     jnp.asarray(p["min_until"], jnp.int32))
+                     jnp.asarray(p["min_until"], jnp.int32),
+                     jnp.asarray(p.get("guide",
+                                       _np.full((len(p["seeds"]),), -1,
+                                                _np.int32)), jnp.int32),
+                     jnp.asarray(p.get("guide_row",
+                                       _np.zeros((len(p["seeds"]),),
+                                                 _np.int32)), jnp.int32),
+                     eng._guide_dev)
             eng._cache, eng._sampling = out[-4], out[-3]
         elif op == "chunk_paged":
             _logits, eng._cache = eng._chunk_fn(
@@ -370,7 +381,7 @@ class DispatchFollower:
                      jnp.float32(p["temperature"]),
                      jnp.float32(p["top_p"]),
                      jnp.int32(p["top_k"]), key,
-                     *self._shape_args(p, jnp, sampler_mod))
+                     *self._shape_args(p, jnp, sampler_mod, eng))
             jax.block_until_ready(out[0])
         elif op == "insert_kv":
             # Disaggregated decode: KV arrives by value (the leader got
@@ -394,7 +405,9 @@ class DispatchFollower:
                 ignore_eos=p.get("ignore_eos", False))
             eng._apply_set_slot(p["slot"], params,
                                 self._jax.random.fold_in(key, 1),
-                                num_prompt=p.get("num_prompt", 0))
+                                num_prompt=p.get("num_prompt", 0),
+                                guide=p.get("guide", -1),
+                                guide_row=p.get("guide_row", 0))
         elif op == "clear_penalties":
             eng._sampling = eng._clear_pen_fn(
                 eng._sampling, jnp.asarray(p["slot"], jnp.int32))
@@ -413,14 +426,15 @@ class DispatchFollower:
                jnp.float32(p["temperature"]),
                jnp.float32(p["top_p"]),
                jnp.int32(p["top_k"]), key,
-               *self._shape_args(p, jnp, sampler_mod))
+               *self._shape_args(p, jnp, sampler_mod, eng))
         elif op == "decode":
             fn = eng._decode_lp_fn if p.get("lp") else eng._decode_fn
             tables = p.get("tables")
             eng._cache, eng._sampling, toks = fn(
                 eng.params, eng._cache, jnp.asarray(p["tokens"]),
                 jnp.asarray(p["lengths"]), eng._sampling,
-                None if tables is None else jnp.asarray(tables))
+                None if tables is None else jnp.asarray(tables),
+                eng._guide_dev)
             # Host-sync like the leader, but via block_until_ready —
             # a follower may not address every shard of toks.
             jax.block_until_ready(toks)
@@ -441,11 +455,20 @@ class DispatchFollower:
                 eng.params, eng._draft_params, eng._cache, eng._draft_cache,
                 jnp.asarray(p["tokens"]), jnp.asarray(p["lengths"]),
                 eng._sampling, jnp.asarray(p["enable"]),
-                None if tables is None else jnp.asarray(tables))
+                None if tables is None else jnp.asarray(tables),
+                eng._guide_dev)
             eng._cache, eng._draft_cache = out[0], out[1]
             counts = out[3]
             eng._sampling = out[4]
             jax.block_until_ready(counts)
+        elif op == "guides":
+            # Guide-table sync: load the leader's host tables and refresh
+            # the device copies NOW — ops after this one in the channel
+            # may reference the new rows.
+            eng.guides.load_state(p["class_ids"], p["trans"], p["version"])
+            eng._guide_dev = (jnp.asarray(eng.guides.class_ids),
+                              jnp.asarray(eng.guides.trans))
+            eng._guide_ver = eng.guides.version
         elif op == "reset":
             eng._reset_device_state()
         else:

@@ -91,6 +91,13 @@ class SamplingState(NamedTuple):
     # while the slot's sequence length is below min_until (0 = off).
     suppress_ids: jnp.ndarray  # i32 [B, NS]
     min_until: jnp.ndarray     # i32 [B]
+    # Guided decoding (guides.py): guide = packed guide id (-1 = none),
+    # guide_row = ABSOLUTE row in the trans table (the slot's DFA state).
+    # shaped() masks tokens whose transition is dead; sample() advances
+    # the row.  Both need the (class_ids, trans) tables passed alongside —
+    # they live on the ENGINE (fixed budget shapes), not in this state.
+    guide: jnp.ndarray        # i32 [B]
+    guide_row: jnp.ndarray    # i32 [B]
 
 
 def init_sampling_state(batch: int, seed: int = 0,
@@ -108,6 +115,8 @@ def init_sampling_state(batch: int, seed: int = 0,
         bias_vals=jnp.zeros((batch, LOGIT_BIAS_MAX), jnp.float32),
         suppress_ids=jnp.full((batch, SUPPRESS_MAX), -1, jnp.int32),
         min_until=jnp.zeros((batch,), jnp.int32),
+        guide=jnp.full((batch,), -1, jnp.int32),
+        guide_row=jnp.zeros((batch,), jnp.int32),
     )
 
 
@@ -144,7 +153,8 @@ def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
              top_p: float, top_k: int, key: jnp.ndarray,
              presence: float = 0.0, frequency: float = 0.0,
              bias_ids=None, bias_vals=None, suppress_ids=None,
-             min_until: int = 0) -> SamplingState:
+             min_until: int = 0, guide: int = -1,
+             guide_row: int = 0) -> SamplingState:
     nb = state.bias_ids.shape[1]
     ns = state.suppress_ids.shape[1]
     return SamplingState(
@@ -163,12 +173,15 @@ def set_slot(state: SamplingState, slot: int | jnp.ndarray, temperature: float,
             jnp.full((ns,), -1, jnp.int32) if suppress_ids is None
             else suppress_ids),
         min_until=state.min_until.at[slot].set(min_until),
+        guide=state.guide.at[slot].set(guide),
+        guide_row=state.guide_row.at[slot].set(guide_row),
     )
 
 
 def transient_state(temperature, top_p, top_k, key,
                     vocab_size: int, bias_ids=None, bias_vals=None,
-                    suppress_ids=None, min_first=None) -> SamplingState:
+                    suppress_ids=None, min_first=None, guide=None,
+                    guide_row=None) -> SamplingState:
     """One-row state for first-token sampling (prefill paths): penalties
     are identity there — the output is empty, so counts are all zero.
     ``min_first`` (i32 scalar, 1 when min_tokens >= 1): the first token
@@ -188,12 +201,17 @@ def transient_state(temperature, top_p, top_k, key,
                       if suppress_ids is None else suppress_ids[None]),
         min_until=(jnp.zeros((1,), jnp.int32)
                    if min_first is None else min_first[None]),
+        guide=(jnp.full((1,), -1, jnp.int32)
+               if guide is None else guide[None]),
+        guide_row=(jnp.zeros((1,), jnp.int32)
+                   if guide_row is None else guide_row[None]),
     )
 
 
 def transient_state_batch(temperature, top_p, top_k, keys,
                           vocab_size: int, bias_ids=None, bias_vals=None,
-                          suppress_ids=None, min_first=None) -> SamplingState:
+                          suppress_ids=None, min_first=None, guide=None,
+                          guide_row=None) -> SamplingState:
     """M-row transient state for BATCHED first-token sampling (fused
     multi-prompt admissions): all params already [M]-shaped."""
     m = temperature.shape[0]
@@ -210,13 +228,16 @@ def transient_state_batch(temperature, top_p, top_k, keys,
                       if suppress_ids is None else suppress_ids),
         min_until=(jnp.zeros((m,), jnp.int32)
                    if min_first is None else min_first),
+        guide=(jnp.full((m,), -1, jnp.int32) if guide is None else guide),
+        guide_row=(jnp.zeros((m,), jnp.int32)
+                   if guide_row is None else guide_row),
     )
 
 
 def set_slots(state: SamplingState, slots: jnp.ndarray, temperature,
               top_p, top_k, keys, presence, frequency,
               bias_ids=None, bias_vals=None, suppress_ids=None,
-              min_until=None) -> SamplingState:
+              min_until=None, guide=None, guide_row=None) -> SamplingState:
     """Batched set_slot: write M slots' sampling params in one scatter
     (one compiled program per batch size M)."""
     m = temperature.shape[0]
@@ -239,6 +260,10 @@ def set_slots(state: SamplingState, slots: jnp.ndarray, temperature,
             if suppress_ids is None else suppress_ids),
         min_until=state.min_until.at[slots].set(
             jnp.zeros((m,), jnp.int32) if min_until is None else min_until),
+        guide=state.guide.at[slots].set(
+            jnp.full((m,), -1, jnp.int32) if guide is None else guide),
+        guide_row=state.guide_row.at[slots].set(
+            jnp.zeros((m,), jnp.int32) if guide_row is None else guide_row),
     )
 
 
@@ -253,7 +278,9 @@ def clear_slot_penalties(state: SamplingState,
         bias_ids=state.bias_ids.at[slot].set(-1),
         bias_vals=state.bias_vals.at[slot].set(0.0),
         suppress_ids=state.suppress_ids.at[slot].set(-1),
-        min_until=state.min_until.at[slot].set(0))
+        min_until=state.min_until.at[slot].set(0),
+        guide=state.guide.at[slot].set(-1),
+        guide_row=state.guide_row.at[slot].set(0))
 
 
 def count_tokens(state: SamplingState, tokens: jnp.ndarray,
@@ -287,10 +314,47 @@ def penalized(logits: jnp.ndarray, state: SamplingState) -> jnp.ndarray:
     return jax.lax.cond(active, apply, lambda x: x, logits)
 
 
+def guide_mask(logits: jnp.ndarray, state: SamplingState,
+               guide_tables) -> jnp.ndarray:
+    """Mask tokens with dead guide transitions to -inf.  guide_tables =
+    (class_ids [G, V] i32, trans [R, C] i32).  lax.cond-gated: unguided
+    batches skip the [B, V] class gather entirely."""
+    class_ids, trans = guide_tables
+
+    def apply(lg):
+        b = lg.shape[0]
+        cls = class_ids[jnp.maximum(state.guide, 0)]          # [B, V]
+        row = trans[jnp.maximum(state.guide_row, 0)]          # [B, C]
+        nxt = jnp.take_along_axis(row, cls, axis=1)           # [B, V]
+        bad = (nxt < 0) & (state.guide >= 0)[:, None]
+        return jnp.where(bad, jnp.float32(-1e30), lg)
+
+    return jax.lax.cond(jnp.any(state.guide >= 0), apply,
+                        lambda x: x, logits)
+
+
+def guide_advance(state: SamplingState, ids: jnp.ndarray, guide_tables,
+                  active: jnp.ndarray | None = None) -> SamplingState:
+    """Advance each guided slot's DFA row by its sampled token.  A dead
+    transition (only reachable when every token was masked — degenerate
+    grammar) holds the row instead of corrupting it."""
+    class_ids, trans = guide_tables
+    b = ids.shape[0]
+    cls = class_ids[jnp.maximum(state.guide, 0), ids]         # [B]
+    nxt = trans[jnp.maximum(state.guide_row, 0), cls]         # [B]
+    upd = state.guide >= 0
+    if active is not None:
+        upd = upd & active
+    upd = upd & (nxt >= 0)
+    return state._replace(
+        guide_row=jnp.where(upd, nxt, state.guide_row))
+
+
 def shaped(logits: jnp.ndarray, state: SamplingState,
-           lengths: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Penalties + OpenAI logit_bias + min_tokens suppression, each
-    lax.cond-gated so the plain batch pays none of it.
+           lengths: jnp.ndarray | None = None,
+           guide_tables=None) -> jnp.ndarray:
+    """Penalties + OpenAI logit_bias + min_tokens suppression + guided-
+    decoding masks, each lax.cond-gated so the plain batch pays none of it.
 
     min_tokens: suppress_ids are masked to -inf while the slot's current
     sequence length sits below min_until.  Without ``lengths`` (first-token
@@ -318,8 +382,13 @@ def shaped(logits: jnp.ndarray, state: SamplingState,
         return lg.at[jnp.arange(b)[:, None], ids].add(
             jnp.where(valid, jnp.float32(-1e30), 0.0))
 
-    return jax.lax.cond(jnp.any(state.min_until > 0), apply_min,
-                        lambda x: x, logits)
+    logits = jax.lax.cond(jnp.any(state.min_until > 0), apply_min,
+                          lambda x: x, logits)
+    # Guide mask LAST: a +100 logit_bias must not resurrect a token the
+    # grammar forbids.
+    if guide_tables is not None:
+        logits = guide_mask(logits, state, guide_tables)
+    return logits
 
 
 def _filtered_scaled(logits: jnp.ndarray, state: SamplingState
@@ -358,6 +427,7 @@ def filtered_probs(logits: jnp.ndarray, state: SamplingState
 def sample(logits: jnp.ndarray, state: SamplingState,
            active: jnp.ndarray | None = None,
            lengths: jnp.ndarray | None = None,
+           guide_tables=None,
            ) -> tuple[jnp.ndarray, SamplingState]:
     """Sample one token per slot. logits [B, V] float32 -> ids [B] int32.
 
@@ -371,7 +441,7 @@ def sample(logits: jnp.ndarray, state: SamplingState,
     the admit program) and its registration — advancing its fresh key
     stream there would make seeded sampling depend on scheduler timing.
     """
-    logits = shaped(logits, state, lengths)
+    logits = shaped(logits, state, lengths, guide_tables)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled, top_idx = _filtered_scaled(logits, state)
 
@@ -383,7 +453,10 @@ def sample(logits: jnp.ndarray, state: SamplingState,
     ids = jnp.where(state.temperature <= 0.0, greedy_ids, sampled_ids)
     if active is not None:
         carry_keys = jnp.where(active[:, None], carry_keys, state.key)
-    return ids, state._replace(key=carry_keys)
+    state = state._replace(key=carry_keys)
+    if guide_tables is not None:
+        state = guide_advance(state, ids, guide_tables, active)
+    return ids, state
 
 
 def draft_sample(logits: jnp.ndarray, state: SamplingState, keys: jnp.ndarray
@@ -417,7 +490,10 @@ def speculative_accept(
     enable: jnp.ndarray | None = None,  # [B] bool; False = no speculation
     lengths: jnp.ndarray | None = None,  # [B] — min_tokens gating for the
                                          # disabled slots' plain sample
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    guide_tables=None,                   # guided slots are always DISABLED
+                                         # (host eligibility) — their one
+                                         # token rides the plain path
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rejection-sampled acceptance (Leviathan et al.): accept draft i with
     prob min(1, p_i(d_i)/q_i(d_i)); at the first rejection sample from the
     residual norm(max(p - q, 0)); after a fully-accepted block sample the
@@ -434,7 +510,7 @@ def speculative_accept(
     batch off the speculative path.
 
     Returns (tokens [B, K] — first counts[b] are valid, counts [B] in
-    1..K, advanced keys)."""
+    1..K, advanced keys, advanced guide rows [B])."""
     b, km1 = drafts.shape
     kk = km1 + 1
     greedy = state.temperature <= 0.0
@@ -481,12 +557,17 @@ def speculative_accept(
     out = jnp.concatenate([drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
     out = out.at[jnp.arange(b), j].set(y)
 
+    guide_row = state.guide_row
     if enable is not None:
         # Disabled slots: one token via the regular sampler (which applies
-        # penalties / logit_bias / min_tokens shaping) from the position-0
-        # target logits.
-        plain, _ = sample(target_logits[:, 0], state._replace(key=r_keys),
-                          lengths=lengths)
+        # penalties / logit_bias / min_tokens / guide shaping) from the
+        # position-0 target logits.
+        plain, pstate = sample(target_logits[:, 0],
+                               state._replace(key=r_keys),
+                               lengths=lengths, guide_tables=guide_tables)
         out = jnp.where(enable[:, None], out, out.at[:, 0].set(plain))
         counts = jnp.where(enable, counts, 1)
-    return out, counts, carry_keys
+        # Guided slots are never spec-ENABLED, so the plain path's advance
+        # is the only one that matters.
+        guide_row = jnp.where(enable, state.guide_row, pstate.guide_row)
+    return out, counts, carry_keys, guide_row

@@ -34,6 +34,9 @@ class SamplingParams:
     # priorities stay FIFO).  Only ordering in the waiting queue changes —
     # running slots are never preempted.
     priority: int = 0
+    # Guided decoding: (kind, pattern) compiled by engine.guides —
+    # ("json", "") for JSON mode, ("regex", pat) for a regex constraint.
+    guide: tuple[str, str] | None = None
 
 
 @dataclasses.dataclass
@@ -57,6 +60,11 @@ class PrefilledState:
     # the logprob stream seamlessly from here (its own dispatches cover
     # every later token).
     first_lp: object | None = None
+    # Guided decoding: the DFA state AFTER the first token, RELATIVE to
+    # the guide's start row (the prefill engine sampled under the guide;
+    # the decode engine rebases onto its own table — absolute rows would
+    # break when the two engines compiled guides in different orders).
+    guide_row: int = 0
 
 
 @dataclasses.dataclass

@@ -80,6 +80,11 @@ class PrefillServer(OpenAIServer):
             return True
         meta = {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
                 "seed": pf.seed}
+        if pf.guide_row:
+            # Guided decoding: the post-first-token DFA state, relative to
+            # the guide's start row (the decode side rebases onto its own
+            # guide table).
+            meta["guide_row"] = pf.guide_row
         if pf.first_lp is not None:
             # First-token logprob data rides the JSON meta (floats + ids);
             # the decode side serves the rest of the logprob stream itself.
@@ -148,7 +153,8 @@ class DecodeServer(OpenAIServer):
             prefilled=PrefilledState(
                 first_token=int(meta["first_token"]),
                 num_prompt=int(meta["num_prompt"]),
-                seed=int(meta["seed"]), k=k, v=v, first_lp=first_lp))
+                seed=int(meta["seed"]), k=k, v=v, first_lp=first_lp,
+                guide_row=int(meta.get("guide_row", 0))))
         self.engine.add_request(req)
         self._respond(h, req, chat, model, body, stop_strings)
 

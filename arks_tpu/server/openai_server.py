@@ -104,6 +104,27 @@ def _sampling_from_body(body: dict, tokenizer,
             raise ValueError(
                 f"logit_bias token ids out of range [0, {vocab}): {bad[:5]}")
     min_tokens = max(int(body.get("min_tokens", 0)), 0)
+    # Guided decoding: OpenAI response_format json_object, plus the
+    # vLLM-style guided_regex extra.  Compiled HERE (cached per pattern)
+    # so an invalid pattern 400s before the request ever queues.
+    guide = None
+    rf = body.get("response_format")
+    if isinstance(rf, dict) and rf.get("type"):
+        rft = rf["type"]
+        if rft == "json_object":
+            guide = ("json", "")
+        elif rft == "regex" and rf.get("regex"):
+            guide = ("regex", str(rf["regex"]))
+        elif rft == "json_schema":
+            raise ValueError(
+                "response_format json_schema is not supported yet; use "
+                "json_object or guided_regex")
+        elif rft != "text":
+            raise ValueError(f"unknown response_format type {rft!r}")
+    if body.get("guided_regex"):
+        guide = ("regex", str(body["guided_regex"]))
+    if guide is not None and engine is not None:
+        engine.guides.compile(*guide)  # ValueError (400) on bad patterns
     params = SamplingParams(
         max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 256),
         temperature=float(body.get("temperature", 1.0)),
@@ -118,6 +139,7 @@ def _sampling_from_body(body: dict, tokenizer,
         logit_bias=logit_bias,
         min_tokens=min_tokens,
         priority=int(body.get("priority") or 0),
+        guide=guide,
     )
     if engine is not None and min_tokens:
         # Same composition the engine admits with (min_tokens_suppress_ids
@@ -327,7 +349,8 @@ class OpenAIServer:
             "created": int(time.time()), "owned_by": "arks-tpu",
         }]}
 
-    def _prompt_ids_batch(self, body: dict, chat: bool) -> list[list[int]]:
+    def _prompt_ids_batch(self, body: dict, chat: bool,
+                          tools: list | None = None) -> list[list[int]]:
         """One id-list per prompt. Chat is always a single prompt; completions
         accept a string, a token-id list, or a list of strings (OpenAI batch
         form -> one choice per prompt)."""
@@ -336,7 +359,7 @@ class OpenAIServer:
             messages = body.get("messages") or []
             if not isinstance(messages, list) or not messages:
                 raise ValueError("messages must be a non-empty list")
-            return [tok.apply_chat_template(messages)]
+            return [tok.apply_chat_template(messages, tools=tools)]
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             if all(isinstance(p, int) for p in prompt) and prompt:
@@ -357,9 +380,28 @@ class OpenAIServer:
         if model != self.served_model_name:
             return h._error(404, f"model {model!r} not found")
         try:
-            batch = self._prompt_ids_batch(body, chat)
+            from arks_tpu.server import tools as tools_mod
+            tools = None
+            tool_choice = "none"
+            if chat:
+                tools, tool_choice = tools_mod.validate_tools(body)
+            tools_on = bool(tools) and tool_choice != "none"
+            batch = self._prompt_ids_batch(body, chat,
+                                           tools=tools if tools_on else None)
             params, stop_strings = _sampling_from_body(
                 body, self.engine.tokenizer, self.engine)
+            tools_ctx = None
+            if tools_on:
+                tools_ctx = os.environ.get("ARKS_TOOL_PARSER", "auto")
+                forced = tools_mod.forced_call_guide(tools, tool_choice)
+                if forced is not None:
+                    if params.guide is not None:
+                        raise ValueError(
+                            "tool_choice required/named cannot combine "
+                            "with response_format/guided_regex")
+                    self.engine.guides.compile(*forced)
+                    import dataclasses as _dc0
+                    params = _dc0.replace(params, guide=forced)
             # OpenAI n: independent samples per prompt (choices are
             # prompt-major).  Seeded requests derive child seeds seed+j so
             # the choices differ while staying reproducible.
@@ -405,10 +447,10 @@ class OpenAIServer:
 
         if len(reqs) > 1:
             self._batch_response(h, reqs, model, stop_strings, chat=chat,
-                                 echo=echo)
+                                 echo=echo, tools_ctx=tools_ctx)
         else:
             self._respond(h, reqs[0], chat, model, body, stop_strings,
-                          echo=echo)
+                          echo=echo, tools_ctx=tools_ctx)
 
     def _context_length_error(self, h, got: int, limit: int) -> None:
         h._json(400, {"error": {
@@ -419,15 +461,22 @@ class OpenAIServer:
         }})
 
     def _respond(self, h, req: Request, chat: bool, model: str, body: dict,
-                 stop_strings: list[str], echo: bool = False) -> None:
-        """Stream-or-full dispatch tail, shared with the disaggregated path."""
+                 stop_strings: list[str], echo: bool = False,
+                 tools_ctx: str | None = None) -> None:
+        """Stream-or-full dispatch tail, shared with the disaggregated path.
+        ``tools_ctx`` is the tool-call parser name when the request carries
+        active tools (chat only)."""
         if bool(body.get("stream", False)):
             include_usage = bool(
                 (body.get("stream_options") or {}).get("include_usage"))
+            if tools_ctx is not None and chat:
+                return self._stream_tools_response(
+                    h, req, model, include_usage, stop_strings, tools_ctx)
             self._stream_response(h, req, chat, model, include_usage,
                                   stop_strings)
         else:
-            self._full_response(h, req, chat, model, stop_strings, echo=echo)
+            self._full_response(h, req, chat, model, stop_strings, echo=echo,
+                                tools_ctx=tools_ctx)
 
     # ------------------------------------------------------------------
 
@@ -563,7 +612,8 @@ class OpenAIServer:
 
     def _batch_response(self, h, reqs: list[Request], model: str,
                         stop_strings: list[str], chat: bool = False,
-                        echo: bool = False) -> None:
+                        echo: bool = False,
+                        tools_ctx: str | None = None) -> None:
         """Multi-choice responses: batched prompts and/or n > 1 (one
         engine request per choice, prompt-major indexes)."""
         choices, usage = [], {"prompt_tokens": 0, "completion_tokens": 0,
@@ -573,8 +623,9 @@ class OpenAIServer:
             text, finish_reason, fin, toks, lps, pieces = self._collect_text(
                 req, stop_strings)
             if chat:
-                choice = {"index": i,
-                          "message": {"role": "assistant", "content": text},
+                message, finish_reason = self._chat_message(
+                    text, finish_reason, tools_ctx)
+                choice = {"index": i, "message": message,
                           "finish_reason": finish_reason}
                 if req.params.logprobs is not None and lps:
                     choice["logprobs"] = {"content": self._lp_chat_content(
@@ -605,8 +656,26 @@ class OpenAIServer:
             "choices": choices, "usage": usage,
         })
 
+    def _chat_message(self, text: str, finish_reason: str,
+                      tools_ctx: str | None) -> tuple[dict, str]:
+        """Assistant message dict (+ effective finish_reason): with active
+        tools, generated text is parsed for tool calls; a call flips the
+        finish_reason to "tool_calls" (OpenAI contract — but never over a
+        truncation, clients must see length limits)."""
+        if tools_ctx is not None:
+            from arks_tpu.server.tools import parse_tool_calls
+            content, calls = parse_tool_calls(text, tools_ctx)
+            if calls:
+                msg = {"role": "assistant", "content": content,
+                       "tool_calls": calls}
+                fr = ("tool_calls" if finish_reason == "stop"
+                      else finish_reason)
+                return msg, fr
+        return {"role": "assistant", "content": text}, finish_reason
+
     def _full_response(self, h, req: Request, chat: bool, model: str,
-                       stop_strings: list[str], echo: bool = False) -> None:
+                       stop_strings: list[str], echo: bool = False,
+                       tools_ctx: str | None = None) -> None:
         text, finish_reason, fin, toks, lps, pieces = self._collect_text(
             req, stop_strings)
         echo_prefix = ""
@@ -630,8 +699,9 @@ class OpenAIServer:
         rid = req.request_id
         n_lp = req.params.logprobs
         if chat:
-            choice = {"index": 0,
-                      "message": {"role": "assistant", "content": text},
+            message, finish_reason = self._chat_message(text, finish_reason,
+                                                        tools_ctx)
+            choice = {"index": 0, "message": message,
                       "finish_reason": finish_reason}
             if n_lp is not None and lps:
                 choice["logprobs"] = {
@@ -652,6 +722,138 @@ class OpenAIServer:
                 "model": model, "choices": [choice], "usage": usage,
             }
         h._json(200, payload)
+
+    def _stream_tools_response(self, h, req: Request, model: str,
+                               include_usage: bool, stop_strings: list[str],
+                               parser: str) -> None:
+        """Chat streaming with active tools: content streams normally until
+        a tool-call marker appears; from there the text buffers and is
+        emitted as ``delta.tool_calls`` when the stream ends (each call's
+        arguments arrive in one delta — permitted by the protocol, and the
+        only faithful option when calls must parse as complete JSON).
+        Stop strings are applied over the full text, like the non-stream
+        path (the stream runs fully buffered when any are set), including
+        the min_tokens exemption."""
+        from arks_tpu.server.tools import (TOOL_OPEN, call_spans,
+                                           parse_tool_calls)
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def send_frame(obj) -> None:
+            data = b"data: " + (obj if isinstance(obj, bytes)
+                                else json.dumps(obj).encode()) + b"\n\n"
+            h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            h.wfile.flush()
+
+        rid = req.request_id
+        created = int(time.time())
+
+        def chunk(delta: dict | None, finish: str | None = None,
+                  usage: dict | None = None,
+                  empty_choices: bool = False) -> dict:
+            choices = [] if empty_choices else [
+                {"index": 0, "delta": delta or {}, "finish_reason": finish}]
+            payload = {"id": rid, "object": "chat.completion.chunk",
+                       "created": created, "model": model,
+                       "choices": choices}
+            if usage is not None:
+                payload["usage"] = usage
+            return payload
+
+        detok = IncrementalDetokenizer(self.engine.tokenizer)
+        text = ""
+        emitted = 0
+        buffering = bool(stop_strings)
+        hold = len(TOOL_OPEN) - 1
+        fin = None
+        min_tok = int(getattr(req.params, "min_tokens", 0) or 0)
+        ntok = 0
+        exempt = 0
+        try:
+            send_frame(chunk({"role": "assistant"}))
+            while True:
+                out = req.outputs.get()
+                prev_ntok = ntok
+                ntok += len(out.token_ids)
+                if stop_strings and prev_ntok < min_tok:
+                    # Token-wise pushes below min_tokens: the stop
+                    # exemption boundary must land on the exact token
+                    # (same semantics as _collect_text).
+                    for j, t in enumerate(out.token_ids):
+                        text += detok.push([t])
+                        if prev_ntok + j + 1 < min_tok:
+                            exempt = len(text)
+                else:
+                    text += detok.push(out.token_ids)
+                if out.finished:
+                    text += detok.flush()
+                    fin = out
+                if not buffering:
+                    m = text.find(TOOL_OPEN)
+                    if m >= 0:
+                        if m > emitted:
+                            send_frame(chunk({"content": text[emitted:m]}))
+                            emitted = m
+                        buffering = True
+                    elif (parser in ("auto", "llama3")
+                          and text.lstrip()[:1] == "{"):
+                        buffering = True  # llama3: whole message is a call
+                    elif not out.finished:
+                        # Hold back a window so a straddling marker isn't
+                        # half-emitted as content.
+                        safe = len(text) - hold
+                        if safe > emitted:
+                            send_frame(chunk({"content": text[emitted:safe]}))
+                            emitted = safe
+                if out.finished:
+                    break
+            finish = fin.finish_reason
+            if stop_strings and ntok >= min_tok:
+                cut = _find_stop(text, stop_strings, min_end=exempt)
+                if cut is not None:
+                    text = text[:cut]
+                    finish = "stop"
+            content, calls = parse_tool_calls(text, parser)
+            if calls:
+                # Leftover content in RAW coordinates: everything outside
+                # the call spans and past what was already streamed
+                # (parse_tool_calls' stripped content doesn't line up
+                # with the emitted offset).
+                pos = emitted
+                rest_parts = []
+                for s, e in call_spans(text, parser):
+                    if s > pos:
+                        rest_parts.append(text[pos:s])
+                    pos = max(pos, e)
+                if pos < len(text):
+                    rest_parts.append(text[pos:])
+                rest = "".join(rest_parts)
+                if rest:
+                    send_frame(chunk({"content": rest}))
+                for idx, call in enumerate(calls):
+                    send_frame(chunk({"tool_calls": [{
+                        "index": idx, "id": call["id"], "type": "function",
+                        "function": dict(call["function"])}]}))
+                if finish == "stop":
+                    finish = "tool_calls"
+            elif len(text) > emitted:
+                send_frame(chunk({"content": text[emitted:]}))
+            send_frame(chunk(None, finish=finish))
+            if include_usage:
+                send_frame(chunk(None, usage={
+                    "prompt_tokens": fin.num_prompt_tokens,
+                    "completion_tokens": fin.num_generated_tokens,
+                    "total_tokens": (fin.num_prompt_tokens
+                                     + fin.num_generated_tokens),
+                }, empty_choices=True))
+            send_frame(b"[DONE]")
+            h.wfile.write(b"0\r\n\r\n")
+            h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            self.engine.abort(req.request_id)
 
     def _stream_response(self, h, req: Request, chat: bool, model: str,
                          include_usage: bool, stop_strings: list[str]) -> None:

@@ -118,6 +118,43 @@ def test_disaggregated_matches_unified():
     assert len(got) == 8
 
 
+def test_disaggregated_guided_decoding():
+    """Guided request across the disagg pair: the prefill engine samples
+    the first token under the guide, the decode engine rebases the
+    RELATIVE DFA row onto its own table (compiled in a different order
+    here, to prove rebasing), and the full output matches the grammar."""
+    import json as _json
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=96,
+                        prefill_buckets=(16, 32), steps_per_dispatch=2)
+    tok = ByteTokenizer()
+    pat = r'\{"ok": (true|false)\}'
+    params = SamplingParams(max_tokens=24, temperature=0.0,
+                            guide=("regex", pat))
+    prefill_engine = InferenceEngine(cfg, ecfg, tok)
+    decode_engine = InferenceEngine(cfg, ecfg, tok)
+    # Skew the decode engine's table layout: an unrelated guide compiled
+    # FIRST shifts this guide's start_row vs the prefill engine's.
+    decode_engine.guides.compile("regex", "[a-z]+")
+    pf = prefill_engine.prefill_detached(tok.encode("zz"), params)
+    g = prefill_engine.guides.lookup("regex", pat)
+    assert 0 <= pf.guide_row < g.n_states
+
+    decode_engine.start()
+    try:
+        dreq = Request(request_id="dg1", prompt_ids=[], params=params,
+                       prefilled=PrefilledState(
+                           first_token=pf.first_token,
+                           num_prompt=pf.num_prompt, seed=pf.seed,
+                           k=pf.k, v=pf.v, guide_row=pf.guide_row))
+        decode_engine.add_request(dreq)
+        got = _drain(dreq)
+    finally:
+        decode_engine.stop()
+    text = tok.decode(got)  # _register_slot emits the first token too
+    assert _json.loads(text)["ok"] in (True, False)
+
+
 def test_detached_prefill_rejects_oversize_prompt():
     """The disaggregated prefill engine raises the typed rejection (the
     servers map it to HTTP 400 context_length_exceeded end-to-end, including

@@ -531,6 +531,42 @@ def test_min_tokens_defers_stop_strings(server):
     assert "stop" in finishes
 
 
+def test_guided_decoding_api(server):
+    """Guided decoding over HTTP: guided_regex forces an exact JSON shape;
+    response_format json_object keeps the stream inside the JSON grammar;
+    invalid patterns 400."""
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "hi", "max_tokens": 32,
+        "temperature": 0, "guided_regex": '\\{"ok": (true|false)\\}',
+    }) as r:
+        data = json.load(r)
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert json.loads(data["choices"][0]["text"])["ok"] in (True, False)
+
+    with _post(server, "/v1/chat/completions", {
+        "model": "tiny-serve",
+        "messages": [{"role": "user", "content": "produce json"}],
+        "max_tokens": 12, "temperature": 0,
+        "response_format": {"type": "json_object"},
+    }) as r:
+        data = json.load(r)
+    text = data["choices"][0]["message"]["content"]
+    from arks_tpu.engine.guides import compile_regex_dfa, json_mode_regex
+    t, _ = compile_regex_dfa(json_mode_regex(3))
+    st = 0
+    for b in text.encode():
+        st = t[st, b]
+        assert st >= 0, f"dead JSON transition in {text!r}"
+
+    try:
+        _post(server, "/v1/completions", {
+            "model": "tiny-serve", "prompt": "x", "max_tokens": 4,
+            "guided_regex": "(unclosed"})
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
 def test_find_stop_min_end_exemption():
     """A stop match ending at or before min_end is exempt, regardless of
     OTHER (longer) stop strings in the set; a straddling match cuts."""

@@ -125,7 +125,7 @@ def test_speculative_accept_distribution_exact():
         q_sel = jnp.stack([q, q2], axis=1)
         q_probs = jnp.stack([qp, qp2], axis=1)
         q_idx = jnp.stack([qi, qi2], axis=1)
-        out, counts, _ = sm.speculative_accept(
+        out, counts, _, _ = sm.speculative_accept(
             drafts, q_sel, q_probs, q_idx, t_logits, state, keys)
         return out[0, 0]  # the FIRST emitted token
 
