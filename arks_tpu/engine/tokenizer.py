@@ -30,7 +30,8 @@ class StreamDecoder(Protocol):
 class Tokenizer(Protocol):
     def encode(self, text: str) -> list[int]: ...
     def decode(self, ids: Sequence[int]) -> str: ...
-    def apply_chat_template(self, messages: list[dict]) -> list[int]: ...
+    def apply_chat_template(self, messages: list[dict],
+                            tools: list | None = None) -> list[int]: ...
     def make_stream_decoder(self) -> StreamDecoder: ...
     @property
     def eos_token_ids(self) -> tuple[int, ...]: ...
@@ -62,9 +63,21 @@ class ByteTokenizer:
         data = bytes((i - self.OFFSET) % 256 for i in ids if i >= self.OFFSET)
         return data.decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages: list[dict]) -> list[int]:
-        text = "".join(f"<{m['role']}>{m['content']}</{m['role']}>" for m in messages)
-        return [1] + self.encode(text)
+    def apply_chat_template(self, messages: list[dict],
+                            tools: list | None = None) -> list[int]:
+        parts = []
+        if tools:
+            from arks_tpu.server.tools import tools_system_text
+            parts.append(f"<system>{tools_system_text(tools)}</system>")
+        for m in messages:
+            body = m.get("content") or ""
+            for tc in m.get("tool_calls") or ():
+                fn = tc.get("function", {})
+                body += (f"<tool_call>{{\"name\": \"{fn.get('name')}\", "
+                         f"\"arguments\": {fn.get('arguments')}}}"
+                         "</tool_call>")
+            parts.append(f"<{m['role']}>{body}</{m['role']}>")
+        return [1] + self.encode("".join(parts))
 
     def make_stream_decoder(self) -> StreamDecoder:
         return _ByteStreamDecoder(self)
@@ -112,7 +125,21 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
-    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+    def apply_chat_template(self, messages: list[dict],
+                            tools: list | None = None) -> list[int]:
+        if tools:
+            try:
+                # Modern templates (Qwen2.5, Llama-3.1, Hermes) render
+                # tools natively.
+                return self._tok.apply_chat_template(
+                    messages, tools=tools, add_generation_prompt=True)
+            except Exception:
+                # Template without tools support: declare them in a system
+                # message using the hermes convention the parser expects.
+                from arks_tpu.server.tools import tools_system_text
+                messages = ([{"role": "system",
+                              "content": tools_system_text(tools)}]
+                            + list(messages))
         return self._tok.apply_chat_template(messages, add_generation_prompt=True)
 
     def make_stream_decoder(self) -> StreamDecoder:

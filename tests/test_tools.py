@@ -1,0 +1,251 @@
+"""OpenAI tool calling: parsing, forced-call guides, HTTP round trips.
+
+Parity target: vLLM/SGLang tools/tool_calls on /v1/chat/completions
+(launched via arksapplication_controller.go:941-1014)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from arks_tpu.server.tools import (forced_call_guide, parse_tool_calls,
+                                   validate_tools)
+
+WEATHER = {"type": "function",
+           "function": {"name": "get_weather",
+                        "description": "Look up weather",
+                        "parameters": {"type": "object", "properties": {
+                            "city": {"type": "string"}}}}}
+TIME = {"type": "function", "function": {"name": "get_time"}}
+
+
+# ---------------------------------------------------------------------------
+# Unit
+# ---------------------------------------------------------------------------
+
+def test_validate_tools():
+    assert validate_tools({}) == (None, "none")
+    tools, choice = validate_tools({"tools": [WEATHER]})
+    assert choice == "auto" and tools[0]["function"]["name"] == "get_weather"
+    for bad in ({"tools": []}, {"tools": [{"type": "x"}]},
+                {"tools": [WEATHER], "tool_choice": "sometimes"},
+                {"tools": [WEATHER],
+                 "tool_choice": {"type": "function",
+                                 "function": {"name": "nope"}}}):
+        with pytest.raises(ValueError):
+            validate_tools(bad)
+
+
+def test_parse_hermes_calls():
+    text = ('thinking first <tool_call>{"name": "get_weather", '
+            '"arguments": {"city": "Oslo"}}</tool_call> and '
+            '<tool_call>{"name": "get_time", "arguments": {}}</tool_call>')
+    content, calls = parse_tool_calls(text)
+    assert content == "thinking first  and"
+    assert [c["function"]["name"] for c in calls] == ["get_weather",
+                                                      "get_time"]
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Oslo"}
+    assert calls[0]["id"].startswith("call_")
+    assert calls[0]["type"] == "function"
+
+    # Calls only -> content is None (OpenAI convention).
+    content, calls = parse_tool_calls(
+        '<tool_call>{"name": "get_time", "arguments": {}}</tool_call>')
+    assert content is None and len(calls) == 1
+
+    # Malformed JSON inside the marker stays content.
+    content, calls = parse_tool_calls("<tool_call>not json</tool_call>")
+    assert calls == [] and "not json" in content
+
+
+def test_parse_llama3_call():
+    content, calls = parse_tool_calls(
+        ' {"name": "get_weather", "parameters": {"city": "Pune"}} ')
+    assert content is None
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Pune"}
+    # Plain prose passes through untouched.
+    content, calls = parse_tool_calls("just words")
+    assert content == "just words" and calls == []
+
+
+def test_call_spans_raw_coordinates():
+    """call_spans reports RAW offsets (streaming emits leftover content
+    from them — stripped-content offsets would drop characters)."""
+    from arks_tpu.server.tools import call_spans
+    text = ('  <tool_call>{"name": "get_time", "arguments": {}}'
+            '</tool_call> result: 42')
+    (s, e), = call_spans(text)
+    assert text[s:].startswith("<tool_call>")
+    assert text[:s] == "  " and text[e:] == " result: 42"
+    # Unparseable block -> no span (it stays content).
+    assert call_spans("<tool_call>junk</tool_call>") == []
+    # llama3 whole-message call spans everything.
+    assert call_spans(' {"name": "f", "arguments": {}} ') == [(0, 32)]
+
+
+def test_forced_call_guide_matches_and_parses():
+    from arks_tpu.engine.guides import compile_regex_dfa
+    kind, pat = forced_call_guide([WEATHER, TIME], "required")
+    assert kind == "regex"
+    t, a = compile_regex_dfa(pat)
+
+    def match(s):
+        st = 0
+        for b in s.encode():
+            st = t[st, b]
+            if st < 0:
+                return False
+        return bool(a[st])
+
+    good = ('<tool_call>{"name": "get_weather", "arguments": '
+            '{"city": "NYC", "n": 3}}</tool_call>')
+    assert match(good)
+    _, calls = parse_tool_calls(good)
+    assert calls and calls[0]["function"]["name"] == "get_weather"
+    assert not match('<tool_call>{"name": "other", "arguments": {}}'
+                     '</tool_call>')
+    assert not match("free text")
+    # Named choice narrows to one function.
+    _, pat1 = forced_call_guide([WEATHER, TIME],
+                                {"type": "function",
+                                 "function": {"name": "get_time"}})
+    t1, a1 = compile_regex_dfa(pat1)
+    s = '<tool_call>{"name": "get_time", "arguments": {}}</tool_call>'
+    st = 0
+    for b in s.encode():
+        st = t1[st, b]
+    assert st >= 0 and a1[st]
+
+
+# ---------------------------------------------------------------------------
+# HTTP round trips (forced calls make the random tiny model emit real
+# tool-call wire format — the DFA does the formatting)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server():
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.server import OpenAIServer
+
+    cfg = get_config("tiny")
+    # ByteTokenizer spends one token per byte, and the textual tools
+    # declaration alone is ~270 bytes — size the window accordingly.
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=640,
+                        prefill_buckets=(64, 128, 256, 512),
+                        steps_per_dispatch=4)
+    engine = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    engine.start()
+    srv = OpenAIServer(engine, served_model_name="tiny-serve",
+                       host="127.0.0.1", port=0)
+    srv.start(background=True)
+    yield srv
+    srv.stop()
+    engine.stop()
+
+
+def _post(server, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_tool_call_roundtrip_forced(server):
+    body = {
+        "model": "tiny-serve", "max_tokens": 96, "temperature": 0,
+        "messages": [{"role": "user", "content": "what time is it?"}],
+        "tools": [WEATHER, TIME],
+        "tool_choice": {"type": "function",
+                        "function": {"name": "get_time"}},
+        # '}' (byte 125 -> id 127) biased +100: the random test model
+        # closes the arguments object at the first legal chance, making
+        # the forced call minimal and the test length-independent (the
+        # guide mask applies AFTER bias, so the bias only acts where '}'
+        # is grammatical).
+        "logit_bias": {"127": 100},
+    }
+    with _post(server, "/v1/chat/completions", body) as r:
+        data = json.load(r)
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    calls = choice["message"]["tool_calls"]
+    assert calls[0]["function"]["name"] == "get_time"
+    json.loads(calls[0]["function"]["arguments"])  # parseable by contract
+    assert choice["message"]["content"] is None
+
+
+def test_tool_call_required_streaming(server):
+    body = {
+        "model": "tiny-serve", "max_tokens": 96, "temperature": 0,
+        "messages": [{"role": "user", "content": "pick any tool"}],
+        "tools": [TIME], "tool_choice": "required",
+        "logit_bias": {"127": 100},  # see test_tool_call_roundtrip_forced
+        "stream": True, "stream_options": {"include_usage": True},
+    }
+    frames = []
+    with _post(server, "/v1/chat/completions", body) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[len("data: "):])
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    tc_deltas = [c["choices"][0]["delta"]["tool_calls"]
+                 for c in chunks
+                 if c["choices"] and "tool_calls" in c["choices"][0]["delta"]]
+    assert tc_deltas and tc_deltas[0][0]["function"]["name"] == "get_time"
+    finishes = [c["choices"][0]["finish_reason"]
+                for c in chunks if c["choices"]]
+    assert "tool_calls" in finishes
+    assert any(c.get("usage") for c in chunks)
+
+
+def test_tools_auto_plain_answer_passes_through(server):
+    """tool_choice auto with a model that answers in prose: content flows,
+    finish_reason stays normal, no tool_calls key."""
+    body = {
+        "model": "tiny-serve", "max_tokens": 8, "temperature": 0,
+        "messages": [{"role": "user", "content": "hello"}],
+        "tools": [WEATHER],  # auto by default
+        "ignore_eos": True,
+    }
+    with _post(server, "/v1/chat/completions", body) as r:
+        data = json.load(r)
+    choice = data["choices"][0]
+    assert "tool_calls" not in choice["message"]
+    assert choice["finish_reason"] in ("length", "stop")
+
+
+def test_tool_choice_none_renders_no_tools(server):
+    """tool_choice none must not inject the tools declaration into the
+    prompt: usage.prompt_tokens matches the same request without tools."""
+    base = {
+        "model": "tiny-serve", "max_tokens": 2, "temperature": 0,
+        "messages": [{"role": "user", "content": "hi"}],
+    }
+    with _post(server, "/v1/chat/completions", base) as r:
+        plain = json.load(r)["usage"]["prompt_tokens"]
+    with _post(server, "/v1/chat/completions",
+               {**base, "tools": [WEATHER], "tool_choice": "none"}) as r:
+        none_toks = json.load(r)["usage"]["prompt_tokens"]
+    with _post(server, "/v1/chat/completions",
+               {**base, "tools": [WEATHER]}) as r:
+        auto_toks = json.load(r)["usage"]["prompt_tokens"]
+    assert none_toks == plain
+    assert auto_toks > plain
+
+
+def test_bad_tools_400(server):
+    try:
+        _post(server, "/v1/chat/completions", {
+            "model": "tiny-serve", "max_tokens": 2,
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": [{"type": "function", "function": {}}]})
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
