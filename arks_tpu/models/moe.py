@@ -136,13 +136,12 @@ def moe_ffn_grouped(x: jnp.ndarray, mp: Params, cfg) -> jnp.ndarray:
     group_sizes = jnp.bincount(flat_expert, length=nx)
 
     from arks_tpu.ops.moe_kernel import grouped_ffn, moe_impl
-    int4 = isinstance(mp["w_gate"], dict) and "gs" in mp["w_gate"]
-    if moe_impl() == "pallas" and not int4:
-        # (int4 experts take the ragged path below: the kernel's fused
-        # dequant understands per-channel int8 scales, not group scales.)
-        # Block-sparse Pallas grouped matmul: int8 expert dequant stays
-        # FUSED (per-channel scales on the accumulator) instead of
-        # materializing full-width weights for ragged_dot.
+    if moe_impl() == "pallas":
+        # Block-sparse Pallas grouped matmul with the dequant FUSED:
+        # int8 per-channel scales fold into the accumulator; int4 group
+        # scales dequant the weight tile in-register — either way the
+        # full-width expert weights never materialize in HBM (ragged_dot
+        # below forces exactly that materialization).
         down = grouped_ffn(xs, jnp.take(flat_expert, order), group_sizes,
                            mp["w_gate"], mp["w_up"], mp["w_down"], x.dtype)
     else:

@@ -1,12 +1,13 @@
 """Block-sparse grouped matmul for MoE prefill (Pallas TPU kernel).
 
-``jax.lax.ragged_dot`` serves the grouped path today, but for int8
-(w8a16) experts it forces a DEQUANTIZED materialization of every routed
-expert's weights before the matmul (models/moe.py) — doubling expert
-weight HBM traffic exactly where MoE prefill is weight-bound.  This kernel
-is the megablocks-style alternative with the dequant FUSED: int8 weight
-tiles are read raw and the per-output-channel scales fold into the f32
-accumulator.
+``jax.lax.ragged_dot`` serves the grouped path today, but for quantized
+(w8a16 / w4a16) experts it forces a DEQUANTIZED materialization of every
+routed expert's weights before the matmul (models/moe.py) — doubling (or
+4x for int4) expert weight HBM traffic exactly where MoE prefill is
+weight-bound.  This kernel is the megablocks-style alternative with the
+dequant FUSED: quantized weight tiles are read raw; int8 per-channel
+scales fold into the f32 accumulator, int4 group scales dequant the tile
+in-register before the MXU dot.
 
 Layout contract (prepared by ``pad_groups``):
 - Rows are sorted by expert and each expert's group is padded to a
@@ -70,13 +71,26 @@ def pad_groups(xs: jnp.ndarray, sorted_expert: jnp.ndarray,
     return xs_padded, dest, block_expert
 
 
-def _gm_kernel(bexp_ref, x_ref, w_ref, *rest, quantized: bool):
+def _gm_kernel(bexp_ref, x_ref, w_ref, *rest, quantized: bool,
+               group: int = 0):
     if quantized:
         ws_ref, o_ref = rest
     else:
         (o_ref,) = rest
     x = x_ref[...]
     w = w_ref[0]
+    if quantized and group:
+        # int4 groupwise: scales vary ALONG the contraction dim, so they
+        # cannot fold into the accumulator like int8's per-channel scales
+        # — dequant the tile in-register (same bf16 math as the XLA
+        # producer fusion in models/quant._dequant_int4) and feed the MXU.
+        gs = ws_ref[0]                                   # [K/G, bn] f32
+        kk, bn = w.shape
+        wdq = (w.astype(x.dtype).reshape(kk // group, group, bn)
+               * gs[:, None, :].astype(x.dtype)).reshape(kk, bn)
+        o_ref[...] = jax.lax.dot(
+            x, wdq, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+        return
     acc = jax.lax.dot(x, w.astype(x.dtype),
                       preferred_element_type=jnp.float32)
     if quantized:
@@ -88,14 +102,15 @@ def _gm_kernel(bexp_ref, x_ref, w_ref, *rest, quantized: bool):
                    static_argnames=("block_t", "block_n", "interpret"))
 def grouped_matmul(
     xs: jnp.ndarray,           # [Tp, K] expert-sorted, block-aligned groups
-    w: jnp.ndarray,            # [E, K, N] (int8 when w_scale given)
+    w: jnp.ndarray,            # [E, K, N] (int8/int4 when scales given)
     block_expert: jnp.ndarray,  # [Tp/block_t] int32 tile -> expert
-    w_scale: jnp.ndarray | None = None,  # [E, N] per-output-channel scales
+    w_scale: jnp.ndarray | None = None,  # int8: [E, N] per-channel scales
+    w_group_scale: jnp.ndarray | None = None,  # int4: [E, K/G, N] scales
     block_t: int = 128,
     block_n: int = 128,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """[Tp, N] = per-tile xs @ w[block_expert[tile]] (* w_scale fused)."""
+    """[Tp, N] = per-tile xs @ w[block_expert[tile]] (scales fused)."""
     tp, k = xs.shape
     nx, _, n = w.shape
     if tp % block_t:
@@ -103,7 +118,12 @@ def grouped_matmul(
     block_n = min(block_n, n)
     if n % block_n:
         raise ValueError(f"N {n} not a multiple of block_n {block_n}")
-    quantized = w_scale is not None
+    group = 0
+    if w_group_scale is not None:
+        if w_scale is not None:
+            raise ValueError("w_scale and w_group_scale are exclusive")
+        group = k // w_group_scale.shape[1]
+    quantized = w_scale is not None or w_group_scale is not None
 
     def x_map(ti, ni, bexp):
         del ni, bexp
@@ -119,12 +139,18 @@ def grouped_matmul(
         del bexp
         return (ti, ni)
 
+    def gs_map(ti, ni, bexp):
+        return (bexp[ti], 0, ni)
+
     in_specs = [
         pl.BlockSpec((block_t, k), x_map),
         pl.BlockSpec((1, k, block_n), w_map),
     ]
     inputs = [block_expert.astype(jnp.int32), xs, w]
-    if quantized:
+    if group:
+        in_specs.append(pl.BlockSpec((1, k // group, block_n), gs_map))
+        inputs.append(w_group_scale)
+    elif quantized:
         in_specs.append(pl.BlockSpec((1, block_n), ws_map))
         inputs.append(w_scale)
 
@@ -135,7 +161,7 @@ def grouped_matmul(
         out_specs=pl.BlockSpec((block_t, block_n), o_map),
     )
     return pl.pallas_call(
-        functools.partial(_gm_kernel, quantized=quantized),
+        functools.partial(_gm_kernel, quantized=quantized, group=group),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((tp, n), xs.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -157,24 +183,28 @@ def grouped_ffn(xs: jnp.ndarray, sorted_expert: jnp.ndarray,
         interpret = jax.default_backend() != "tpu"
 
     def wv(wq):
+        """(raw weight, kwargs for grouped_matmul's scale argument)."""
         if is_quantized(wq):
+            if "gs" in wq:    # int4 groupwise [E, K/G, N]
+                return wq["q"], {"w_group_scale":
+                                 wq["gs"].astype(jnp.float32)}
             s = wq["s"].astype(jnp.float32)
             if s.ndim == 3:       # [E, 1, N] per-output-channel -> [E, N]
                 s = s[:, 0, :]
-            return wq["q"], s
-        return wq, None
+            return wq["q"], {"w_scale": s}
+        return wq, {}
 
     wg, sg = wv(w_gate)
     wu, su = wv(w_up)
     wd, sd = wv(w_down)
 
     xs_p, dest, bexp = pad_groups(xs, sorted_expert, group_sizes, block_t)
-    gate = grouped_matmul(xs_p, wg, bexp, sg, block_t=block_t,
-                          interpret=interpret)
-    up = grouped_matmul(xs_p, wu, bexp, su, block_t=block_t,
-                        interpret=interpret)
+    gate = grouped_matmul(xs_p, wg, bexp, block_t=block_t,
+                          interpret=interpret, **sg)
+    up = grouped_matmul(xs_p, wu, bexp, block_t=block_t,
+                        interpret=interpret, **su)
     act = (jax.nn.silu(gate.astype(jnp.float32)).astype(act_dtype)
            * up.astype(act_dtype))
-    down = grouped_matmul(act, wd, bexp, sd, block_t=block_t,
-                          interpret=interpret)
+    down = grouped_matmul(act, wd, bexp, block_t=block_t,
+                          interpret=interpret, **sd)
     return down[dest]

@@ -208,6 +208,16 @@ def test_grouped_matmul_kernel_matches_ragged_dot():
     np.testing.assert_allclose(np.asarray(got_q), np.asarray(ref_q),
                                atol=1e-3, rtol=1e-3)
 
+    # int4 groupwise fused dequant vs materialized dequant + ragged_dot.
+    from arks_tpu.models.quant import quantize_tensor_int4
+    w4 = quantize_tensor_int4(w, group=8)
+    ref_4 = jax.lax.ragged_dot(xs, dequantize(w4, jnp.float32), group_sizes)
+    got_4 = grouped_matmul(xs_p, w4["q"], bexp,
+                           w_group_scale=w4["gs"].astype(jnp.float32),
+                           block_t=bt, block_n=16, interpret=True)[dest]
+    np.testing.assert_allclose(np.asarray(got_4), np.asarray(ref_4),
+                               atol=1e-3, rtol=1e-3)
+
 
 def test_moe_grouped_pallas_matches_xla_path(monkeypatch):
     """The full grouped MoE FFN through the Pallas kernel == the ragged_dot
@@ -242,4 +252,14 @@ def test_moe_grouped_pallas_matches_xla_path(monkeypatch):
     monkeypatch.setenv("ARKS_MOE_KERNEL", "pallas")
     got_q = moe_ffn_grouped(x, qp1, cfg)
     np.testing.assert_allclose(np.asarray(got_q), np.asarray(ref_q),
+                               atol=2e-3, rtol=2e-3)
+
+    # int4 (w4a16) experts: group-scale dequant fused in the kernel.
+    q4 = quantize_params(params, bits=4)["layers"]
+    q41 = jax.tree.map(lambda a: a[0], q4)
+    monkeypatch.setenv("ARKS_MOE_KERNEL", "xla")
+    ref_4 = moe_ffn_grouped(x, q41, cfg)
+    monkeypatch.setenv("ARKS_MOE_KERNEL", "pallas")
+    got_4 = moe_ffn_grouped(x, q41, cfg)
+    np.testing.assert_allclose(np.asarray(got_4), np.asarray(ref_4),
                                atol=2e-3, rtol=2e-3)
