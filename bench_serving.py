@@ -66,9 +66,13 @@ def _client_main(argv: list[str]) -> None:
     ap.add_argument("--max-tokens", type=int, default=256)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--prefix-len", type=int, default=0)
-    ap.add_argument("--probes", type=int, default=2)
+    # Probe sizing: p99 claims need >= 100 TTFT observations per window
+    # (r04 shipped a "p99" from 14 samples — i.e. the max).  Each probe
+    # cycle costs ttft + interval, so at the saturated-regime TTFT (~2.5s
+    # pre-deferral) 10 probes at 0.25s still clear ~100 per 30s window.
+    ap.add_argument("--probes", type=int, default=10)
     ap.add_argument("--probe-prompt-len", type=int, default=512)
-    ap.add_argument("--probe-interval", type=float, default=0.5)
+    ap.add_argument("--probe-interval", type=float, default=0.25)
     args = ap.parse_args(argv)
 
     stop_at = time.monotonic() + args.seconds
@@ -234,6 +238,9 @@ def _run_moderate_phase(port: int, slots: int, seconds: float,
          "--max-tokens", str(max_tokens),
          "--prompt-len", str(prompt_len),
          "--probe-prompt-len", str(probe_len),
+         "--probes", os.environ.get("ARKS_BENCH_SERVE_PROBES", "10"),
+         "--probe-interval",
+         os.environ.get("ARKS_BENCH_SERVE_PROBE_INTERVAL", "0.25"),
          "--prefix-len", str(prefix_len)],
         stdout=subprocess.PIPE, text=True)
     try:
@@ -383,6 +390,9 @@ def run_serving_bench(model: str | None = None) -> dict:
          "--clients", str(clients), "--seconds", str(total_s),
          "--max-tokens", str(max_tokens), "--prompt-len", str(prompt_len),
          "--probe-prompt-len", str(probe_len),
+         "--probes", os.environ.get("ARKS_BENCH_SERVE_PROBES", "10"),
+         "--probe-interval",
+         os.environ.get("ARKS_BENCH_SERVE_PROBE_INTERVAL", "0.25"),
          "--prefix-len", str(prefix_len)],
         stdout=subprocess.PIPE, text=True)
     names = ("generation_tokens_total", "scheduler_seconds_total",

@@ -1,0 +1,138 @@
+"""Quantify the dp/paged exclusion (engine.py:_resolve_kv_layout).
+
+The paged KV pool has no batch dim to shard over a ``data`` mesh axis, so
+dp-meshed engines fall back to the slot layout — trading away on-device
+prefix sharing and page-granular HBM.  The recommended alternative is
+REPLICA GROUPS (independent engines behind weighted routes), each running
+paged.  This tool measures both sides per chip:
+
+  A. one engine meshed dp=DP over DP devices, slot layout (the excluded
+     configuration), throughput / DP chips;
+  B. one single-device engine on the paged layout (a replica group member
+     — replica scaling is linear by construction, no cross-replica
+     collectives), throughput / 1 chip.
+
+Run on TPU for real numbers (paged interpret-mode kernels make CPU
+figures mechanics-only):
+
+  python tools/bench_dp_paged.py                     # chip defaults
+  XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  JAX_PLATFORMS=cpu ARKS_DPBENCH_MODEL=tiny \
+  ARKS_DPBENCH_REQUESTS=8 ARKS_DPBENCH_MAX_TOKENS=16 \
+  python tools/bench_dp_paged.py                     # CPU mechanics
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_engine(model: str, *, data_parallel: int, kv_layout: str,
+                num_slots: int, cache_len: int, steps: int,
+                requests: int, prompt_len: int, max_tokens: int) -> float:
+    """Tokens/second over `requests` greedy requests, drained together."""
+    import numpy as np
+
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.engine.types import Request, SamplingParams
+    from arks_tpu.models import get_config
+
+    cfg = get_config(model)
+    ecfg = EngineConfig(
+        model=model, num_slots=num_slots, max_cache_len=cache_len,
+        steps_per_dispatch=steps, kv_layout=kv_layout,
+        data_parallel=data_parallel,
+        weight_dtype=os.environ.get("ARKS_DPBENCH_WEIGHT_DTYPE", "bf16"),
+        prefill_buckets=(max(prompt_len, 8),))
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    rng = np.random.default_rng(0)
+    try:
+        reqs = []
+        params = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                                ignore_eos=True)
+        # Warmup: compile every program before the measured window.
+        w = Request(request_id="warm",
+                    prompt_ids=[int(x) for x in
+                                rng.integers(3, 200, prompt_len)],
+                    params=SamplingParams(max_tokens=steps + 1,
+                                          temperature=0.0, ignore_eos=True))
+        eng.add_request(w)
+        while True:
+            if w.outputs.get(timeout=600).finished:
+                break
+        t0 = time.monotonic()
+        for i in range(requests):
+            r = Request(request_id=f"r{i}",
+                        prompt_ids=[int(x) for x in
+                                    rng.integers(3, 200, prompt_len)],
+                        params=params)
+            eng.add_request(r)
+            reqs.append(r)
+        total = 0
+        for r in reqs:
+            while True:
+                out = r.outputs.get(timeout=1200)
+                total += len(out.token_ids)
+                if out.finished:
+                    break
+        dt = time.monotonic() - t0
+        return total / dt
+    finally:
+        eng.stop()
+
+
+def main() -> None:
+    env = os.environ.get
+    if env("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", env("JAX_PLATFORMS"))
+    import jax
+    devs = jax.devices()
+    on_tpu = jax.default_backend() == "tpu"
+    dp = int(env("ARKS_DPBENCH_DP", "2"))
+    if len(devs) < dp:
+        print(json.dumps({"error": f"need {dp} devices, have {len(devs)}"}))
+        return
+    model = env("ARKS_DPBENCH_MODEL", "qwen2.5-7b" if on_tpu else "tiny")
+    requests = int(env("ARKS_DPBENCH_REQUESTS", "64" if on_tpu else "8"))
+    num_slots = int(env("ARKS_DPBENCH_SLOTS", "32" if on_tpu else "4"))
+    cache_len = int(env("ARKS_DPBENCH_CACHE_LEN", "1024" if on_tpu else "64"))
+    prompt_len = int(env("ARKS_DPBENCH_PROMPT_LEN", "128" if on_tpu else "8"))
+    max_tokens = int(env("ARKS_DPBENCH_MAX_TOKENS", "128" if on_tpu else "8"))
+    steps = int(env("ARKS_DPBENCH_STEPS", "8" if on_tpu else "2"))
+
+    common = dict(num_slots=num_slots, cache_len=cache_len, steps=steps,
+                  prompt_len=prompt_len, max_tokens=max_tokens)
+    # A: the excluded config — dp mesh forces the slot layout.
+    a = _run_engine(model, data_parallel=dp, kv_layout="slot",
+                    requests=requests, **common)
+    # B: a replica-group member — single device, paged (the production
+    # default on TPU; CPU runs it in interpret mode, mechanics only).
+    b_layout = "paged" if on_tpu else env("ARKS_DPBENCH_B_LAYOUT", "slot")
+    b = _run_engine(model, data_parallel=1, kv_layout=b_layout,
+                    requests=requests // dp, **common)
+    a_chip, b_chip = a / dp, b
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "model": model,
+        "dp": dp,
+        "dp_slot_tok_s_chip": round(a_chip, 1),
+        "replica_tok_s_chip": round(b_chip, 1),
+        "replica_layout": b_layout,
+        "dp_penalty_pct": round((1 - a_chip / b_chip) * 100, 1) if b_chip
+        else None,
+        "mechanics_only": not on_tpu,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# Real-cluster e2e on Kind — the executable form of docs/runbook.md §1-§5,
+# mirroring the reference's Kind suite (test/e2e/e2e_test.go:45-270):
+# deploy CRDs + operator, assert the controller runs, serve the quickstart,
+# complete a request through the gateway (auth positive AND negative),
+# scrape TokenReview-authenticated operator metrics, kill the leader and
+# assert standby failover, tear down.
+#
+# Usage:   tools/e2e_kind.sh
+# Env:     CLUSTER=arks-e2e      kind cluster name
+#          EXISTING_CLUSTER=1    skip kind create/delete (use current ctx)
+#          KEEP=1                keep the cluster + workloads on success
+#          SKIP_BUILD=1          image already present in the cluster
+set -euo pipefail
+
+CLUSTER="${CLUSTER:-arks-e2e}"
+IMG=arks-tpu/engine:latest
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+need() { command -v "$1" >/dev/null || { echo "SKIP: $1 not installed" >&2; exit 3; }; }
+need kind; need kubectl; need docker; need curl
+
+say() { echo "=== $*" >&2; }
+
+cleanup() {
+  code=$?
+  if [ "${KEEP:-0}" != 1 ] && [ "${EXISTING_CLUSTER:-0}" != 1 ]; then
+    kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
+  fi
+  pkill -f "kubectl.*port-forward.*arks" 2>/dev/null || true
+  exit $code
+}
+trap cleanup EXIT
+
+if [ "${EXISTING_CLUSTER:-0}" != 1 ]; then
+  say "creating kind cluster $CLUSTER"
+  kind create cluster --name "$CLUSTER" --wait 120s
+fi
+
+if [ "${SKIP_BUILD:-0}" != 1 ]; then
+  say "building + loading $IMG"
+  docker build -t "$IMG" -f dockerfiles/Dockerfile .
+  kind load docker-image "$IMG" --name "$CLUSTER"
+fi
+
+say "installing CRDs + operator (runbook §1)"
+kubectl apply -f deploy/crds.yaml
+kubectl apply -f deploy/operator.yaml
+kubectl -n arks-system rollout status deploy/arks-operator --timeout=180s
+
+say "asserting exactly one Ready replica (leader-only readiness)"
+ready_count() {
+  kubectl -n arks-system get pods -l app=arks-operator \
+    -o jsonpath='{range .items[*]}{.status.containerStatuses[0].ready}{"\n"}{end}' \
+    | grep -c true || true
+}
+for i in $(seq 1 60); do
+  [ "$(ready_count)" = 1 ] && break
+  sleep 2
+done
+[ "$(ready_count)" = 1 ] || { echo "FAIL: want exactly 1 Ready operator replica, got $(ready_count)" >&2; exit 1; }
+
+say "serving the quickstart (runbook §2)"
+kubectl apply -f examples/quickstart/quickstart.yaml
+for i in $(seq 1 90); do
+  phase=$(kubectl get arksapplication qwen2.5-app -o jsonpath='{.status.phase}' 2>/dev/null || true)
+  [ "$phase" = Running ] && break
+  sleep 2
+done
+[ "${phase:-}" = Running ] || { echo "FAIL: quickstart phase=$phase (want Running)" >&2; kubectl describe arksapplication qwen2.5-app >&2 || true; exit 1; }
+
+say "completion through the gateway (runbook §3)"
+kubectl -n arks-system port-forward svc/arks-operator-gateway 18081:8081 >/dev/null 2>&1 &
+PF=$!
+sleep 3
+body='{"model": "qwen2.5", "messages": [{"role": "user", "content": "hi"}], "max_tokens": 8}'
+resp=$(curl -sf localhost:18081/v1/chat/completions \
+  -H 'Authorization: Bearer sk-quickstart' -H 'Content-Type: application/json' \
+  -d "$body")
+echo "$resp" | grep -q '"usage"' || { echo "FAIL: no usage in completion: $resp" >&2; exit 1; }
+code=$(curl -s -o /dev/null -w '%{http_code}' localhost:18081/v1/chat/completions \
+  -H 'Content-Type: application/json' -d "$body")
+[ "$code" = 401 ] || { echo "FAIL: unauthenticated completion got $code (want 401)" >&2; exit 1; }
+kill $PF 2>/dev/null || true
+
+say "TokenReview-authenticated metrics scrape (runbook §4)"
+kubectl -n arks-system port-forward deploy/arks-operator 18082:8082 >/dev/null 2>&1 &
+PF=$!
+sleep 3
+tok=$(kubectl -n arks-system create token arks-operator)
+mcode=$(curl -s -o /tmp/arks_e2e_metrics -w '%{http_code}' \
+  -H "Authorization: Bearer $tok" localhost:18082/metrics)
+[ "$mcode" = 200 ] || { echo "FAIL: authed metrics scrape got $mcode" >&2; exit 1; }
+ucode=$(curl -s -o /dev/null -w '%{http_code}' localhost:18082/metrics)
+case "$ucode" in 401|403) ;; *) echo "FAIL: unauthed metrics got $ucode (want 401/403)" >&2; exit 1;; esac
+kill $PF 2>/dev/null || true
+
+say "leader failover: delete the Ready pod, standby must take over"
+leader=$(kubectl -n arks-system get pods -l app=arks-operator \
+  -o jsonpath='{range .items[*]}{.metadata.name}={.status.containerStatuses[0].ready}{"\n"}{end}' \
+  | awk -F= '$2=="true"{print $1; exit}')
+[ -n "$leader" ] || { echo "FAIL: no Ready operator pod found" >&2; exit 1; }
+kubectl -n arks-system delete pod "$leader" --wait=false
+for i in $(seq 1 90); do
+  now=$(kubectl -n arks-system get pods -l app=arks-operator \
+    -o jsonpath='{range .items[*]}{.metadata.name}={.status.containerStatuses[0].ready}{"\n"}{end}' \
+    | awk -F= '$2=="true"{print $1; exit}')
+  if [ -n "$now" ] && [ "$now" != "$leader" ]; then break; fi
+  now=""
+  sleep 2
+done
+[ -n "$now" ] || { echo "FAIL: no standby became Ready after leader deletion" >&2; exit 1; }
+say "failover OK: $leader -> $now"
+
+if [ "${KEEP:-0}" != 1 ]; then
+  say "teardown (runbook §5)"
+  kubectl delete -f examples/quickstart/quickstart.yaml --timeout=120s
+  kubectl delete -f deploy/operator.yaml -f deploy/crds.yaml --timeout=120s
+fi
+
+say "PASS"
