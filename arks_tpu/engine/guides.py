@@ -31,13 +31,14 @@ CONTENTS when the compiler's version bumps.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 
 import numpy as np
 
 __all__ = ["GuideError", "GuideCompiler", "compile_regex_dfa",
-           "json_mode_regex"]
+           "json_mode_regex", "json_schema_regex"]
 
 
 class GuideError(ValueError):
@@ -401,7 +402,12 @@ def compile_regex_dfa(pattern: str) -> tuple[np.ndarray, np.ndarray]:
 # JSON mode (depth-bounded JSON grammar as a regex)
 # ---------------------------------------------------------------------------
 
-_WS = r"[ \t\n\r]*"
+# BOUNDED whitespace between JSON tokens: an unbounded star would let a
+# sampling model wander in whitespace forever (whitespace is legal, eos
+# is not, and nothing forces progress) — the standard guided-decoding
+# recipe (outlines) bounds it for exactly this reason.  Accepting parsers
+# are unaffected; generation just cannot stall.
+_WS = r"[ \t\n\r]{0,2}"
 _STR = r'"([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u[0-9a-fA-F]{4}))*"'
 _NUM = r"\-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][\+\-]?[0-9]+)?"
 
@@ -433,6 +439,155 @@ def json_mode_regex(depth: int | None = None) -> str:
     if depth < 1:
         raise GuideError("json depth must be >= 1")
     return _WS + obj(depth) + _WS
+
+
+# ---------------------------------------------------------------------------
+# JSON-schema -> regex (the outlines-style subset)
+# ---------------------------------------------------------------------------
+
+def _rx_quote(s: str) -> str:
+    """Escape a literal for the byte-regex dialect (non-ASCII expands to
+    UTF-8 bytes in the parser's literal path, so only ASCII
+    metacharacters need escaping)."""
+    out = []
+    for ch in s:
+        if ch in r"\.^$|?*+()[]{}-":
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _json_literal(value) -> str:
+    return _rx_quote(json.dumps(value, ensure_ascii=False))
+
+
+def json_schema_regex(schema: dict, depth: int | None = None) -> str:
+    """A regex matching JSON documents that satisfy ``schema`` — the
+    practical subset structured-output schemas use (object properties in
+    declaration order, string/integer/number/boolean/null, enum/const,
+    arrays with item schemas and min/maxItems, anyOf/oneOf, local $refs).
+    Unsupported constructs raise GuideError rather than silently
+    loosening; numeric minimum/maximum are ignored (not regular).
+    ``depth`` bounds untyped-value nesting and $ref recursion."""
+    if depth is None:
+        depth = int(os.environ.get("ARKS_JSON_DEPTH", "3"))
+    defs = {}
+    for key in ("$defs", "definitions"):
+        defs.update(schema.get(key) or {})
+
+    def resolve(s, d):
+        ref = s.get("$ref")
+        if ref is None:
+            return s
+        name = ref.rsplit("/", 1)[-1]
+        if name not in defs:
+            raise GuideError(f"unresolvable $ref {ref!r}")
+        if d <= 0:
+            raise GuideError(
+                f"$ref {ref!r} recursion exceeds depth {depth} "
+                "(raise ARKS_JSON_DEPTH for deeper nesting)")
+        return defs[name]
+
+    def value(s, d) -> str:
+        if not isinstance(s, dict):
+            raise GuideError("schema nodes must be objects")
+        if "$ref" in s:
+            return value(resolve(s, d), d - 1)
+        if "const" in s:
+            return _json_literal(s["const"])
+        if "enum" in s:
+            if not s["enum"]:
+                raise GuideError("empty enum")
+            return "(" + "|".join(_json_literal(v) for v in s["enum"]) + ")"
+        for comb in ("anyOf", "oneOf"):
+            if comb in s:
+                return ("(" + "|".join(value(sub, d) for sub in s[comb])
+                        + ")")
+        typ = s.get("type")
+        if isinstance(typ, list):
+            return "(" + "|".join(value({**s, "type": t}, d) for t in typ) + ")"
+        if typ == "string":
+            lo = s.get("minLength")
+            hi = s.get("maxLength")
+            if lo is not None or hi is not None:
+                # Bounded strings count CHARS, approximated as bytes with
+                # escapes excluded (bounded + escapes is not regular in
+                # byte space).  minLength alone keeps the tail UNBOUNDED
+                # ({lo,}) — inventing a max would both reject valid
+                # documents and unroll ~max DFA states per property.
+                bound = "{%d,%s}" % (int(lo or 0),
+                                     "" if hi is None else int(hi))
+                return '"[^"\\\\\\x00-\\x1f]%s"' % bound
+            return _STR
+        if typ == "integer":
+            return r"\-?(0|[1-9][0-9]*)"
+        if typ == "number":
+            return _NUM
+        if typ == "boolean":
+            return "(true|false)"
+        if typ == "null":
+            return "null"
+        if typ == "array":
+            item = s.get("items")
+            inner = value(item, d - 1) if item else _any_value(d - 1)
+            lo = int(s.get("minItems", 0))
+            hi = s.get("maxItems")
+            if hi is not None and int(hi) == 0:
+                return r"\[" + _WS + r"\]"
+            rep = (f"({_WS},{_WS}{inner})" + "{%d,%s}"
+                   % (max(lo - 1, 0), "" if hi is None else int(hi) - 1))
+            seq = f"{inner}{rep}"
+            if lo == 0:
+                seq = f"({seq})?"
+            return r"\[" + _WS + seq + _WS + r"\]"
+        if typ == "object" or "properties" in s:
+            return obj(s, d)
+        if typ is None:
+            return _any_value(d)
+        raise GuideError(f"unsupported schema type {typ!r}")
+
+    def _any_value(d: int) -> str:
+        alts = [_STR, _NUM, "true", "false", "null"]
+        if d > 0:
+            alts += [obj({"additionalProperties": True}, d),
+                     r"\[" + _WS
+                     + f"({_any_value(d - 1)}({_WS},{_WS}{_any_value(d - 1)})*)?"
+                     + _WS + r"\]"]
+        return "(" + "|".join(alts) + ")"
+
+    def obj(s, d) -> str:
+        props = s.get("properties") or {}
+        if not props:
+            # Free-form object (JSON-mode member grammar).
+            member = f"{_STR}{_WS}:{_WS}{_any_value(d - 1)}"
+            return (r"\{" + _WS + f"({member}({_WS},{_WS}{member})*)?"
+                    + _WS + r"\}")
+        required = set(s.get("required", list(props)))
+        missing = required - set(props)
+        if missing:
+            raise GuideError(
+                f"required properties {sorted(missing)} are not declared "
+                "in properties (the guide would silently drop them)")
+        parts = []
+        seen_required = False
+        for name, sub in props.items():
+            member = (_json_literal(name) + f"{_WS}:{_WS}"
+                      + value(sub, d - 1))
+            if name in required:
+                prefix = f"{_WS},{_WS}" if seen_required or parts else ""
+                parts.append(prefix + member)
+                seen_required = True
+            else:
+                if not seen_required and not parts:
+                    raise GuideError(
+                        "optional properties before the first required "
+                        "one are not supported (declare a required "
+                        "property first, or mark all required)")
+                parts.append(f"({_WS},{_WS}{member})?")
+        return r"\{" + _WS + "".join(parts) + _WS + r"\}"
+
+    return _WS + value(schema, depth) + _WS
 
 
 # ---------------------------------------------------------------------------
@@ -605,6 +760,11 @@ class GuideCompiler:
                 rx = json_mode_regex(int(pattern) if pattern else None)
             elif kind == "regex":
                 rx = pattern
+            elif kind == "json_schema":
+                try:
+                    rx = json_schema_regex(json.loads(pattern))
+                except json.JSONDecodeError as e:
+                    raise GuideError(f"invalid json_schema: {e}") from None
             else:
                 raise GuideError(f"unknown guide kind {kind!r}")
             char_table, accept = compile_regex_dfa(rx)

@@ -116,13 +116,25 @@ def _sampling_from_body(body: dict, tokenizer,
         elif rft == "regex" and rf.get("regex"):
             guide = ("regex", str(rf["regex"]))
         elif rft == "json_schema":
-            raise ValueError(
-                "response_format json_schema is not supported yet; use "
-                "json_object or guided_regex")
+            # OpenAI structured outputs: {"type": "json_schema",
+            # "json_schema": {"name": ..., "schema": {...}}}; a bare
+            # "schema" key is accepted too.  The cache key preserves the
+            # body's own key order — sort_keys would reorder
+            # "properties", breaking the declaration-order contract.
+            wrapper = rf.get("json_schema")
+            schema = (wrapper.get("schema") if isinstance(wrapper, dict)
+                      else rf.get("schema"))
+            if not isinstance(schema, dict):
+                raise ValueError("response_format json_schema needs "
+                                 "json_schema.schema")
+            guide = ("json_schema", json.dumps(schema))
         elif rft != "text":
             raise ValueError(f"unknown response_format type {rft!r}")
     if body.get("guided_regex"):
         guide = ("regex", str(body["guided_regex"]))
+    if isinstance(body.get("guided_json"), dict):
+        # vLLM extra: guided_json carries the schema directly.
+        guide = ("json_schema", json.dumps(body["guided_json"]))
     if guide is not None and engine is not None:
         engine.guides.compile(*guide)  # ValueError (400) on bad patterns
     params = SamplingParams(

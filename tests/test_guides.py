@@ -71,6 +71,67 @@ def test_json_mode_grammar():
         assert not _match(t, a, s), s
 
 
+def test_json_schema_regex():
+    from arks_tpu.engine.guides import json_schema_regex
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string", "maxLength": 10},
+            "age": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"},
+                     "minItems": 1, "maxItems": 2},
+            "mood": {"enum": ["happy", "sad", 3]},
+            "nick": {"type": "string"},
+        },
+        "required": ["name", "age", "tags", "mood"],
+    }
+    t, a = compile_regex_dfa(json_schema_regex(schema))
+    good = [
+        '{"name": "bo", "age": 3, "tags": ["x"], "mood": "sad"}',
+        '{"name": "", "age": 0, "tags": ["a", "b"], "mood": 3, '
+        '"nick": "z"}',
+    ]
+    bad = [
+        '{"age": 3, "name": "bo", "tags": ["x"], "mood": "sad"}',  # order
+        '{"name": "bo", "age": 3.5, "tags": ["x"], "mood": "sad"}',
+        '{"name": "bo", "age": 3, "tags": [], "mood": "sad"}',     # minItems
+        '{"name": "bo", "age": 3, "tags": ["a","b","c"], "mood": "sad"}',
+        '{"name": "bo", "age": 3, "tags": ["x"], "mood": "angry"}',
+        '{"name": "longerthanten!", "age": 3, "tags": ["x"], "mood": 3}',
+        '{"name": "bo", "age": 3, "tags": ["x"]}',                 # missing
+    ]
+    for s in good:
+        assert _match(t, a, s), s
+    for s in bad:
+        assert not _match(t, a, s), s
+
+    # anyOf, const, $refs with bounded recursion.
+    t, a = compile_regex_dfa(json_schema_regex({
+        "anyOf": [{"const": "yes"}, {"type": "object", "properties": {
+            "next": {"$ref": "#/$defs/node"}}, "required": ["next"]}],
+        "$defs": {"node": {"type": "null"}}}))
+    assert _match(t, a, '"yes"') and _match(t, a, '{"next": null}')
+    assert not _match(t, a, "no")
+
+    with pytest.raises(GuideError):
+        json_schema_regex({"type": "object", "properties": {
+            "opt": {"type": "integer"}}, "required": []})
+    # required names absent from properties must raise, not silently drop.
+    with pytest.raises(GuideError, match="not declared"):
+        json_schema_regex({"type": "object", "properties": {
+            "a": {"type": "integer"}}, "required": ["a", "b"]})
+    # minLength alone leaves the tail unbounded (no invented max).
+    t, a = compile_regex_dfa(json_schema_regex(
+        {"type": "string", "minLength": 2}))
+    assert _match(t, a, '"' + "x" * 5000 + '"')
+    assert not _match(t, a, '"x"')
+    # Property names are JSON-escaped, not just regex-escaped.
+    t, a = compile_regex_dfa(json_schema_regex({
+        "type": "object", "properties": {'a"b': {"type": "null"}}}))
+    assert _match(t, a, '{"a\\"b": null}')
+    assert not _match(t, a, '{"a"b": null}')
+
+
 # ---------------------------------------------------------------------------
 # Token tables / compiler registry
 # ---------------------------------------------------------------------------

@@ -558,6 +558,22 @@ def test_guided_decoding_api(server):
         st = t[st, b]
         assert st >= 0, f"dead JSON transition in {text!r}"
 
+    # json_schema structured output.  eos (id 0) biased +100: the random
+    # test model then ends at the FIRST grammar-legal point (the guide
+    # masks eos everywhere before the object closes; the grammar's
+    # trailing-whitespace star would otherwise let greedy wander to
+    # max_tokens).
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "s", "max_tokens": 48,
+        "temperature": 0, "logit_bias": {"0": 100},
+        "response_format": {"type": "json_schema", "json_schema": {
+            "name": "t", "schema": {"type": "object", "properties": {
+                "ok": {"type": "boolean"}}}}},
+    }) as r:
+        data = json.load(r)
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert json.loads(data["choices"][0]["text"])["ok"] in (True, False)
+
     try:
         _post(server, "/v1/completions", {
             "model": "tiny-serve", "prompt": "x", "max_tokens": 4,
