@@ -266,6 +266,9 @@ class EngineMetrics:
             "prefix_cache_usage_bytes", "Host bytes held by the prefix cache")
         self.prefix_cache_hit_rate = r.gauge(
             "prefix_cache_hit_rate", "Lifetime prefix-cache token hit rate")
+        self.guided_requests_total = r.counter(
+            "guided_requests_total",
+            "Admitted guided-decoding requests by guide kind")
         self.spec_decode_proposed_tokens_total = r.counter(
             "spec_decode_proposed_tokens_total",
             "Draft tokens proposed to the verifier")
@@ -927,6 +930,8 @@ class InferenceEngine:
             # never stall the scheduler; bad patterns raise GuideError
             # (ValueError) here instead of faulting the engine.
             self.guides.compile(*request.params.guide)
+            self.metrics.guided_requests_total.inc(
+                1, kind=request.params.guide[0])
         self.metrics.num_requests_waiting.inc(1)
         with self._abort_lock:
             self._queued_rids.add(request.request_id)

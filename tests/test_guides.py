@@ -268,6 +268,103 @@ def test_engine_bad_pattern_rejected_on_caller_thread(engine):
         engine.add_request(req)
 
 
+@pytest.fixture(scope="module")
+def hf_tokenizer(tmp_path_factory):
+    """A real byte-level-BPE HF tokenizer built locally (no hub access):
+    the production tokenizer shape (Qwen2/Llama-3/GPT-2 style), with
+    multi-byte merged tokens like '{\"' and 'Ġtrue'."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<|end|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(
+        ['{"name": "value", "ok": true, "n": 123}',
+         'hello world json {"a": [1, 2], "b": false}'] * 50, trainer)
+    d = tmp_path_factory.mktemp("hftok")
+    tok.save(str(d / "tokenizer.json"))
+    (d / "config.json").write_text('{"model_type": "gpt2"}')
+    from arks_tpu.engine.tokenizer import HFTokenizer
+
+    hf = HFTokenizer(str(d))
+    hf._tok.eos_token = "<|end|>"
+    return hf
+
+
+def test_token_byte_table_hf(hf_tokenizer):
+    """The byte table inverts the GPT-2 byte<->unicode mapping: joining a
+    real encoding's token bytes reproduces the input bytes exactly."""
+    from arks_tpu.engine.guides import token_byte_table
+
+    hf = hf_tokenizer
+    vocab = len(hf._tok)
+    arr, lens = token_byte_table(hf, vocab)
+    for s in ['{"ok": true}', 'hello world', '{"n": 123, "b": false}']:
+        ids = hf.encode(s)
+        got = b"".join(bytes(arr[i, : lens[i]]) for i in ids)
+        assert got == s.encode(), s
+    # The special token has no byte representation.
+    assert lens[hf._tok.eos_token_id if hf._tok.eos_token_id is not None
+                else 0] == 0
+
+
+def test_guide_walk_hf_tokenizer(hf_tokenizer):
+    """Guided decoding against merged multi-byte BPE tokens: a real
+    encoding of a matching document walks the token DFA to accept, and
+    eos flips legal exactly there."""
+    hf = hf_tokenizer
+    gc = GuideCompiler(hf, len(hf._tok), eos_ids=(0,))
+    gc.compile("json")
+    g = gc.compile("regex", r'\{"ok": (true|false)\}')
+    row = g.start_row
+    for tid in hf.encode('{"ok": true}'):
+        assert gc.allowed(row)[tid], (row, tid)
+        row = gc.next_row(row, tid)
+    assert gc.allowed(row)[0]
+    # Mid-document eos is illegal.
+    row = g.start_row
+    for tid in hf.encode('{"ok"'):
+        row = gc.next_row(row, tid)
+    assert not gc.allowed(row)[0]
+    # JSON mode accepts the same doc through merged tokens.
+    gj = gc.lookup("json")
+    row = gj.start_row
+    for tid in hf.encode('{"n": 1, "b": [true, null]}'):
+        assert gc.allowed(row)[tid]
+        row = gc.next_row(row, tid)
+    assert gc.allowed(row)[0]
+
+
+def test_engine_guided_with_hf_tokenizer(hf_tokenizer):
+    """Full engine round trip on the HF tokenizer: the guide must drive
+    multi-byte BPE pieces to a valid document."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16), steps_per_dispatch=2)
+    eng = InferenceEngine(cfg, ecfg, hf_tokenizer)
+    eng.start()
+    try:
+        req = Request(request_id="hf1",
+                      prompt_ids=hf_tokenizer.encode("hello"),
+                      params=SamplingParams(
+                          max_tokens=24, temperature=0.0,
+                          guide=("regex", r'\{"ok": (true|false)\}')))
+        eng.add_request(req)
+        toks = []
+        while True:
+            out = req.outputs.get(timeout=120)
+            toks.extend(out.token_ids)
+            if out.finished:
+                break
+        assert out.finish_reason == "stop"
+        assert json.loads(hf_tokenizer.decode(toks))["ok"] in (True, False)
+    finally:
+        eng.stop()
+
+
 def test_engine_guide_with_chunked_prefill():
     """Guided first-token sampling on the chunked-prefill path: the prompt
     exceeds the one-shot buckets, so the first token comes from
