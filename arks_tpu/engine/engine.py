@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from arks_tpu.engine import sampler as sampler_mod
+from arks_tpu.engine.guides import GuideError
 from arks_tpu.engine.tokenizer import Tokenizer
 from arks_tpu.engine.types import PrefilledState, Request, RequestOutput
 from arks_tpu.models.config import ModelConfig
@@ -269,6 +270,28 @@ class EngineMetrics:
         self.guided_requests_total = r.counter(
             "guided_requests_total",
             "Admitted guided-decoding requests by guide kind")
+        # Guide compile pipeline (engine.guides): async worker-pool
+        # compiles + LRU registry — the families that make a cold-compile
+        # stall or an eviction storm visible on a dashboard.
+        self.guide_compile_seconds = r.histogram(
+            "guide_compile_seconds",
+            "Guided-decoding DFA compile latency (worker-pool threads)",
+            buckets=[0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120])
+        self.guide_cache_hits_total = r.counter(
+            "guide_cache_hits_total",
+            "Guide requests served from the compiled registry")
+        self.guide_cache_misses_total = r.counter(
+            "guide_cache_misses_total",
+            "Guide requests that scheduled a cold compile")
+        self.guide_cache_evictions_total = r.counter(
+            "guide_cache_evictions_total",
+            "Guides evicted from the registry (LRU, no active slot)")
+        self.guide_registry_guides_in_use = r.gauge(
+            "guide_registry_guides_in_use",
+            "Guides currently packed in the registry")
+        self.guide_registry_rows_in_use = r.gauge(
+            "guide_registry_rows_in_use",
+            "DFA rows currently packed in the transition table")
         self.spec_decode_proposed_tokens_total = r.counter(
             "spec_decode_proposed_tokens_total",
             "Draft tokens proposed to the verifier")
@@ -404,13 +427,32 @@ class InferenceEngine:
         # device copies are allocated up front so compiling a guide later
         # never changes program shapes (no mid-serving retrace).  The
         # engine thread re-uploads CONTENTS when the version bumps.
+        from types import SimpleNamespace
+
         from arks_tpu.engine.guides import GuideCompiler
         eos_all = tuple(dict.fromkeys(
             list(cfg.eos_token_ids) + list(tokenizer.eos_token_ids)))
-        self.guides = GuideCompiler(tokenizer, cfg.vocab_size, eos_all)
+        self.guides = GuideCompiler(
+            tokenizer, cfg.vocab_size, eos_all,
+            metrics=SimpleNamespace(
+                compile_seconds=self.metrics.guide_compile_seconds,
+                hits=self.metrics.guide_cache_hits_total,
+                misses=self.metrics.guide_cache_misses_total,
+                evictions=self.metrics.guide_cache_evictions_total,
+                guides_in_use=self.metrics.guide_registry_guides_in_use,
+                rows_in_use=self.metrics.guide_registry_rows_in_use))
         self._guide_dev = (jnp.asarray(self.guides.class_ids),
                            jnp.asarray(self.guides.trans))
         self._guide_ver = self.guides.version
+        # Requests whose guide is still compiling on the worker pool, each
+        # with its CompileTicket: the scheduler re-checks them every step
+        # (guide_wait phase) and re-queues/fails them — the engine thread
+        # itself NEVER waits on a compile.  Engine-thread-only.
+        self._awaiting_guide: list = []
+        # request_id -> guide key for requests holding a registry pin
+        # (acquired at admission, released at every end-of-life path);
+        # pinned guides are never evicted.  Engine-thread-only.
+        self._guide_pins: dict[str, tuple[str, str]] = {}
 
         # Host-authoritative mirrors.
         self._lengths = np.zeros((engine_cfg.num_slots,), np.int32)
@@ -925,11 +967,16 @@ class InferenceEngine:
         sampler_mod.np_suppress_col(
             self.min_tokens_suppress_ids(request.params))
         if request.params.guide is not None:
-            # Compile on the CALLER's thread: guide compilation is
-            # seconds-scale for a cold pattern (cached after), which must
-            # never stall the scheduler; bad patterns raise GuideError
-            # (ValueError) here instead of faulting the engine.
-            self.guides.compile(*request.params.guide)
+            # Cheap syntactic validation on the CALLER's thread: malformed
+            # patterns raise GuideError (ValueError -> HTTP 400) here.
+            # The seconds-scale DFA build is handed to the compiler's
+            # worker pool (ensure) — this call never blocks, and the
+            # scheduler parks the request until the guide publishes
+            # (compile failure -> per-request "error" output, not a
+            # dropped stream).
+            if self.guides.lookup(*request.params.guide) is None:
+                self.guides.validate(*request.params.guide)
+            self.guides.ensure(*request.params.guide)
             self.metrics.guided_requests_total.inc(
                 1, kind=request.params.guide[0])
         self.metrics.num_requests_waiting.inc(1)
@@ -975,11 +1022,12 @@ class InferenceEngine:
 
     @property
     def idle(self) -> bool:
-        """No decoding slots, no queued admissions, no chunked prefills or
-        deferred admit batches in flight — the drain gate (servers must
-        not poke at privates)."""
+        """No decoding slots, no queued admissions, no chunked prefills,
+        deferred admit batches, or requests parked on a guide compile —
+        the drain gate (servers must not poke at privates)."""
         return (not self._slots and self._queue.empty()
-                and not self._prefilling and not self._pending_admits)
+                and not self._prefilling and not self._pending_admits
+                and not self._awaiting_guide)
 
     # ------------------------------------------------------------------
     # Scheduler loop
@@ -1101,10 +1149,7 @@ class InferenceEngine:
         dispatch."""
         if self._guide_ver == self.guides.version:
             return
-        with self.guides._lock:
-            cls_host = self.guides.class_ids.copy()
-            trans_host = self.guides.trans.copy()
-            ver = self.guides.version
+        cls_host, trans_host, ver = self.guides.snapshot()
         self._emit("guides", class_ids=cls_host, trans=trans_host,
                    version=ver)
         self._guide_dev = (jnp.asarray(cls_host), jnp.asarray(trans_host))
@@ -1141,6 +1186,7 @@ class InferenceEngine:
             # the engine thread — the only thread allowed to touch
             # _pending_admits/_pending_n/_free.
             self._abort_pending_admits()
+            self._abort_awaiting_guide()
 
     def _run_loop(self) -> None:
         while self._running:
@@ -1155,6 +1201,7 @@ class InferenceEngine:
                 for slot in list(self._slots):
                     self._finish(slot, "abort")
                 for slot, st in list(self._prefilling.items()):
+                    self._unpin_guide(st.request)
                     st.request.outputs.put(RequestOutput(
                         request_id=st.request.request_id, token_ids=[],
                         finished=True, finish_reason="abort",
@@ -1228,15 +1275,26 @@ class InferenceEngine:
         the breakdown attributes WALL time, not device time."""
         t0 = time.monotonic()
         self._ensure_guides_uploaded()
-        pending = None
         worked = False
+        if self._awaiting_guide:
+            # Requests parked on a worker-pool guide compile: re-queue the
+            # ones whose guide published, fail the ones whose compile
+            # failed, keep waiting on the rest.  Never blocks — a step
+            # with only parked requests falls through to the idle sleep.
+            worked = self._service_awaiting_guides()
+            tg = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(tg - t0,
+                                                     phase="guide_wait")
+            t0 = tg
+        pending = None
+        issued = False
         if self._slots and self._draft_cfg is None and self._overlap:
             pending = self._issue_decode()  # may retire/abort even if None
-            worked = True
+            issued = True
         t1 = time.monotonic()
-        if worked:
+        if issued:
             self.metrics.scheduler_seconds_total.inc(t1 - t0, phase="decode")
-        worked = self._admit() or worked
+        worked = self._admit() or worked or issued
         t2 = time.monotonic()
         if t2 - t1 > 1e-4:
             self.metrics.scheduler_seconds_total.inc(t2 - t1, phase="admit")
@@ -1367,6 +1425,7 @@ class InferenceEngine:
             # were already failed by its issue/resolve handler.)
             for items in groups.values():
                 for req, ids, _ in items:
+                    self._unpin_guide(req)
                     req.outputs.put(RequestOutput(
                         request_id=req.request_id, token_ids=[],
                         finished=True, finish_reason="abort",
@@ -1375,6 +1434,7 @@ class InferenceEngine:
                 for (req, ids, _), slot in zip(rec[0], rec[1]):
                     if slot not in self._slots:
                         self._free.append(slot)
+                    self._unpin_guide(req)
                     req.outputs.put(RequestOutput(
                         request_id=req.request_id, token_ids=[],
                         finished=True, finish_reason="abort",
@@ -1410,6 +1470,7 @@ class InferenceEngine:
                 if slot not in self._slots:
                     self._release_slot_pages(slot)
                     self._free.append(slot)
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
@@ -1423,15 +1484,35 @@ class InferenceEngine:
             self._queued_rids.discard(req.request_id)
             if req.request_id in self._aborted:
                 self._aborted.discard(req.request_id)
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort"))
+                return
+        if req.params.guide is not None:
+            # Cold-guide gate: park the request while its guide compiles
+            # on the worker pool (the scheduler never blocks on
+            # compilation); fail it on compile error; PIN the published
+            # guide for the request's lifetime so eviction can't repack
+            # the rows its slot decodes against.
+            gate = self._gate_guide(req)
+            if gate == "park":
+                return
+            if gate is not None:
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="error",
+                    error=f"guide_compile_failed: {gate}",
+                    num_prompt_tokens=len(req.prompt_ids)))
+                log.info("rejected %s: guide compile failed: %s",
+                         req.request_id, gate)
                 return
         if req.prefilled is not None:
             return self._admit_prefilled(req)
         try:
             ids, padded = self._prepare_prompt(req.prompt_ids)
         except ContextLengthExceededError as e:
+            self._unpin_guide(req)
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="error", error="context_length_exceeded",
@@ -1572,6 +1653,7 @@ class InferenceEngine:
             # clients block forever.  (Slot and page bookkeeping are
             # rebuilt by _run's reset.)
             for req, ids, _ in items:
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
@@ -1600,6 +1682,7 @@ class InferenceEngine:
             for (req, ids, _), slot in zip(items, slots_l):
                 if slot not in self._slots:
                     self._free.append(slot)
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
@@ -1614,6 +1697,7 @@ class InferenceEngine:
             if was_aborted:
                 self._release_slot_pages(slot)
                 self._free.append(slot)
+                self._unpin_guide(req)
                 p = req.params
                 if (p.presence_penalty or p.frequency_penalty
                         or p.logit_bias or p.min_tokens
@@ -1687,6 +1771,7 @@ class InferenceEngine:
             # A logprob request whose transferred state carries no
             # first-token logprob data (pre-upgrade prefill peer): serving
             # a partial stream would be silently wrong — reject cleanly.
+            self._unpin_guide(req)
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="error", error="logprobs_unavailable",
@@ -1695,6 +1780,7 @@ class InferenceEngine:
         usable = self.ecfg.max_cache_len - self.ecfg.steps_per_dispatch - 1
         k, v = jnp.asarray(pf.k), jnp.asarray(pf.v)
         if pf.num_prompt > usable:
+            self._unpin_guide(req)
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="abort", num_prompt_tokens=pf.num_prompt))
@@ -1729,6 +1815,11 @@ class InferenceEngine:
                 self._cache = self._insert_fn(self._cache, k, v,
                                               jnp.asarray(slot))
             gid, start = self._guide_cols(p)
+            # Refresh the device tables like every other admission path: a
+            # guide published (or evicted+repacked) after this step's
+            # top-of-loop refresh would otherwise decode against stale
+            # device rows (all -1 -> everything masked -> instant eos).
+            self._ensure_guides_uploaded()
             # pf.guide_row is RELATIVE to the guide's start state; rebase
             # onto THIS engine's table (compile orders may differ).
             grow = start + pf.guide_row if gid >= 0 else 0
@@ -1745,6 +1836,7 @@ class InferenceEngine:
                                  num_prompt=pf.num_prompt, guide=gid,
                                  guide_row=grow)
         except Exception:
+            self._unpin_guide(req)
             req.outputs.put(RequestOutput(
                 request_id=req.request_id, token_ids=[], finished=True,
                 finish_reason="abort", num_prompt_tokens=pf.num_prompt))
@@ -1776,16 +1868,115 @@ class InferenceEngine:
         min_until = num_prompt + p.min_tokens - 1 if p.min_tokens > 0 else 0
         return bias_ids, bias_vals, sup, min_first, min_until
 
+    def _gate_guide(self, req: Request) -> str | None:
+        """Resolve a guided request's guide at admission: None = published
+        and PINNED (proceed), "park" = parked on the in-flight compile
+        (caller returns), any other string = compile failure message.
+        Never blocks on compilation."""
+        from arks_tpu.engine.guides import Guide
+        if req.request_id in self._guide_pins:
+            return None
+        for _ in range(3):
+            got = self.guides.ensure(*req.params.guide)
+            if isinstance(got, Guide):
+                try:
+                    self._pin_guide(req)
+                    return None
+                except GuideError:
+                    # Evicted between publish and pin (another worker's
+                    # publish ran in the gap): re-kick and retry.
+                    continue
+            if got.event.is_set() and got.error is not None:
+                return got.error
+            self._awaiting_guide.append((req, got))
+            self.metrics.num_requests_waiting.inc(1)
+            return "park"
+        return "guide evicted repeatedly during admission"
+
+    def _service_awaiting_guides(self) -> bool:
+        """Advance the parked-on-compile requests: aborted ones fail,
+        failed compiles produce per-request error outputs, published
+        guides send their requests back to the admission queue (this
+        step's _admit pops them).  Returns True when anything moved."""
+        did = False
+        still: list = []
+        for req, ticket in self._awaiting_guide:
+            with self._abort_lock:
+                was_aborted = req.request_id in self._aborted
+                self._aborted.discard(req.request_id)
+            if was_aborted:
+                self.metrics.num_requests_waiting.inc(-1)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="abort",
+                    num_prompt_tokens=len(req.prompt_ids)))
+                did = True
+                continue
+            if not ticket.event.is_set():
+                still.append((req, ticket))
+                continue
+            if ticket.error is not None:
+                self.metrics.num_requests_waiting.inc(-1)
+                req.outputs.put(RequestOutput(
+                    request_id=req.request_id, token_ids=[], finished=True,
+                    finish_reason="error",
+                    error=f"guide_compile_failed: {ticket.error}",
+                    num_prompt_tokens=len(req.prompt_ids)))
+                log.info("rejected %s: guide compile failed: %s",
+                         req.request_id, ticket.error)
+                did = True
+                continue
+            # Published: back to the admission queue (the waiting gauge
+            # stays up — _preadmit decrements it again on the re-pop).
+            with self._abort_lock:
+                self._queued_rids.add(req.request_id)
+                self._queue_seq += 1
+                seq = self._queue_seq
+            self._queue.put((req.params.priority, seq, req))
+            did = True
+        self._awaiting_guide = still
+        return did
+
+    def _abort_awaiting_guide(self) -> None:
+        """Fail every request parked on a guide compile (engine exit):
+        no scheduler remains to unpark them."""
+        for req, _ in self._awaiting_guide:
+            self.metrics.num_requests_waiting.inc(-1)
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="abort",
+                num_prompt_tokens=len(req.prompt_ids)))
+        self._awaiting_guide = []
+
+    def _pin_guide(self, req: Request) -> None:
+        """Refcount the request's guide (idempotent per request): pinned
+        guides are never evicted, so the absolute rows its slot carries on
+        device stay valid from admission through _finish."""
+        if req.params.guide is None or req.request_id in self._guide_pins:
+            return
+        self.guides.acquire(*req.params.guide)
+        self._guide_pins[req.request_id] = req.params.guide
+
+    def _unpin_guide(self, req: Request) -> None:
+        """Release the request's guide pin (idempotent, no-op when
+        unguided) — called on EVERY request end-of-life path."""
+        key = self._guide_pins.pop(req.request_id, None)
+        if key is not None:
+            self.guides.release(*key)
+
     def _guide_cols(self, p) -> tuple[int, int]:
         """(guide_id, start_row) for a request's guide spec, (-1, 0) when
-        unguided.  Resolves through the local compiler registry — the
-        HTTP layer compiles at add_request on ITS thread, so this is a
-        dict hit; compile() here covers direct engine callers (idempotent,
-        caller-thread-safe, raises GuideError -> the admission fault path
-        fails just this request)."""
+        unguided.  Admission paths reach here only after _gate_guide
+        pinned the published guide, so this is a registry hit; a miss
+        means the pin discipline broke — GuideError routes to the
+        admission fault path, failing just this request."""
         if p.guide is None:
             return -1, 0
-        g = self.guides.compile(*p.guide)
+        g = self.guides.lookup(*p.guide)
+        if g is None:
+            raise GuideError(
+                f"guide {p.guide[0]}:{p.guide[1]!r} is not registered "
+                "(evicted without a pin?)")
         return g.guide_id, g.start_row
 
     def _apply_set_slot(self, slot: int, p, key, num_prompt: int = 0,
@@ -1832,6 +2023,7 @@ class InferenceEngine:
                 # request — fail it here or its client blocks forever
                 # (same contract as the pre-registration dispatches).
                 self._free.append(slot)
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=num_prompt))
@@ -1945,6 +2137,7 @@ class InferenceEngine:
             except Exception:
                 self._alloc.decref(shared)
                 self._free.append(slot)
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
@@ -1970,6 +2163,7 @@ class InferenceEngine:
                     jnp.asarray(slot))
             except Exception:
                 self._free.append(slot)
+                self._unpin_guide(req)
                 req.outputs.put(RequestOutput(
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
@@ -1995,6 +2189,7 @@ class InferenceEngine:
                 del self._prefilling[slot]
                 self._release_slot_pages(slot)
                 self._free.append(slot)
+                self._unpin_guide(st.request)
                 st.request.outputs.put(RequestOutput(
                     request_id=rid, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(st.ids)))
@@ -2026,6 +2221,7 @@ class InferenceEngine:
             del self._prefilling[slot]
             self._release_slot_pages(slot)
             self._free.append(slot)
+            self._unpin_guide(st.request)
             st.request.outputs.put(RequestOutput(
                 request_id=st.request.request_id, token_ids=[], finished=True,
                 finish_reason="abort", num_prompt_tokens=len(st.ids)))
@@ -2117,6 +2313,25 @@ class InferenceEngine:
 
         want_lp = getattr(params, "logprobs", None) is not None
         first_lp = None
+        pinned = False
+        if params.guide is not None:
+            # BLOCKING compile on this server thread (deduped against
+            # concurrent compiles of the same key), taken OUTSIDE the
+            # prefill lock so a cold compile never serializes other
+            # prefills; then pin for the dispatch window so an eviction
+            # cannot repack the guide's rows under us.
+            self.guides.compile(*params.guide)
+            self.guides.acquire(*params.guide)
+            pinned = True
+        try:
+            return self._prefill_detached_pinned(ids, padded, params,
+                                                 want_lp, first_lp)
+        finally:
+            if pinned:
+                self.guides.release(*params.guide)
+
+    def _prefill_detached_pinned(self, ids, padded, params, want_lp,
+                                 first_lp) -> PrefilledState:
         with self._prefill_lock:
             self._request_seed += 1
             seed = params.seed if params.seed is not None else self._request_seed
@@ -2195,6 +2410,9 @@ class InferenceEngine:
         # would lose aborts raised between issue and registration.
         active |= {req.request_id for rec in self._pending_admits
                    for req, _, _ in rec[0]}
+        # ...as are requests parked on a guide compile (their aborts are
+        # honored by _service_awaiting_guides).
+        active |= {req.request_id for req, _ in self._awaiting_guide}
         with self._abort_lock:
             self._aborted -= consumed
             self._aborted &= active | self._queued_rids
@@ -2457,6 +2675,7 @@ class InferenceEngine:
         st = self._slots.pop(slot)
         self._release_slot_pages(slot)
         self._free.append(slot)
+        self._unpin_guide(st.request)
         p = st.request.params
         if (p.presence_penalty or p.frequency_penalty or p.logit_bias
                 or p.min_tokens or p.guide is not None):

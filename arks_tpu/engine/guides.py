@@ -27,13 +27,33 @@ All guides live in two fixed-budget arrays (``class_ids [G, V]``,
 ``trans [R, C]``) allocated at engine init, so compiling a new guide
 never retraces the decode programs — the engine just re-uploads table
 CONTENTS when the compiler's version bumps.
+
+The registry is a NON-BLOCKING compile pipeline with LRU eviction:
+
+  - Compilation runs OUTSIDE the registry lock, on a small bounded
+    worker pool (``ARKS_GUIDE_COMPILE_WORKERS``); the lock is held only
+    to check the registry and to pack/publish the finished tables.  A
+    cold JSON-mode compile at a 152k vocab (~25 s) therefore never
+    stalls the engine thread or other server threads.
+  - Concurrent requests for the same (kind, pattern) dedupe onto ONE
+    compile through a per-key in-flight ticket (``ensure``/``compile``).
+  - When the guide or row budget fills, the least-recently-used guide
+    with no active slot (``acquire``/``release`` refcounts, maintained
+    by the engine per running/parked slot) is evicted: its id and row
+    span return to free lists, ``version`` bumps so device copies
+    refresh, and only when EVERY registered guide is pinned does a new
+    pattern fail with GuideError (HTTP 400).  Guides never move once
+    packed — live slots carry absolute device rows — so eviction frees
+    spans instead of compacting over them.
 """
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -702,14 +722,31 @@ def token_transition_tables(char_table: np.ndarray, accept: np.ndarray,
 # ---------------------------------------------------------------------------
 
 class Guide:
-    __slots__ = ("guide_id", "start_row", "n_states", "n_classes")
+    __slots__ = ("guide_id", "start_row", "n_states", "n_classes",
+                 "key", "refcount", "lru")
 
     def __init__(self, guide_id: int, start_row: int, n_states: int,
-                 n_classes: int) -> None:
+                 n_classes: int, key: tuple[str, str] | None = None) -> None:
         self.guide_id = guide_id
         self.start_row = start_row
         self.n_states = n_states
         self.n_classes = n_classes
+        self.key = key
+        self.refcount = 0   # active/parked slots using this guide (engine)
+        self.lru = 0        # last-touched tick (compiler lock held)
+
+
+class CompileTicket:
+    """Per-key in-flight compile record: concurrent requests for the same
+    (kind, pattern) all wait on ONE of these instead of compiling N times.
+    ``event`` is set when the compile finished; exactly one of the guide
+    being in the registry or ``error`` being set holds afterwards."""
+
+    __slots__ = ("event", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.error: str | None = None
 
 
 class GuideCompiler:
@@ -721,13 +758,25 @@ class GuideCompiler:
     Budgets are fixed at init so device shapes never change:
       class_ids [max_guides, V] int32  (class of token v under guide g)
       trans     [max_rows,  max_classes] int32 (ABSOLUTE next row | -1)
-    """
+
+    Concurrency contract:
+      - ``ensure`` (non-blocking) and ``compile`` (blocking) dedupe onto a
+        per-key CompileTicket; the expensive DFA/token-table build runs
+        with NO lock held (``ensure`` on a pool worker, ``compile`` on the
+        caller's thread), and the lock is re-taken only to publish.
+      - ``acquire``/``release`` refcount guides per live slot; eviction
+        (triggered by a publish that needs an id or rows) only ever
+        removes refcount-0 guides, so a published guide's absolute rows
+        stay valid for as long as any slot decodes under it.
+      - Row→guide resolution (``next_row``/``allowed``) reads an immutable
+        interval-index snapshot — no lock, no O(guides) scan."""
 
     def __init__(self, tokenizer, vocab_size: int,
                  eos_ids: tuple[int, ...] = (),
                  max_guides: int | None = None,
                  max_rows: int | None = None,
-                 max_classes: int | None = None) -> None:
+                 max_classes: int | None = None,
+                 metrics=None) -> None:
         env = os.environ.get
         self.vocab_size = vocab_size
         self.max_guides = max_guides or int(env("ARKS_GUIDE_MAX", "8"))
@@ -737,68 +786,97 @@ class GuideCompiler:
         self._tokenizer = tokenizer
         self._eos_ids = tuple(eos_ids)
         self._tok_table: tuple[np.ndarray, np.ndarray] | None = None
+        self._tok_lock = threading.Lock()
         self.class_ids = np.zeros((self.max_guides, vocab_size), np.int32)
         self.trans = np.full((self.max_rows, self.max_classes), -1, np.int32)
         self._registry: dict[tuple[str, str], Guide] = {}
-        self._next_guide = 0
-        self._next_row = 0
+        self._inflight: dict[tuple[str, str], CompileTicket] = {}
+        self._free_ids: list[int] = list(range(self.max_guides))
+        self._free_spans: list[tuple[int, int]] = [(0, self.max_rows)]
+        # Immutable (starts, (start, end, gid)) snapshot for lock-free
+        # row→guide bisect on the hot path; rebuilt under the lock on
+        # every registry change and swapped atomically.
+        self._row_index: tuple[tuple, tuple] = ((), ())
+        self._lru_tick = 0
+        self._executor = None
+        self._metrics = metrics  # namespace of prom metric objects | None
         self.version = 0
-        self._lock = threading.Lock()  # server threads compile concurrently
+        self._lock = threading.Lock()  # registry/publish only, never compile
 
     # -- public ----------------------------------------------------------
 
-    def compile(self, kind: str, pattern: str = "") -> Guide:
-        """('json', '') or ('json', depth-digits) or ('regex', pattern) ->
-        packed Guide.  Idempotent per (kind, pattern); raises GuideError
-        on bad patterns or exhausted budgets."""
+    def validate(self, kind: str, pattern: str = "") -> None:
+        """Cheap syntactic check (render + parse, no DFA/token tables):
+        raises GuideError for malformed patterns/schemas so callers can
+        400 on THEIR thread before the expensive build is ever scheduled."""
+        _Parser(self._render(kind, pattern)).parse()
+
+    def ensure(self, kind: str, pattern: str = "") -> "Guide | CompileTicket":
+        """Non-blocking: the published Guide on a registry hit (LRU
+        touched), else the in-flight CompileTicket — scheduling the build
+        on the worker pool if nobody owns it yet.  Never blocks, never
+        raises; compile failures surface through ``ticket.error``."""
         key = (kind, pattern)
-        with self._lock:
-            got = self._registry.get(key)
-            if got is not None:
-                return got
-            if kind == "json":
-                rx = json_mode_regex(int(pattern) if pattern else None)
-            elif kind == "regex":
-                rx = pattern
-            elif kind == "json_schema":
-                try:
-                    rx = json_schema_regex(json.loads(pattern))
-                except json.JSONDecodeError as e:
-                    raise GuideError(f"invalid json_schema: {e}") from None
-            else:
-                raise GuideError(f"unknown guide kind {kind!r}")
-            char_table, accept = compile_regex_dfa(rx)
-            if self._tok_table is None:
-                self._tok_table = token_byte_table(self._tokenizer,
-                                                   self.vocab_size)
-            cls, trans = token_transition_tables(
-                char_table, accept, *self._tok_table, self._eos_ids)
-            n_states, n_classes = trans.shape
-            if self._next_guide >= self.max_guides:
-                raise GuideError(
-                    f"guide budget exhausted ({self.max_guides} guides)")
-            if self._next_row + n_states > self.max_rows:
-                raise GuideError(
-                    f"guide row budget exhausted ({n_states} states needed, "
-                    f"{self.max_rows - self._next_row} rows free; raise "
-                    "ARKS_GUIDE_ROWS)")
-            if n_classes > self.max_classes:
-                raise GuideError(
-                    f"guide has {n_classes} token classes > budget "
-                    f"{self.max_classes}; raise ARKS_GUIDE_CLASSES")
-            g = Guide(self._next_guide, self._next_row, n_states, n_classes)
-            base = g.start_row
-            self.class_ids[g.guide_id] = cls
-            self.trans[base: base + n_states, :n_classes] = np.where(
-                trans >= 0, trans + base, -1)
-            self._next_guide += 1
-            self._next_row += n_states
-            self._registry[key] = g
-            self.version += 1
+        g, ticket, owner = self._claim(key)
+        if g is not None:
             return g
+        if owner:
+            self._m_inc("misses")
+            self._pool().submit(self._compile_job, key, ticket)
+        return ticket
+
+    def compile(self, kind: str, pattern: str = "") -> Guide:
+        """Blocking compile: registry hit, or wait on (join) the in-flight
+        compile, or run the build on the CALLER's thread.  Idempotent per
+        (kind, pattern); raises GuideError on bad patterns or budgets
+        exhausted with every guide pinned."""
+        key = (kind, pattern)
+        first = True
+        while True:
+            g, ticket, owner = self._claim(key, count_hit=first)
+            first = False
+            if g is not None:
+                return g
+            if owner:
+                self._m_inc("misses")
+                self._compile_job(key, ticket)
+            else:
+                ticket.event.wait()
+            if ticket.error is not None:
+                raise GuideError(ticket.error)
+            # Published: loop re-claims from the registry.  (A guide
+            # evicted in the microseconds before our re-claim just
+            # triggers one more compile round.)
+
+    def acquire(self, kind: str, pattern: str = "") -> Guide:
+        """Pin a published guide (refcount +1, LRU touch).  The engine
+        holds one pin per admitted request from admission through finish;
+        pinned guides are never evicted, so their absolute device rows
+        stay stable for the slot's lifetime.  Raises GuideError when the
+        guide is not (or no longer) registered."""
+        with self._lock:
+            g = self._registry.get((kind, pattern))
+            if g is None:
+                raise GuideError(
+                    f"guide {kind}:{pattern!r} is not registered")
+            g.refcount += 1
+            self._touch_locked(g)
+            return g
+
+    def release(self, kind: str, pattern: str = "") -> None:
+        with self._lock:
+            g = self._registry.get((kind, pattern))
+            if g is not None and g.refcount > 0:
+                g.refcount -= 1
 
     def lookup(self, kind: str, pattern: str = "") -> Guide | None:
         return self._registry.get((kind, pattern))
+
+    def snapshot(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """Consistent (class_ids copy, trans copy, version) for the device
+        upload / multi-host table sync."""
+        with self._lock:
+            return self.class_ids.copy(), self.trans.copy(), self.version
 
     def next_row(self, row: int, token: int) -> int:
         """Host-side single-token advance (absolute row coords) for the
@@ -815,21 +893,205 @@ class GuideCompiler:
 
     def load_state(self, class_ids: np.ndarray, trans: np.ndarray,
                    version: int) -> None:
-        """Follower-side table sync from the leader's emit."""
+        """Follower-side table sync from the leader's emit.  Eviction-driven
+        repacks need no special handling: the leader always ships the FULL
+        fixed-shape arrays, and followers resolve guides by value (guide id
+        + absolute row travel in each dispatch payload), never through a
+        local registry."""
         with self._lock:
             self.class_ids = np.asarray(class_ids, np.int32)
             self.trans = np.asarray(trans, np.int32)
             self.version = version
 
+    # -- compile pipeline -------------------------------------------------
+
+    def _claim(self, key, count_hit: bool = True):
+        """(guide, ticket, owner): registry hit -> (g, None, False); an
+        existing in-flight compile -> (None, ticket, False); otherwise this
+        caller owns a fresh ticket -> (None, ticket, True)."""
+        with self._lock:
+            g = self._registry.get(key)
+            if g is not None:
+                self._touch_locked(g)
+                if count_hit:
+                    self._m_inc("hits")
+                return g, None, False
+            ticket = self._inflight.get(key)
+            if ticket is not None:
+                return None, ticket, False
+            ticket = CompileTicket()
+            self._inflight[key] = ticket
+            return None, ticket, True
+
+    def _compile_job(self, key, ticket: CompileTicket) -> None:
+        """Owner-side build + publish.  Runs UNLOCKED except for the final
+        publish; never raises (errors land on the ticket for every waiter
+        — blocking compile() callers and engine-parked requests alike)."""
+        t0 = time.monotonic()
+        try:
+            rx = self._render(*key)
+            cls, trans = self._build(rx)
+            with self._lock:
+                self._publish_locked(key, cls, trans)
+            if self._metrics is not None:
+                self._metrics.compile_seconds.observe(time.monotonic() - t0)
+        except GuideError as e:
+            ticket.error = str(e)
+        except Exception as e:  # worker pool must never die silently
+            ticket.error = f"{type(e).__name__}: {e}"
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            ticket.event.set()
+
+    def _render(self, kind: str, pattern: str) -> str:
+        if kind == "json":
+            return json_mode_regex(int(pattern) if pattern else None)
+        if kind == "regex":
+            return pattern
+        if kind == "json_schema":
+            try:
+                return json_schema_regex(json.loads(pattern))
+            except json.JSONDecodeError as e:
+                raise GuideError(f"invalid json_schema: {e}") from None
+        raise GuideError(f"unknown guide kind {kind!r}")
+
+    def _build(self, rx: str) -> tuple[np.ndarray, np.ndarray]:
+        """The expensive part (char DFA + vocab walk), lock-free.  An
+        instance method so tests can wrap it (compile counting, artificial
+        slowdowns) without touching module functions."""
+        char_table, accept = compile_regex_dfa(rx)
+        with self._tok_lock:
+            if self._tok_table is None:
+                self._tok_table = token_byte_table(self._tokenizer,
+                                                   self.vocab_size)
+            tok_table = self._tok_table
+        return token_transition_tables(char_table, accept, *tok_table,
+                                       self._eos_ids)
+
+    def _pool(self):
+        with self._lock:
+            if self._executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+                n = max(1, int(os.environ.get(
+                    "ARKS_GUIDE_COMPILE_WORKERS", "2")))
+                self._executor = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="guide-compile")
+            return self._executor
+
+    # -- packing / eviction (lock held) -----------------------------------
+
+    def _publish_locked(self, key, cls: np.ndarray,
+                        trans: np.ndarray) -> Guide:
+        n_states, n_classes = trans.shape
+        if n_classes > self.max_classes:
+            raise GuideError(
+                f"guide has {n_classes} token classes > budget "
+                f"{self.max_classes}; raise ARKS_GUIDE_CLASSES")
+        if n_states > self.max_rows:
+            raise GuideError(
+                f"guide row budget exhausted ({n_states} states needed, "
+                f"{self.max_rows} total rows; raise ARKS_GUIDE_ROWS)")
+        while not self._free_ids:
+            if not self._evict_one_locked():
+                raise GuideError(
+                    f"guide budget exhausted ({self.max_guides} guides, "
+                    "all with active slots; raise ARKS_GUIDE_MAX)")
+        base = self._take_span_locked(n_states)
+        while base is None:
+            if not self._evict_one_locked():
+                raise GuideError(
+                    f"guide row budget exhausted ({n_states} states "
+                    f"needed, {sum(ln for _, ln in self._free_spans)} rows "
+                    "free and every registered guide pinned; raise "
+                    "ARKS_GUIDE_ROWS)")
+            base = self._take_span_locked(n_states)
+        gid = self._free_ids.pop(0)
+        g = Guide(gid, base, n_states, n_classes, key=key)
+        self.class_ids[gid] = cls
+        # Clear the FULL row width first: a previous tenant of this span
+        # may have had more classes than the new guide fills.
+        self.trans[base: base + n_states] = -1
+        self.trans[base: base + n_states, :n_classes] = np.where(
+            trans >= 0, trans + base, -1)
+        self._registry[key] = g
+        self._touch_locked(g)
+        self.version += 1
+        self._rebuild_row_index_locked()
+        self._update_gauges_locked()
+        return g
+
+    def _evict_one_locked(self) -> bool:
+        """Evict the LRU guide with no active slot; False when every
+        registered guide is pinned (or the registry is empty)."""
+        victims = [g for g in self._registry.values() if g.refcount <= 0]
+        if not victims:
+            return False
+        v = min(victims, key=lambda g: g.lru)
+        del self._registry[v.key]
+        bisect.insort(self._free_ids, v.guide_id)
+        self._free_span_locked(v.start_row, v.n_states)
+        self.trans[v.start_row: v.start_row + v.n_states] = -1
+        self.version += 1  # device copies must refresh before id/row reuse
+        self._rebuild_row_index_locked()
+        self._update_gauges_locked()
+        self._m_inc("evictions")
+        return True
+
+    def _take_span_locked(self, n: int) -> int | None:
+        """First-fit allocation from the free row spans; None when no
+        contiguous span covers ``n`` rows."""
+        for i, (s, ln) in enumerate(self._free_spans):
+            if ln >= n:
+                if ln == n:
+                    self._free_spans.pop(i)
+                else:
+                    self._free_spans[i] = (s + n, ln - n)
+                return s
+        return None
+
+    def _free_span_locked(self, start: int, n: int) -> None:
+        spans = self._free_spans
+        spans.insert(bisect.bisect_left(spans, (start, 0)), (start, n))
+        merged: list[tuple[int, int]] = []
+        for s, ln in spans:
+            if merged and merged[-1][0] + merged[-1][1] == s:
+                merged[-1] = (merged[-1][0], merged[-1][1] + ln)
+            else:
+                merged.append((s, ln))
+        self._free_spans = merged
+
+    def _rebuild_row_index_locked(self) -> None:
+        entries = sorted((g.start_row, g.start_row + g.n_states, g.guide_id)
+                         for g in self._registry.values())
+        self._row_index = (tuple(e[0] for e in entries), tuple(entries))
+
+    def _touch_locked(self, g: Guide) -> None:
+        self._lru_tick += 1
+        g.lru = self._lru_tick
+
+    def _update_gauges_locked(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.guides_in_use.set(len(self._registry))
+        self._metrics.rows_in_use.set(
+            self.max_rows - sum(ln for _, ln in self._free_spans))
+
+    def _m_inc(self, name: str) -> None:
+        if self._metrics is not None:
+            getattr(self._metrics, name).inc(1)
+
     # -- internal --------------------------------------------------------
 
     def _guide_of_row(self, row: int) -> int:
-        # Snapshot under the lock: server threads compile (insert) while
-        # the engine thread advances rows — iterating the live dict here
-        # could raise mid-scheduler.
-        with self._lock:
-            guides = list(self._registry.values())
-        for g in guides:
-            if g.start_row <= row < g.start_row + g.n_states:
-                return g.guide_id
+        # Lock-free: bisect an immutable interval-index snapshot (replaced
+        # atomically under the lock on registry changes) instead of the old
+        # O(guides) scan under the lock — this sits on the engine thread's
+        # first-token path.
+        starts, entries = self._row_index
+        i = bisect.bisect_right(starts, row) - 1
+        if i >= 0:
+            s, e, gid = entries[i]
+            if s <= row < e:
+                return gid
         raise GuideError(f"row {row} belongs to no registered guide")

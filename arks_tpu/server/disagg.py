@@ -71,12 +71,18 @@ class PrefillServer(OpenAIServer):
             h._error(400, "disaggregated serving does not support n > 1")
             return True
         from arks_tpu.engine.engine import ContextLengthExceededError
+        from arks_tpu.engine.guides import GuideError
         try:
             pf = self.engine.prefill_detached(batch[0], params)
         except ContextLengthExceededError as e:
             h._json(400, {"error": {"message": str(e),
                                     "type": "invalid_request_error",
                                     "code": "context_length_exceeded"}})
+            return True
+        except GuideError as e:
+            # Guide compile failure on the prefill tier (budget exhausted
+            # with every guide pinned, etc.) — a request fault, not a 500.
+            h._error(400, str(e))
             return True
         meta = {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
                 "seed": pf.seed}

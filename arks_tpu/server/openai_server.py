@@ -136,7 +136,14 @@ def _sampling_from_body(body: dict, tokenizer,
         # vLLM extra: guided_json carries the schema directly.
         guide = ("json_schema", json.dumps(body["guided_json"]))
     if guide is not None and engine is not None:
-        engine.guides.compile(*guide)  # ValueError (400) on bad patterns
+        # Syntactic check only (ValueError -> 400 on bad patterns): the
+        # expensive DFA build runs on the compiler's worker pool once the
+        # request is queued (engine.add_request kicks it), so a cold
+        # schema never blocks this server thread for the ~seconds-scale
+        # compile.  Compile-time failures (budgets exhausted with every
+        # guide pinned) surface as a per-request 400 through the
+        # finish_reason="error" output.
+        engine.guides.validate(*guide)
     params = SamplingParams(
         max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 256),
         temperature=float(body.get("temperature", 1.0)),
@@ -411,7 +418,7 @@ class OpenAIServer:
                         raise ValueError(
                             "tool_choice required/named cannot combine "
                             "with response_format/guided_regex")
-                    self.engine.guides.compile(*forced)
+                    self.engine.guides.validate(*forced)
                     import dataclasses as _dc0
                     params = _dc0.replace(params, guide=forced)
             # OpenAI n: independent samples per prompt (choices are
@@ -479,13 +486,24 @@ class OpenAIServer:
         ``tools_ctx`` is the tool-call parser name when the request carries
         active tools (chat only)."""
         if bool(body.get("stream", False)):
+            # Peek the first engine output BEFORE committing to SSE: an
+            # admission-time rejection (async guide-compile failure,
+            # engine-side context check) must map to a clean HTTP 400,
+            # not a text/event-stream carrying finish_reason "error".
+            first = req.outputs.get()
+            if first.finished and first.finish_reason == "error":
+                if first.error == "context_length_exceeded":
+                    return self._context_length_error(
+                        h, first.num_prompt_tokens, self.engine.max_prompt_len)
+                return h._error(400, first.error or "request rejected")
             include_usage = bool(
                 (body.get("stream_options") or {}).get("include_usage"))
             if tools_ctx is not None and chat:
                 return self._stream_tools_response(
-                    h, req, model, include_usage, stop_strings, tools_ctx)
+                    h, req, model, include_usage, stop_strings, tools_ctx,
+                    first_out=first)
             self._stream_response(h, req, chat, model, include_usage,
-                                  stop_strings)
+                                  stop_strings, first_out=first)
         else:
             self._full_response(h, req, chat, model, stop_strings, echo=echo,
                                 tools_ctx=tools_ctx)
@@ -634,6 +652,16 @@ class OpenAIServer:
         for i, req in enumerate(reqs):
             text, finish_reason, fin, toks, lps, pieces = self._collect_text(
                 req, stop_strings)
+            if finish_reason == "error":
+                # One rejected choice fails the whole batch (the OpenAI
+                # response has no per-choice error channel); release the
+                # siblings' slots instead of decoding for nobody.
+                for r in reqs:
+                    self.engine.abort(r.request_id)
+                if fin.error == "context_length_exceeded":
+                    return self._context_length_error(
+                        h, fin.num_prompt_tokens, self.engine.max_prompt_len)
+                return h._error(400, fin.error or "request rejected")
             if chat:
                 message, finish_reason = self._chat_message(
                     text, finish_reason, tools_ctx)
@@ -737,7 +765,7 @@ class OpenAIServer:
 
     def _stream_tools_response(self, h, req: Request, model: str,
                                include_usage: bool, stop_strings: list[str],
-                               parser: str) -> None:
+                               parser: str, first_out=None) -> None:
         """Chat streaming with active tools: content streams normally until
         a tool-call marker appears; from there the text buffers and is
         emitted as ``delta.tool_calls`` when the stream ends (each call's
@@ -787,7 +815,9 @@ class OpenAIServer:
         try:
             send_frame(chunk({"role": "assistant"}))
             while True:
-                out = req.outputs.get()
+                out = first_out if first_out is not None \
+                    else req.outputs.get()
+                first_out = None  # _respond peeked the first output
                 prev_ntok = ntok
                 ntok += len(out.token_ids)
                 if stop_strings and prev_ntok < min_tok:
@@ -868,7 +898,8 @@ class OpenAIServer:
             self.engine.abort(req.request_id)
 
     def _stream_response(self, h, req: Request, chat: bool, model: str,
-                         include_usage: bool, stop_strings: list[str]) -> None:
+                         include_usage: bool, stop_strings: list[str],
+                         first_out=None) -> None:
         h.send_response(200)
         h.send_header("Content-Type", "text/event-stream")
         h.send_header("Cache-Control", "no-cache")
@@ -966,7 +997,9 @@ class OpenAIServer:
             if chat:
                 send_frame(chunk(None, role="assistant"))
             while True:
-                out = req.outputs.get()
+                out = first_out if first_out is not None \
+                    else req.outputs.get()
+                first_out = None  # _respond peeked the first output
                 prev_ntok = ntok
                 ntok += len(out.token_ids)
                 if n_lp is not None:

@@ -28,6 +28,13 @@ import uuid
 TOOL_OPEN = "<tool_call>"
 TOOL_CLOSE = "</tool_call>"
 
+# Function names are interpolated into the forced-call regex AND into the
+# JSON the DFA makes the model emit: anything beyond this set (quotes,
+# braces, backslashes, whitespace...) would corrupt the grammar into a DFA
+# whose forced output parse_tool_calls cannot parse back.  OpenAI's own
+# contract is the same alphabet.
+_FN_NAME_RE = re.compile(r"[A-Za-z0-9_.-]+")
+
 
 def validate_tools(body: dict) -> tuple[list | None, object]:
     """Returns (tools, tool_choice) validated, or raises ValueError.
@@ -44,6 +51,11 @@ def validate_tools(body: dict) -> tuple[list | None, object]:
         fn = t.get("function") or {}
         if not isinstance(fn.get("name"), str) or not fn["name"]:
             raise ValueError("each tool function needs a name")
+        if not _FN_NAME_RE.fullmatch(fn["name"]):
+            raise ValueError(
+                f"tool function name {fn['name']!r} must match "
+                "[A-Za-z0-9_.-]+ (other characters would corrupt the "
+                "forced-call grammar)")
     choice = body.get("tool_choice", "auto")
     if isinstance(choice, str):
         if choice not in ("auto", "none", "required"):

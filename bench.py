@@ -201,6 +201,25 @@ def main() -> None:
         return
 
     cfg = get_config(model)
+
+    # Guided-decoding cold start: the host-side char-DFA + vocab-walk build
+    # for JSON mode at this model's REAL vocab size — the latency the async
+    # compile pipeline hides from the scheduler (it bounds added TTFT for
+    # the first request per schema only; warm requests are a registry hit).
+    # A byte-level vocab walks 1 byte per token where a merged-BPE vocab
+    # walks ~word-length strings, so treat this as a floor, tracked across
+    # BENCH rounds for regressions in the compile pipeline itself.
+    try:
+        from arks_tpu.engine.guides import GuideCompiler
+        from arks_tpu.engine.tokenizer import ByteTokenizer
+        gcomp = GuideCompiler(ByteTokenizer(), cfg.vocab_size, eos_ids=(0,))
+        tg0 = time.perf_counter()
+        gcomp.compile("json")
+        result["guided_cold_start_s"] = round(time.perf_counter() - tg0, 3)
+        del gcomp
+    except Exception as e:
+        result["guided_cold_start_error"] = f"{type(e).__name__}: {e}"
+
     n_chips = len(jax.devices())
 
     # ---- Raw-loop sections: fault-isolated so a failure here still leaves

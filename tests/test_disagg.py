@@ -155,6 +155,51 @@ def test_disaggregated_guided_decoding():
     assert _json.loads(text)["ok"] in (True, False)
 
 
+def test_admit_prefilled_refreshes_guide_tables():
+    """Regression (advisor high-severity): _admit_prefilled set guide_row
+    WITHOUT refreshing the device guide tables, unlike every other
+    admission path — a guide published after the step's top-of-loop
+    refresh (routine now that compiles finish on worker threads at
+    arbitrary times, and the ordering tests/test_spec_decode.py followed
+    by test_disagg.py::test_disaggregated_guided_decoding hit in one
+    process) decoded against stale device rows: all -1 -> everything
+    masked -> instant eos."""
+    import json as _json
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=96,
+                        prefill_buckets=(16, 32), steps_per_dispatch=2)
+    tok = ByteTokenizer()
+    pat = r'\{"ok": (true|false)\}'
+    params = SamplingParams(max_tokens=24, temperature=0.0,
+                            guide=("regex", pat))
+    prefill_engine = InferenceEngine(cfg, ecfg, tok)
+    pf = prefill_engine.prefill_detached(tok.encode("zz"), params)
+
+    decode_engine = InferenceEngine(cfg, ecfg, tok)
+    decode_engine._ensure_guides_uploaded()  # the top-of-loop refresh
+    # The guide publishes AFTER that refresh (what a worker-pool compile
+    # finishing mid-step looks like): device tables are now stale.
+    decode_engine.guides.compile(*params.guide)
+    assert decode_engine._guide_ver != decode_engine.guides.version
+    dreq = Request(request_id="rg1", prompt_ids=[], params=params,
+                   prefilled=PrefilledState(
+                       first_token=pf.first_token, num_prompt=pf.num_prompt,
+                       seed=pf.seed, k=pf.k, v=pf.v,
+                       guide_row=pf.guide_row))
+    decode_engine.metrics.num_requests_waiting.inc(1)  # _preadmit decs
+    assert decode_engine._preadmit(dreq) is None  # prefilled admits inline
+    # THE regression check: the admission must have refreshed the device
+    # tables before the slot's first decode dispatch.
+    assert decode_engine._guide_ver == decode_engine.guides.version
+    decode_engine.start()
+    try:
+        got = _drain(dreq)
+    finally:
+        decode_engine.stop()
+    text = tok.decode(got)
+    assert _json.loads(text)["ok"] in (True, False)
+
+
 def test_detached_prefill_rejects_oversize_prompt():
     """The disaggregated prefill engine raises the typed rejection (the
     servers map it to HTTP 400 context_length_exceeded end-to-end, including

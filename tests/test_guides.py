@@ -6,6 +6,8 @@ reachable through the reference's runtime launch path
 
 import json
 import queue
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -361,6 +363,256 @@ def test_engine_guided_with_hf_tokenizer(hf_tokenizer):
                 break
         assert out.finish_reason == "stop"
         assert json.loads(hf_tokenizer.decode(toks))["ok"] in (True, False)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Non-blocking compile pipeline + LRU eviction
+# ---------------------------------------------------------------------------
+
+def test_concurrent_compiles_of_same_key_build_once():
+    """N threads compiling one (kind, pattern) dedupe onto a single
+    expensive build through the in-flight ticket."""
+    tok = ByteTokenizer()
+    gc = GuideCompiler(tok, tok.vocab_size, eos_ids=(0,))
+    builds: list[str] = []
+    orig = gc._build
+
+    def counting_build(rx):
+        builds.append(rx)
+        time.sleep(0.2)  # widen the race window
+        return orig(rx)
+
+    gc._build = counting_build
+    out: list = []
+    threads = [threading.Thread(
+        target=lambda: out.append(gc.compile("regex", "[0-9]+")))
+        for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(builds) == 1, "same-key compiles must dedupe onto one build"
+    assert len(out) == 6 and all(g is out[0] for g in out)
+
+
+def test_lru_eviction_pins_and_row_reuse():
+    tok = ByteTokenizer()
+    gc = GuideCompiler(tok, tok.vocab_size, eos_ids=(0,), max_guides=2)
+    g1 = gc.compile("regex", "a+")
+    g2 = gc.compile("regex", "b+")
+    v0 = gc.version
+    gc.acquire("regex", "b+")  # pin g2 (simulates an active slot)
+    g3 = gc.compile("regex", "c+")  # budget full -> evicts g1 (LRU, unpinned)
+    assert gc.lookup("regex", "a+") is None
+    assert gc.lookup("regex", "b+") is g2, "pinned guide must survive"
+    assert gc.version > v0, "eviction + publish must bump version"
+    assert g3.guide_id == g1.guide_id, "evicted id is reused"
+    assert g3.start_row == g1.start_row, "evicted row span is reused"
+    # The interval index resolves rows correctly after the repack.
+    row = g3.start_row
+    for tid in tok.encode("cc"):
+        assert gc.allowed(row)[tid]
+        row = gc.next_row(row, tid)
+    assert gc.allowed(row)[0]
+    # Every guide pinned -> a new pattern fails with a clean GuideError...
+    gc.acquire("regex", "c+")
+    with pytest.raises(GuideError, match="budget"):
+        gc.compile("regex", "d+")
+    # ...and releasing a pin makes the same pattern compile (evicting it).
+    gc.release("regex", "b+")
+    g4 = gc.compile("regex", "d+")
+    assert gc.lookup("regex", "b+") is None
+    assert gc.lookup("regex", "d+") is g4
+
+
+def test_engine_slow_compile_does_not_block_unguided_stream():
+    """A cold guide compile (artificially slowed to 2.5 s) must not stall
+    the scheduler: a concurrent unguided stream decodes to completion
+    while the compile runs, and the guided request then completes with
+    grammar-valid output."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=96,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    try:
+        _run(eng, "warm", None, max_tokens=4)  # jit warmup off the clock
+        orig = eng.guides._build
+
+        def slow_build(rx):
+            time.sleep(2.5)
+            return orig(rx)
+
+        eng.guides._build = slow_build
+        pat = r'\{"k": (true|false)\}'
+        greq = Request(request_id="slowg",
+                       prompt_ids=ByteTokenizer().encode("zz"),
+                       params=SamplingParams(max_tokens=48, temperature=0.0,
+                                             guide=("regex", pat)))
+        eng.add_request(greq)
+        time.sleep(0.1)  # compile is now in flight on the worker pool
+        t0 = time.monotonic()
+        _, fin_u, _ = _run(eng, "ab", None, max_tokens=8)
+        unguided_s = time.monotonic() - t0
+        assert unguided_s < 2.0, (
+            f"unguided stream took {unguided_s:.2f}s — it stalled behind "
+            "the guide compile")
+        toks: list[int] = []
+        while True:
+            out = greq.outputs.get(timeout=60)
+            toks.extend(out.token_ids)
+            if out.finished:
+                break
+        assert out.finish_reason == "stop"
+        assert json.loads(ByteTokenizer().decode(toks))["k"] in (True, False)
+    finally:
+        eng.stop()
+
+
+def _counter_total(counter) -> float:
+    return sum(counter._values.values())
+
+
+def test_engine_lru_eviction_end_to_end(monkeypatch):
+    """ARKS_GUIDE_MAX + 4 distinct schemas served sequentially on one
+    engine: LRU eviction keeps admitting (no restart, no 400), evictions
+    advance the metric, and guided outputs stay grammar-valid after
+    eviction-driven device-table refreshes."""
+    monkeypatch.setenv("ARKS_GUIDE_MAX", "3")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=96,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert eng.guides.max_guides == 3
+    eng.start()
+    try:
+        for i in range(3 + 4):
+            pat = r'\{"k%d": (true|false)\}' % i
+            text, fin, _ = _run(eng, "zz", ("regex", pat), max_tokens=48)
+            assert fin.finish_reason == "stop", (i, fin)
+            assert json.loads(text)[f"k{i}"] in (True, False)
+        assert _counter_total(
+            eng.metrics.guide_cache_evictions_total) >= 4
+        assert eng.metrics.guide_registry_guides_in_use.get() <= 3
+    finally:
+        eng.stop()
+
+
+def test_engine_all_guides_pinned_rejects_cleanly(monkeypatch):
+    """With ARKS_GUIDE_MAX=1 and the only guide pinned by a running slot,
+    a second pattern gets a per-request error (HTTP 400 at the server),
+    not a dropped stream — and once the pin releases, the same pattern
+    compiles via eviction."""
+    monkeypatch.setenv("ARKS_GUIDE_MAX", "1")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=256,
+                        prefill_buckets=(8, 16), steps_per_dispatch=4)
+    tok = ByteTokenizer()
+    eng = InferenceEngine(cfg, ecfg, tok)
+    eng.start()
+    try:
+        # Long-running guided request: pins the single guide slot.
+        r1 = Request(request_id="pin1", prompt_ids=tok.encode("zz"),
+                     params=SamplingParams(max_tokens=180, temperature=0.0,
+                                           guide=("regex", "(a|b)+")))
+        eng.add_request(r1)
+        out1 = r1.outputs.get(timeout=60)  # first token -> slot registered
+        assert not out1.finished
+        # Second pattern: compiles fine, but publish finds the budget full
+        # with every guide pinned -> per-request error output.
+        r2 = Request(request_id="pin2", prompt_ids=tok.encode("q"),
+                     params=SamplingParams(max_tokens=8, temperature=0.0,
+                                           guide=("regex", "[0-9]+")))
+        eng.add_request(r2)
+        while True:
+            out2 = r2.outputs.get(timeout=60)
+            if out2.finished:
+                break
+        assert out2.finish_reason == "error"
+        assert "guide" in (out2.error or "")
+        # Drain the pinning request; its _finish releases the pin.
+        toks1 = list(out1.token_ids)
+        while True:
+            o = r1.outputs.get(timeout=120)
+            toks1.extend(o.token_ids)
+            if o.finished:
+                break
+        assert set(tok.decode(toks1)) <= {"a", "b"}
+        # Now the same second pattern succeeds (evicts the released guide).
+        text3, fin3, _ = _run(eng, "q", ("regex", "[0-9]{2}"), max_tokens=24)
+        assert fin3.finish_reason == "stop"
+        assert text3.isdigit() and len(text3) == 2
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_guided_cold_vs_warm_admit_bench():
+    """Micro-benchmark (BENCH rounds track bench.py's guided_cold_start_s;
+    this is the CPU-tier counterpart): admit-to-first-token with a cold vs
+    warm guide, plus the headline assertion that scheduler progress during
+    a background compile stays bounded on CPU."""
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=96,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    try:
+        _run(eng, "warm", None, max_tokens=4)  # jit warmup
+
+        def ttft(pat: str) -> float:
+            req = Request(request_id=f"b-{pat}",
+                          prompt_ids=ByteTokenizer().encode("zz"),
+                          params=SamplingParams(max_tokens=8,
+                                                temperature=0.0,
+                                                guide=("regex", pat)))
+            t0 = time.monotonic()
+            eng.add_request(req)
+            first = req.outputs.get(timeout=120)
+            dt = time.monotonic() - t0
+            while not first.finished:
+                first = req.outputs.get(timeout=120)
+            return dt
+
+        cold = ttft(r'\{"bench": [0-9]\}')
+        warm = ttft(r'\{"bench": [0-9]\}')
+        assert cold > 0 and warm > 0
+        # Scheduler responsiveness during a background compile: an
+        # unguided request admitted mid-compile must reach its first
+        # token well before the compile finishes (loose CPU bound).
+        orig = eng.guides._build
+
+        def slow_build(rx):
+            time.sleep(2.0)
+            return orig(rx)
+
+        eng.guides._build = slow_build
+        greq = Request(request_id="b-bg",
+                       prompt_ids=ByteTokenizer().encode("zz"),
+                       params=SamplingParams(max_tokens=8, temperature=0.0,
+                                             guide=("regex", "[a-f]+")))
+        eng.add_request(greq)
+        time.sleep(0.05)
+        ureq = Request(request_id="b-un",
+                       prompt_ids=ByteTokenizer().encode("ab"),
+                       params=SamplingParams(max_tokens=4, temperature=0.0))
+        t0 = time.monotonic()
+        eng.add_request(ureq)
+        out = ureq.outputs.get(timeout=60)
+        step_bound = time.monotonic() - t0
+        while not out.finished:
+            out = ureq.outputs.get(timeout=60)
+        while True:
+            o = greq.outputs.get(timeout=60)
+            if o.finished:
+                break
+        assert step_bound < 1.5, (
+            f"admit-to-first-token {step_bound:.2f}s during a background "
+            "compile — the scheduler blocked on compilation")
+        print(f"guided admit-to-first-token: cold={cold:.3f}s "
+              f"warm={warm:.3f}s mid-compile-unguided={step_bound:.3f}s")
     finally:
         eng.stop()
 

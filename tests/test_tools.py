@@ -37,6 +37,24 @@ def test_validate_tools():
             validate_tools(bad)
 
 
+def test_validate_tools_rejects_unsafe_function_names():
+    """Names outside [A-Za-z0-9_.-]+ must 400: a quote (or brace, space,
+    backslash...) interpolated into the forced-call regex would compile a
+    DFA whose forced output parse_tool_calls cannot parse back."""
+    for bad_name in ('has"quote', "sp ace", "br{ace", "back\\slash",
+                     "pipe|alt", "nl\nline", "paren(s)"):
+        body = {"tools": [{"type": "function",
+                           "function": {"name": bad_name}}]}
+        with pytest.raises(ValueError, match="name"):
+            validate_tools(body)
+    # The full legal alphabet passes.
+    tools, choice = validate_tools(
+        {"tools": [{"type": "function",
+                    "function": {"name": "get_weather.v2-beta_1"}}]})
+    assert choice == "auto"
+    assert tools[0]["function"]["name"] == "get_weather.v2-beta_1"
+
+
 def test_parse_hermes_calls():
     text = ('thinking first <tool_call>{"name": "get_weather", '
             '"arguments": {"city": "Oslo"}}</tool_call> and '
