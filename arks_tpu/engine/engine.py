@@ -301,6 +301,15 @@ class EngineMetrics:
         self.spec_decode_acceptance_rate = r.gauge(
             "spec_decode_acceptance_rate",
             "Lifetime draft-token acceptance rate")
+        # Mixed-step scheduling (ARKS_MIXED_STEP): one token-budget dispatch
+        # per iteration carrying decode tokens + prefill-chunk tokens.
+        self.mixed_batch_tokens = r.histogram(
+            "mixed_batch_tokens",
+            "Valid tokens per mixed dispatch (decode + chunk)",
+            buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048])
+        self.mixed_chunk_tokens_total = r.counter(
+            "mixed_chunk_tokens_total",
+            "Prefill-chunk tokens processed inside mixed dispatches")
         # Scheduler phase breakdown (seconds of engine-thread wall time):
         # where a serving cycle actually goes — the counters bench_serving
         # scrapes to attribute throughput loss (admit vs chunk vs decode).
@@ -618,6 +627,35 @@ class InferenceEngine:
         # processes (arks_tpu.engine.multihost); None single-host.
         self.dispatcher = None
 
+        # ---- Mixed prefill+decode step (ARKS_MIXED_STEP) ---------------
+        # ONE token-budget dispatch per scheduler iteration: every decoding
+        # slot's next token plus up to ARKS_MIXED_CHUNK_TOKENS prefill-chunk
+        # tokens spread round-robin across ALL prefilling sequences, sampled
+        # in the same program.  Replaces the admit_batch x chunk_step x
+        # decode_loop program family for paged engines — default ON where
+        # supported; spec-decode, non-paged, and no-chunk (pp) engines stay
+        # on the legacy paths.
+        _mx = os.environ.get("ARKS_MIXED_STEP", "auto")
+        if _mx not in ("auto", "0", "1"):
+            raise ValueError(f"ARKS_MIXED_STEP={_mx!r}: expected auto|0|1")
+        mixed_capable = (self._paged and bool(self._chunk)
+                         and engine_cfg.draft_model is None)
+        self._mixed = mixed_capable and _mx != "0"
+        if _mx == "1" and not mixed_capable:
+            log.warning(
+                "ARKS_MIXED_STEP=1 requested but unsupported here "
+                "(paged=%s chunk=%s draft=%s); staying on the legacy "
+                "scheduler", self._paged, self._chunk,
+                engine_cfg.draft_model)
+        self._mixed_budget = 0
+        if self._mixed:
+            budget = int(os.environ.get("ARKS_MIXED_CHUNK_TOKENS",
+                                        str(self._chunk)))
+            if budget < 1:
+                raise ValueError(
+                    f"ARKS_MIXED_CHUNK_TOKENS={budget}: must be >= 1")
+            self._mixed_budget = min(budget, engine_cfg.max_cache_len)
+
         # Surface the RESOLVED configuration — the auto decisions, not the
         # requested ones — as an _info gauge and one startup log line, so
         # bench_serving / Grafana / an operator can tell which perf
@@ -634,6 +672,7 @@ class InferenceEngine:
             "kv_cache_dtype": self.ecfg.resolve_kv_cache_dtype(),
             "weight_dtype": self.ecfg.weight_dtype or "native",
             "model": self.ecfg.model,
+            "mixed_step": str(bool(self._mixed)).lower(),
         }
         self.metrics.engine_config_info.set(1, **self.resolved_config)
         log.info("engine resolved config: %s",
@@ -866,6 +905,73 @@ class InferenceEngine:
 
         self._decode_lp_fn = jax.jit(decode_loop_lp, donate_argnums=(1, 4))
 
+        if self._mixed:
+            # The unified mixed prefill+decode program: count the decode
+            # feed, run ONE model forward over the flat token batch, then
+            # ONE sampler.sample over every lane — persistent rows for
+            # decoding slots, transient override columns (packed per lane)
+            # for sequences whose prompt completes this step.  Only key and
+            # guide-row advances of DECODE lanes merge back into the
+            # persistent state; completion lanes are written by the host's
+            # set_slot at registration, exactly like the legacy chunk path.
+            def mixed_prog(params, cache, sampling, tokens, token_slot,
+                           token_pos, tables, feed_tokens, feed_active,
+                           lengths, sample_src, seq_q_start, seq_q_len,
+                           seq_pos_start, ov_mask, ov_temp, ov_top_p,
+                           ov_top_k, ov_key, ov_bias_ids, ov_bias_vals,
+                           ov_sup, ov_min_until, ov_guide, ov_guide_row,
+                           gtables, want_lp: bool):
+                sampling = sampler_mod.count_tokens(sampling, feed_tokens,
+                                                    feed_active)
+                logits, cache = tf.mixed_step(
+                    params, cfg, cache, tables, tokens, token_slot,
+                    token_pos, sample_src, seq_q_start, seq_q_len,
+                    seq_pos_start, mesh)
+                ovc = ov_mask[:, None]
+                # Completion lanes sample with transient first-token
+                # semantics: penalties are identity (their output is
+                # empty — counts don't matter once presence/frequency are
+                # zeroed), bias/suppression/guide come from the override
+                # columns, and min_until is pre-shifted by the host so
+                # ``lengths < min_until`` reads as the min_first flag.
+                eff = sampling._replace(
+                    temperature=jnp.where(ov_mask, ov_temp,
+                                          sampling.temperature),
+                    top_p=jnp.where(ov_mask, ov_top_p, sampling.top_p),
+                    top_k=jnp.where(ov_mask, ov_top_k, sampling.top_k),
+                    key=jnp.where(ovc, ov_key, sampling.key),
+                    presence=jnp.where(ov_mask, 0.0, sampling.presence),
+                    frequency=jnp.where(ov_mask, 0.0, sampling.frequency),
+                    bias_ids=jnp.where(ovc, ov_bias_ids, sampling.bias_ids),
+                    bias_vals=jnp.where(ovc, ov_bias_vals,
+                                        sampling.bias_vals),
+                    suppress_ids=jnp.where(ovc, ov_sup,
+                                           sampling.suppress_ids),
+                    min_until=jnp.where(ov_mask, ov_min_until,
+                                        sampling.min_until),
+                    guide=jnp.where(ov_mask, ov_guide, sampling.guide),
+                    guide_row=jnp.where(ov_mask, ov_guide_row,
+                                        sampling.guide_row))
+                ids, eff2 = sampler_mod.sample(logits, eff, feed_active,
+                                               lengths,
+                                               guide_tables=gtables)
+                sampling = sampling._replace(
+                    key=jnp.where(feed_active[:, None], eff2.key,
+                                  sampling.key),
+                    guide_row=jnp.where(feed_active, eff2.guide_row,
+                                        sampling.guide_row))
+                if want_lp:
+                    clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
+                    return ids, clp, vals, lids, cache, sampling
+                return ids, cache, sampling
+
+            self._mixed_fn = jax.jit(
+                functools.partial(mixed_prog, want_lp=False),
+                donate_argnums=(1, 2))
+            self._mixed_lp_fn = jax.jit(
+                functools.partial(mixed_prog, want_lp=True),
+                donate_argnums=(1, 2))
+
         if self._draft_cfg is not None:
             dcfg = self._draft_cfg
             DK = self.ecfg.draft_len
@@ -1019,6 +1125,23 @@ class InferenceEngine:
         # this to detect completion, and a pending admission is running
         # work in every sense that matters to them.
         return len(self._slots) + self._pending_n
+
+    def compiled_program_variants(self) -> dict[str, int]:
+        """Program name -> number of compiled variants, for every jitted
+        function this engine owns.  The compile-budget regression surface:
+        the mixed scheduler exists partly to collapse the (bucket, M, lp)
+        admit-program family into ONE budget-shaped program, and a future
+        scheduler edit that silently reintroduces per-shape retraces shows
+        up here long before it shows up as TPU compile stalls."""
+        out: dict[str, int] = {}
+        for name, fn in vars(self).items():
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                try:
+                    out[name] = int(size())
+                except Exception:  # jax internals may shift across versions
+                    continue
+        return out
 
     @property
     def idle(self) -> bool:
@@ -1288,34 +1411,57 @@ class InferenceEngine:
             t0 = tg
         pending = None
         issued = False
-        if self._slots and self._draft_cfg is None and self._overlap:
-            pending = self._issue_decode()  # may retire/abort even if None
-            issued = True
-        t1 = time.monotonic()
-        if issued:
-            self.metrics.scheduler_seconds_total.inc(t1 - t0, phase="decode")
-        worked = self._admit() or worked or issued
-        t2 = time.monotonic()
-        if t2 - t1 > 1e-4:
-            self.metrics.scheduler_seconds_total.inc(t2 - t1, phase="admit")
-        if self._prefilling:
-            self._process_chunk()
-            t3 = time.monotonic()
-            self.metrics.scheduler_seconds_total.inc(t3 - t2, phase="chunk")
-            t2 = t3
-            worked = True
-        if pending is not None:
-            self._resolve_decode(pending, exclude_s=t2 - t1)
-            self.metrics.scheduler_seconds_total.inc(
-                time.monotonic() - t2, phase="decode")
-        elif self._slots and (self._draft_cfg is not None
-                              or not self._overlap):
-            # Sequential order: speculative engines, and platforms where
-            # the overlap cannot pay (see _overlap above).
-            self._decode_dispatch()
-            self.metrics.scheduler_seconds_total.inc(
-                time.monotonic() - t2, phase="decode")
-            worked = True
+        if self._mixed:
+            # Mixed scheduling: ONE model dispatch per iteration carries
+            # every decoding slot's next token AND all prefilling
+            # sequences' chunk tokens — admission host work overlaps the
+            # in-flight dispatch exactly as in the legacy issue/resolve
+            # split.
+            if self._slots or self._prefilling:
+                pending = self._issue_mixed()
+                issued = pending is not None
+            t1 = time.monotonic()
+            if issued:
+                self.metrics.scheduler_seconds_total.inc(t1 - t0,
+                                                         phase="mixed")
+            worked = self._admit() or worked or issued
+            t2 = time.monotonic()
+            if t2 - t1 > 1e-4:
+                self.metrics.scheduler_seconds_total.inc(t2 - t1,
+                                                         phase="admit")
+            if pending is not None:
+                self._resolve_mixed(pending, exclude_s=t2 - t1)
+                self.metrics.scheduler_seconds_total.inc(
+                    time.monotonic() - t2, phase="mixed")
+        else:
+            if self._slots and self._draft_cfg is None and self._overlap:
+                pending = self._issue_decode()  # may retire/abort even if None
+                issued = True
+            t1 = time.monotonic()
+            if issued:
+                self.metrics.scheduler_seconds_total.inc(t1 - t0, phase="decode")
+            worked = self._admit() or worked or issued
+            t2 = time.monotonic()
+            if t2 - t1 > 1e-4:
+                self.metrics.scheduler_seconds_total.inc(t2 - t1, phase="admit")
+            if self._prefilling:
+                self._process_chunk()
+                t3 = time.monotonic()
+                self.metrics.scheduler_seconds_total.inc(t3 - t2, phase="chunk")
+                t2 = t3
+                worked = True
+            if pending is not None:
+                self._resolve_decode(pending, exclude_s=t2 - t1)
+                self.metrics.scheduler_seconds_total.inc(
+                    time.monotonic() - t2, phase="decode")
+            elif self._slots and (self._draft_cfg is not None
+                                  or not self._overlap):
+                # Sequential order: speculative engines, and platforms where
+                # the overlap cannot pay (see _overlap above).
+                self._decode_dispatch()
+                self.metrics.scheduler_seconds_total.inc(
+                    time.monotonic() - t2, phase="decode")
+                worked = True
         if self._pending_admits:
             # Deferred admissions: resolve whatever the device finished
             # while this step ran (the decode resolve above usually means
@@ -1552,7 +1698,11 @@ class InferenceEngine:
             if plen:
                 return self._start_chunked(req, ids, prefix_len=plen)
 
-        if padded is None:
+        if padded is None or self._mixed:
+            # Mixed scheduling: EVERY prompt rides the chunked path — its
+            # tokens reach the model through mixed dispatches, so the
+            # bucketed one-shot admit programs never compile (the variant
+            # family collapses to one budget-shaped program).
             return self._start_chunked(req, ids)
 
         return (req, ids, padded)
@@ -2540,6 +2690,281 @@ class InferenceEngine:
                     request_id=st.request.request_id, token_ids=delta,
                     num_prompt_tokens=st.num_prompt,
                     logprobs=lp_delta))
+
+    # ------------------------------------------------------------------
+    # Mixed prefill+decode dispatch (ARKS_MIXED_STEP)
+    # ------------------------------------------------------------------
+
+    def _mixed_abort_and_retire(self) -> None:
+        """Mixed-mode scheduling boundary: honor aborts for decoding AND
+        prefilling sequences, purge stale abort flags, and retire slots
+        that would overflow the cache this dispatch (margin 1 — the mixed
+        step writes exactly one decode row per slot)."""
+        with self._abort_lock:
+            aborted = set(self._aborted)
+        consumed = set()
+        for slot in list(self._slots):
+            rid = self._slots[slot].request.request_id
+            if rid in aborted:
+                self._finish(slot, "abort")
+                consumed.add(rid)
+        for slot, st in list(self._prefilling.items()):
+            rid = st.request.request_id
+            if rid in aborted:
+                del self._prefilling[slot]
+                self._release_slot_pages(slot)
+                self._free.append(slot)
+                self._unpin_guide(st.request)
+                st.request.outputs.put(RequestOutput(
+                    request_id=rid, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(st.ids)))
+                consumed.add(rid)
+        active = {st.request.request_id for st in self._slots.values()}
+        active |= {st.request.request_id for st in self._prefilling.values()}
+        active |= {req.request_id for rec in self._pending_admits
+                   for req, _, _ in rec[0]}
+        active |= {req.request_id for req, _ in self._awaiting_guide}
+        with self._abort_lock:
+            self._aborted -= consumed
+            self._aborted &= active | self._queued_rids
+        for slot in list(self._slots):
+            if int(self._lengths[slot]) + 2 > self.ecfg.max_cache_len:
+                self._finish(slot, "length")
+
+    def _issue_mixed(self):
+        """Build and issue ONE mixed dispatch: every decoding slot's next
+        token plus up to ARKS_MIXED_CHUNK_TOKENS prefill tokens spread
+        round-robin across ALL prefilling sequences (each makes progress
+        every step — no head-of-line prefill serialization).  Sequences
+        whose prompt completes inside this batch get transient first-token
+        sampling columns packed into their lane; everything samples in the
+        program's single sampler.sample call.  Returns the pending record
+        for _resolve_mixed, or None when no sequence needs the model."""
+        self._mixed_abort_and_retire()
+        if not self._slots and not self._prefilling:
+            return None
+        self._ensure_guides_uploaded()
+        self._grow_slot_pages(1)
+        num_slots = self.ecfg.num_slots
+        t_budget = num_slots + self._mixed_budget
+        sentinel = self._park_sentinel()
+        tokens = np.zeros((t_budget,), np.int32)
+        token_slot = np.full((t_budget,), -1, np.int32)
+        token_pos = np.full((t_budget,), sentinel, np.int32)
+        sample_src = np.zeros((num_slots,), np.int32)
+        feed_tokens = np.zeros((num_slots,), np.int32)
+        feed_active = np.zeros((num_slots,), bool)
+        seq_q_start = np.zeros((num_slots,), np.int32)
+        seq_q_len = np.zeros((num_slots,), np.int32)
+        seq_pos_start = np.zeros((num_slots,), np.int32)
+        ov_mask = np.zeros((num_slots,), bool)
+        ov_temp = np.zeros((num_slots,), np.float32)
+        ov_top_p = np.ones((num_slots,), np.float32)
+        ov_top_k = np.zeros((num_slots,), np.int32)
+        ov_key = np.zeros((num_slots, 2), np.uint32)
+        ov_bias_ids = np.full((num_slots, sampler_mod.LOGIT_BIAS_MAX), -1,
+                              np.int32)
+        ov_bias_vals = np.zeros((num_slots, sampler_mod.LOGIT_BIAS_MAX),
+                                np.float32)
+        ov_sup = np.full((num_slots, sampler_mod.SUPPRESS_MAX), -1, np.int32)
+        ov_min_until = np.zeros((num_slots,), np.int32)
+        ov_guide = np.full((num_slots,), -1, np.int32)
+        ov_guide_row = np.zeros((num_slots,), np.int32)
+
+        t = 0
+        dec_slots = list(self._slots.keys())
+        for slot in dec_slots:
+            tokens[t] = self._last_token[slot]
+            token_slot[t] = slot
+            token_pos[t] = self._lengths[slot]
+            sample_src[slot] = t
+            feed_tokens[slot] = self._last_token[slot]
+            feed_active[slot] = True
+            seq_q_start[slot] = t
+            seq_q_len[slot] = 1
+            seq_pos_start[slot] = self._lengths[slot]
+            t += 1
+
+        completing: list = []
+        chunk_take: list[tuple[int, int]] = []
+        pre = list(self._prefilling.items())
+        if pre and self._mixed_budget:
+            # Round-robin fill: an even quota per prefilling sequence
+            # first, FIFO greedy for the leftover — a burst of long
+            # prompts shares the budget instead of serializing.
+            budget = self._mixed_budget
+            quota = max(budget // len(pre), 1)
+            takes: dict[int, int] = {}
+            for slot, st in pre:
+                if budget <= 0:
+                    break
+                take = min(len(st.ids) - st.pos, quota, budget)
+                if take > 0:
+                    takes[slot] = take
+                    budget -= take
+            for slot, st in pre:
+                if budget <= 0:
+                    break
+                extra = min(len(st.ids) - st.pos - takes.get(slot, 0),
+                            budget)
+                if extra > 0:
+                    takes[slot] = takes.get(slot, 0) + extra
+                    budget -= extra
+            for slot, st in pre:
+                take = takes.get(slot, 0)
+                if not take:
+                    continue
+                tokens[t: t + take] = st.ids[st.pos: st.pos + take]
+                token_slot[t: t + take] = slot
+                token_pos[t: t + take] = np.arange(st.pos, st.pos + take)
+                seq_q_start[slot] = t
+                seq_q_len[slot] = take
+                seq_pos_start[slot] = st.pos
+                chunk_take.append((slot, take))
+                if st.pos + take == len(st.ids):
+                    # Prompt completes inside this batch: its lane samples
+                    # the FIRST token with the transient columns (same key
+                    # and shaping semantics as the legacy sample_one).
+                    sample_src[slot] = t + take - 1
+                    p = st.request.params
+                    gid, grow0 = self._guide_cols(p)
+                    bias_ids, bias_vals, sup, min_first, _mu = \
+                        self._shape_cols(p, 0)
+                    ov_mask[slot] = True
+                    ov_temp[slot] = p.temperature
+                    ov_top_p[slot] = p.top_p
+                    ov_top_k[slot] = p.top_k
+                    ov_key[slot] = np.asarray(st.key)
+                    ov_bias_ids[slot] = bias_ids
+                    ov_bias_vals[slot] = bias_vals
+                    ov_sup[slot] = sup
+                    # lengths[slot] carries len(ids) while prefilling; +1
+                    # makes ``lengths < min_until`` read as min_first.
+                    ov_min_until[slot] = \
+                        len(st.ids) + 1 if min_first else 0
+                    ov_guide[slot] = gid
+                    ov_guide_row[slot] = grow0
+                    completing.append((slot, st, gid, grow0))
+                t += take
+
+        want_lp = any(self._slots[s].request.params.logprobs is not None
+                      for s in dec_slots)
+        want_lp = want_lp or any(
+            st.request.params.logprobs is not None
+            for _, st, _, _ in completing)
+        lengths = np.array(self._lengths)
+        tables = self._tables.copy()
+        n_chunk = sum(take for _, take in chunk_take)
+        self.metrics.mixed_batch_tokens.observe(t)
+        if n_chunk:
+            self.metrics.mixed_chunk_tokens_total.inc(n_chunk)
+        self._emit("mixed", tokens=tokens, token_slot=token_slot,
+                   token_pos=token_pos, tables=tables,
+                   feed_tokens=feed_tokens, feed_active=feed_active,
+                   lengths=lengths, sample_src=sample_src,
+                   seq_q_start=seq_q_start, seq_q_len=seq_q_len,
+                   seq_pos_start=seq_pos_start, ov_mask=ov_mask,
+                   ov_temp=ov_temp, ov_top_p=ov_top_p, ov_top_k=ov_top_k,
+                   ov_key=ov_key, ov_bias_ids=ov_bias_ids,
+                   ov_bias_vals=ov_bias_vals, ov_sup=ov_sup,
+                   ov_min_until=ov_min_until, ov_guide=ov_guide,
+                   ov_guide_row=ov_guide_row, lp=want_lp)
+        t0 = time.monotonic()
+        args = (self.params, self._cache, self._sampling,
+                jnp.asarray(tokens), jnp.asarray(token_slot),
+                jnp.asarray(token_pos), jnp.asarray(tables),
+                jnp.asarray(feed_tokens), jnp.asarray(feed_active),
+                jnp.asarray(lengths), jnp.asarray(sample_src),
+                jnp.asarray(seq_q_start), jnp.asarray(seq_q_len),
+                jnp.asarray(seq_pos_start), jnp.asarray(ov_mask),
+                jnp.asarray(ov_temp), jnp.asarray(ov_top_p),
+                jnp.asarray(ov_top_k), jnp.asarray(ov_key),
+                jnp.asarray(ov_bias_ids), jnp.asarray(ov_bias_vals),
+                jnp.asarray(ov_sup), jnp.asarray(ov_min_until),
+                jnp.asarray(ov_guide), jnp.asarray(ov_guide_row),
+                self._guide_dev)
+        lp_devs = None
+        if want_lp:
+            ids_dev, clps, lvals, lids, self._cache, self._sampling = \
+                self._mixed_lp_fn(*args)
+            lp_devs = (clps, lvals, lids)
+        else:
+            ids_dev, self._cache, self._sampling = self._mixed_fn(*args)
+        return (dec_slots, completing, chunk_take, want_lp, ids_dev,
+                lp_devs, t0)
+
+    def _resolve_mixed(self, rec, exclude_s: float = 0.0) -> None:
+        """Host-sync tail of a mixed dispatch: fan the decode tokens out,
+        advance every prefilling sequence's position, and promote the
+        sequences whose prompt completed (set_slot + registration — the
+        same tail as the legacy final chunk, minus its extra sample_one
+        dispatch)."""
+        dec_slots, completing, chunk_take, want_lp, ids_dev, lp_devs, t0 = rec
+        t_wait = time.monotonic()
+        ids = np.asarray(ids_dev)   # [B] — host sync point
+        self.metrics.decode_resolve_wait_seconds_total.inc(
+            time.monotonic() - t_wait)
+        if lp_devs is not None:
+            clps = np.asarray(lp_devs[0])
+            lvals = np.asarray(lp_devs[1])
+            lids = np.asarray(lp_devs[2])
+        dt = max(time.monotonic() - t0 - exclude_s, 1e-6)
+        for slot in dec_slots:
+            st = self._slots[slot]
+            tok = int(ids[slot])
+            n_lp = st.request.params.logprobs
+            st.generated.append(tok)
+            if want_lp and n_lp is not None:
+                st.logprobs.append(self._lp_entry(
+                    clps[slot], lvals[slot], lids[slot], n_lp))
+            self._lengths[slot] += 1
+            self._last_token[slot] = tok
+            self.metrics.generation_tokens_total.inc(1)
+            self.metrics.time_per_output_token_seconds.observe(dt)
+            if (self._is_stop(st, tok)
+                    or len(st.generated) >= st.request.params.max_tokens):
+                self._finish(slot, self._finish_reason(st))
+            else:
+                delta = st.generated[st.num_emitted:]
+                lp_delta = (st.logprobs[st.num_emitted:]
+                            if n_lp is not None else None)
+                st.num_emitted = len(st.generated)
+                st.request.outputs.put(RequestOutput(
+                    request_id=st.request.request_id, token_ids=delta,
+                    num_prompt_tokens=st.num_prompt, logprobs=lp_delta))
+        for slot, take in chunk_take:
+            st = self._prefilling.get(slot)
+            if st is not None:
+                st.pos += take
+        for slot, st, gid, grow0 in completing:
+            del self._prefilling[slot]
+            p = st.request.params
+            first = int(ids[slot])
+            first_lp = None
+            if want_lp and p.logprobs is not None:
+                first_lp = self._lp_entry(clps[slot], lvals[slot],
+                                          lids[slot], p.logprobs)
+            grow1 = self.guides.next_row(grow0, first) if gid >= 0 else 0
+            self._emit("set_slot", slot=slot, temperature=p.temperature,
+                       top_p=p.top_p, top_k=p.top_k, seed=st.seed,
+                       presence=p.presence_penalty,
+                       frequency=p.frequency_penalty,
+                       logit_bias=list(p.logit_bias),
+                       min_tokens=p.min_tokens,
+                       stop_ids=list(p.stop_token_ids),
+                       ignore_eos=p.ignore_eos, num_prompt=len(st.ids),
+                       guide=gid, guide_row=grow1)
+            self._apply_set_slot(slot, p, jax.random.fold_in(st.key, 1),
+                                 num_prompt=len(st.ids), guide=gid,
+                                 guide_row=grow1)
+            self._register_slot(st.request, slot, first, len(st.ids),
+                                first_lp=first_lp)
+            # Zero-cost harvest, as in the legacy chunk path: every full
+            # prompt page is now written — register the digest chain so
+            # later prompts share on device.
+            self._register_prompt_pages(st.ids,
+                                        self._slot_pages.get(slot, []),
+                                        st.digests)
 
     def _spec_dispatch(self, eligible: dict[int, bool]) -> None:
         """One speculative step: draft proposes, target verifies, each

@@ -438,6 +438,34 @@ class DispatchFollower:
             # Host-sync like the leader, but via block_until_ready —
             # a follower may not address every shard of toks.
             jax.block_until_ready(toks)
+        elif op == "mixed":
+            # Unified mixed prefill+decode dispatch (ARKS_MIXED_STEP): the
+            # whole batch description arrives by value — followers never
+            # need the leader's scheduler state, only the identical jit
+            # call (override keys included, so gang sampling stays in
+            # lockstep without the guide/seed registries).
+            fn = eng._mixed_lp_fn if p.get("lp") else eng._mixed_fn
+            out = fn(eng.params, eng._cache, eng._sampling,
+                     jnp.asarray(p["tokens"]), jnp.asarray(p["token_slot"]),
+                     jnp.asarray(p["token_pos"]), jnp.asarray(p["tables"]),
+                     jnp.asarray(p["feed_tokens"]),
+                     jnp.asarray(p["feed_active"]),
+                     jnp.asarray(p["lengths"]),
+                     jnp.asarray(p["sample_src"]),
+                     jnp.asarray(p["seq_q_start"]),
+                     jnp.asarray(p["seq_q_len"]),
+                     jnp.asarray(p["seq_pos_start"]),
+                     jnp.asarray(p["ov_mask"]), jnp.asarray(p["ov_temp"]),
+                     jnp.asarray(p["ov_top_p"]), jnp.asarray(p["ov_top_k"]),
+                     jnp.asarray(p["ov_key"]),
+                     jnp.asarray(p["ov_bias_ids"]),
+                     jnp.asarray(p["ov_bias_vals"]),
+                     jnp.asarray(p["ov_sup"]),
+                     jnp.asarray(p["ov_min_until"]),
+                     jnp.asarray(p["ov_guide"]),
+                     jnp.asarray(p["ov_guide_row"]), eng._guide_dev)
+            eng._cache, eng._sampling = out[-2], out[-1]
+            jax.block_until_ready(out[0])
         elif op == "draft_prefill":
             # Speculative decoding: the draft cache mirrors the leader's
             # (identical draft params: same spec + same seed/shards).

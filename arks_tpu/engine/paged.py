@@ -34,14 +34,27 @@ class OutOfPagesError(RuntimeError):
     pass
 
 
-def chain_digests(ids, page: int, nblocks: int) -> list[bytes]:
-    """Chained content digests: digest j covers ids[: (j+1)*page]."""
+def iter_chain_digests(ids, page: int):
+    """Lazily yield chained content digests: digest j covers
+    ids[: (j+1)*page].  THE one hash-chaining implementation — the paged
+    allocator's prefix index and the host PrefixKVCache key the same bytes
+    through here, and lazy yielding lets a matcher stop hashing at the
+    first missing block instead of digesting a whole long prompt on what
+    may be a first-block miss."""
     h = hashlib.sha1()
     arr = np.asarray(ids, np.int32)
-    out = []
-    for j in range(nblocks):
+    for j in range(len(arr) // page):
         h.update(arr[j * page:(j + 1) * page].tobytes())
-        out.append(h.digest())
+        yield h.digest()
+
+
+def chain_digests(ids, page: int, nblocks: int) -> list[bytes]:
+    """First ``nblocks`` chained digests as a list (see iter_chain_digests)."""
+    out = []
+    for j, d in enumerate(iter_chain_digests(ids, page)):
+        if j >= nblocks:
+            break
+        out.append(d)
     return out
 
 

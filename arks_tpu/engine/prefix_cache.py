@@ -28,11 +28,12 @@ on server threads.
 
 from __future__ import annotations
 
-import hashlib
 import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from arks_tpu.engine.paged import chain_digests, iter_chain_digests
 
 
 class PrefixKVCache:
@@ -53,30 +54,26 @@ class PrefixKVCache:
 
     def _keys(self, ids, nblocks: int) -> list[bytes]:
         """Chained digests for blocks 1..nblocks (digest j covers
-        ids[: j*block])."""
-        h = hashlib.sha1()
-        arr = np.asarray(ids, np.int32)
-        keys = []
-        for j in range(nblocks):
-            h.update(arr[j * self.block:(j + 1) * self.block].tobytes())
-            keys.append(h.digest())
-        return keys
+        ids[: j*block]) — the ONE hash-chaining implementation, shared
+        with the paged allocator's prefix index (engine.paged)."""
+        return chain_digests(ids, self.block, nblocks)
 
     # -- read ----------------------------------------------------------
 
     def match(self, ids) -> int:
         """Longest cached prefix of ``ids`` in tokens (multiple of block;
-        0 = miss).  Does not touch LRU order or stats."""
-        nblocks = len(ids) // self.block
-        if nblocks == 0:
+        0 = miss).  Does not touch LRU order or stats.  Digests LAZILY and
+        stops at the first missing block — a first-block miss on a long
+        prompt costs ONE SHA1, not len(ids)/block of them."""
+        if len(ids) < self.block:
             return 0
-        keys = self._keys(ids, nblocks)
-        with self._lock:
-            plen = 0
-            for key in keys:
-                if key not in self._blocks:
-                    break
-                plen += self.block
+        plen = 0
+        for key in iter_chain_digests(ids, self.block):
+            with self._lock:
+                hit = key in self._blocks
+            if not hit:
+                break
+            plen += self.block
         return plen
 
     def get(self, ids, plen: int) -> tuple[np.ndarray, np.ndarray]:

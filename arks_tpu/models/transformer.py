@@ -796,6 +796,67 @@ def prefill_chunk_paged(
     return logits, PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
 
 
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    tables: jnp.ndarray,       # [B, MaxP] int32 — lane b == slot b
+    tokens: jnp.ndarray,       # [T] int32 flat mixed token batch
+    token_slot: jnp.ndarray,   # [T] int32 slot per token (-1 = padding)
+    token_pos: jnp.ndarray,    # [T] int32 global position per token
+    sample_src: jnp.ndarray,   # [B] int32 — flat index each lane samples from
+    seq_q_start: jnp.ndarray,  # [B] int32 — lane's first flat-token index
+    seq_q_len: jnp.ndarray,    # [B] int32 — lane's token count (0 inactive)
+    seq_pos_start: jnp.ndarray,  # [B] int32 — lane's first global position
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One unified mixed prefill+decode forward: a flat ``[T]`` token batch
+    carrying every decoding slot's next token PLUS one or more sequences'
+    prefill-chunk tokens runs the model ONCE, writing all KV rows into the
+    paged pool in place (write-then-attend, causal within each chunk) and
+    returning logits only at ``sample_src`` — the last valid position of
+    each lane that samples this step (decode lanes, and prefill lanes that
+    just finished their prompt).  Returns (logits [B, V] f32, cache).
+
+    This is the single-dispatch continuous-batching step: it replaces the
+    chunk_step × decode_loop (× bucketed admit) program family for paged
+    engines, so N prefills make progress per scheduler iteration without
+    stalling decode.  Padding tokens (token_slot < 0) drop their writes and
+    attend nothing; their activations are garbage no sample_src points at.
+    Numerically equivalent to the legacy paths (same math, blockwise — only
+    fp reassociation differs across chunk boundaries)."""
+    t_flat = tokens.shape[0]
+    cover = tables.shape[1] * cache.page
+    # RoPE positions must be real for valid tokens; padding rows only need
+    # a value the cache ops drop (their write_idx is routed past coverage).
+    rope_pos = jnp.minimum(token_pos, cover - 1)[None]           # [1, T]
+    h = embed_lookup(params["embed"], tokens[None],
+                     params["layers"]["attn_norm"].dtype)        # [1, T, E]
+    kv_sharded = mesh is not None and shard_kv_heads(
+        cfg, mesh.shape.get(AXIS_MODEL, 1))
+    from arks_tpu.ops.attention import paged_mixed_update_and_attend
+
+    def body(carry, xs):
+        h, kc, vc, ksc, vsc = carry
+        lp, layer = xs
+        q, k, v = _block_qkv(h, lp, cfg, rope_pos)   # [1, T, H(.kv), D]
+        attn, kc, vc, ksc, vsc = paged_mixed_update_and_attend(
+            q[0], k[0], v[0], kc, vc, tables, token_slot, token_pos,
+            seq_q_start, seq_q_len, seq_pos_start, layer, mesh, kv_sharded,
+            model_axis=AXIS_MODEL, k_scale=ksc, v_scale=vsc)
+        attn = attn.reshape(1, t_flat, cfg.q_dim)
+        attn = _constrain(attn, mesh, None, None, AXIS_MODEL)
+        h = _block_tail(h, attn, lp, cfg, mesh, None)
+        return (h, kc, vc, ksc, vsc), None
+
+    (h, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    h_sel = jnp.take(h[0], sample_src.astype(jnp.int32), axis=0)  # [B, E]
+    logits = _unembed(h_sel, params, cfg, mesh, None)
+    return logits, PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
 def extract(cache: KVCache, slot: jnp.ndarray,
             dtype: jnp.dtype | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Read one slot's KV back out time-major ``[L, 1, S, Hkv, D]`` — the

@@ -333,6 +333,141 @@ def paged_verify_update_and_attend(
     return out, kp, vp, ks, vs
 
 
+def paged_mixed_update_and_attend(
+    q: jnp.ndarray,        # [T, H, D] — flat mixed token batch
+    k_new: jnp.ndarray,    # [T, Hkv, D]
+    v_new: jnp.ndarray,
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,   # [B, MaxP] int32 — lane b == slot b
+    token_slot: jnp.ndarray,   # [T] int32 slot per token (-1 = padding)
+    token_pos: jnp.ndarray,    # [T] int32 global position per token
+    seq_q_start: jnp.ndarray,  # [B] int32 — lane's first flat-token index
+    seq_q_len: jnp.ndarray,    # [B] int32 — lane's token count (0 inactive)
+    seq_pos_start: jnp.ndarray,  # [B] int32 — lane's first global position
+    layer,
+    mesh=None,
+    kv_sharded: bool = False,
+    impl: str | None = None,
+    model_axis: str = "model",
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray | None, jnp.ndarray | None]:
+    """Mixed prefill+decode attention over one flat token batch: write every
+    token's KV row through its slot's block table, then attend token t
+    (slot b = token_slot[t], global position p = token_pos[t]) over that
+    slot's pages at positions [0, p] — causal within a prefill chunk, the
+    plain decode read for q_len-1 lanes, in ONE op.  Padding tokens
+    (token_slot < 0) drop their writes and attend nothing.
+
+    The per-token view (token_slot/token_pos) drives the KV write and the
+    XLA oracle; the per-lane view (seq_q_start/q_len/pos_start) drives the
+    ragged Pallas kernel, which needs queries grouped by sequence.  Returns
+    (out [T, H, D], k_pool, v_pool, k_scale, v_scale)."""
+    t_flat, h, d_model = q.shape
+    hkv = k_pool.shape[2]
+    g = h // hkv
+    page = k_pool.shape[3]
+    cover = tables.shape[1] * page
+    d = k_pool.shape[-1]
+    if d != d_model:
+        # Lane padding (see decode_update_and_attend): pad to the stored
+        # head dim, prescale q to keep the effective 1/sqrt(d_model) scale.
+        q = _pad_last(q, d) * ((d / d_model) ** 0.5)
+        k_new = _pad_last(k_new, d)
+        v_new = _pad_last(v_new, d)
+    quantized = k_scale is not None
+    impl = impl or default_decode_impl()
+    tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
+    lane_ok = d % 128 == 0 or jax.default_backend() != "tpu"
+    use_pallas = impl == "pallas" and (kv_sharded or tp_trivial) and lane_ok
+
+    tables_tok = jnp.take(tables, jnp.maximum(token_slot, 0),
+                          axis=0)                       # [T, MaxP]
+    write_idx = jnp.where(token_slot < 0, cover, token_pos)
+
+    if not use_pallas:
+        from arks_tpu.ops.paged_attention import paged_gather_kv, paged_update_xla
+        kp, vp, ks, vs = paged_update_xla(
+            k_pool, v_pool, k_scale, v_scale, k_new, v_new, write_idx,
+            tables_tok, layer)
+        kc = paged_gather_kv(kp, tables_tok, layer)     # [T, Hkv, cover, D]
+        vc = paged_gather_kv(vp, tables_tok, layer)
+        attend_lens = jnp.where(token_slot < 0, 0, token_pos + 1)
+        if quantized:
+            ksc = paged_gather_kv(ks, tables_tok, layer)
+            vsc = paged_gather_kv(vs, tables_tok, layer)
+            out = _decode_attention_xla_quant(
+                q.reshape(t_flat, hkv, g, d), kc, vc, ksc, vsc, attend_lens)
+        else:
+            out = decode_attention_xla(q.reshape(t_flat, hkv, g, d), kc, vc,
+                                       attend_lens)
+        return out.reshape(t_flat, h, d)[..., :d_model], kp, vp, ks, vs
+
+    from arks_tpu.ops.paged_attention import (
+        paged_kv_update, paged_kv_update_quant, paged_mixed_attention,
+    )
+    interpret = jax.default_backend() != "tpu"
+    b_lanes = seq_q_start.shape[0]
+    qmax = max(t_flat - b_lanes, 1)
+
+    def local(qg, kn, vn, kp, vp, ks, vs, tbl, tok_tbl, widx, q_start,
+              qlen, pos0, lyr):
+        if quantized:
+            kp, vp, ks, vs = paged_kv_update_quant(
+                kp, vp, ks, vs, kn, vn, widx, tok_tbl, lyr,
+                interpret=interpret)
+        else:
+            kp, vp = paged_kv_update(kp, vp, kn, vn, widx, tok_tbl, lyr,
+                                     interpret=interpret)
+        hkv_l = qg.shape[1]
+        span = q_start[:, None] + jnp.arange(qmax, dtype=jnp.int32)
+        gather_idx = jnp.minimum(span, t_flat - 1)      # [B, Qmax]
+        qs = jnp.take(qg, gather_idx.reshape(-1), axis=0).reshape(
+            b_lanes, qmax, hkv_l, g, d)
+        qs = jnp.transpose(qs, (0, 2, 3, 1, 4))         # [B,Hkv,G,Qmax,D]
+        out_seq = paged_mixed_attention(qs, kp, vp, tbl, pos0, qlen, lyr,
+                                        k_scale=ks, v_scale=vs,
+                                        interpret=interpret)
+        rows = jnp.transpose(out_seq, (0, 3, 1, 2, 4)).reshape(
+            b_lanes * qmax, hkv_l, g, d)
+        q_valid = jnp.arange(qmax, dtype=jnp.int32)[None] < qlen[:, None]
+        scatter_idx = jnp.where(q_valid, span, t_flat)  # OOB rows dropped
+        out = jnp.zeros((t_flat, hkv_l, g, d), qg.dtype).at[
+            scatter_idx.reshape(-1)].set(rows)
+        return out, kp, vp, ks, vs
+
+    qg = q.reshape(t_flat, hkv, g, d)
+    if mesh is None or mesh.size == 1:
+        out, kp, vp, ks, vs = local(qg, k_new, v_new, k_pool, v_pool,
+                                    k_scale, v_scale, tables, tables_tok,
+                                    write_idx, seq_q_start, seq_q_len,
+                                    seq_pos_start, layer)
+        return out.reshape(t_flat, h, d)[..., :d_model], kp, vp, ks, vs
+
+    from arks_tpu.parallel.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    model = model_axis if kv_sharded else None
+    qspec = P(None, model, None, None)
+    kvspec = P(None, model, None)
+    pspec = P(None, None, model, None, None)
+    sspec = P(None, None, model, None) if quantized else None
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, pspec, pspec, sspec, sspec,
+                  P(None, None), P(None, None), P(None), P(None), P(None),
+                  P(None), P()),
+        out_specs=(qspec, pspec, pspec, sspec, sspec),
+        check_vma=False,
+    )
+    out, kp, vp, ks, vs = fn(qg, k_new, v_new, k_pool, v_pool,
+                             k_scale, v_scale, tables, tables_tok,
+                             write_idx, seq_q_start, seq_q_len,
+                             seq_pos_start, jnp.asarray(layer, jnp.int32))
+    return out.reshape(t_flat, h, d)[..., :d_model], kp, vp, ks, vs
+
+
 def paged_decode_update_and_attend(
     q: jnp.ndarray,        # [B, H, D]
     k_new: jnp.ndarray,    # [B, Hkv, D]
@@ -422,7 +557,7 @@ def paged_decode_update_and_attend(
                                     attend_lens, layer)
         return out.reshape(b, h, d)[..., :d_model], kp, vp, ks, vs
 
-    from jax import shard_map
+    from arks_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
     model = model_axis if kv_sharded else None
     qspec = P(None, model, None, None)
@@ -570,7 +705,7 @@ def decode_update_and_attend(
                                     k_scale, v_scale, write_idx, layer)
         return out.reshape(b, h, d)[..., :d_model], kc, vc, ks, vs
 
-    from jax import shard_map
+    from arks_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
     model = model_axis if kv_sharded else None
     qspec = P(batch_axis, model, None, None)

@@ -33,6 +33,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _compiler_params(**kw):
+    """Compat shim: pallas renamed TPUCompilerParams -> CompilerParams across
+    jax releases; resolve whichever this jax ships."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def moe_impl() -> str:
     impl = os.environ.get("ARKS_MOE_KERNEL", "auto")
     if impl not in ("auto", "pallas", "xla"):
@@ -164,7 +171,7 @@ def grouped_matmul(
         functools.partial(_gm_kernel, quantized=quantized, group=group),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((tp, n), xs.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(*inputs)

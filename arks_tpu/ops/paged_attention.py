@@ -36,6 +36,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
+def _compiler_params(**kw):
+    """Compat shim: pallas renamed TPUCompilerParams -> CompilerParams across
+    jax releases; resolve whichever this jax ships."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
 _NEG_INF = -1e30
 
 
@@ -351,8 +358,207 @@ def paged_decode_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# Ragged mixed-query paged attention (prefill chunks + decode in one grid)
+# ---------------------------------------------------------------------------
+
+
+def _paged_mixed_kernel(layer_ref, tables_ref, pos_start_ref, qlen_ref,
+                        q_ref, kpool, vpool, *rest,
+                        page: int, block_q: int, scale: float,
+                        quantized: bool):
+    """One SEQUENCE per grid row, ``block_q`` queries per q-block, pages on
+    the innermost axis.  The q_len=1 decode kernel generalized: query i of
+    sequence s sits at global position pos_start[s]+i and attends cache
+    positions [0, pos_start[s]+i] (causal within its own chunk — the rows
+    were written before this kernel runs, write-then-attend as everywhere).
+    Pages wholly past a q-block's causal end are skipped, so a decode lane
+    (q_len=1) costs the same page reads as the dedicated decode kernel."""
+    if quantized:
+        kspool, vspool, o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, \
+            acc_ref, sem = rest
+    else:
+        o_ref, kbuf, vbuf, m_ref, l_ref, acc_ref, sem = rest
+        kspool = vspool = ksbuf = vsbuf = None
+    s_i = pl.program_id(0)
+    qb = pl.program_id(1)
+    si = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+    lyr = layer_ref[0]
+    pos0 = pos_start_ref[s_i]
+    qlen = qlen_ref[s_i]
+    q_lo = qb * block_q
+    # KV positions this q-block can causally see end just past its last
+    # VALID query; empty blocks (q_lo >= qlen) see nothing.
+    kv_end = jnp.where(q_lo < qlen,
+                       pos0 + jnp.minimum(q_lo + block_q, qlen), 0)
+
+    def start_copies(page_i, buf):
+        pg = tables_ref[s_i, page_i]
+        pltpu.make_async_copy(kpool.at[lyr, pg], kbuf.at[buf],
+                              sem.at[0, buf]).start()
+        pltpu.make_async_copy(vpool.at[lyr, pg], vbuf.at[buf],
+                              sem.at[1, buf]).start()
+        if quantized:
+            pltpu.make_async_copy(kspool.at[lyr, pg], ksbuf.at[buf],
+                                  sem.at[2, buf]).start()
+            pltpu.make_async_copy(vspool.at[lyr, pg], vsbuf.at[buf],
+                                  sem.at[3, buf]).start()
+
+    def wait_copies(buf):
+        pltpu.make_async_copy(kpool.at[lyr, 0], kbuf.at[buf],
+                              sem.at[0, buf]).wait()
+        pltpu.make_async_copy(vpool.at[lyr, 0], vbuf.at[buf],
+                              sem.at[1, buf]).wait()
+        if quantized:
+            pltpu.make_async_copy(kspool.at[lyr, 0], ksbuf.at[buf],
+                                  sem.at[2, buf]).wait()
+            pltpu.make_async_copy(vspool.at[lyr, 0], vsbuf.at[buf],
+                                  sem.at[3, buf]).wait()
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        @pl.when(kv_end > 0)
+        def _():
+            start_copies(0, 0)
+
+    valid = si * page < kv_end
+
+    # Double buffering: kick page si+1's copies before computing page si.
+    @pl.when(valid & ((si + 1) * page < kv_end))
+    def _prefetch():
+        start_copies(si + 1, (si + 1) % 2)
+
+    @pl.when(valid)
+    def _block():
+        buf = si % 2
+        wait_copies(buf)
+        _, hkv, g, bq, d = q_ref.shape
+        q = q_ref[0].reshape(hkv, g * bq, d)
+        k = kbuf[buf].astype(q.dtype)          # [Hkv, page, D]
+        v = vbuf[buf].astype(q.dtype)
+        scores = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [Hkv, G*BQ, page]
+        if quantized:
+            scores = scores * ksbuf[buf][:, None, :]
+        # Row r of the G*BQ axis is query index r % BQ (g-major layout).
+        row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        qpos = pos0 + q_lo + row % bq
+        kvpos = si * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+        scores = jnp.where(kvpos <= qpos, scores, _NEG_INF)
+
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_curr = jnp.max(scores, axis=2, keepdims=True)
+        m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
+        correction = jnp.exp(m_prev - m_next)
+        p = jnp.exp(scores - m_next[..., :1])
+        l_curr = jnp.sum(p, axis=2, keepdims=True)
+        l_next = l_prev * correction + jnp.broadcast_to(l_curr, l_prev.shape)
+        if quantized:
+            p = p * vsbuf[buf][:, None, :]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [Hkv, G*BQ, D]
+        acc_ref[:] = acc_ref[:] * correction[..., :1] + pv
+        m_ref[:] = m_next
+        l_ref[:] = l_next
+
+    @pl.when(si == num_pages - 1)
+    def _finish():
+        _, hkv, g, bq, d = q_ref.shape
+        out = acc_ref[:] / (l_ref[..., :1] + 1e-9)
+        o_ref[:] = out.reshape(1, hkv, g, bq, d).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def paged_mixed_attention(
+    q: jnp.ndarray,        # [S, Hkv, G, Q, D] — Q query tokens per sequence
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,   # [S, MaxP] int32 block tables
+    pos_start: jnp.ndarray,  # [S] int32 — global position of query 0
+    q_len: jnp.ndarray,      # [S] int32 — valid queries (0 = inactive lane)
+    layer,                   # int32
+    k_scale: jnp.ndarray | None = None,  # [L, N, Hkv, P] f32 (int8 pools)
+    v_scale: jnp.ndarray | None = None,
+    block_q: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """[S, Hkv, G, Q, D] ragged mixed attention: query i of sequence s
+    attends its table pages over positions [0, pos_start[s]+i].  Rows past
+    q_len[s] are garbage the caller drops (the flat-batch scatter masks
+    them) — the ONE kernel serving decode lanes (q_len=1) and prefill
+    chunks (q_len>1) in a single dispatch."""
+    s, hkv, g, qmax, d = q.shape
+    page = k_pool.shape[3]
+    max_pages = tables.shape[1]
+    quantized = k_scale is not None
+    if block_q is None:
+        block_q = min(qmax, 32)
+    while qmax % block_q:
+        block_q -= 1
+    num_qb = qmax // block_q
+    scale = 1.0 / (d ** 0.5)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    def q_map(s_i, qb, si, *prefetch):
+        del si, prefetch
+        return (s_i, 0, 0, qb, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, g, block_q, d), q_map),
+        pl.BlockSpec(memory_space=pl.ANY),   # k pool (manual DMA)
+        pl.BlockSpec(memory_space=pl.ANY),   # v pool
+    ]
+    inputs = [layer_arr, tables.astype(jnp.int32),
+              pos_start.astype(jnp.int32), q_len.astype(jnp.int32),
+              q, k_pool, v_pool]
+    scratch = [
+        pltpu.VMEM((2, hkv, page, d), k_pool.dtype),  # kbuf
+        pltpu.VMEM((2, hkv, page, d), v_pool.dtype),  # vbuf
+    ]
+    n_sem = 2
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        inputs += [k_scale, v_scale]
+        scratch += [pltpu.VMEM((2, hkv, page), jnp.float32),
+                    pltpu.VMEM((2, hkv, page), jnp.float32)]
+        n_sem = 4
+    scratch += [
+        pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # m
+        pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # l
+        pltpu.VMEM((hkv, g * block_q, d), jnp.float32),    # acc
+        pltpu.SemaphoreType.DMA((n_sem, 2)),
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # layer, tables, pos_start, q_len
+        grid=(s, num_qb, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, hkv, g, block_q, d), q_map),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(_paged_mixed_kernel, page=page,
+                               block_q=block_q, scale=scale,
+                               quantized=quantized)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(*inputs)
 

@@ -1,0 +1,37 @@
+"""jax API compatibility shims shared across modules.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace across releases, and its replication-check kwarg was
+renamed ``check_rep`` -> ``check_vma``.  Resolve whichever this jax ships
+so the sharded ops use one name everywhere.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5-ish
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, **kwargs):
+    if not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif _HAS_CHECK_VMA and "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name) -> "int":
+    """``jax.lax.axis_size`` where available (newer jax), else the psum-of-1
+    identity every release supports inside shard_map/pmap bodies."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)

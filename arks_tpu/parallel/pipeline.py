@@ -26,7 +26,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from arks_tpu.parallel.compat import shard_map, axis_size
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from arks_tpu.models import transformer as tf
@@ -69,7 +69,7 @@ def pipeline_forward(
     x_mb = tokens.reshape(m, mb, t)
 
     def local(layers_local, embed, x_mb):
-        s_ax = jax.lax.axis_size(stage_axis)
+        s_ax = axis_size(stage_axis)
         s_id = jax.lax.axis_index(stage_axis)
         perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
@@ -229,7 +229,7 @@ def pp_decode_step_paged(
     from arks_tpu.ops.attention import paged_decode_update_and_attend
 
     def local(layers_local, embed, kc, vc, ksc, vsc, tables, tokens, lengths):
-        s_ax = jax.lax.axis_size(stage_axis)
+        s_ax = axis_size(stage_axis)
         s_id = jax.lax.axis_index(stage_axis)
         perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
         toks_mb = tokens.reshape(m, mbs)
@@ -362,7 +362,7 @@ def pp_decode_step(
     from arks_tpu.ops.attention import decode_update_and_attend
 
     def local(layers_local, embed, kc, vc, ksc, vsc, tokens, lengths):
-        s_ax = jax.lax.axis_size(stage_axis)
+        s_ax = axis_size(stage_axis)
         s_id = jax.lax.axis_index(stage_axis)
         perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
         toks_mb = tokens.reshape(m, mbs)
@@ -487,7 +487,7 @@ def pp_prefill(
     compute_dtype = params["layers"]["attn_norm"].dtype
 
     def local(layers_local, embed, tokens):
-        s_ax = jax.lax.axis_size(stage_axis)
+        s_ax = axis_size(stage_axis)
         s_id = jax.lax.axis_index(stage_axis)
         perm = [(i, (i + 1) % s_ax) for i in range(s_ax)]
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))

@@ -24,6 +24,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from arks_tpu.parallel.compat import axis_size
+
 _NEG_INF = -1e30
 
 
@@ -36,7 +38,7 @@ def ring_self_attention(
     causal: bool = True,
 ) -> jnp.ndarray:
     """Runs INSIDE shard_map over ``axis_name``. Returns [B, Tl, H, D]."""
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, tl, h, d = q.shape
     hkv = k.shape[2]
@@ -98,7 +100,7 @@ def ring_prefill_attention(
     dim stays model-sharded inside the ring — TP devices each ring their own
     heads instead of all-gathering q/k/v and redoing every head's FLOPs.
     """
-    from jax import shard_map
+    from arks_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     model = model_axis if heads_sharded else None

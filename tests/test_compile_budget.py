@@ -1,0 +1,75 @@
+"""Compile-budget regression guard (tier-1).
+
+The mixed scheduler collapses the (bucket, M, lp) admit-program family
+into one budget-shaped program.  This test runs a mixed workload —
+admissions of several lengths + chunked prefill + decode — and asserts the
+number of DISTINCT jitted program variants stays under a declared budget,
+so a future scheduler edit that silently reintroduces per-shape retraces
+(or a dtype/weak-type wobble that doubles every program) fails CI instead
+of surfacing as TPU compile stalls in production.
+"""
+
+from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.models import get_config
+
+# One mixed program + its logprob twin, set_slot/clear_penalties state
+# writes, and the handful of single-shape helpers the engine always jits.
+# The point is the ORDER of magnitude: the legacy scheduler's admit family
+# alone is len(buckets) x len(admit_sizes) x 2 programs.
+MIXED_TOTAL_BUDGET = 14
+MIXED_PER_PROGRAM_BUDGET = 2  # lp twins are separate jit objects already
+
+
+def _drain(req, timeout=120):
+    while True:
+        out = req.outputs.get(timeout=timeout)
+        if out.finished:
+            return out
+
+
+def test_mixed_workload_compile_variant_budget(monkeypatch):
+    monkeypatch.setenv("ARKS_MIXED_STEP", "auto")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=4, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        prefill_chunk=16, kv_layout="paged")
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert eng._mixed
+
+    # Admissions of several lengths (one-shot-sized AND chunk-length),
+    # logprobs on/off, sampled and greedy, plus decode churn.
+    prompts = [[5, 6], [3] * 12, [7] * 20, list(range(3, 51)), [9] * 30,
+               [4] * 5, [8] * 17]
+    reqs = []
+    for i, p in enumerate(prompts):
+        sp = SamplingParams(
+            max_tokens=4,
+            temperature=0.0 if i % 2 == 0 else 0.7,
+            seed=i, ignore_eos=True,
+            logprobs=1 if i == 1 else None)
+        reqs.append(Request(f"cb{i}", [int(x) % cfg.vocab_size for x in p],
+                            sp))
+    for r in reqs:
+        eng.add_request(r)
+    for _ in range(600):
+        eng.step(block_s=0.01)
+        if (eng.num_running == 0 and eng._queue.empty()
+                and not eng._prefilling):
+            break
+    for r in reqs:
+        assert _drain(r).finished
+
+    variants = eng.compiled_program_variants()
+    assert variants, "no jitted programs discovered on the engine"
+    total = sum(variants.values())
+    assert total <= MIXED_TOTAL_BUDGET, variants
+    for name, n in variants.items():
+        assert n <= MIXED_PER_PROGRAM_BUDGET, (name, variants)
+    # The admit family must not have compiled at all: mixed mode routes
+    # every prompt through the chunked path.
+    assert variants.get("_admit_fn", 0) == 0, variants
+    assert variants.get("_admit_lp_fn", 0) == 0, variants
+    # The mixed program itself is ONE variant per lp flavor.
+    assert variants.get("_mixed_fn", 0) == 1, variants
+    assert variants.get("_mixed_lp_fn", 0) <= 1, variants

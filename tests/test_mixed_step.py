@@ -1,0 +1,294 @@
+"""Mixed prefill+decode scheduling (ARKS_MIXED_STEP): token-exact parity
+vs the legacy chunk+decode path, single-dispatch-per-step, aborts mid-
+prefill, and guides publishing while mixed batches flow."""
+
+import json
+import time
+
+import pytest
+
+from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.models import get_config
+
+# Every op on the dispatch channel that runs the MODEL (admission state
+# writes like set_slot/clear_penalties are not dispatches of the model).
+MODEL_DISPATCH_OPS = {
+    "mixed", "decode", "chunk", "chunk_paged", "admit_batch",
+    "admit_batch_lp", "spec", "draft_prefill", "prefill_detached",
+    "prefill_detached_lp", "sample_one", "sample_one_lp",
+}
+
+
+class RecordingDispatcher:
+    def __init__(self):
+        self.ops = []
+
+    def broadcast(self, op, payload):
+        self.ops.append((op, payload))
+
+
+def _mk_engine(monkeypatch, mixed: str, **kw):
+    monkeypatch.setenv("ARKS_MIXED_STEP", mixed)
+    cfg = get_config("tiny")
+    defaults = dict(model="tiny", num_slots=2, max_cache_len=64,
+                    prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                    prefill_chunk=16, kv_layout="paged")
+    defaults.update(kw)
+    ecfg = EngineConfig(**defaults)
+    return cfg, InferenceEngine(cfg, ecfg, ByteTokenizer())
+
+
+def _collect(req, timeout=120):
+    ids, lps, fin = [], [], None
+    while True:
+        out = req.outputs.get(timeout=timeout)
+        ids.extend(out.token_ids)
+        if out.logprobs:
+            lps.extend(out.logprobs)
+        if out.finished:
+            fin = out
+            break
+    return ids, lps, fin
+
+
+def _drive(engine, n_steps=500):
+    for _ in range(n_steps):
+        engine.step(block_s=0.01)
+        if (engine.num_running == 0 and engine._queue.empty()
+                and not engine._prefilling):
+            break
+
+
+def test_mixed_matches_legacy_token_exact(monkeypatch):
+    """Mixed vs legacy must produce IDENTICAL token streams on CPU: greedy
+    and fixed-seed sampled, short (one-shot-sized) and chunked prompts,
+    logprobs on and off, with slot churn (more requests than slots)."""
+    cfg = get_config("tiny")
+    prompts = [[5, 6, 7], [3] * 20, list(range(3, 51)), [9] * 10, [4, 8]]
+
+    def run(mixed):
+        _, eng = _mk_engine(monkeypatch, mixed)
+        assert eng._mixed == (mixed == "auto")
+        reqs = []
+        for i, p in enumerate(prompts):
+            if i % 2 == 0:
+                sp = SamplingParams(max_tokens=6, temperature=0.0,
+                                    ignore_eos=True,
+                                    logprobs=2 if i == 0 else None)
+            else:
+                sp = SamplingParams(max_tokens=6, temperature=0.8,
+                                    top_p=0.9, top_k=40, seed=42 + i,
+                                    ignore_eos=True)
+            reqs.append(Request(f"r{i}", [int(x) % cfg.vocab_size for x in p],
+                                sp))
+        for r in reqs:
+            eng.add_request(r)
+        _drive(eng)
+        outs = []
+        for r in reqs:
+            ids, lps, fin = _collect(r)
+            outs.append((ids, lps, fin.finish_reason,
+                         fin.num_prompt_tokens))
+        return outs
+
+    mixed, legacy = run("auto"), run("0")
+    # Token streams are EXACT; logprob floats come from different compiled
+    # programs (mixed forward vs prefill/decode loop) — same math,
+    # blockwise, so only fp reassociation separates them.
+    for (m_ids, m_lps, m_fin, m_np), (l_ids, l_lps, l_fin, l_np) in zip(
+            mixed, legacy):
+        assert m_ids == l_ids
+        assert (m_fin, m_np) == (l_fin, l_np)
+        assert len(m_lps) == len(l_lps)
+        for (m_clp, m_top), (l_clp, l_top) in zip(m_lps, l_lps):
+            assert abs(m_clp - l_clp) < 5e-3
+            assert [t for t, _ in m_top] == [t for t, _ in l_top]
+            for (_, mv), (_, lv) in zip(m_top, l_top):
+                assert abs(mv - lv) < 5e-3
+
+
+def test_mixed_single_model_dispatch_per_step(monkeypatch):
+    """With decodes active AND a prefill chunk pending, one scheduler step
+    issues EXACTLY ONE model dispatch — the acceptance criterion the whole
+    tentpole exists for (legacy pays one chunk dispatch + one decode
+    dispatch in that state)."""
+    cfg, eng = _mk_engine(monkeypatch, "auto")
+    eng.dispatcher = RecordingDispatcher()
+
+    # A short request reaches decode...
+    short = Request("s", [5, 6], SamplingParams(max_tokens=40,
+                                                temperature=0.0,
+                                                ignore_eos=True))
+    eng.add_request(short)
+    for _ in range(50):
+        eng.step(block_s=0.01)
+        if eng._slots:
+            break
+    assert eng._slots
+    # ...then a long prompt starts chunked prefill (48 tokens, chunk 16).
+    long_req = Request("l", [int(x) % cfg.vocab_size for x in range(3, 51)],
+                       SamplingParams(max_tokens=2, temperature=0.0,
+                                      ignore_eos=True))
+    eng.add_request(long_req)
+    for _ in range(50):
+        eng.step(block_s=0.01)
+        if eng._prefilling:
+            break
+    assert eng._slots and eng._prefilling
+
+    pos_before = next(iter(eng._prefilling.values())).pos
+    tokens_before = len(eng._slots[next(iter(eng._slots))].generated)
+    eng.dispatcher.ops.clear()
+    eng.step(block_s=0.01)
+    model_ops = [op for op, _ in eng.dispatcher.ops
+                 if op in MODEL_DISPATCH_OPS]
+    assert model_ops == ["mixed"], model_ops
+    # ...and that single dispatch advanced BOTH the decode and the prefill.
+    assert len(eng._slots[next(iter(eng._slots))].generated) \
+        == tokens_before + 1
+    st = next(iter(eng._prefilling.values()), None)
+    assert st is None or st.pos > pos_before
+    _drive(eng)
+    _collect(short)
+    _collect(long_req)
+
+
+def test_mixed_round_robin_spreads_budget_across_prefills(monkeypatch):
+    """Two concurrent long prompts must BOTH make progress in one mixed
+    step (the legacy scheduler only ever advanced the FIFO head)."""
+    cfg, eng = _mk_engine(monkeypatch, "auto", num_slots=4)
+    longs = [Request(f"l{i}", [(3 + i + x) % cfg.vocab_size
+                               for x in range(48)],
+                     SamplingParams(max_tokens=2, temperature=0.0,
+                                    ignore_eos=True))
+             for i in range(2)]
+    for r in longs:
+        eng.add_request(r)
+    for _ in range(10):
+        eng.step(block_s=0.01)
+        if len(eng._prefilling) == 2:
+            break
+    assert len(eng._prefilling) == 2
+    before = {s: st.pos for s, st in eng._prefilling.items()}
+    eng.step(block_s=0.01)
+    after = {s: st.pos for s, st in eng._prefilling.items()}
+    advanced = [s for s in before if s not in after or after[s] > before[s]]
+    assert len(advanced) == 2, (before, after)
+    _drive(eng)
+    for r in longs:
+        _collect(r)
+
+
+def test_mixed_abort_prefilling_between_steps(monkeypatch):
+    """Aborting a sequence mid-chunked-prefill frees its slot and pages at
+    the next mixed boundary and fails the request with reason=abort."""
+    cfg, eng = _mk_engine(monkeypatch, "auto", prefix_cache_mb=0)
+    free_pages = eng._alloc.free_pages
+    long_req = Request("al", [int(x) % cfg.vocab_size for x in range(3, 51)],
+                       SamplingParams(max_tokens=2, temperature=0.0,
+                                      ignore_eos=True))
+    eng.add_request(long_req)
+    st = None
+    for _ in range(30):
+        eng.step(block_s=0.01)
+        st = next(iter(eng._prefilling.values()), None)
+        if st is not None and st.pos > 0:
+            break
+    assert st is not None and 0 < st.pos < len(st.ids)  # mid-prefill
+    eng.abort("al")
+    eng.step(block_s=0.01)
+    assert not eng._prefilling
+    ids, _, fin = _collect(long_req)
+    assert fin.finish_reason == "abort" and not ids
+    assert eng._alloc.free_pages == free_pages  # pages reclaimed
+    assert len(eng._free) == eng.ecfg.num_slots
+
+    # The engine still serves afterwards.
+    ok = Request("ok", [5, 6, 7], SamplingParams(max_tokens=3,
+                                                 temperature=0.0,
+                                                 ignore_eos=True))
+    eng.add_request(ok)
+    _drive(eng)
+    ids, _, fin = _collect(ok)
+    assert len(ids) == 3 and fin.finish_reason == "length"
+
+
+def test_mixed_guided_request_publishes_mid_batches(monkeypatch):
+    """A guided request whose guide compiles WHILE mixed dispatches are in
+    flight: the request parks (never blocking the scheduler), decode keeps
+    flowing through mixed steps, and once the guide publishes the request
+    admits through the chunked path and its output obeys the grammar."""
+    cfg, eng = _mk_engine(monkeypatch, "auto", max_cache_len=96)
+    eng.start()
+    try:
+        tok = ByteTokenizer()
+        # Keep a decode stream alive for the whole compile window.
+        load = Request("load", tok.encode("zz"), SamplingParams(
+            max_tokens=200, temperature=0.0, ignore_eos=True))
+        eng.add_request(load)
+        load.outputs.get(timeout=120)  # decoding
+
+        orig = eng.guides._build
+
+        def slow_build(rx):
+            time.sleep(1.5)
+            return orig(rx)
+
+        eng.guides._build = slow_build
+        pat = r'\{"k": (true|false)\}'
+        greq = Request("g", tok.encode("zz"), SamplingParams(
+            max_tokens=48, temperature=0.0, guide=("regex", pat)))
+        eng.add_request(greq)
+        time.sleep(0.1)
+        # While the compile is in flight, the mixed scheduler must keep
+        # producing decode tokens (the request parks; nothing blocks).
+        produced = 0
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            try:
+                produced += len(load.outputs.get(timeout=0.2).token_ids)
+            except Exception:
+                pass
+        assert produced > 0, "decode stalled behind the guide compile"
+        toks = []
+        while True:
+            out = greq.outputs.get(timeout=120)
+            toks.extend(out.token_ids)
+            if out.finished:
+                break
+        assert out.finish_reason == "stop"
+        assert json.loads(ByteTokenizer().decode(toks))["k"] in (True, False)
+        eng.abort("load")
+    finally:
+        eng.stop()
+
+
+def test_mixed_disabled_for_unsupported_engines(monkeypatch):
+    """Spec-decode and non-paged engines stay on the legacy scheduler even
+    when ARKS_MIXED_STEP=1 asks for mixed (with a warning, not a crash)."""
+    monkeypatch.setenv("ARKS_MIXED_STEP", "1")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        kv_layout="slot")
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert not eng._mixed
+    assert eng.resolved_config["mixed_step"] == "false"
+    req = Request("x", [5, 6, 7], SamplingParams(max_tokens=3,
+                                                 temperature=0.0,
+                                                 ignore_eos=True))
+    eng.add_request(req)
+    _drive(eng)
+    ids, _, fin = _collect(req)
+    assert len(ids) == 3
+
+
+def test_mixed_env_validation(monkeypatch):
+    monkeypatch.setenv("ARKS_MIXED_STEP", "bogus")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8,), steps_per_dispatch=2,
+                        kv_layout="paged", prefill_chunk=16)
+    with pytest.raises(ValueError):
+        InferenceEngine(cfg, ecfg, ByteTokenizer())

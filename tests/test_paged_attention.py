@@ -14,6 +14,7 @@ from arks_tpu.ops.paged_attention import (
     paged_gather_kv,
     paged_kv_update,
     paged_kv_update_quant,
+    paged_mixed_attention,
     paged_update_xla,
 )
 
@@ -117,3 +118,77 @@ def test_paged_update_out_of_range_dropped():
                                    interpret=True)
     np.testing.assert_allclose(np.asarray(got_k), np.asarray(kp))
     np.testing.assert_allclose(np.asarray(got_v), np.asarray(vp))
+
+
+# ---------------------------------------------------------------------------
+# Ragged mixed-query kernel (prefill chunks + decode lanes in one grid)
+# ---------------------------------------------------------------------------
+
+
+def _mixed_ref(q, kp, vp, kps, vps, tables, pos_start, q_len, layer):
+    """Oracle: per-(sequence, query) masked attention over gathered pages —
+    query i of sequence s attends positions [0, pos_start[s]+i]."""
+    kc = paged_gather_kv(kp, tables, layer)
+    vc = paged_gather_kv(vp, tables, layer)
+    out = np.zeros(np.asarray(q).shape, np.float32)
+    for s in range(q.shape[0]):
+        for i in range(int(q_len[s])):
+            lens = jnp.asarray([int(pos_start[s]) + i + 1], jnp.int32)
+            if kps is not None:
+                ksc = paged_gather_kv(kps, tables, layer)
+                vsc = paged_gather_kv(vps, tables, layer)
+                ref = _decode_attention_xla_quant(
+                    q[s:s + 1, :, :, i], kc[s:s + 1], vc[s:s + 1],
+                    ksc[s:s + 1], vsc[s:s + 1], lens)
+            else:
+                ref = decode_attention_xla(q[s:s + 1, :, :, i],
+                                           kc[s:s + 1], vc[s:s + 1], lens)
+            out[s, :, :, i] = np.asarray(ref[0], np.float32)
+    return out
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("block_q", [2, 4, 8])
+def test_paged_mixed_attention_matches_oracle(quantized, block_q):
+    """Ragged q_len parity vs the XLA oracle: q_len = 1 (a decode lane),
+    a partial chunk, a full chunk, and an inactive lane — the shapes the
+    mixed scheduler actually dispatches — with SHARED prefix pages."""
+    page = 128 if quantized else 16
+    q, kp, vp, kps, vps, tables, _ = _setup(quantized=quantized, page=page)
+    b, hkv, g, d = q.shape
+    qmax = 8
+    key = jax.random.PRNGKey(3)
+    qm = jax.random.normal(key, (b, hkv, g, qmax, d), jnp.float32)
+    # Slot 1 shares slot 0's first page (prefix reuse): its queries read
+    # the shared prefix through its own table.
+    tables = tables.at[1, 0].set(tables[0, 0])
+    pos_start = jnp.asarray([5, page, 0, 3], jnp.int32)
+    q_len = jnp.asarray([1, qmax, 3, 0], jnp.int32)
+    for layer in (0, 1):
+        out = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len,
+                                    layer, k_scale=kps, v_scale=vps,
+                                    block_q=block_q, interpret=True)
+        ref = _mixed_ref(qm, kp, vp, kps, vps, tables, pos_start, q_len,
+                         layer)
+        for s in range(b):
+            for i in range(int(q_len[s])):
+                np.testing.assert_allclose(
+                    np.asarray(out[s, :, :, i], np.float32), ref[s, :, :, i],
+                    atol=2e-2 if quantized else 2e-5,
+                    rtol=2e-2 if quantized else 2e-5)
+
+
+def test_paged_mixed_attention_decode_lane_matches_decode_kernel():
+    """A q_len=1 lane through the mixed kernel equals the dedicated decode
+    kernel on the same pool/tables — the two paths must never diverge."""
+    q, kp, vp, _, _, tables, lengths = _setup(page=16)
+    b, hkv, g, d = q.shape
+    qm = q[:, :, :, None, :]  # [B, Hkv, G, 1, D]
+    pos_start = lengths - 1   # decode lane: query at position len-1
+    q_len = jnp.ones((b,), jnp.int32)
+    out = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                interpret=True)
+    ref = paged_decode_attention(q, kp, vp, tables, lengths, 0,
+                                 block_b=1, interpret=True)
+    np.testing.assert_allclose(np.asarray(out[:, :, :, 0]), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -346,9 +346,11 @@ def test_engine_paged_multihost_gang_prefix_cache():
         eng.stop()
     assert eng._alloc.hit_tokens > 0  # prefix cache live under a dispatcher
     ops = [op for op, _ in eng.dispatcher.ops]
-    assert "decode" in ops
-    decode_payloads = [p for op, p in eng.dispatcher.ops if op == "decode"]
-    assert all(p.get("tables") is not None for p in decode_payloads)
+    # Paged engines default to the mixed scheduler: the model dispatches on
+    # the channel are "mixed" ops (each carrying the tables by value).
+    assert "mixed" in ops
+    mixed_payloads = [p for op, p in eng.dispatcher.ops if op == "mixed"]
+    assert all(p.get("tables") is not None for p in mixed_payloads)
 
 
 def test_chunked_prefill_garbage_writes_cannot_corrupt_shared_pages():
