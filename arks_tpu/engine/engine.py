@@ -212,6 +212,13 @@ class _Slot:
     # Per-token logprob entries parallel to ``generated`` (only populated
     # when the request asked for logprobs): (chosen_lp, [(id, lp), ...]).
     logprobs: list = dataclasses.field(default_factory=list)
+    # Pipelined decode (ARKS_PIPELINE_DEPTH): the device stop column for
+    # this slot (None = stop set exceeds sampler.STOP_IDS_MAX, slot rides
+    # the sequential path) and the absolute length at which the device
+    # must stop dispatching it (min of the max_tokens cutoff and the
+    # cache-cap margin) — both frozen at registration.
+    stop_col: object = None   # np.ndarray [STOP_IDS_MAX] | None
+    dead_len: int = 0
 
 
 @dataclasses.dataclass
@@ -319,7 +326,16 @@ class EngineMetrics:
         self.decode_resolve_wait_seconds_total = r.counter(
             "decode_resolve_wait_seconds_total",
             "Seconds blocked fetching decode results (pure device-stream "
-            "wait, unpolluted by overlapped host work)")
+            "wait, unpolluted by overlapped host work), split by "
+            "mode=pipelined|sequential")
+        # Pipelined decode (ARKS_PIPELINE_DEPTH): in-flight dispatches
+        # after each issue.  At depth N steady state this sits at N — a
+        # histogram stuck at 1 means the engine keeps leaving the
+        # pipelined path (admission churn, aborts, oversized stop sets).
+        self.pipeline_depth_occupancy = r.histogram(
+            "pipeline_depth_occupancy",
+            "In-flight decode dispatches after each pipelined issue",
+            buckets=[1, 2, 3, 4, 6, 8])
         # Resolved-config info gauge (value always 1, config as labels —
         # the kube-state-metrics "_info" idiom): which KV layout / decode
         # impl / overlap mode a replica ACTUALLY runs, so an operator can
@@ -656,6 +672,58 @@ class InferenceEngine:
                     f"ARKS_MIXED_CHUNK_TOKENS={budget}: must be >= 1")
             self._mixed_budget = min(budget, engine_cfg.max_cache_len)
 
+        # ---- Pipelined decode (ARKS_PIPELINE_DEPTH) --------------------
+        # Steady-state decoding free of blocking host syncs: the decode
+        # state (last token / lengths / liveness) lives ON DEVICE and each
+        # dispatch consumes the previous dispatch's arrays, so up to
+        # ``depth`` dispatches ride the stream while results drain through
+        # async copies and resolve one full pipeline slot later.  Dead
+        # slots self-mask (pad token, KV writes dropped at the slot
+        # sentinel) until the host retires them at resolve.  0 disables
+        # (pure sequential issue/resolve); speculative engines fall back
+        # exactly like ARKS_MIXED_STEP's unsupported shapes — their
+        # dispatch eligibility needs host token values every step.
+        _pd = os.environ.get("ARKS_PIPELINE_DEPTH", "2")
+        try:
+            pipe_depth = int(_pd)
+        except ValueError:
+            raise ValueError(
+                f"ARKS_PIPELINE_DEPTH={_pd!r}: expected an integer >= 0")
+        if pipe_depth < 0:
+            raise ValueError(
+                f"ARKS_PIPELINE_DEPTH={pipe_depth}: must be >= 0")
+        pipe_capable = engine_cfg.draft_model is None
+        self._pipe_depth = pipe_depth if pipe_capable else 0
+        # Rows a pipelined dispatch writes per slot: mixed engines pipeline
+        # their own one-token mixed step (kernel parity across the
+        # pipeline boundary); legacy engines pipeline the K-step fused
+        # loop.  Also the cache-cap margin for dead_len.
+        self._pipe_rows = 1 if self._mixed else engine_cfg.steps_per_dispatch
+        if pipe_depth and not pipe_capable:
+            log.info("pipelined decode disabled: speculative engines "
+                     "resolve their dispatches inline")
+        # In-flight dispatch records (FIFO), the threaded device state,
+        # and the per-run device stop columns.  Engine-thread-only.
+        self._pipe_inflight: "deque" = deque()
+        self._pipe_state = None       # (tokens, lengths, alive) on device
+        self._pipe_cols = None        # (stop_ids, dead_len) on device
+        self._pipe_cols_np = None     # host copies for follower payloads
+        self._pipe_last_resolve = None
+        # Off-thread warmup of the pipe programs: jit's dispatch cache is
+        # NOT populated by AOT lower/compile on this jax, so the warmed
+        # executables are kept and called directly.  Until they exist the
+        # engine stays on the (already warm) sequential path — a first
+        # steady-state entry must never freeze live token streams behind
+        # an inline compile.
+        self._pipe_exec: dict = {}    # want_lp -> AOT-compiled executable
+        self._pipe_warm_state = None  # None|"compiling"|"ready"|"failed"
+        self._pipe_warm_thread = None
+        # Slot registration generations: a pipelined dispatch snapshots
+        # (slot, gen) pairs, so a resolve arriving after the slot was
+        # retired AND re-admitted can never fan overshoot tokens into the
+        # new request's stream.
+        self._slot_gen = np.zeros((engine_cfg.num_slots,), np.int64)
+
         # Surface the RESOLVED configuration — the auto decisions, not the
         # requested ones — as an _info gauge and one startup log line, so
         # bench_serving / Grafana / an operator can tell which perf
@@ -673,6 +741,7 @@ class InferenceEngine:
             "weight_dtype": self.ecfg.weight_dtype or "native",
             "model": self.ecfg.model,
             "mixed_step": str(bool(self._mixed)).lower(),
+            "pipeline_depth": str(self._pipe_depth),
         }
         self.metrics.engine_config_info.set(1, **self.resolved_config)
         log.info("engine resolved config: %s",
@@ -905,6 +974,64 @@ class InferenceEngine:
 
         self._decode_lp_fn = jax.jit(decode_loop_lp, donate_argnums=(1, 4))
 
+        # Pipelined decode program (ARKS_PIPELINE_DEPTH): the fused loop
+        # with DEVICE-RESIDENT state — tokens/lengths/liveness come in as
+        # arrays threaded from the PREVIOUS dispatch and go back out
+        # updated, so the next dispatch needs no host values at all.  Dead
+        # slots run masked at the park sentinel (pad fed, KV writes
+        # dropped, keys/penalties frozen) and end-of-dispatch liveness
+        # replicates the host's retire condition exactly
+        # (sampler.advance_liveness) — which is what keeps token streams
+        # byte-identical to the sequential path at any depth.
+        if self._pp > 1:
+            def model_decode_state(params, cache, tokens, lengths, alive,
+                                   tables=None):
+                eff = jnp.where(alive, lengths, jnp.int32(sentinel))
+                return model_decode(params, cache, tokens, eff, tables)
+        else:
+            def model_decode_state(params, cache, tokens, lengths, alive,
+                                   tables=None):
+                return tf.decode_state_step(params, cfg, cache, tokens,
+                                            lengths, alive, sentinel, mesh,
+                                            batch_axis, tables=tables)
+
+        def decode_pipe(params, cache, tokens, lengths, alive, stop_ids,
+                        dead_len, sstate, tables, gtables, want_lp: bool):
+            def body(carry, _):
+                cache, tokens, lengths, sstate = carry
+                eff = jnp.where(alive, lengths, jnp.int32(sentinel))
+                active = eff < sentinel
+                sstate = sampler_mod.count_tokens(sstate, tokens, active)
+                logits, cache = model_decode_state(params, cache, tokens,
+                                                   lengths, alive, tables)
+                nxt, sstate = sampler_mod.sample(logits, sstate, active,
+                                                 eff, guide_tables=gtables)
+                nxt = jnp.where(alive, nxt, jnp.int32(0))
+                if want_lp:
+                    clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
+                    out = (nxt, clp, vals, lids)
+                else:
+                    out = nxt
+                return (cache, nxt, lengths + 1, sstate), out
+
+            (cache, tokens, lengths, sstate), outs = jax.lax.scan(
+                body, (cache, tokens, lengths, sstate), None, length=K)
+            toks = outs[0] if want_lp else outs          # [K, B]
+            alive = sampler_mod.advance_liveness(toks, alive, lengths,
+                                                 stop_ids, dead_len)
+            tokens = jnp.where(alive, tokens, jnp.int32(0))
+            if want_lp:
+                return (cache, sstate, toks, outs[1], outs[2], outs[3],
+                        tokens, lengths, alive)
+            return cache, sstate, toks, tokens, lengths, alive
+
+        self._decode_pipe_fn = jax.jit(
+            functools.partial(decode_pipe, want_lp=False),
+            donate_argnums=(1, 2, 3, 4, 7))
+        self._decode_pipe_lp_fn = jax.jit(
+            functools.partial(decode_pipe, want_lp=True),
+            donate_argnums=(1, 2, 3, 4, 7))
+
         if self._mixed:
             # The unified mixed prefill+decode program: count the decode
             # feed, run ONE model forward over the flat token batch, then
@@ -971,6 +1098,51 @@ class InferenceEngine:
             self._mixed_lp_fn = jax.jit(
                 functools.partial(mixed_prog, want_lp=True),
                 donate_argnums=(1, 2))
+
+            # Device-state mixed variant (ARKS_PIPELINE_DEPTH): the
+            # steady-state (decode-only) mixed step consuming threaded
+            # token/length/liveness arrays.  ONE token per dispatch like
+            # every mixed dispatch, and the SAME mixed kernel — the fused
+            # K-step loop is mathematically equal but not bitwise equal
+            # (fp reassociation), and a kernel switch at the pipeline
+            # boundary would let sampled streams diverge across depths.
+            B = self.ecfg.num_slots
+            lane = jnp.arange(B, dtype=jnp.int32)
+
+            def mixed_pipe(params, cache, tokens, lengths, alive, stop_ids,
+                           dead_len, sstate, tables, gtables, want_lp: bool):
+                eff = jnp.where(alive, lengths, jnp.int32(sentinel))
+                sstate = sampler_mod.count_tokens(sstate, tokens, alive)
+                # Decode-only flat batch, lane t == slot t: dead lanes
+                # park at the sentinel position (writes dropped, nothing
+                # attended) exactly like the host-built batch's padding.
+                logits, cache = tf.mixed_step(
+                    params, cfg, cache, tables, tokens,
+                    jnp.where(alive, lane, jnp.int32(-1)), eff,
+                    lane, lane, alive.astype(jnp.int32), eff, mesh)
+                nxt, sstate = sampler_mod.sample(logits, sstate, alive,
+                                                 eff, guide_tables=gtables)
+                nxt = jnp.where(alive, nxt, jnp.int32(0))
+                lengths = lengths + 1
+                alive = sampler_mod.advance_liveness(
+                    nxt[None], alive, lengths, stop_ids, dead_len)
+                tokens_out = jnp.where(alive, nxt, jnp.int32(0))
+                if want_lp:
+                    clp, vals, lids = sampler_mod.top_logprobs(logits, nxt)
+                    # [1, B]-shaped outputs so the resolve fanout shares
+                    # the K-step record format.
+                    return (cache, sstate, nxt[None], clp[None],
+                            vals[None], lids[None], tokens_out, lengths,
+                            alive)
+                return (cache, sstate, nxt[None], tokens_out, lengths,
+                        alive)
+
+            self._mixed_pipe_fn = jax.jit(
+                functools.partial(mixed_pipe, want_lp=False),
+                donate_argnums=(1, 2, 3, 4, 7))
+            self._mixed_pipe_lp_fn = jax.jit(
+                functools.partial(mixed_pipe, want_lp=True),
+                donate_argnums=(1, 2, 3, 4, 7))
 
         if self._draft_cfg is not None:
             dcfg = self._draft_cfg
@@ -1196,15 +1368,23 @@ class InferenceEngine:
             return 1
         return 128 if self.ecfg.kv_quantized else 16
 
-    def _grow_slot_pages(self, rows_per_slot: int) -> None:
+    def _grow_slot_pages(self, rows_per_slot: int, ahead: int = 0) -> None:
         """Paged layout: before a dispatch that writes ``rows_per_slot``
         rows per active slot (K for the fused decode loop, draft_len for a
         speculative verify), extend each slot's block table to cover them.
-        Host-only bookkeeping; the pool is sized so allocation cannot fail
-        for active slots."""
+        ``ahead`` counts dispatches already in flight (pipelined decode):
+        the host's lagged lengths must pre-own pages for EVERY unresolved
+        dispatch's write window, not just the next one.  Host-only
+        bookkeeping; the pool is sized so allocation cannot fail for
+        active slots (pages_needed clamps at the per-slot table width —
+        the device's dead_len mask retires a slot before any write could
+        land past it)."""
+        from arks_tpu.engine.paged import pages_needed
         page = self._page_size()
+        rows = rows_per_slot * (ahead + 1)
         for slot in self._slots:
-            need = (int(self._lengths[slot]) + rows_per_slot - 1) // page + 1
+            need = pages_needed(int(self._lengths[slot]), rows, page,
+                                self._max_pages)
             row = self._slot_pages[slot]
             if len(row) < need:
                 new = self._alloc.alloc(need - len(row))
@@ -1337,6 +1517,10 @@ class InferenceEngine:
                 time.sleep(0.001)
 
     def _reset_device_state(self) -> None:
+        # Pipelined decode: in-flight records reference donated-away device
+        # buffers; drop them rather than resolve (their requests were
+        # already aborted by the fault path).
+        self._pipe_reset()
         # Followers rebuild too (their _run path never sees the exception).
         if self.dispatcher is not None:
             self._emit("reset")
@@ -1409,6 +1593,27 @@ class InferenceEngine:
             self.metrics.scheduler_seconds_total.inc(tg - t0,
                                                      phase="guide_wait")
             t0 = tg
+        if self._pipe_ready():
+            # Steady-state pipelined decoding: exactly ONE dispatch issued
+            # per iteration, up to ARKS_PIPELINE_DEPTH in flight; the
+            # oldest resolves (lagged host view) only once the pipeline is
+            # full, so the device never waits on Python between
+            # dispatches.
+            self._step_pipelined()
+            self.metrics.scheduler_seconds_total.inc(
+                time.monotonic() - t0, phase="decode")
+            return True
+        if self._pipe_inflight or self._pipe_state is not None:
+            # Leaving steady state (admission possible, abort raised,
+            # prefill work, or a slot's stop set outgrew the device
+            # column): resolve every in-flight dispatch so the host
+            # mirrors are authoritative again before any host-side
+            # mutation touches scheduler state.
+            self._pipe_drain()
+            worked = True
+            td = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(td - t0, phase="decode")
+            t0 = td
         pending = None
         issued = False
         if self._mixed:
@@ -2186,6 +2391,13 @@ class InferenceEngine:
         if first_lp is not None:
             st.logprobs.append(first_lp)
         st.first_token_time = now
+        # Pipelined-decode liveness data (device mirrors of _is_stop and
+        # the retire conditions), frozen for the slot's lifetime.
+        st.stop_col = sampler_mod.np_stop_col(
+            self._stop_ids_for(req.params))
+        st.dead_len = min(num_prompt + req.params.max_tokens - 1,
+                          self.ecfg.max_cache_len - self._pipe_rows)
+        self._slot_gen[slot] += 1
         self._slots[slot] = st
         self._lengths[slot] = num_prompt
         self._last_token[slot] = first
@@ -2525,6 +2737,281 @@ class InferenceEngine:
                               guide_row=(self.guides.next_row(grow0, first)
                                          - grow0 if gid >= 0 else 0))
 
+    # ------------------------------------------------------------------
+    # Pipelined decode (ARKS_PIPELINE_DEPTH)
+    # ------------------------------------------------------------------
+
+    def _stop_ids_for(self, p) -> list[int]:
+        """The token ids that end a stream for these params — the EXACT
+        set _is_stop checks, mirrored onto the device as a stop column so
+        pipelined dispatches can compute liveness without the host."""
+        if p.ignore_eos:
+            return list(p.stop_token_ids)
+        return (list(self.cfg.eos_token_ids)
+                + list(self.tokenizer.eos_token_ids)
+                + list(p.stop_token_ids))
+
+    def _pipe_ready(self) -> bool:
+        """True when the next iteration can stay on the zero-host-sync
+        pipelined path: live decoding slots, no host-side scheduler work
+        pending (admission, chunked prefill, deferred admits), no abort
+        aimed at a live slot, and every slot's stop set fits the device
+        column.  Anything else drains the pipeline first — host mutations
+        need authoritative mirrors.  Requests parked on an in-flight guide
+        compile do NOT drain it: the park is pure host bookkeeping, and a
+        slow compile must not degrade live decoding to the sequential
+        path — step() re-queues the request the moment its guide
+        publishes, which the admission check below then catches."""
+        if not self._pipe_depth or not self._slots:
+            return False
+        if self._prefilling or self._pending_admits:
+            return False
+        if self._free and not self._queue.empty():
+            # Admission is possible RIGHT NOW; with no free slot the queue
+            # can only wait anyway, so saturation keeps pipelining.
+            return False
+        if any(st.stop_col is None for st in self._slots.values()):
+            return False
+        with self._abort_lock:
+            if self._aborted:
+                live = {st.request.request_id
+                        for st in self._slots.values()}
+                if self._aborted & live:
+                    return False
+        if self._pipe_warm_state != "ready":
+            # Pipe programs still cold: keep serving on the warm
+            # sequential path and compile them off-thread — an inline
+            # compile here would freeze every live token stream for the
+            # whole build (seconds on CPU, potentially tens on TPU).
+            self._pipe_kick_warmup()
+            return False
+        return True
+
+    def _pipe_signature(self):
+        """Specimen arguments for AOT-lowering the pipe programs: the
+        exact avals+shardings a fresh `_pipe_issue` produces.  Built on
+        the calling thread while the referenced arrays are alive (the
+        engine thread may donate self._cache away at any later dispatch,
+        so the background thread must never touch the arrays — only this
+        frozen aval view)."""
+        n = self.ecfg.num_slots
+        state = (jnp.asarray(np.zeros((n,), np.int32)),
+                 jnp.asarray(np.zeros((n,), np.int32)),
+                 jnp.asarray(np.zeros((n,), bool)))
+        cols = (jnp.asarray(np.full((n, sampler_mod.STOP_IDS_MAX), -1,
+                                    np.int32)),
+                jnp.asarray(np.zeros((n,), np.int32)))
+        tables = jnp.asarray(self._tables) if self._paged else None
+        args = (self.params, self._cache, *state, *cols, self._sampling,
+                tables, self._guide_dev)
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), args)
+
+    def _pipe_jit_fn(self, want_lp: bool):
+        if self._mixed:
+            return self._mixed_pipe_lp_fn if want_lp else self._mixed_pipe_fn
+        return self._decode_pipe_lp_fn if want_lp else self._decode_pipe_fn
+
+    def _pipe_kick_warmup(self) -> None:
+        """Start the one-shot background compile of both pipe-program
+        variants (with/without logprobs).  Idempotent; engine-thread."""
+        if self._pipe_warm_state is not None or not self._pipe_depth:
+            return
+        self._pipe_warm_state = "compiling"
+        sig = self._pipe_signature()
+        t = threading.Thread(target=self._pipe_warmup, args=(sig,),
+                             name="pipe-warmup", daemon=True)
+        self._pipe_warm_thread = t
+        t.start()
+
+    def _pipe_warmup(self, sig) -> None:
+        try:
+            t0 = time.monotonic()
+            for lp in (False, True):
+                self._pipe_exec[lp] = self._pipe_jit_fn(lp).lower(
+                    *sig).compile()
+            self._pipe_warm_state = "ready"
+            log.info("pipelined decode programs warm in %.1fs "
+                     "(depth=%d, %s)", time.monotonic() - t0,
+                     self._pipe_depth,
+                     "mixed_pipe" if self._mixed else "decode_pipe")
+        except Exception:
+            self._pipe_warm_state = "failed"
+            log.warning("pipelined decode warmup failed; engine stays on "
+                        "the sequential path", exc_info=True)
+
+    def _pipe_warm_wait(self, timeout: float | None = None) -> str | None:
+        """Kick the warmup and block until it resolves — tests and
+        preflight only; the serving path never blocks on it."""
+        self._pipe_kick_warmup()
+        t = self._pipe_warm_thread
+        if t is not None:
+            t.join(timeout)
+        return self._pipe_warm_state
+
+    def _pipe_call(self, want_lp: bool, *args):
+        """Dispatch one pipe program: the warmed AOT executable when the
+        inputs still match its signature, else the jit path (which then
+        compiles the drifted variant inline ONCE — e.g. after the guide
+        tables grew, or for threaded state whose sharding differs from
+        the fresh-entry signature on a meshed engine)."""
+        exe = self._pipe_exec.get(bool(want_lp))
+        if exe is not None:
+            try:
+                return exe(*args)
+            except (TypeError, ValueError):
+                pass  # aval/sharding drift: inputs not consumed, retry jit
+        return self._pipe_jit_fn(want_lp)(*args)
+
+    def _step_pipelined(self) -> None:
+        """One steady-state iteration: issue ONE dispatch (if the pipeline
+        has room), then resolve — blocking on the oldest only when the
+        pipeline is full, else opportunistically draining whatever the
+        device already finished."""
+        if len(self._pipe_inflight) < self._pipe_depth:
+            self._pipe_issue()
+        if len(self._pipe_inflight) >= self._pipe_depth:
+            self._pipe_resolve_one()
+        else:
+            while self._pipe_inflight and self._pipe_rec_ready(
+                    self._pipe_inflight[0]):
+                self._pipe_resolve_one()
+
+    @staticmethod
+    def _pipe_rec_ready(rec) -> bool:
+        try:
+            return rec[2].is_ready()
+        except AttributeError:  # platform without readiness polling
+            return True
+
+    def _pipe_issue(self) -> None:
+        """Issue one pipelined decode dispatch.  Fresh (pipeline cold):
+        device state is built from the host mirrors — the ONE host->device
+        state upload per run.  Threaded: the previous dispatch's returned
+        arrays feed this one untouched; only the block tables (host-owned
+        page bookkeeping) travel per dispatch."""
+        K = self._pipe_rows
+        fresh = self._pipe_state is None
+        if fresh:
+            # Host-authoritative entry: retire slots whose next dispatch
+            # would overflow the cache (same margin dead_len enforces on
+            # device for every later dispatch of the run).
+            for slot in list(self._slots):
+                if int(self._lengths[slot]) >= self.ecfg.max_cache_len - K:
+                    self._finish(slot, "length")
+            if not self._slots:
+                return
+        if self._paged:
+            self._grow_slot_pages(K, ahead=len(self._pipe_inflight))
+        self._ensure_guides_uploaded()
+        if fresh:
+            n = self.ecfg.num_slots
+            alive = np.zeros((n,), bool)
+            stop_ids = np.full((n, sampler_mod.STOP_IDS_MAX), -1, np.int32)
+            dead_len = np.zeros((n,), np.int32)
+            for slot, st in self._slots.items():
+                alive[slot] = True
+                stop_ids[slot] = st.stop_col
+                dead_len[slot] = st.dead_len
+            state = (jnp.asarray(self._last_token),
+                     jnp.asarray(self._lengths), jnp.asarray(alive))
+            self._pipe_cols = (jnp.asarray(stop_ids), jnp.asarray(dead_len))
+            self._pipe_cols_np = (stop_ids, dead_len)
+        else:
+            state = self._pipe_state
+        want_lp = any(st.request.params.logprobs is not None
+                      for st in self._slots.values())
+        tables_arg = jnp.asarray(self._tables) if self._paged else None
+        payload = dict(lp=want_lp, fresh=fresh,
+                       tables=self._tables.copy() if self._paged else None,
+                       occupancy=len(self._pipe_inflight) + 1)
+        if fresh:
+            payload.update(tokens=np.array(self._last_token),
+                           lengths=np.array(self._lengths),
+                           alive=alive.copy(),
+                           stop_ids=self._pipe_cols_np[0].copy(),
+                           dead_len=self._pipe_cols_np[1].copy())
+        self._emit("decode_pipe", **payload)
+        t0 = time.monotonic()
+        out = self._pipe_call(want_lp, self.params, self._cache, *state,
+                              *self._pipe_cols, self._sampling, tables_arg,
+                              self._guide_dev)
+        if want_lp:
+            (self._cache, self._sampling, toks, clps, lvals, lids,
+             ntok, nlen, nalive) = out
+            lp_devs = (clps, lvals, lids)
+        else:
+            self._cache, self._sampling, toks, ntok, nlen, nalive = out
+            lp_devs = None
+        self._pipe_state = (ntok, nlen, nalive)
+        # Start the device->host copies NOW so the lagged resolve finds
+        # them materialized instead of blocking the engine thread.
+        for arr in (toks,) + (lp_devs or ()):
+            try:
+                arr.copy_to_host_async()
+            except Exception:  # platform without async host copies
+                pass
+        snapshot = [(s, int(self._slot_gen[s])) for s in self._slots]
+        self._pipe_inflight.append((snapshot, want_lp, toks, lp_devs, K, t0))
+        self.metrics.pipeline_depth_occupancy.observe(
+            len(self._pipe_inflight))
+
+    def _pipe_resolve_one(self) -> None:
+        """Resolve the OLDEST in-flight dispatch on the lagged host view:
+        fan its tokens out, apply the host-only semantics (stop tokens,
+        max_tokens truncation, logprob formatting), and retire finished
+        slots — whose overshoot tokens in NEWER in-flight dispatches are
+        discarded by the (slot, gen) snapshot guard."""
+        snapshot, want_lp, toks, lp_devs, K, t0 = self._pipe_inflight.popleft()
+        t_wait = time.monotonic()
+        toks = np.asarray(toks)  # host sync point (async copy usually done)
+        if lp_devs is not None:
+            clps = np.asarray(lp_devs[0])    # [K, B]
+            lvals = np.asarray(lp_devs[1])   # [K, B, L]
+            lids = np.asarray(lp_devs[2])
+        now = time.monotonic()
+        self.metrics.decode_resolve_wait_seconds_total.inc(
+            now - t_wait, mode="pipelined")
+        # TPOT from resolve interarrival: in steady state one resolve
+        # lands per dispatch, so the gap IS the per-dispatch device time —
+        # this dispatch's own issue->resolve span covers the whole
+        # pipeline depth and would overstate TPOT by ~depth x.
+        last = self._pipe_last_resolve
+        self._pipe_last_resolve = now
+        dt = max(now - (t0 if last is None else last), 1e-6)
+        cols = toks.T.tolist()
+        for slot, gen in snapshot:
+            st = self._slots.get(slot)
+            if st is None or int(self._slot_gen[slot]) != gen:
+                continue  # retired at an earlier resolve: overshoot dropped
+            lp_rows = None
+            if want_lp and st.request.params.logprobs is not None:
+                lp_rows = (clps[:, slot], lvals[:, slot], lids[:, slot])
+            self._fanout_decode_tokens(slot, cols[slot], lp_rows, dt)
+
+    def _pipe_drain(self) -> None:
+        """Resolve every in-flight dispatch and hand authority back to the
+        host mirrors (they are exact after the last resolve)."""
+        try:
+            while self._pipe_inflight:
+                self._pipe_resolve_one()
+        finally:
+            self._pipe_state = None
+            self._pipe_cols = None
+            self._pipe_cols_np = None
+            self._pipe_last_resolve = None
+
+    def _pipe_reset(self) -> None:
+        """Fault path: drop in-flight records without resolving (the
+        dispatch error already aborted their requests; the device state is
+        being rebuilt)."""
+        self._pipe_inflight.clear()
+        self._pipe_state = None
+        self._pipe_cols = None
+        self._pipe_cols_np = None
+        self._pipe_last_resolve = None
+
     def _decode_dispatch(self) -> None:
         rec = self._issue_decode()
         if rec is not None:
@@ -2648,7 +3135,7 @@ class InferenceEngine:
         # (the phase-seconds breakdown attributes WALL time, which in
         # overlap mode can land waits in whichever phase fetches first).
         self.metrics.decode_resolve_wait_seconds_total.inc(
-            time.monotonic() - t_wait)
+            time.monotonic() - t_wait, mode="sequential")
         if lp_devs is not None:
             clps = np.asarray(lp_devs[0])    # [K, B]
             lvals = np.asarray(lp_devs[1])   # [K, B, L]
@@ -2661,35 +3148,48 @@ class InferenceEngine:
 
         for slot in snapshot:
             st = self._slots[slot]
-            col = cols[slot]
-            n_lp = st.request.params.logprobs
-            finished = False
-            new_tokens = 0
-            for k in range(K):
-                tok = col[k]
-                st.generated.append(tok)
-                if want_lp and n_lp is not None:
-                    st.logprobs.append(self._lp_entry(
-                        clps[k, slot], lvals[k, slot], lids[k, slot], n_lp))
-                new_tokens += 1
-                if self._is_stop(st, tok) or len(st.generated) >= st.request.params.max_tokens:
-                    finished = True
-                    break
-            self._lengths[slot] += K  # all K KVs were written on device
-            self._last_token[slot] = col[K - 1]
-            self.metrics.generation_tokens_total.inc(new_tokens)
-            self.metrics.time_per_output_token_seconds.observe(dt / K)
-            if finished:
-                self._finish(slot, self._finish_reason(st))
-            else:
-                delta = st.generated[st.num_emitted:]
-                lp_delta = (st.logprobs[st.num_emitted:]
-                            if n_lp is not None else None)
-                st.num_emitted = len(st.generated)
-                st.request.outputs.put(RequestOutput(
-                    request_id=st.request.request_id, token_ids=delta,
-                    num_prompt_tokens=st.num_prompt,
-                    logprobs=lp_delta))
+            lp_rows = None
+            if want_lp and st.request.params.logprobs is not None:
+                lp_rows = (clps[:, slot], lvals[:, slot], lids[:, slot])
+            self._fanout_decode_tokens(slot, cols[slot], lp_rows, dt)
+
+    def _fanout_decode_tokens(self, slot: int, col: list, lp_rows,
+                              dt: float) -> None:
+        """Per-slot tail shared by the sequential resolve and the
+        pipelined resolve: append the dispatch's K tokens (truncating at
+        the first stop token or the max_tokens cutoff — everything past it
+        is overshoot the device computed but the client never sees),
+        advance the host mirrors, and finish or stream the delta."""
+        st = self._slots[slot]
+        K = len(col)
+        n_lp = st.request.params.logprobs
+        finished = False
+        new_tokens = 0
+        for k in range(K):
+            tok = col[k]
+            st.generated.append(tok)
+            if lp_rows is not None:
+                st.logprobs.append(self._lp_entry(
+                    lp_rows[0][k], lp_rows[1][k], lp_rows[2][k], n_lp))
+            new_tokens += 1
+            if self._is_stop(st, tok) or len(st.generated) >= st.request.params.max_tokens:
+                finished = True
+                break
+        self._lengths[slot] += K  # all K KVs were written on device
+        self._last_token[slot] = col[K - 1]
+        self.metrics.generation_tokens_total.inc(new_tokens)
+        self.metrics.time_per_output_token_seconds.observe(dt / K)
+        if finished:
+            self._finish(slot, self._finish_reason(st))
+        else:
+            delta = st.generated[st.num_emitted:]
+            lp_delta = (st.logprobs[st.num_emitted:]
+                        if n_lp is not None else None)
+            st.num_emitted = len(st.generated)
+            st.request.outputs.put(RequestOutput(
+                request_id=st.request.request_id, token_ids=delta,
+                num_prompt_tokens=st.num_prompt,
+                logprobs=lp_delta))
 
     # ------------------------------------------------------------------
     # Mixed prefill+decode dispatch (ARKS_MIXED_STEP)
@@ -2903,7 +3403,7 @@ class InferenceEngine:
         t_wait = time.monotonic()
         ids = np.asarray(ids_dev)   # [B] — host sync point
         self.metrics.decode_resolve_wait_seconds_total.inc(
-            time.monotonic() - t_wait)
+            time.monotonic() - t_wait, mode="sequential")
         if lp_devs is not None:
             clps = np.asarray(lp_devs[0])
             lvals = np.asarray(lp_devs[1])
@@ -3010,7 +3510,7 @@ class InferenceEngine:
         a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
         counts = np.asarray(counts).tolist()
         self.metrics.decode_resolve_wait_seconds_total.inc(
-            time.monotonic() - t_wait)
+            time.monotonic() - t_wait, mode="sequential")
         dt = time.monotonic() - t0
 
         n_spec = sum(1 for s in self._slots if enable[s])

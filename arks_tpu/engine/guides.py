@@ -954,6 +954,20 @@ class GuideCompiler:
                 return json_schema_regex(json.loads(pattern))
             except json.JSONDecodeError as e:
                 raise GuideError(f"invalid json_schema: {e}") from None
+        if kind == "choice":
+            # vLLM-style guided_choice: the pattern is a JSON array of
+            # literal strings, compiled as an escaped alternation over the
+            # same DFA machinery — the decoder can only emit one of the
+            # choices verbatim.
+            try:
+                choices = json.loads(pattern)
+            except json.JSONDecodeError as e:
+                raise GuideError(f"invalid choice list: {e}") from None
+            if (not isinstance(choices, list) or not choices
+                    or not all(isinstance(c, str) for c in choices)):
+                raise GuideError(
+                    "guided_choice requires a non-empty array of strings")
+            return "|".join(_rx_quote(c) for c in choices)
         raise GuideError(f"unknown guide kind {kind!r}")
 
     def _build(self, rx: str) -> tuple[np.ndarray, np.ndarray]:

@@ -216,6 +216,11 @@ class DispatchFollower:
 
         self.engine = engine
         self._jax = jax
+        # Pipelined decode replay: the follower threads its OWN device
+        # state between "decode_pipe" ops (the leader cannot broadcast
+        # token values it never fetched); a fresh op re-seeds it.
+        self._pipe_state = None
+        self._pipe_cols = None
         secret = _secret()
         deadline = time.monotonic() + connect_timeout_s
         while True:
@@ -438,6 +443,35 @@ class DispatchFollower:
             # Host-sync like the leader, but via block_until_ready —
             # a follower may not address every shard of toks.
             jax.block_until_ready(toks)
+        elif op == "decode_pipe":
+            # Pipelined decode (ARKS_PIPELINE_DEPTH): the op stream carries
+            # NO host token values — a fresh op ships the host-built state
+            # (pipeline entry), every later op consumes this process's own
+            # device arrays threaded from the previous dispatch, exactly
+            # like the leader.  No host sync either: lockstep rides the
+            # collectives inside the program, and blocking here would
+            # re-introduce on the follower the per-step stall the pipeline
+            # exists to remove.
+            if p.get("fresh"):
+                self._pipe_state = (jnp.asarray(p["tokens"]),
+                                    jnp.asarray(p["lengths"], jnp.int32),
+                                    jnp.asarray(p["alive"]))
+                self._pipe_cols = (jnp.asarray(p["stop_ids"]),
+                                   jnp.asarray(p["dead_len"], jnp.int32))
+            elif self._pipe_state is None:
+                raise RuntimeError(
+                    "decode_pipe without fresh state: leader/follower "
+                    "pipeline streams diverged")
+            tables = p.get("tables")
+            # Same program resolution as the leader (_pipe_call prefers
+            # this process's warmed executable when one exists).
+            out = eng._pipe_call(bool(p.get("lp")), eng.params, eng._cache,
+                                 *self._pipe_state, *self._pipe_cols,
+                                 eng._sampling,
+                                 None if tables is None else
+                                 jnp.asarray(tables), eng._guide_dev)
+            eng._cache, eng._sampling = out[0], out[1]
+            self._pipe_state = out[-3:]
         elif op == "mixed":
             # Unified mixed prefill+decode dispatch (ARKS_MIXED_STEP): the
             # whole batch description arrives by value — followers never

@@ -58,6 +58,20 @@ def chain_digests(ids, page: int, nblocks: int) -> list[bytes]:
     return out
 
 
+def pages_needed(length: int, rows: int, page: int, max_pages: int) -> int:
+    """Block-table entries a slot needs before a dispatch burst writing
+    ``rows`` rows from position ``length`` (rows = K per fused dispatch x
+    the in-flight pipeline depth: with ARKS_PIPELINE_DEPTH dispatches
+    issued ahead of host resolution, the host must pre-own pages for
+    EVERY in-flight dispatch's write window, not just the next one).
+
+    Clamped to ``max_pages``: near the cache cap the host's lagged view
+    can overshoot the window, but the device's dead_len mask retires the
+    slot before any write lands past max_cache_len — growing the table
+    beyond its row width would corrupt the neighbouring slot's row."""
+    return min((length + rows - 1) // page + 1, max_pages)
+
+
 class PageAllocator:
     def __init__(self, num_pages: int, page: int) -> None:
         self.page = page

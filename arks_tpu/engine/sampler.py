@@ -68,6 +68,44 @@ def top_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray
 LOGIT_BIAS_MAX = 300  # full OpenAI logit_bias key budget; the bias pass
                       # is lax.cond-gated so unbiased batches pay nothing.
 SUPPRESS_MAX = 8      # eos + stop_token_ids suppressed under min_tokens.
+STOP_IDS_MAX = 32     # per-slot stop set (eos + stop_token_ids) mirrored
+                      # onto the device so the pipelined decode path can
+                      # compute liveness without a host round-trip.  A
+                      # request whose stop set exceeds this rides the
+                      # sequential path instead (never truncated).
+
+
+def np_stop_col(stop_ids) -> np.ndarray | None:
+    """Host-side [STOP_IDS_MAX] stop column for device-side liveness
+    (pipelined decoding); ids < 0 pad.  Returns None on overflow — the
+    caller must then keep the slot on the host-resolved sequential path
+    (silently dropping a stop id would let the device keep a slot alive
+    past its stop token and emit overshoot the host never discards)."""
+    ids = list(dict.fromkeys(int(t) for t in stop_ids))
+    if len(ids) > STOP_IDS_MAX:
+        return None
+    col = np.full((STOP_IDS_MAX,), -1, np.int32)
+    col[: len(ids)] = ids
+    return col
+
+
+def advance_liveness(toks: jnp.ndarray, alive: jnp.ndarray,
+                     lengths: jnp.ndarray, stop_ids: jnp.ndarray,
+                     dead_len: jnp.ndarray) -> jnp.ndarray:
+    """End-of-dispatch device liveness for the pipelined decode path.
+
+    ``toks`` [K, B] are the dispatch's sampled tokens, ``lengths`` [B] the
+    POST-dispatch absolute lengths, ``stop_ids`` [B, S] the per-slot stop
+    sets (< 0 pad), ``dead_len`` [B] the absolute length at which the host
+    would retire the slot (min of the max_tokens cutoff and the cache-cap
+    margin).  A slot stays alive iff none of its K tokens is a stop token
+    AND its new length sits below dead_len — EXACTLY the host's retire
+    condition in _resolve_decode, which is what lets in-flight dispatches
+    self-mask dead slots before the host has seen the death."""
+    valid = stop_ids >= 0                                   # [B, S]
+    hit = jnp.any((toks[:, :, None] == stop_ids[None, :, :])
+                  & valid[None, :, :], axis=(0, 2))         # [B]
+    return alive & ~hit & (lengths < dead_len)
 
 
 class SamplingState(NamedTuple):

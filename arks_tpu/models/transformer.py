@@ -953,6 +953,42 @@ def decode_step(
     return logits, cls(k=ks, v=vs, k_scale=kss, v_scale=vss)
 
 
+class DecodeState(NamedTuple):
+    """Device-resident decode state for the pipelined dispatch path: the
+    arrays the NEXT decode dispatch consumes from the PREVIOUS one without
+    a host round-trip (engine ARKS_PIPELINE_DEPTH).  Host mirrors lag by
+    the in-flight depth; dead slots self-mask (pad token, writes dropped
+    at the sentinel) until the host retires them at resolve time."""
+
+    tokens: jnp.ndarray   # [B] i32 — last sampled token (0 for dead slots)
+    lengths: jnp.ndarray  # [B] i32 — absolute lengths (only alive slots'
+                          # values are meaningful; dead/free rows keep
+                          # advancing harmlessly, masked by ``alive``)
+    alive: jnp.ndarray    # [B] bool — device-computed liveness
+
+
+def decode_state_step(
+    params: Params,
+    cfg: ModelConfig,
+    cache: KVCache | PagedKVCache,
+    tokens: jnp.ndarray,    # [B] i32
+    lengths: jnp.ndarray,   # [B] i32 — true lengths for alive slots
+    alive: jnp.ndarray,     # [B] bool
+    sentinel: int,          # engine's write-drop length (park value)
+    mesh: Mesh | None = None,
+    batch_axis: str | None = None,
+    tables: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, KVCache | PagedKVCache]:
+    """Liveness-masked ``decode_step`` for device-state decoding: dead
+    slots read/write at the engine's park sentinel, so their KV scatters
+    drop and nothing is attended — identical math to a host that had
+    already parked the slot's length, which is what keeps the pipelined
+    token stream byte-identical to the sequential path for live slots."""
+    eff = jnp.where(alive, lengths, jnp.int32(sentinel))
+    return decode_step(params, cfg, cache, tokens, eff, mesh, batch_axis,
+                       tables=tables)
+
+
 def verify_step(
     params: Params,
     cfg: ModelConfig,

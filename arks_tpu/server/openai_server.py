@@ -135,6 +135,17 @@ def _sampling_from_body(body: dict, tokenizer,
     if isinstance(body.get("guided_json"), dict):
         # vLLM extra: guided_json carries the schema directly.
         guide = ("json_schema", json.dumps(body["guided_json"]))
+    if body.get("guided_choice") is not None:
+        # vLLM extra: the completion must be one of these literal strings,
+        # compiled as an escaped alternation over the DFA machinery.
+        # Non-string entries 400 here — coercing them (numbers, nulls)
+        # would constrain to text the caller never wrote.
+        choices = body["guided_choice"]
+        if (not isinstance(choices, list) or not choices
+                or any(not isinstance(c, str) for c in choices)):
+            raise ValueError(
+                "guided_choice must be a non-empty array of strings")
+        guide = ("choice", json.dumps(choices))
     if guide is not None and engine is not None:
         # Syntactic check only (ValueError -> 400 on bad patterns): the
         # expensive DFA build runs on the compiler's worker pool once the

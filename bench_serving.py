@@ -397,7 +397,9 @@ def run_serving_bench(model: str | None = None) -> dict:
         stdout=subprocess.PIPE, text=True)
     names = ("generation_tokens_total", "scheduler_seconds_total",
              "prefix_cache_hit_tokens_total",
-             "decode_resolve_wait_seconds_total")
+             "decode_resolve_wait_seconds_total",
+             "pipeline_depth_occupancy_sum",
+             "pipeline_depth_occupancy_count")
     moderate = None
     try:
         t_launch = time.monotonic()
@@ -449,10 +451,30 @@ def run_serving_bench(model: str | None = None) -> dict:
                 (s1[key] - s0.get(key, 0.0)) / (t1 - t0), 3)
     # Pure device-stream wait fraction: trustworthy in overlap mode, where
     # the phase-seconds wall attribution can land waits in whichever phase
-    # fetched first.
+    # fetched first.  Split by mode: "pipelined" waits land a full
+    # pipeline slot after issue (the device computed through them), so a
+    # high pipelined fraction means the HOST is the bottleneck draining
+    # results, while a high "sequential" fraction is the per-step stall
+    # ARKS_PIPELINE_DEPTH exists to remove.
     dw_key = "decode_resolve_wait_seconds_total"
-    device_wait = round((s1.get(dw_key, 0.0) - s0.get(dw_key, 0.0))
-                        / (t1 - t0), 3)
+    resolve_wait = {}
+    for key in s1:
+        if key.startswith(dw_key):
+            mode = (key.split('mode="')[-1].rstrip('"}')
+                    if "mode=" in key else "total")
+        else:
+            continue
+        resolve_wait[mode] = resolve_wait.get(mode, 0.0) + round(
+            (s1[key] - s0.get(key, 0.0)) / (t1 - t0), 3)
+    device_wait = round(sum(resolve_wait.values()), 3)
+    # Mean in-flight dispatches after each pipelined issue over the
+    # window: at ARKS_PIPELINE_DEPTH=N steady state this reads ~N; stuck
+    # near 1 means the scheduler keeps falling off the pipelined path.
+    occ_n = (s1.get("pipeline_depth_occupancy_count", 0.0)
+             - s0.get("pipeline_depth_occupancy_count", 0.0))
+    occ_sum = (s1.get("pipeline_depth_occupancy_sum", 0.0)
+               - s0.get("pipeline_depth_occupancy_sum", 0.0))
+    occupancy = round(occ_sum / occ_n, 3) if occ_n else None
     hit0 = s0.get("prefix_cache_hit_tokens_total", 0.0)
     hit1 = s1.get("prefix_cache_hit_tokens_total", 0.0)
     return {
@@ -478,6 +500,8 @@ def run_serving_bench(model: str | None = None) -> dict:
         "serving_ttft_samples": len(ttfts),
         "serving_phase_fractions": phases,
         "serving_device_wait_fraction": device_wait,
+        "decode_resolve_wait_fraction": resolve_wait,
+        "pipeline_depth_occupancy": occupancy,
         **(moderate or {}),
     }
 

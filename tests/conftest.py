@@ -8,6 +8,14 @@ too late here — switch the platform via jax.config before any backend is used.
 
 import os
 
+# Pipelined decoding stays opt-in per test: at the production default
+# (depth 2) every engine that reaches steady state kicks a background
+# compile of both pipe-program variants, loading the CPU under the whole
+# suite for no extra coverage — token streams are depth-invariant by
+# contract, and tests/test_pipeline_decode.py asserts depths 1-3
+# explicitly (its engines set this env themselves).
+os.environ.setdefault("ARKS_PIPELINE_DEPTH", "0")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -284,7 +284,11 @@ def test_resolved_config_surfaced(engine):
     engine.add_request(req)
     _drive(engine)
     text = engine.metrics.registry.render()
-    assert "\ndecode_resolve_wait_seconds_total " in text
+    # Split by mode since the pipelined scheduler: either family proves
+    # the counter rides the resolves.
+    assert ('decode_resolve_wait_seconds_total{mode="sequential"}' in text
+            or 'decode_resolve_wait_seconds_total{mode="pipelined"}' in text)
+    assert f'pipeline_depth="{rc["pipeline_depth"]}"' in text
 
 
 def test_cache_len_alignment_rounds_up_for_pallas(monkeypatch):

@@ -1,0 +1,97 @@
+"""Static guard over the decode hot path.
+
+The zero-host-sync contract of the pipelined scheduler lives or dies on
+the ISSUE side of the issue/resolve split never blocking on device
+values: one stray ``np.asarray(device_array)`` in an ``_issue_*``
+function silently reintroduces the per-step host stall the pipeline
+exists to remove — and it would still pass every token-parity test,
+because blocking changes only the overlap, not the values.  This test
+walks the scheduler's issue-side functions via AST and fails on any new
+blocking fetch (np.asarray / jax.device_get / .block_until_ready /
+.item) outside the ``_resolve_*`` / ``_pipe_resolve_*`` tails, where
+host syncs belong.
+"""
+
+import ast
+import inspect
+
+from arks_tpu.engine import engine as engine_mod
+
+# The issue-side hot path: one dispatch goes OUT per call, nothing comes
+# back.  _resolve_* and _pipe_resolve_* are deliberately absent — they
+# are the sanctioned host-sync tails.
+HOT_PATH_FUNCTIONS = (
+    "step",
+    "_step_pipelined",
+    "_pipe_issue",
+    "_issue_decode",
+    "_issue_mixed",
+    "_issue_admit_batch",
+)
+
+# Sanctioned exceptions, keyed (function, unparsed argument).  Each entry
+# must stay justifiable as a NON-blocking read:
+#   - _issue_mixed / st.key: an 8-byte PRNG key materialized at
+#     _start_chunked, long before any in-flight dispatch could pin it.
+#   - _issue_admit_batch / slots_l: a host python list, not device data.
+ALLOWED = {
+    ("_issue_mixed", "st.key"),
+    ("_issue_admit_batch", "slots_l"),
+}
+
+BLOCKING_ATTRS = {"block_until_ready", "item"}
+
+
+def _blocking_calls(func_name: str, tree: ast.AST):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        hit = None
+        if (f.attr == "asarray" and isinstance(f.value, ast.Name)
+                and f.value.id == "np"):
+            hit = "np.asarray"
+        elif f.attr == "device_get":
+            hit = "device_get"
+        elif f.attr in BLOCKING_ATTRS:
+            hit = f.attr
+        if hit is None:
+            continue
+        arg = ast.unparse(node.args[0]) if node.args else ""
+        # Literal host containers are host data by construction.
+        if node.args and isinstance(node.args[0],
+                                    (ast.List, ast.ListComp, ast.Tuple,
+                                     ast.GeneratorExp, ast.Constant)):
+            continue
+        if (func_name, arg) in ALLOWED:
+            continue
+        out.append((func_name, hit, arg, node.lineno))
+    return out
+
+
+def test_no_blocking_fetches_on_the_issue_path():
+    src = inspect.getsource(engine_mod)
+    module = ast.parse(src)
+    cls = next(n for n in module.body
+               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
+    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+    missing = [f for f in HOT_PATH_FUNCTIONS if f not in funcs]
+    assert not missing, f"hot-path functions renamed/removed: {missing}"
+
+    violations = []
+    for name in HOT_PATH_FUNCTIONS:
+        violations += _blocking_calls(name, funcs[name])
+    assert not violations, (
+        "blocking device fetch on the issue-side hot path (move it into a "
+        f"_resolve_* tail or justify it in ALLOWED): {violations}")
+
+
+def test_resolve_tails_exist():
+    """The guard above is only meaningful while the sanctioned sync tails
+    exist under their expected names."""
+    for name in ("_resolve_decode", "_resolve_mixed", "_pipe_resolve_one",
+                 "_resolve_admit_batch"):
+        assert callable(getattr(engine_mod.InferenceEngine, name)), name

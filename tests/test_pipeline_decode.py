@@ -1,0 +1,367 @@
+"""Pipelined decode (ARKS_PIPELINE_DEPTH): token-exact parity vs the
+sequential issue/resolve path at depths 1-3, mid-stream aborts, stop-token
+overshoot truncation, slot-reuse-after-overshoot KV correctness, multihost
+follower replay of the pipelined op stream, and the emit-stream depth
+bound."""
+
+import numpy as np
+import pytest
+
+from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.models import get_config
+
+
+class RecordingDispatcher:
+    def __init__(self):
+        self.ops = []
+
+    def broadcast(self, op, payload):
+        self.ops.append((op, payload))
+
+
+def _mk_engine(monkeypatch, depth, mixed="0", **kw):
+    monkeypatch.setenv("ARKS_PIPELINE_DEPTH", str(depth))
+    monkeypatch.setenv("ARKS_MIXED_STEP", mixed)
+    cfg = get_config("tiny")
+    defaults = dict(model="tiny", num_slots=2, max_cache_len=64,
+                    prefill_buckets=(8, 16, 32), steps_per_dispatch=4)
+    defaults.update(kw)
+    eng = InferenceEngine(cfg, EngineConfig(**defaults), ByteTokenizer())
+    if depth and isinstance(depth, int) and depth > 0:
+        # Deterministic engagement: serving warms the pipe programs in the
+        # background and stays sequential meanwhile; tests wait so short
+        # workloads can't finish before the pipelined path opens.
+        assert eng._pipe_warm_wait(300) == "ready"
+    return cfg, eng
+
+
+def _collect(req, timeout=120):
+    ids, lps, fin = [], [], None
+    while True:
+        out = req.outputs.get(timeout=timeout)
+        ids.extend(out.token_ids)
+        if out.logprobs:
+            lps.extend(out.logprobs)
+        if out.finished:
+            fin = out
+            break
+    return ids, lps, fin
+
+
+def _drive(engine, n_steps=800):
+    for _ in range(n_steps):
+        engine.step(block_s=0.01)
+        if (engine.num_running == 0 and engine._queue.empty()
+                and not engine._prefilling):
+            break
+
+
+def _run_workload(monkeypatch, depth, mixed="0", **kw):
+    """Greedy + fixed-seed sampled + logprob requests with slot churn
+    (more requests than slots); returns each request's full output."""
+    cfg, eng = _mk_engine(monkeypatch, depth, mixed, **kw)
+    assert eng._pipe_depth == (depth if depth >= 0 else 0)
+    prompts = [[5, 6, 7], list(range(3, 23)), [9] * 5, [4] * 12, [8, 3]]
+    reqs = []
+    for i, p in enumerate(prompts):
+        sp = SamplingParams(max_tokens=9,
+                            temperature=0.0 if i % 2 == 0 else 0.8,
+                            top_p=0.9, top_k=40, seed=7 + i, ignore_eos=True,
+                            logprobs=2 if i == 2 else None)
+        reqs.append(Request(f"r{i}", [int(x) % cfg.vocab_size for x in p], sp))
+    for r in reqs:
+        eng.add_request(r)
+    _drive(eng)
+    return [_collect(r) for r in reqs], eng
+
+
+@pytest.mark.parametrize("mixed,kw", [
+    ("0", {}),
+    ("auto", dict(prefill_chunk=16, kv_layout="paged")),
+])
+def test_pipeline_token_parity_depths(monkeypatch, mixed, kw):
+    """Depths 1/2/3 must produce BYTE-IDENTICAL streams (tokens, logprobs,
+    finish reasons) to the sequential path (depth 0), on both the legacy
+    slot engine and the mixed paged engine."""
+    base, _ = _run_workload(monkeypatch, 0, mixed, **kw)
+    for depth in (1, 2, 3):
+        got, eng = _run_workload(monkeypatch, depth, mixed, **kw)
+        assert got == base, f"depth {depth} diverged from sequential"
+        # The pipelined path actually ran (occupancy histogram observed).
+        occ = eng.metrics.pipeline_depth_occupancy._data
+        assert occ, "pipelined path never engaged"
+
+
+def test_pipeline_one_dispatch_per_iteration_and_depth_bound(monkeypatch):
+    """Emit-stream contract: in steady state exactly ONE model dispatch is
+    issued per scheduler iteration, and the advertised occupancy never
+    exceeds ARKS_PIPELINE_DEPTH."""
+    depth = 2
+    cfg, eng = _mk_engine(monkeypatch, depth)
+    eng.dispatcher = RecordingDispatcher()
+    r = Request("p0", [5, 6, 7], SamplingParams(
+        max_tokens=40, temperature=0.0, ignore_eos=True))
+    eng.add_request(r)
+    per_step = []
+    for _ in range(400):
+        before = sum(1 for op, _ in eng.dispatcher.ops if op == "decode_pipe")
+        eng.step(block_s=0.01)
+        after = sum(1 for op, _ in eng.dispatcher.ops if op == "decode_pipe")
+        per_step.append(after - before)
+        if eng.num_running == 0 and eng._queue.empty():
+            break
+    _collect(r)
+    pipe_ops = [p for op, p in eng.dispatcher.ops if op == "decode_pipe"]
+    assert pipe_ops, "no pipelined dispatches on the emit stream"
+    assert max(per_step) == 1, "more than one pipelined dispatch per step"
+    occs = [p["occupancy"] for p in pipe_ops]
+    assert max(occs) <= depth, occs
+    assert depth in occs, "pipeline never filled to the configured depth"
+    # Exactly the first dispatch of the run carries fresh host state.
+    assert pipe_ops[0]["fresh"] is True
+    assert all(not p["fresh"] for p in pipe_ops[1:])
+
+
+def test_pipeline_midstream_abort(monkeypatch):
+    """An abort raised while dispatches are in flight drains the pipeline
+    and frees the slot; the engine keeps serving afterwards."""
+    cfg, eng = _mk_engine(monkeypatch, 2)
+    victim = Request("v", [5, 6, 7], SamplingParams(
+        max_tokens=10_000, temperature=0.0, ignore_eos=True))
+    eng.add_request(victim)
+    for _ in range(50):
+        eng.step(block_s=0.01)
+        if eng._pipe_inflight:
+            break
+    assert eng._pipe_inflight, "pipeline never engaged"
+    eng.abort("v")
+    _drive(eng)
+    ids, _, fin = _collect(victim)
+    assert fin.finish_reason == "abort"
+    assert not eng._pipe_inflight and eng._pipe_state is None
+    # Slot is reusable: a fresh request completes normally.
+    nxt = Request("n", [9, 9], SamplingParams(
+        max_tokens=4, temperature=0.0, ignore_eos=True))
+    eng.add_request(nxt)
+    _drive(eng)
+    ids2, _, fin2 = _collect(nxt)
+    assert fin2.finish_reason == "length" and len(ids2) == 4
+
+
+def _greedy_probe(monkeypatch, prompt, n):
+    _, eng = _mk_engine(monkeypatch, 0)
+    r = Request("probe", prompt, SamplingParams(
+        max_tokens=n, temperature=0.0, ignore_eos=True))
+    eng.add_request(r)
+    _drive(eng)
+    ids, _, _ = _collect(r)
+    return ids
+
+
+def test_pipeline_stop_overshoot_truncation(monkeypatch):
+    """A stop token landing mid-dispatch with further dispatches in flight:
+    the stream truncates at the stop exactly like the sequential path, and
+    the <= depth*K overshoot tokens are discarded."""
+    probe = _greedy_probe(monkeypatch, [5, 6, 7], 16)
+    stop = probe[9]  # lands mid-dispatch (K=4) with the pipeline deep
+
+    def run(depth):
+        _, eng = _mk_engine(monkeypatch, depth)
+        r = Request("s", [5, 6, 7], SamplingParams(
+            max_tokens=64, temperature=0.0, ignore_eos=True,
+            stop_token_ids=(int(stop),)))
+        eng.add_request(r)
+        _drive(eng)
+        return _collect(r)
+
+    base = run(0)
+    for depth in (2, 3):
+        assert run(depth) == base
+    ids, _, fin = base
+    assert fin.finish_reason == "stop"
+    assert int(stop) not in ids  # stop token itself excluded from output
+
+
+def test_pipeline_slot_reuse_after_overshoot(monkeypatch):
+    """After a request dies mid-run (overshoot KV rows written past its
+    stop in its pages/rows), the SAME slot must serve the next request
+    with correct attention — the reclaimed rows are garbage until real
+    prefill/decode overwrites them.  num_slots=1 forces reuse; paged
+    layout exercises page reclaim."""
+    probe = _greedy_probe(monkeypatch, [5, 6, 7], 12)
+    stop = probe[5]
+
+    def run(depth, reuse_first):
+        _, eng = _mk_engine(monkeypatch, depth, mixed="auto", num_slots=1,
+                            prefill_chunk=16, kv_layout="paged")
+        outs = []
+        if reuse_first:
+            a = Request("a", [5, 6, 7], SamplingParams(
+                max_tokens=64, temperature=0.0, ignore_eos=True,
+                stop_token_ids=(int(stop),)))
+            eng.add_request(a)
+            _drive(eng)
+            outs.append(_collect(a))
+        b = Request("b", list(range(3, 21)), SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True))
+        eng.add_request(b)
+        _drive(eng)
+        outs.append(_collect(b))
+        return outs
+
+    # b's stream through a reused slot (garbage overshoot rows reclaimed)
+    # must equal b's stream on a fresh engine, at every depth.
+    fresh = run(2, reuse_first=False)[-1]
+    for depth in (0, 1, 2, 3):
+        got = run(depth, reuse_first=True)
+        assert got[-1] == fresh, f"slot reuse corrupted stream at depth {depth}"
+        assert got[0][2].finish_reason == "stop"
+
+
+def test_pipeline_follower_replay(monkeypatch):
+    """A follower fed the leader's recorded op stream replays the
+    pipelined dispatches from its OWN threaded device state (no host token
+    values on the wire) and converges to the leader's exact device state."""
+    from arks_tpu.engine.multihost import DispatchFollower
+
+    cfg, leader = _mk_engine(monkeypatch, 2, mixed="auto",
+                             prefill_chunk=16, kv_layout="paged")
+    leader.dispatcher = RecordingDispatcher()
+    reqs = []
+    for i, p in enumerate([[5, 6, 7], list(range(3, 23)), [9] * 5]):
+        sp = SamplingParams(max_tokens=6,
+                            temperature=0.0 if i % 2 == 0 else 0.8,
+                            seed=11 + i, ignore_eos=True)
+        reqs.append(Request(f"f{i}", p, sp))
+        leader.add_request(reqs[-1])
+    _drive(leader)
+    for r in reqs:
+        _collect(r)
+    ops = leader.dispatcher.ops
+    pipe_ops = [p for op, p in ops if op == "decode_pipe"]
+    assert pipe_ops, "no pipelined ops on the channel"
+    # Pipelined ops carry NO token values except the run-opening fresh one.
+    assert all(("tokens" in p) == bool(p["fresh"]) for p in pipe_ops)
+
+    import jax
+    import jax.numpy as jnp
+
+    _, feng = _mk_engine(monkeypatch, 2, mixed="auto",
+                         prefill_chunk=16, kv_layout="paged")
+    follower = DispatchFollower.__new__(DispatchFollower)
+    follower.engine = feng
+    follower._jax = jax
+    follower._pipe_state = None
+    follower._pipe_cols = None
+    for op, payload in ops:
+        follower._apply(feng, jax, jnp, op, payload)
+    # Lockstep invariant: identical op replay -> identical device state.
+    np.testing.assert_array_equal(np.asarray(leader._cache.k),
+                                  np.asarray(feng._cache.k))
+    np.testing.assert_array_equal(np.asarray(leader._sampling.key),
+                                  np.asarray(feng._sampling.key))
+
+
+def test_pipeline_survives_parked_guide_compile(monkeypatch):
+    """A request parked on a slow guide compile is pure host bookkeeping:
+    it must NOT drain the pipeline (live decoding would degrade to the
+    sequential path for the whole compile window); once the guide
+    publishes, the request admits and its output obeys the grammar."""
+    import threading
+    import time as _time
+
+    cfg, eng = _mk_engine(monkeypatch, 2, mixed="auto",
+                          prefill_chunk=16, kv_layout="paged",
+                          max_cache_len=96)
+    eng.dispatcher = RecordingDispatcher()
+    load = Request("load", [5, 6, 7], SamplingParams(
+        max_tokens=400, temperature=0.0, ignore_eos=True))
+    eng.add_request(load)
+
+    def pipe_ops():
+        return sum(1 for op, _ in eng.dispatcher.ops if op == "decode_pipe")
+
+    for _ in range(100):
+        eng.step(block_s=0.01)
+        if pipe_ops():
+            break
+    assert pipe_ops(), "pipeline never engaged"
+
+    release = threading.Event()
+    orig = eng.guides._build
+
+    def gated_build(rx):
+        release.wait(30)
+        return orig(rx)
+
+    eng.guides._build = gated_build
+    greq = Request("g", [9, 9], SamplingParams(
+        max_tokens=24, temperature=0.0, guide=("regex", r"ab+a")))
+    eng.add_request(greq)
+    deadline = _time.monotonic() + 2.0
+    while _time.monotonic() < deadline and not eng._awaiting_guide:
+        eng.step(block_s=0.01)
+    assert eng._awaiting_guide, "guided request never parked"
+    # Parked compile in flight: every iteration keeps issuing pipelined
+    # dispatches (no degradation to the sequential path).
+    before = pipe_ops()
+    for _ in range(10):
+        eng.step(block_s=0.01)
+    assert eng._awaiting_guide, "guide published before the gate opened"
+    assert pipe_ops() - before >= 10, \
+        "parked guide compile knocked decoding off the pipelined path"
+    release.set()
+    _drive(eng, n_steps=2000)
+    ids, _, fin = _collect(greq)
+    assert fin.finish_reason == "stop"
+    import re
+    assert re.fullmatch(r"ab+a", ByteTokenizer().decode(ids))
+    _, _, lfin = _collect(load)
+    assert lfin.finish_reason == "length"
+
+
+def test_pipeline_disabled_for_spec_engines(monkeypatch):
+    """Speculative engines resolve dispatches inline: the pipelined path
+    must resolve to depth 0 regardless of the env."""
+    monkeypatch.setenv("ARKS_PIPELINE_DEPTH", "2")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        draft_model="tiny", draft_len=3)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert eng._pipe_depth == 0
+    assert eng.resolved_config["pipeline_depth"] == "0"
+
+
+def test_pipeline_env_validation(monkeypatch):
+    monkeypatch.setenv("ARKS_PIPELINE_DEPTH", "bogus")
+    cfg = get_config("tiny")
+    with pytest.raises(ValueError, match="ARKS_PIPELINE_DEPTH"):
+        InferenceEngine(cfg, EngineConfig(model="tiny", num_slots=2,
+                                          max_cache_len=64,
+                                          prefill_buckets=(8, 16, 32)),
+                        ByteTokenizer())
+
+
+def test_pipeline_oversized_stop_set_falls_back(monkeypatch):
+    """A request whose stop set exceeds the device column keeps the engine
+    on the sequential path (stream still correct, never truncated)."""
+    from arks_tpu.engine import sampler as sampler_mod
+
+    big_stops = tuple(range(100, 100 + sampler_mod.STOP_IDS_MAX + 4))
+
+    def run(depth):
+        _, eng = _mk_engine(monkeypatch, depth)
+        r = Request("big", [5, 6, 7], SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True,
+            stop_token_ids=big_stops))
+        eng.add_request(r)
+        _drive(eng)
+        return _collect(r), eng
+
+    base, _ = run(0)
+    got, eng = run(2)
+    assert got == base
+    # The oversized stop set kept the pipeline cold.
+    assert not eng.metrics.pipeline_depth_occupancy._data

@@ -583,6 +583,39 @@ def test_guided_decoding_api(server):
         assert e.code == 400
 
 
+def test_guided_choice_api(server):
+    """vLLM-style guided_choice round-trip: the completion is EXACTLY one
+    of the literal choices (regex metacharacters escaped); non-string
+    entries and empty lists 400."""
+    choices = ["red", "green", "blu.e(x)"]  # metachars must be literal
+    with _post(server, "/v1/completions", {
+        "model": "tiny-serve", "prompt": "pick", "max_tokens": 16,
+        "temperature": 0, "guided_choice": choices,
+    }) as r:
+        data = json.load(r)
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert data["choices"][0]["text"] in choices
+
+    # Chat surface takes the extra too.
+    with _post(server, "/v1/chat/completions", {
+        "model": "tiny-serve",
+        "messages": [{"role": "user", "content": "pick"}],
+        "max_tokens": 16, "temperature": 0,
+        "guided_choice": ["alpha", "beta"],
+    }) as r:
+        data = json.load(r)
+    assert data["choices"][0]["message"]["content"] in ("alpha", "beta")
+
+    for bad in (["ok", 3], [], "red", [None]):
+        try:
+            _post(server, "/v1/completions", {
+                "model": "tiny-serve", "prompt": "x", "max_tokens": 4,
+                "guided_choice": bad})
+            raise AssertionError(f"expected HTTP 400 for {bad!r}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
 def test_find_stop_min_end_exemption():
     """A stop match ending at or before min_end is exempt, regardless of
     OTHER (longer) stop strings in the set; a straddling match cuts."""
