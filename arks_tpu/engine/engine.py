@@ -36,7 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from arks_tpu.engine import faults as faults_mod
 from arks_tpu.engine import sampler as sampler_mod
+from arks_tpu.engine.faults import StepFault
 from arks_tpu.engine.guides import GuideError
 from arks_tpu.engine.tokenizer import Tokenizer
 from arks_tpu.engine.types import PrefilledState, Request, RequestOutput
@@ -219,6 +221,9 @@ class _Slot:
     # cache-cap margin) — both frozen at registration.
     stop_col: object = None   # np.ndarray [STOP_IDS_MAX] | None
     dead_len: int = 0
+    # Sampling seed (request seed or the engine-assigned one) — fault
+    # recovery reconstructs the slot's key stream from it (advance_key).
+    seed: int = 0
 
 
 @dataclasses.dataclass
@@ -233,6 +238,102 @@ class _ChunkState:
     # Paged layout: the prompt's chained page digests (computed at match
     # time), registered into the allocator's prefix index at promote.
     digests: list | None = None
+
+
+@dataclasses.dataclass
+class _Survivor:
+    """An in-flight request's replayable state, snapshotted at a step
+    fault (engine._recover_from_fault).  ``generated`` empty = the request
+    had emitted nothing (queued/prefilling/deferred admission) and simply
+    re-queues; non-empty = token-replay resume (deterministic
+    re-execution behind a _ReplayGate — see that class)."""
+
+    request: Request
+    seed: int
+    num_prompt: int
+    generated: list = dataclasses.field(default_factory=list)
+    num_emitted: int = 0
+    logprobs: list = dataclasses.field(default_factory=list)
+    first_token_time: float | None = None
+
+
+class _ReplayGate:
+    """Token-replay resume by DETERMINISTIC RE-EXECUTION (fault recovery).
+
+    A surviving stream is re-admitted through its ORIGINAL schedule — the
+    same admission path, the same compiled programs, the same pinned seed
+    — so every regenerated token is byte-identical to the recorded stream
+    by run-to-run determinism.  (The alternative, re-prefilling the
+    generated tokens and restoring sampler state, recomputes KV rows with
+    DIFFERENT program shapes than the original decode wrote them — the
+    ulp-level drift occasionally flips a sampled token several steps after
+    resume, which is exactly the silent corruption replay must never
+    produce.)
+
+    The gate wraps the request's output queue for the re-run:
+
+    - **suppression**: regenerated tokens the client already received
+      (the first ``client_total``) are dropped, so the resumed stream has
+      no duplicates;
+    - **verification**: every regenerated token is checked against the
+      recorded stream; a mismatch (broken determinism) fails THIS request
+      with an engine_fault error instead of splicing a divergent tail
+      onto the client's stream — byte-identity is enforced, not assumed;
+    - **re-entrancy**: a second fault during the re-run just restarts the
+      cursor (``restart``); ``client_total`` survives, so suppression
+      stays exact across nested recoveries.
+
+    put() runs on the engine thread; get() is the server side's
+    pass-through to the original queue.
+    """
+
+    def __init__(self, inner, engine, request_id: str, expect: list,
+                 client_total: int):
+        self._inner = inner
+        self._engine = engine
+        self._rid = request_id
+        self.expect = [int(t) for t in expect]
+        self.pos = 0              # regenerated tokens seen this run
+        self.client_total = client_total  # tokens the client has received
+        self.failed = False
+
+    def restart(self, expect: list | None = None) -> None:
+        self.pos = 0
+        if expect and len(expect) > len(self.expect):
+            self.expect = [int(t) for t in expect]
+
+    def get(self, *args, **kwargs):
+        return self._inner.get(*args, **kwargs)
+
+    def put(self, out: RequestOutput) -> None:
+        if self.failed:
+            # The client already saw the divergence error; drop the rest
+            # of the doomed re-run (its abort tail included).
+            return
+        toks = list(out.token_ids)
+        start = self.pos
+        n_check = min(len(toks), len(self.expect) - start)
+        if toks[:n_check] != self.expect[start:start + n_check]:
+            self.failed = True
+            self._engine.abort(self._rid)
+            self._inner.put(RequestOutput(
+                request_id=self._rid, token_ids=[], finished=True,
+                finish_reason="error",
+                error="engine_fault: replay_diverged",
+                num_prompt_tokens=out.num_prompt_tokens))
+            log.error("replay of %s diverged from the recorded stream at "
+                      "token %d; failing the request", self._rid,
+                      start + 1)
+            return
+        self.pos += len(toks)
+        skip = max(0, min(self.client_total - start, len(toks)))
+        fwd = toks[skip:]
+        lps = out.logprobs[skip:] if out.logprobs else None
+        if not fwd and not out.finished:
+            return  # entirely inside the already-delivered prefix
+        self.client_total = max(self.client_total, self.pos)
+        self._inner.put(dataclasses.replace(
+            out, token_ids=fwd, logprobs=lps, ttft_s=None))
 
 
 class EngineMetrics:
@@ -336,6 +437,30 @@ class EngineMetrics:
             "pipeline_depth_occupancy",
             "In-flight decode dispatches after each pipelined issue",
             buckets=[1, 2, 3, 4, 6, 8])
+        # Fault isolation / recovery (engine.faults + _recover_from_fault):
+        # the observability DeepServe-style request-preserving recovery
+        # needs — who faulted (phase, kind), who survived, who was
+        # quarantined, and how long the replay took.
+        self.engine_faults_total = r.counter(
+            "engine_faults_total",
+            "Scheduler-step faults by phase and kind")
+        self.requests_recovered_total = r.counter(
+            "requests_recovered_total",
+            "In-flight requests restored to serving after an engine fault "
+            "(token-replay resume or re-queued admission)")
+        self.requests_quarantined_total = r.counter(
+            "requests_quarantined_total",
+            "Culprit requests failed alone after exhausting "
+            "ARKS_FAULT_RETRIES")
+        self.engine_recovery_seconds = r.histogram(
+            "engine_recovery_seconds",
+            "Fault-to-resumed-decoding recovery latency",
+            buckets=[0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120])
+        # 0=serving 1=recovering 2=wedged (faults.STATE_CODES); /readiness
+        # reports 503 "recovering"/"wedged" while nonzero.
+        self.engine_state = r.gauge(
+            "engine_state",
+            "Engine serving state (0=serving, 1=recovering, 2=wedged)")
         # Resolved-config info gauge (value always 1, config as labels —
         # the kube-state-metrics "_info" idiom): which KV layout / decode
         # impl / overlap mode a replica ACTUALLY runs, so an operator can
@@ -343,6 +468,29 @@ class EngineMetrics:
         self.engine_config_info = r.gauge(
             "engine_config_info",
             "Resolved engine configuration (labels; value is always 1)")
+
+
+def _scoped(phase: str):
+    """Fault-context decorator for scheduler phases: any exception leaving
+    the wrapped method is re-raised as a StepFault tagged with the phase
+    and the culprit request ids (blast-radius attribution — the recovery
+    loop's quarantine input).  Inner StepFaults (narrower attribution from
+    a per-request handler) pass through untouched."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            hb = self._step_hb
+            if hb is not None:
+                self._step_hb = (phase, hb[1])
+            try:
+                return fn(self, *args, **kwargs)
+            except StepFault:
+                raise
+            except Exception as e:
+                raise StepFault(phase, faults_mod.classify(e),
+                                culprits=self._phase_culprits(phase)) from e
+        return wrapper
+    return deco
 
 
 class InferenceEngine:
@@ -616,6 +764,29 @@ class InferenceEngine:
         self._running = False
         self._thread: threading.Thread | None = None
         self._request_seed = engine_cfg.seed
+        # ---- Fault isolation (engine.faults) ---------------------------
+        # Injector (ARKS_FAULT_INJECT chaos hook), per-request fault
+        # counts (the quarantine budget), and the serving/recovering/
+        # wedged state machine /readiness reports.
+        self._faults = faults_mod.FaultInjector()
+        self._fault_retries = int(os.environ.get("ARKS_FAULT_RETRIES", "1"))
+        if self._fault_retries < 0:
+            raise ValueError(
+                f"ARKS_FAULT_RETRIES={self._fault_retries}: must be >= 0")
+        self._fault_counts: dict[str, int] = {}
+        self._consec_faults = 0
+        # Request ids currently replaying (re-executing behind a
+        # _ReplayGate) after a fault; the recovery window closes when the
+        # last one re-registers (or dies).  Engine-thread-only.
+        self._replaying: set[str] = set()
+        self._state = "serving"
+        self.metrics.engine_state.set(faults_mod.STATE_SERVING)
+        self._recover_t0 = 0.0
+        # Watchdog heartbeat: (phase, t0) of the in-flight scheduler step,
+        # None while idle.  Written by the engine thread, read by the
+        # watchdog thread (a torn read degrades to one missed poll).
+        self._step_hb: tuple[str, float] | None = None
+        self._watchdog: faults_mod.Watchdog | None = None
         # Deferred admissions: issued batches whose first tokens haven't
         # been fetched yet (FIFO).  Resolving lazily (is_ready polling in
         # step) keeps the engine thread issuing decode dispatches instead
@@ -1272,11 +1443,44 @@ class InferenceEngine:
 
     def start(self) -> None:
         self._running = True
+        deadline = float(os.environ.get("ARKS_DISPATCH_DEADLINE_S", "0") or 0)
+        if deadline > 0:
+            # Wedged-dispatch escalation: a device call that never returns
+            # (hung DMA, deadlocked collective) cannot be cancelled from
+            # Python — flip state (readiness 503s), dump diagnostics, exit
+            # 70 so the supervisor restarts the pod.  The deadline must
+            # exceed the worst in-step jit compile (docs/runbook.md).
+            self._watchdog = faults_mod.Watchdog(
+                deadline, lambda: self._step_hb, self._on_wedged)
+            self._watchdog.start()
         self._thread = threading.Thread(target=self._run, name="engine", daemon=True)
         self._thread.start()
 
+    def _on_wedged(self, phase: str, age_s: float) -> None:
+        """Watchdog callback: record the wedged state (readiness reads it)
+        and log the in-flight picture an operator needs post-mortem."""
+        self._set_state("wedged")
+        log.critical(
+            "wedged dispatch diagnostics: phase=%s age=%.1fs slots=%s "
+            "prefilling=%s pending_admits=%d pipe_inflight=%d queue=%d",
+            phase, age_s,
+            {s: st.request.request_id for s, st in self._slots.items()},
+            {s: cs.request.request_id for s, cs in self._prefilling.items()},
+            self._pending_n, len(self._pipe_inflight), self._queue.qsize())
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        self.metrics.engine_state.set(faults_mod.STATE_CODES[state])
+
+    @property
+    def state(self) -> str:
+        """"serving" | "recovering" | "wedged" — the /readiness gate."""
+        return self._state
+
     def stop(self) -> None:
         self._running = False
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._thread is not None:
             self._thread.join(timeout=120.0)
             if self._thread.is_alive():
@@ -1311,7 +1515,8 @@ class InferenceEngine:
             if callable(size):
                 try:
                     out[name] = int(size())
-                except Exception:  # jax internals may shift across versions
+                except Exception as e:  # jax internals may shift across versions
+                    faults_mod.swallowed("compiled_program_variants", e)
                     continue
         return out
 
@@ -1380,6 +1585,7 @@ class InferenceEngine:
         the device's dead_len mask retires a slot before any write could
         land past it)."""
         from arks_tpu.engine.paged import pages_needed
+        self._faults.fire("pages")
         page = self._page_size()
         rows = rows_per_slot * (ahead + 1)
         for slot in self._slots:
@@ -1443,6 +1649,7 @@ class InferenceEngine:
             return shard_paged_cache_pp(cache, self.mesh)
         return tf.shard_paged_cache(cache, self.cfg, self.mesh)
 
+    @_scoped("guide")
     def _ensure_guides_uploaded(self) -> None:
         """Refresh the device guide tables when the compiler's version
         bumped (server threads compile guides on THEIR threads; only the
@@ -1452,6 +1659,7 @@ class InferenceEngine:
         dispatch."""
         if self._guide_ver == self.guides.version:
             return
+        self._faults.fire("guide")
         cls_host, trans_host, ver = self.guides.snapshot()
         self._emit("guides", class_ids=cls_host, trans=trans_host,
                    version=ver)
@@ -1493,28 +1701,283 @@ class InferenceEngine:
 
     def _run_loop(self) -> None:
         while self._running:
+            self._step_hb = ("step", time.monotonic())
             try:
                 progressed = self.step()
-            except Exception:
-                # A scheduler/device fault must not wedge every connected
-                # client: fail the in-flight requests, REBUILD the device
-                # state (the dispatch donated cache+sampler buffers, so they
-                # may already be invalidated), and keep serving.
-                log.exception("engine step failed; aborting in-flight requests")
-                for slot in list(self._slots):
-                    self._finish(slot, "abort")
-                for slot, st in list(self._prefilling.items()):
-                    self._unpin_guide(st.request)
-                    st.request.outputs.put(RequestOutput(
-                        request_id=st.request.request_id, token_ids=[],
-                        finished=True, finish_reason="abort",
-                        num_prompt_tokens=len(st.ids)))
-                self._prefilling.clear()
-                self._abort_pending_admits()
-                self._reset_device_state()
-                progressed = True
+                self._consec_faults = 0
+            except Exception as e:
+                # Fault-isolated recovery (engine.faults): quarantine the
+                # culprit request(s), REBUILD the device state (the
+                # dispatch donated cache+sampler buffers, so they may
+                # already be invalidated), and token-replay every other
+                # in-flight request so its stream resumes byte-identically.
+                progressed = self._recover_from_fault(e)
+            finally:
+                self._step_hb = None
             if not progressed:
                 time.sleep(0.001)
+
+    # ------------------------------------------------------------------
+    # Fault-isolated recovery
+    # ------------------------------------------------------------------
+
+    def _recover_from_fault(self, exc: Exception) -> bool:
+        """Top-level fault handler: attempt quarantine + token-replay
+        recovery, escalating to the blanket abort-everything path only
+        when recovery itself keeps faulting (crash-loop guard)."""
+        self._set_state("recovering")
+        self._recover_t0 = time.monotonic()
+        attempts = max(self._fault_retries + 2, 3)
+        for _ in range(attempts):
+            try:
+                self._do_recovery(exc)
+                return True
+            except Exception as e:  # routed back into _do_recovery
+                exc = e
+        log.error("recovery kept faulting after %d attempts; falling back "
+                  "to abort-everything", attempts)
+        self._blanket_abort(exc)
+        return True
+
+    def _do_recovery(self, exc: Exception) -> None:
+        """One recovery round: attribute, quarantine culprits over budget,
+        snapshot every other in-flight request, rebuild the device state,
+        and re-admit the survivors (token-replay for streams that already
+        emitted, plain re-queue for the rest)."""
+        if isinstance(exc, StepFault):
+            phase, kind = exc.phase, exc.kind
+            culprits = set(exc.culprits)
+            survivors: list[_Survivor] = list(exc.survivors)
+            cause = exc.__cause__ or exc
+        else:
+            phase, kind = "step", faults_mod.classify(exc)
+            culprits, survivors = set(), []
+            cause = exc
+        self._consec_faults += 1
+        self.metrics.engine_faults_total.inc(1, phase=phase, kind=kind)
+        log.error("engine fault in phase %r (kind=%s, culprits=%s, "
+                  "consecutive=%d); recovering",
+                  phase, kind, sorted(culprits) or "-", self._consec_faults,
+                  exc_info=cause)
+        for rid in culprits:
+            self._fault_counts[rid] = self._fault_counts.get(rid, 0) + 1
+        if self._consec_faults > max(self._fault_retries + 1, 2):
+            # Unattributed (or mis-attributed) fault storm: per-request
+            # budgets cannot bound it — stop the crash loop.
+            raise RuntimeError(
+                f"{self._consec_faults} consecutive step faults") from cause
+
+        # ---- snapshot every in-flight request --------------------------
+        for st in self._slots.values():
+            survivors.append(_Survivor(
+                request=st.request, seed=st.seed, num_prompt=st.num_prompt,
+                generated=list(st.generated), num_emitted=st.num_emitted,
+                logprobs=list(st.logprobs),
+                first_token_time=st.first_token_time))
+        for cs in self._prefilling.values():
+            # Mid-prefill sequences re-run from the top (nothing emitted);
+            # a replaying one keeps its gate — _do_recovery's re-admit
+            # detects it on the request and restarts the cursor.
+            survivors.append(_Survivor(
+                request=cs.request, seed=cs.seed, num_prompt=len(cs.ids)))
+        for rec in self._pending_admits:
+            for req, ids, _ in rec[0]:
+                survivors.append(_Survivor(
+                    request=req, seed=self._resolve_seed(req),
+                    num_prompt=len(ids)))
+        self._slots.clear()
+        self._prefilling.clear()
+        self._pending_admits.clear()
+        self._pending_n = 0
+        self.metrics.num_requests_running.set(0)
+
+        # ---- quarantine / abort / keep ---------------------------------
+        with self._abort_lock:
+            aborted = set(self._aborted)
+        keep: list[_Survivor] = []
+        seen: set[str] = set()
+        err = f"engine_fault: {phase}/{kind}"
+        for sv in survivors:
+            rid = sv.request.request_id
+            if rid in seen:
+                continue
+            seen.add(rid)
+            if rid in aborted:
+                # Abort raced the fault: honor it instead of replaying.
+                with self._abort_lock:
+                    self._aborted.discard(rid)
+                self._fail_survivor(sv, "abort", None)
+                continue
+            if self._fault_counts.get(rid, 0) > self._fault_retries:
+                # The culprit fails ALONE: finish_reason="error" maps to
+                # an OpenAI-style 500 at the HTTP layer.
+                self.metrics.requests_quarantined_total.inc(1)
+                log.warning("quarantining %s after %d faults (%s)", rid,
+                            self._fault_counts[rid], err)
+                self._fail_survivor(sv, "error", err)
+                continue
+            if ((sv.generated or isinstance(sv.request.outputs, _ReplayGate))
+                    and not self._replay_ok()):
+                # No replay on this engine shape: speculative decoding's
+                # key stream advances per DISPATCH, so a re-run is not
+                # reproducible from the token record — the stream cannot
+                # resume without risking duplicated or changed tokens.
+                # Fail it alone rather than corrupt.
+                self._fail_survivor(sv, "error", err)
+                continue
+            keep.append(sv)
+
+        # ---- re-admit survivors ----------------------------------------
+        # BEFORE the device reset: the admission queue is untouched by a
+        # reset, so if the rebuild itself faults the survivors ride the
+        # queue into the next recovery round instead of vanishing with
+        # this frame's locals (clients blocked forever).  Nothing admits
+        # until recovery returns, so ordering is otherwise free.
+        replay_n = 0
+        for sv in keep:
+            req = sv.request
+            rid = req.request_id
+            gate = (req.outputs
+                    if isinstance(req.outputs, _ReplayGate) else None)
+            if sv.generated or gate is not None:
+                # Token-replay resume by deterministic re-execution: wrap
+                # (or restart) the emission gate, then re-run the request
+                # through its ORIGINAL admission path with its pinned
+                # seed — the same compiled programs that produced the
+                # recorded stream reproduce it bitwise, the gate
+                # suppresses the already-delivered prefix and verifies
+                # every regenerated token.  Replayers jump the admission
+                # queue: they were already decoding before the fault.
+                if gate is None:
+                    req.outputs = _ReplayGate(req.outputs, self, rid,
+                                              sv.generated, sv.num_emitted)
+                else:
+                    gate.restart(sv.generated)
+                self._replaying.add(rid)
+                prio = req.params.priority - (1 << 20)
+                replay_n += 1
+            else:
+                # Nothing emitted yet: plain re-queue (the pinned seed
+                # makes the re-run byte-identical to a fault-free
+                # admission).
+                prio = req.params.priority
+                self.metrics.requests_recovered_total.inc(1)
+            with self._abort_lock:
+                self._queued_rids.add(rid)
+                self._queue_seq += 1
+                seq = self._queue_seq
+            self.metrics.num_requests_waiting.inc(1)
+            self._queue.put((prio, seq, req))
+
+        # ---- rebuild device state; tell followers ----------------------
+        self._emit("recover", manifest=[
+            (sv.request.request_id, sv.num_prompt, len(sv.generated))
+            for sv in keep], phase=phase, kind=kind)
+        self._reset_device_state()
+        if not replay_n:
+            self._finish_recovery()
+
+    def _phase_culprits(self, phase: str):
+        """Blast-radius attribution for a phase-scoped fault: the requests
+        the failing operation was doing work for.  Guide-table uploads
+        serve no specific request — nobody's retry budget burns for one."""
+        if phase == "guide":
+            return ()
+        rids = [st.request.request_id for st in self._slots.values()]
+        if phase == "mixed":
+            rids += [cs.request.request_id
+                     for cs in self._prefilling.values()]
+        return rids
+
+    def _purge_stale_aborts(self, consumed=()) -> None:
+        """Drop abort flags that no live request can ever consume.  Aborts
+        for requests still waiting in the admission queue stay until
+        _preadmit consumes them; anything else (request already finished,
+        or never existed) is garbage — without this, an abort racing
+        _finish would sit in the set forever (and the set could grow
+        without bound under abort-heavy clients)."""
+        active = {st.request.request_id for st in self._slots.values()}
+        active |= {st.request.request_id for st in self._prefilling.values()}
+        active |= {req.request_id for rec in self._pending_admits
+                   for req, _, _ in rec[0]}
+        active |= {req.request_id for req, _ in self._awaiting_guide}
+        with self._abort_lock:
+            self._aborted -= set(consumed)
+            self._aborted &= active | self._queued_rids
+
+    def _replay_ok(self) -> bool:
+        """Token-replay rides deterministic re-execution: valid wherever
+        a request's stream is a pure function of (prompt, params, seed) —
+        every engine shape except speculative decoding, whose key stream
+        advances per DISPATCH (schedule-dependent, not reproducible from
+        the token record)."""
+        return self._draft_cfg is None
+
+    def _fail_survivor(self, sv: "_Survivor", reason: str,
+                       error: str | None) -> None:
+        self._unpin_guide(sv.request)
+        self._fault_counts.pop(sv.request.request_id, None)
+        sv.request.outputs.put(RequestOutput(
+            request_id=sv.request.request_id, token_ids=[], finished=True,
+            finish_reason=reason, error=error,
+            num_prompt_tokens=sv.num_prompt,
+            num_generated_tokens=len(sv.generated)))
+        if reason == "error":
+            self.metrics.request_success_total.inc(reason="error")
+
+    def _finish_recovery(self) -> None:
+        self.metrics.engine_recovery_seconds.observe(
+            time.monotonic() - self._recover_t0)
+        self._set_state("serving")
+        log.info("recovery complete in %.3fs",
+                 time.monotonic() - self._recover_t0)
+
+    def _maybe_finish_recovery(self) -> None:
+        """Close the recovery window once the last replaying request has
+        re-registered into a decoding slot (or died on the way):
+        engine_recovery_seconds measures fault -> every surviving stream
+        decoding again."""
+        if self._state != "recovering":
+            return
+        if self._replaying:
+            # Drop replayers that went terminal without re-registering
+            # (an abort or per-request rejection raced the re-run).
+            live = {st.request.request_id for st in self._slots.values()}
+            live |= {cs.request.request_id
+                     for cs in self._prefilling.values()}
+            live |= {req.request_id for rec in self._pending_admits
+                     for req, _, _ in rec[0]}
+            live |= {req.request_id for req, _ in self._awaiting_guide}
+            with self._abort_lock:
+                live |= self._queued_rids
+            self._replaying &= live
+            if self._replaying:
+                return
+        self._finish_recovery()
+
+    def _blanket_abort(self, exc: Exception) -> None:
+        """Last-resort path (recovery crash loop): fail EVERY in-flight
+        request and rebuild — the pre-recovery behavior, kept as the
+        backstop so an unattributable fault storm cannot spin forever."""
+        log.exception("engine step failed; aborting in-flight requests",
+                      exc_info=exc)
+        for slot in list(self._slots):
+            self._finish(slot, "abort")
+        for slot, st in list(self._prefilling.items()):
+            self._unpin_guide(st.request)
+            st.request.outputs.put(RequestOutput(
+                request_id=st.request.request_id, token_ids=[],
+                finished=True, finish_reason="abort",
+                num_prompt_tokens=len(st.ids)))
+        self._prefilling.clear()
+        self._abort_pending_admits()
+        if self._prefix is not None:
+            # Deep clean: cached prefix KV may itself be the poison.
+            self._prefix.clear()
+        self._fault_counts.clear()
+        self._consec_faults = 0
+        self._reset_device_state()
+        self._finish_recovery()
 
     def _reset_device_state(self) -> None:
         # Pipelined decode: in-flight records reference donated-away device
@@ -1581,6 +2044,7 @@ class InferenceEngine:
         the shared device stream land in whichever phase fetches first —
         the breakdown attributes WALL time, not device time."""
         t0 = time.monotonic()
+        self._maybe_finish_recovery()
         self._ensure_guides_uploaded()
         worked = False
         if self._awaiting_guide:
@@ -1678,6 +2142,10 @@ class InferenceEngine:
             self.metrics.scheduler_seconds_total.inc(
                 time.monotonic() - t4, phase="admit")
         if not worked:
+            # Idle housekeeping: an abort that raced _finish (or targeted
+            # a request that never existed) must not linger in the set
+            # forever — the busy-path purges only run while slots exist.
+            self._purge_stale_aborts()
             # Idle: wait briefly for a request, then try admission again.
             try:
                 _, _, req = self._queue.get(timeout=block_s)
@@ -1768,29 +2236,30 @@ class InferenceEngine:
             else:
                 while recs:
                     self._resolve_admit_batch(recs.pop(0))
-        except Exception:
+        except Exception as e:
             # A failing batch must not strand its SIBLINGS: un-issued items
             # and unresolved already-issued batches hold no registered slot
-            # (invisible to _run's recovery) — fail them here or their
-            # clients block forever.  (The failing batch's own requests
-            # were already failed by its issue/resolve handler.)
-            for items in groups.values():
-                for req, ids, _ in items:
-                    self._unpin_guide(req)
-                    req.outputs.put(RequestOutput(
-                        request_id=req.request_id, token_ids=[],
-                        finished=True, finish_reason="abort",
-                        num_prompt_tokens=len(ids)))
+            # (invisible to the recovery snapshot) — carry them as
+            # survivors on the StepFault so recovery re-queues them.  (The
+            # failing operation's own requests ride its inner StepFault.)
+            survivors = []
+            for sib_items in groups.values():
+                for req, ids, _ in sib_items:
+                    survivors.append(_Survivor(
+                        request=req, seed=self._resolve_seed(req),
+                        num_prompt=len(ids)))
             for rec in recs:
                 for (req, ids, _), slot in zip(rec[0], rec[1]):
                     if slot not in self._slots:
                         self._free.append(slot)
-                    self._unpin_guide(req)
-                    req.outputs.put(RequestOutput(
-                        request_id=req.request_id, token_ids=[],
-                        finished=True, finish_reason="abort",
-                        num_prompt_tokens=len(ids)))
-            raise
+                    survivors.append(_Survivor(
+                        request=req, seed=self._resolve_seed(req),
+                        num_prompt=len(ids)))
+            if isinstance(e, StepFault):
+                e.survivors.extend(survivors)
+                raise
+            raise StepFault("admit", faults_mod.classify(e),
+                            survivors=survivors) from e
         return admitted
 
     def _drain_ready_admits(self, force_one: bool = False) -> bool:
@@ -1826,6 +2295,18 @@ class InferenceEngine:
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(ids)))
 
+    def _resolve_seed(self, req: Request) -> int:
+        """The request's sampling seed, assigned ONCE per request: an
+        explicit params.seed wins; otherwise the engine counter value is
+        pinned on the request (fault recovery re-admits with the identical
+        key stream instead of drawing a fresh counter value)."""
+        if req.params.seed is not None:
+            return req.params.seed
+        if req.assigned_seed is None:
+            self._request_seed += 1
+            req.assigned_seed = self._request_seed
+        return req.assigned_seed
+
     def _preadmit(self, req: Request):
         """Admission front half: aborts, disagg-transferred KV, rejects,
         and the chunked/prefix paths are handled HERE (individually);
@@ -1840,6 +2321,22 @@ class InferenceEngine:
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort"))
                 return
+        if isinstance(req.outputs, _ReplayGate):
+            # Fault-recovery re-admission: a per-request injectable point
+            # ("replay" phase) so the chaos suite can kill one survivor's
+            # resume specifically — the StepFault attributes the fault to
+            # THIS request alone and carries its replay state.
+            try:
+                self._faults.fire("replay")
+            except Exception as e:
+                raise StepFault(
+                    "replay", faults_mod.classify(e),
+                    culprits=[req.request_id],
+                    survivors=[_Survivor(
+                        request=req, seed=self._resolve_seed(req),
+                        num_prompt=len(req.prompt_ids),
+                        generated=list(req.outputs.expect),
+                        num_emitted=req.outputs.client_total)]) from e
         if req.params.guide is not None:
             # Cold-guide gate: park the request while its guide compiles
             # on the worker pool (the scheduler never blocks on
@@ -1938,10 +2435,10 @@ class InferenceEngine:
         guide_col = np.full((m,), -1, np.int32)
         guide_row_col = np.zeros((m,), np.int32)
         try:
+            self._faults.fire("admit")
             for i, (req, ids, _) in enumerate(items):
                 p = req.params
-                self._request_seed += 1
-                seed = p.seed if p.seed is not None else self._request_seed
+                seed = self._resolve_seed(req)
                 seeds.append(seed)
                 keys.append(sampler_mod.np_prng_key(seed))
                 slot = self._free.pop()
@@ -2002,17 +2499,22 @@ class InferenceEngine:
                 first_ids, self._cache, self._sampling, ks, vs = \
                     self._admit_fn(*args)
                 lp_out = None
-        except Exception:
+        except Exception as e:
             # None of the requests holds a REGISTERED slot yet, so _run's
-            # recovery path can't see them — fail them here or their
-            # clients block forever.  (Slot and page bookkeeping are
-            # rebuilt by _run's reset.)
-            for req, ids, _ in items:
-                self._unpin_guide(req)
-                req.outputs.put(RequestOutput(
-                    request_id=req.request_id, token_ids=[], finished=True,
-                    finish_reason="abort", num_prompt_tokens=len(ids)))
-            raise
+            # recovery snapshot can't see them — carry them as survivors
+            # on the StepFault (they re-queue with their pinned seeds) or
+            # their clients block forever.  (Slot and page bookkeeping are
+            # rebuilt by the recovery reset.)
+            survivors = [_Survivor(request=req, seed=self._resolve_seed(req),
+                                   num_prompt=len(ids))
+                         for req, ids, _ in items]
+            if isinstance(e, StepFault):
+                e.survivors.extend(survivors)
+                raise
+            raise StepFault(
+                "admit", faults_mod.classify(e),
+                culprits=[req.request_id for req, _, _ in items],
+                survivors=survivors) from e
         # Only the slot-layout single-prompt prefix harvest reads ks/vs at
         # resolve; everywhere else, keeping them in the record would pin
         # the batch's full prompt KV in HBM for the deferral window.
@@ -2025,23 +2527,26 @@ class InferenceEngine:
         tokens, register the slots, emit, and harvest prefixes."""
         items, slots_l, first_ids, lp_out, ks, vs = rec
         try:
+            self._faults.fire("admit_resolve")
             firsts = np.asarray(first_ids).tolist()  # device round-trip
             if lp_out is not None:
                 clps = np.asarray(lp_out[0])
                 valss = np.asarray(lp_out[1])
                 lidss = np.asarray(lp_out[2])
-        except Exception:
-            # Dispatch failed asynchronously; the requests hold slots that
-            # _run's recovery will not free (not registered) — fail them
-            # and reclaim here.
+        except Exception as e:
+            # Dispatch failed asynchronously; the requests hold slots the
+            # recovery snapshot will not see (not registered) — carry them
+            # as survivors so they re-queue with their pinned seeds.
             for (req, ids, _), slot in zip(items, slots_l):
                 if slot not in self._slots:
                     self._free.append(slot)
-                self._unpin_guide(req)
-                req.outputs.put(RequestOutput(
-                    request_id=req.request_id, token_ids=[], finished=True,
-                    finish_reason="abort", num_prompt_tokens=len(ids)))
-            raise
+            raise StepFault(
+                "admit_resolve", faults_mod.classify(e),
+                culprits=[req.request_id for req, _, _ in items],
+                survivors=[_Survivor(request=req,
+                                     seed=self._resolve_seed(req),
+                                     num_prompt=len(ids))
+                           for req, ids, _ in items]) from e
         for i, ((req, ids, _), slot) in enumerate(zip(items, slots_l)):
             # Aborts raised between issue and this (deferred) resolve:
             # honor them here instead of registering a dead slot for one
@@ -2071,7 +2576,8 @@ class InferenceEngine:
                 first_lp = self._lp_entry(clps[i], valss[i], lidss[i],
                                           req.params.logprobs)
             self._register_slot(req, slot, firsts[i], len(ids),
-                                first_lp=first_lp)
+                                first_lp=first_lp,
+                                seed=self._resolve_seed(req))
             if self._paged and self._chunk:
                 # Zero-cost harvest: the prompt's full pages are already in
                 # the pool — register their digests so later prompts share
@@ -2190,15 +2696,18 @@ class InferenceEngine:
             self._apply_set_slot(slot, p, jax.random.fold_in(key, 1),
                                  num_prompt=pf.num_prompt, guide=gid,
                                  guide_row=grow)
-        except Exception:
-            self._unpin_guide(req)
-            req.outputs.put(RequestOutput(
-                request_id=req.request_id, token_ids=[], finished=True,
-                finish_reason="abort", num_prompt_tokens=pf.num_prompt))
-            raise
+        except Exception as e:
+            # The transferred KV lives on the REQUEST (host arrays): the
+            # survivor simply re-queues and re-inserts after the reset.
+            raise StepFault(
+                "admit", faults_mod.classify(e),
+                culprits=[req.request_id],
+                survivors=[_Survivor(request=req, seed=pf.seed,
+                                     num_prompt=pf.num_prompt)]) from e
         self._register_slot(req, slot, pf.first_token, pf.num_prompt,
                             first_lp=pf.first_lp
-                            if req.params.logprobs is not None else None)
+                            if req.params.logprobs is not None else None,
+                            seed=pf.seed)
 
     @staticmethod
     def _lp_entry(clp, vals, lids, n: int):
@@ -2354,7 +2863,8 @@ class InferenceEngine:
             jnp.asarray(guide, jnp.int32), jnp.asarray(guide_row, jnp.int32))
 
     def _register_slot(self, req: Request, slot: int, first: int,
-                       num_prompt: int, first_lp=None) -> None:
+                       num_prompt: int, first_lp=None,
+                       seed: int = 0) -> None:
         # Draft-cache prompt prefill (speculative decoding).  Skipped when
         # the prompt tokens aren't available (disagg-transferred KV) or the
         # prompt exceeds the one-shot buckets (a monolithic draft prefill
@@ -2373,20 +2883,29 @@ class InferenceEngine:
                     self._draft_params, self._draft_cache,
                     jnp.asarray(padded),
                     jnp.asarray([len(ids)], jnp.int32), jnp.asarray(slot))
-            except Exception:
-                # Not registered yet: _run's recovery can't see this
-                # request — fail it here or its client blocks forever
-                # (same contract as the pre-registration dispatches).
+            except Exception as e:
+                # Not registered yet, nothing emitted yet: the survivor
+                # re-queues and re-admits with its pinned seed (same
+                # contract as the pre-registration dispatches).
                 self._free.append(slot)
-                self._unpin_guide(req)
-                req.outputs.put(RequestOutput(
-                    request_id=req.request_id, token_ids=[], finished=True,
-                    finish_reason="abort", num_prompt_tokens=num_prompt))
-                raise
+                raise StepFault(
+                    "admit", faults_mod.classify(e),
+                    culprits=[req.request_id],
+                    survivors=[_Survivor(request=req,
+                                         seed=self._resolve_seed(req),
+                                         num_prompt=num_prompt)]) from e
             draft_synced = True
         now = time.monotonic()
         st = _Slot(request=req, num_prompt=num_prompt,
-                   draft_synced=draft_synced)
+                   draft_synced=draft_synced, seed=seed)
+        self._fault_counts.pop(req.request_id, None)
+        replaying = req.request_id in self._replaying
+        if replaying:
+            # Token-replay re-execution reached a decoding slot again:
+            # the stream is live (the gate streams the continuation once
+            # the re-run passes the delivered prefix).
+            self._replaying.discard(req.request_id)
+            self.metrics.requests_recovered_total.inc(1)
         st.generated.append(first)
         if first_lp is not None:
             st.logprobs.append(first_lp)
@@ -2405,7 +2924,11 @@ class InferenceEngine:
         self.metrics.prompt_tokens_total.inc(num_prompt)
         self.metrics.num_requests_running.set(len(self._slots))
         ttft = now - req.arrival_time
-        self.metrics.time_to_first_token_seconds.observe(ttft)
+        if not replaying:
+            # A replay re-registration is not a first token — the client
+            # got theirs long ago; observing it would poison the TTFT
+            # histogram with fault-to-now spans.
+            self.metrics.time_to_first_token_seconds.observe(ttft)
 
         if self._check_finished(slot):
             return
@@ -2478,8 +3001,7 @@ class InferenceEngine:
                        prefix_len: int = 0, prefix_pages=None,
                        digests=None) -> None:
         p = req.params
-        self._request_seed += 1
-        seed = p.seed if p.seed is not None else self._request_seed
+        seed = self._resolve_seed(req)
         slot = self._free.pop()
         if self._paged:
             # Pages must cover positions [0, len+K-1]: while this slot
@@ -2490,20 +3012,25 @@ class InferenceEngine:
             # sequence's page sits behind.  Shared prefix pages (already
             # incref'd by match) head the table; only the tail is newly
             # allocated.
+            from arks_tpu.engine.paged import pages_needed
             page = self._page_size()
             k_steps = self.ecfg.steps_per_dispatch
-            total = (len(ids) + k_steps - 1) // page + 1
+            # Clamped at the table width: a replayed near-cap stream's
+            # ids + K window can overshoot max_cache_len — the device's
+            # dead_len mask retires the slot before a write lands there.
+            total = pages_needed(len(ids), k_steps, page, self._max_pages)
             shared = list(prefix_pages or [])
             try:
+                self._faults.fire("pages")
                 self._assign_slot_pages(slot, total, head_pages=shared)
-            except Exception:
+            except Exception as e:
                 self._alloc.decref(shared)
                 self._free.append(slot)
-                self._unpin_guide(req)
-                req.outputs.put(RequestOutput(
-                    request_id=req.request_id, token_ids=[], finished=True,
-                    finish_reason="abort", num_prompt_tokens=len(ids)))
-                raise
+                raise StepFault(
+                    "pages", faults_mod.classify(e),
+                    culprits=[req.request_id],
+                    survivors=[_Survivor(request=req, seed=seed,
+                                         num_prompt=len(ids))]) from e
         elif prefix_len:
             # Cached prefix blocks land in the slot first; chunked prefill
             # then continues from prefix_len (a chunk boundary by
@@ -2523,13 +3050,13 @@ class InferenceEngine:
                 self._cache = self._insert_fn(
                     self._cache, jnp.asarray(k), jnp.asarray(v),
                     jnp.asarray(slot))
-            except Exception:
+            except Exception as e:
                 self._free.append(slot)
-                self._unpin_guide(req)
-                req.outputs.put(RequestOutput(
-                    request_id=req.request_id, token_ids=[], finished=True,
-                    finish_reason="abort", num_prompt_tokens=len(ids)))
-                raise
+                raise StepFault(
+                    "chunk", faults_mod.classify(e),
+                    culprits=[req.request_id],
+                    survivors=[_Survivor(request=req, seed=seed,
+                                         num_prompt=len(ids))]) from e
         self._prefilling[slot] = _ChunkState(request=req, ids=ids,
                                              pos=prefix_len, seed=seed,
                                              key=jnp.asarray(
@@ -2562,6 +3089,7 @@ class InferenceEngine:
         padded = np.zeros((c,), np.int32)
         padded[:valid] = chunk
         try:
+            self._faults.fire("chunk")
             if self._paged:
                 self._emit("chunk_paged", slot=slot, tokens=padded,
                            start=st.pos, valid=valid,
@@ -2577,17 +3105,17 @@ class InferenceEngine:
                     self.params, self._cache, jnp.asarray(slot, jnp.int32),
                     jnp.asarray(padded), jnp.asarray(st.pos, jnp.int32),
                     jnp.asarray(valid, jnp.int32))
-        except Exception:
-            # Free the reserved slot and fail the request: _run's recovery
-            # only sees registered slots.
+        except Exception as e:
+            # Attribute the fault to THIS request (the chunk dispatch does
+            # work for exactly one sequence) and carry its replayable
+            # state with the StepFault — _run's recovery quarantines it
+            # within the retry budget while every other request survives.
             del self._prefilling[slot]
-            self._release_slot_pages(slot)
-            self._free.append(slot)
-            self._unpin_guide(st.request)
-            st.request.outputs.put(RequestOutput(
-                request_id=st.request.request_id, token_ids=[], finished=True,
-                finish_reason="abort", num_prompt_tokens=len(st.ids)))
-            raise
+            raise StepFault(
+                "chunk", faults_mod.classify(e),
+                culprits=[st.request.request_id],
+                survivors=[_Survivor(request=st.request, seed=st.seed,
+                                     num_prompt=len(st.ids))]) from e
         st.pos += valid
         if st.pos < len(st.ids):
             return
@@ -2632,7 +3160,7 @@ class InferenceEngine:
                              num_prompt=len(st.ids), guide=gid,
                              guide_row=grow1)
         self._register_slot(st.request, slot, first, len(st.ids),
-                            first_lp=first_lp)
+                            first_lp=first_lp, seed=st.seed)
         if self._paged and self._chunk:
             # Zero-cost harvest: every full prompt page is now written —
             # register the digest chain so later prompts share on device
@@ -2836,8 +3364,9 @@ class InferenceEngine:
                      "(depth=%d, %s)", time.monotonic() - t0,
                      self._pipe_depth,
                      "mixed_pipe" if self._mixed else "decode_pipe")
-        except Exception:
+        except Exception as e:
             self._pipe_warm_state = "failed"
+            faults_mod.swallowed("pipe_warmup", e)
             log.warning("pipelined decode warmup failed; engine stays on "
                         "the sequential path", exc_info=True)
 
@@ -2864,6 +3393,7 @@ class InferenceEngine:
                 pass  # aval/sharding drift: inputs not consumed, retry jit
         return self._pipe_jit_fn(want_lp)(*args)
 
+    @_scoped("decode")
     def _step_pipelined(self) -> None:
         """One steady-state iteration: issue ONE dispatch (if the pipeline
         has room), then resolve — blocking on the oldest only when the
@@ -2905,6 +3435,7 @@ class InferenceEngine:
         if self._paged:
             self._grow_slot_pages(K, ahead=len(self._pipe_inflight))
         self._ensure_guides_uploaded()
+        self._faults.fire("decode")
         if fresh:
             n = self.ecfg.num_slots
             alive = np.zeros((n,), bool)
@@ -2950,8 +3481,8 @@ class InferenceEngine:
         for arr in (toks,) + (lp_devs or ()):
             try:
                 arr.copy_to_host_async()
-            except Exception:  # platform without async host copies
-                pass
+            except Exception as e:  # platform without async host copies
+                faults_mod.swallowed("copy_to_host_async", e)
         snapshot = [(s, int(self._slot_gen[s])) for s in self._slots]
         self._pipe_inflight.append((snapshot, want_lp, toks, lp_devs, K, t0))
         self.metrics.pipeline_depth_occupancy.observe(
@@ -2964,6 +3495,7 @@ class InferenceEngine:
         slots — whose overshoot tokens in NEWER in-flight dispatches are
         discarded by the (slot, gen) snapshot guard."""
         snapshot, want_lp, toks, lp_devs, K, t0 = self._pipe_inflight.popleft()
+        self._faults.fire("resolve")
         t_wait = time.monotonic()
         toks = np.asarray(toks)  # host sync point (async copy usually done)
         if lp_devs is not None:
@@ -2990,6 +3522,7 @@ class InferenceEngine:
                 lp_rows = (clps[:, slot], lvals[:, slot], lids[:, slot])
             self._fanout_decode_tokens(slot, cols[slot], lp_rows, dt)
 
+    @_scoped("decode")
     def _pipe_drain(self) -> None:
         """Resolve every in-flight dispatch and hand authority back to the
         host mirrors (they are exact after the last resolve)."""
@@ -3017,6 +3550,7 @@ class InferenceEngine:
         if rec is not None:
             self._resolve_decode(rec)
 
+    @_scoped("decode")
     def _issue_decode(self):
         """Decode bookkeeping + ASYNC dispatch.  Returns the pending record
         for _resolve_decode, or None when nothing dispatched (no live
@@ -3038,21 +3572,10 @@ class InferenceEngine:
                 self._finish(slot, "abort")
                 consumed.add(rid)
         # Aborts for requests still waiting in the admission queue stay in
-        # the set until _admit_one consumes them; anything else (request
-        # already finished, or never existed) is garbage — purge it so the
-        # set can't grow without bound.
-        active = {st.request.request_id for st in self._slots.values()}
-        active |= {st.request.request_id for st in self._prefilling.values()}
-        # Deferred admits are live too: purging their abort flags here
-        # would lose aborts raised between issue and registration.
-        active |= {req.request_id for rec in self._pending_admits
-                   for req, _, _ in rec[0]}
-        # ...as are requests parked on a guide compile (their aborts are
-        # honored by _service_awaiting_guides).
-        active |= {req.request_id for req, _ in self._awaiting_guide}
-        with self._abort_lock:
-            self._aborted -= consumed
-            self._aborted &= active | self._queued_rids
+        # the set until _preadmit consumes them; deferred admits and
+        # guide-parked requests count as live (purging their flags would
+        # lose aborts raised between issue and registration).
+        self._purge_stale_aborts(consumed)
         # Retire any slot that would overflow its cache this dispatch (the
         # spec path writes draft_len rows, the fused loop K).
         margin = max(K, self.ecfg.draft_len if self._draft_cfg else 0)
@@ -3095,6 +3618,7 @@ class InferenceEngine:
         if self._paged:
             self._grow_slot_pages(K)
 
+        self._faults.fire("decode")
         t0 = time.monotonic()
         # Logprob variant selected per dispatch: only dispatches containing
         # a logprob-bearing slot pay the full-vocab log-softmax.
@@ -3122,12 +3646,14 @@ class InferenceEngine:
         # free-slot sentinel at issue).
         return (list(self._slots.keys()), want_lp, toks, lp_devs, K, t0)
 
+    @_scoped("decode")
     def _resolve_decode(self, rec, exclude_s: float = 0.0) -> None:
         """Host-sync tail: fetch the dispatch's tokens and fan them out to
         the SNAPSHOT slots.  ``exclude_s`` subtracts the overlapped
         admit/chunk wall time from the TPOT observation — in overlap mode
         issue-to-resolve spans that host work, which is not decode time."""
         snapshot, want_lp, toks, lp_devs, K, t0 = rec
+        self._faults.fire("resolve")
         t_wait = time.monotonic()
         toks = np.asarray(toks)  # [K, B] — host sync point
         # Pure device-stream wait, free of overlapped host work: the
@@ -3219,18 +3745,12 @@ class InferenceEngine:
                     request_id=rid, token_ids=[], finished=True,
                     finish_reason="abort", num_prompt_tokens=len(st.ids)))
                 consumed.add(rid)
-        active = {st.request.request_id for st in self._slots.values()}
-        active |= {st.request.request_id for st in self._prefilling.values()}
-        active |= {req.request_id for rec in self._pending_admits
-                   for req, _, _ in rec[0]}
-        active |= {req.request_id for req, _ in self._awaiting_guide}
-        with self._abort_lock:
-            self._aborted -= consumed
-            self._aborted &= active | self._queued_rids
+        self._purge_stale_aborts(consumed)
         for slot in list(self._slots):
             if int(self._lengths[slot]) + 2 > self.ecfg.max_cache_len:
                 self._finish(slot, "length")
 
+    @_scoped("mixed")
     def _issue_mixed(self):
         """Build and issue ONE mixed dispatch: every decoding slot's next
         token plus up to ARKS_MIXED_CHUNK_TOKENS prefill tokens spread
@@ -3245,6 +3765,7 @@ class InferenceEngine:
             return None
         self._ensure_guides_uploaded()
         self._grow_slot_pages(1)
+        self._faults.fire("decode")
         num_slots = self.ecfg.num_slots
         t_budget = num_slots + self._mixed_budget
         sentinel = self._park_sentinel()
@@ -3393,13 +3914,16 @@ class InferenceEngine:
         return (dec_slots, completing, chunk_take, want_lp, ids_dev,
                 lp_devs, t0)
 
+    @_scoped("mixed")
     def _resolve_mixed(self, rec, exclude_s: float = 0.0) -> None:
         """Host-sync tail of a mixed dispatch: fan the decode tokens out,
         advance every prefilling sequence's position, and promote the
         sequences whose prompt completed (set_slot + registration — the
         same tail as the legacy final chunk, minus its extra sample_one
         dispatch)."""
-        dec_slots, completing, chunk_take, want_lp, ids_dev, lp_devs, t0 = rec
+        (dec_slots, completing, chunk_take, want_lp, ids_dev,
+         lp_devs, t0) = rec
+        self._faults.fire("resolve")
         t_wait = time.monotonic()
         ids = np.asarray(ids_dev)   # [B] — host sync point
         self.metrics.decode_resolve_wait_seconds_total.inc(
@@ -3458,7 +3982,7 @@ class InferenceEngine:
                                  num_prompt=len(st.ids), guide=gid,
                                  guide_row=grow1)
             self._register_slot(st.request, slot, first, len(st.ids),
-                                first_lp=first_lp)
+                                first_lp=first_lp, seed=st.seed)
             # Zero-cost harvest, as in the legacy chunk path: every full
             # prompt page is now written — register the digest chain so
             # later prompts share on device.
@@ -3466,6 +3990,7 @@ class InferenceEngine:
                                         self._slot_pages.get(slot, []),
                                         st.digests)
 
+    @_scoped("spec")
     def _spec_dispatch(self, eligible: dict[int, bool]) -> None:
         """One speculative step: draft proposes, target verifies, each
         ELIGIBLE slot advances 1..draft_len tokens; disabled slots advance
@@ -3481,6 +4006,7 @@ class InferenceEngine:
         tables_arg = jnp.asarray(self._tables) if self._paged else None
         want_lp = any(st.request.params.logprobs is not None
                       for st in self._slots.values())
+        self._faults.fire("spec")
         t0 = time.monotonic()
         self._emit("spec", tokens=np.array(self._last_token),
                    lengths=np.array(self._lengths), enable=enable.copy(),

@@ -296,13 +296,15 @@ class DispatchFollower:
                 return
             try:
                 self._apply(eng, jax, jnp, op, p)
-            except Exception:
+            except Exception as e:
                 # A deterministic device fault raises here AND on the
-                # leader; the leader's recovery broadcasts "reset" next,
-                # which rebuilds this process's device state too.  (A
-                # follower-only fault diverges instead — the next
+                # leader; the leader's recovery broadcasts "recover" +
+                # "reset" next, which rebuilds this process's device state
+                # too.  (A follower-only fault diverges instead — the next
                 # collective then hangs and jax's coordination service
                 # kills the gang, which the driver restarts.)
+                from arks_tpu.engine import faults as faults_mod
+                faults_mod.swallowed("follower_dispatch", e)
                 log.exception("dispatch op %r failed; awaiting reset", op)
 
     @staticmethod
@@ -413,6 +415,18 @@ class DispatchFollower:
                                 num_prompt=p.get("num_prompt", 0),
                                 guide=p.get("guide", -1),
                                 guide_row=p.get("guide_row", 0))
+        elif op == "recover":
+            # Leader entered fault recovery: log the surviving-request
+            # manifest (the streams about to be replayed through ordinary
+            # chunk/set_slot ops) and drop the threaded pipeline state —
+            # the next decode_pipe op after a recovery is always fresh.
+            self._pipe_state = None
+            self._pipe_cols = None
+            log.warning(
+                "leader fault recovery (phase=%s kind=%s): replaying %d "
+                "surviving request(s): %s", p.get("phase"), p.get("kind"),
+                len(p.get("manifest", ())),
+                [rid for rid, _, _ in p.get("manifest", ())])
         elif op == "clear_penalties":
             eng._sampling = eng._clear_pen_fn(
                 eng._sampling, jnp.asarray(p["slot"], jnp.int32))

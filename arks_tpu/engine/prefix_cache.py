@@ -120,6 +120,15 @@ class PrefixKVCache:
                 _, (kb, vb) = self._blocks.popitem(last=False)
                 self._bytes -= kb.nbytes + vb.nbytes
 
+    def clear(self) -> None:
+        """Drop every cached block (fault recovery's blanket fallback: a
+        fault storm that survives per-request quarantine may be poisoned
+        cached KV itself — the deep clean removes that possibility before
+        serving resumes)."""
+        with self._lock:
+            self._blocks.clear()
+            self._bytes = 0
+
     # -- stats ---------------------------------------------------------
 
     def record_query(self, num_tokens: int, hit: int) -> None:

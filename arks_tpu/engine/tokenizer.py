@@ -133,9 +133,11 @@ class HFTokenizer:
                 # tools natively.
                 return self._tok.apply_chat_template(
                     messages, tools=tools, add_generation_prompt=True)
-            except Exception:
+            except Exception as e:
                 # Template without tools support: declare them in a system
                 # message using the hermes convention the parser expects.
+                from arks_tpu.engine.faults import swallowed
+                swallowed("chat_template_tools", e)
                 from arks_tpu.server.tools import tools_system_text
                 messages = ([{"role": "system",
                               "content": tools_system_text(tools)}]

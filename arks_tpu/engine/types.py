@@ -79,6 +79,11 @@ class Request:
     # Disaggregated serving: KV produced by a prefill engine; when set, the
     # decode engine inserts it instead of running its own prefill.
     prefilled: PrefilledState | None = None
+    # Engine-assigned sampling seed (set once at first admission when
+    # params.seed is None).  Pinned on the REQUEST so fault recovery can
+    # re-admit/replay it with the identical key stream — a fresh counter
+    # draw on replay would silently change the resumed stream's tokens.
+    assigned_seed: int | None = None
 
 
 @dataclasses.dataclass

@@ -255,6 +255,9 @@ class Router:
             "router_requests_total", "Routed requests")
         self.backends_gauge = self.registry.gauge(
             "router_backends", "Known backends")
+        self.retries_total = self.registry.counter(
+            "router_retries_total",
+            "Requests retried on another backend (by reason)")
 
     # ------------------------------------------------------------------
 
@@ -335,7 +338,7 @@ class Router:
                 status = 503
                 return h._error(503, "no ready prefill/decode backends")
             p, d = self._pick(body, prefill, decode)
-            status = self._forward(h, body, p, d, started)
+            status = self._forward_failover(h, body, p, d, decode, started)
         except (BrokenPipeError, ConnectionResetError):
             status = 499
         except Exception as e:
@@ -362,8 +365,63 @@ class Router:
         n = next(self._rr)
         return prefill[n % len(prefill)], decode[n % len(decode)]
 
+    def _forward_failover(self, h, body: bytes, prefill_addr: str,
+                          decode_addr: str, decode: list[str],
+                          started: list[bool]) -> int:
+        """Backend failover: the picked decode backend first, then every
+        other ready one, retried for ONE bounded backoff round — a request
+        moves to the next backend on a connection error or a 503
+        (draining/recovering replica) IFF no response bytes have been
+        streamed to the client yet.  When every backend 503s, the largest
+        Retry-After the backends offered passes through so clients back
+        off the amount the slowest replica asked for."""
+        candidates = [decode_addr] + [b for b in decode if b != decode_addr]
+        backoff = float(os.environ.get("ARKS_ROUTER_RETRY_BACKOFF_S", "0.05"))
+        retry_after: str | None = None
+        last_err: Exception | None = None
+        for attempt in range(2):
+            if attempt:
+                time.sleep(backoff)  # one bounded backoff round, then give up
+            for cand in candidates:
+                try:
+                    status, ra = self._forward(h, body, prefill_addr, cand,
+                                               started)
+                except (OSError, http.client.HTTPException) as e:
+                    if started[0]:
+                        # Bytes already reached the client: a retry would
+                        # splice two streams — surface the truncation.
+                        raise
+                    last_err = e
+                    self.retries_total.inc(reason="connect_error")
+                    log.warning("decode backend %s unreachable (%s); "
+                                "trying next", cand, e)
+                    continue
+                if status is None:
+                    # 503 captured before any relay: replica draining or
+                    # recovering — another backend may accept.
+                    retry_after = ra or retry_after
+                    self.retries_total.inc(reason="backend_503")
+                    continue
+                return status
+        data = json.dumps({"error": {
+            "message": ("no decode backend accepted the request"
+                        + (f" (last error: {last_err})" if last_err else "")),
+            "code": 503}}).encode()
+        h.send_response(503)
+        if retry_after:
+            h.send_header("Retry-After", retry_after)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        h.wfile.write(data)
+        return 503
+
     def _forward(self, h, body: bytes, prefill_addr: str, decode_addr: str,
-                 started: list[bool]) -> int:
+                 started: list[bool]) -> tuple[int | None, str | None]:
+        """Forward to one decode backend.  Returns (status, None) after
+        relaying, or (None, retry_after) for a 503 swallowed BEFORE any
+        byte reached the client (the failover input).  Raises OSError /
+        http.client.HTTPException on connection failure."""
         path = "/v1/disagg" + h.path[len("/v1"):]
         host, _, port = decode_addr.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
@@ -373,6 +431,9 @@ class Router:
                 HDR_PREFILL_ADDR: prefill_addr,
             })
             resp = conn.getresponse()
+            if resp.status == 503:
+                resp.read()  # drain for keep-alive hygiene
+                return None, resp.headers.get("Retry-After")
             started[0] = True
             h.send_response(resp.status)
             ctype = resp.headers.get("Content-Type", "application/json")
@@ -394,6 +455,6 @@ class Router:
                     h.wfile.flush()
                 h.wfile.write(b"0\r\n\r\n")
                 h.wfile.flush()
-            return resp.status
+            return resp.status, None
         finally:
             conn.close()

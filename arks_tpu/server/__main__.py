@@ -89,6 +89,17 @@ def main() -> None:
                         "become request-lossless when it covers the longest "
                         "request; launchers set the ARKS_DRAIN_TIMEOUT env "
                         "default to fit their own kill escalation windows)")
+    p.add_argument("--dispatch-deadline", type=float, default=None,
+                   help="watchdog deadline in seconds for a wedged device "
+                        "dispatch: past it the engine flips readiness, "
+                        "dumps in-flight diagnostics, and exits 70 so the "
+                        "pod restarts (sets ARKS_DISPATCH_DEADLINE_S; "
+                        "0/unset disables; must exceed the worst in-step "
+                        "jit compile — see docs/runbook.md)")
+    p.add_argument("--fault-retries", type=int, default=None,
+                   help="per-request fault retry budget before a culprit "
+                        "request fails alone with an engine_fault 500 "
+                        "(sets ARKS_FAULT_RETRIES; default 1)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, help="force a jax platform (cpu for tests)")
     p.add_argument("--disaggregation-mode", choices=("prefill", "decode"),
@@ -99,6 +110,13 @@ def main() -> None:
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    # Fault-tolerance knobs travel by env (the engine and its watchdog
+    # read them at start); explicit flags win over inherited env.
+    if args.dispatch_deadline is not None:
+        os.environ["ARKS_DISPATCH_DEADLINE_S"] = str(args.dispatch_deadline)
+    if args.fault_retries is not None:
+        os.environ["ARKS_FAULT_RETRIES"] = str(args.fault_retries)
 
     import jax
     if args.platform:

@@ -266,6 +266,13 @@ class OpenAIServer:
                         self._error(503, "draining")
                     elif not server._ready.is_set():
                         self._error(503, "not ready")
+                    elif getattr(server.engine, "state",
+                                 "serving") != "serving":
+                        # Fault recovery in progress ("recovering") or a
+                        # wedged dispatch awaiting the watchdog's exit
+                        # ("wedged"): pull this backend from Service
+                        # endpoints; in-flight streams keep draining.
+                        self._error(503, server.engine.state)
                     else:
                         # Worker-wedge gate: a follower that is alive but
                         # hung (SIGSTOP, OOM-thrash) stops heartbeating on
@@ -490,6 +497,24 @@ class OpenAIServer:
             "code": "context_length_exceeded",
         }})
 
+    def _request_error(self, h, fin) -> None:
+        """Map a finish_reason="error" engine output to HTTP.  Client-
+        caused rejections (context length, bad guide) stay 400s; a request
+        quarantined by fault recovery (error "engine_fault: ...") is the
+        SERVER's failure — OpenAI-style 500 so clients and the gateway
+        retry/alert correctly instead of blaming the request."""
+        if fin.error == "context_length_exceeded":
+            return self._context_length_error(
+                h, fin.num_prompt_tokens, self.engine.max_prompt_len)
+        if fin.error and fin.error.startswith("engine_fault"):
+            return h._json(500, {"error": {
+                "message": ("The server had an error while processing "
+                            f"your request ({fin.error})."),
+                "type": "server_error",
+                "code": "engine_fault",
+            }})
+        return h._error(400, fin.error or "request rejected")
+
     def _respond(self, h, req: Request, chat: bool, model: str, body: dict,
                  stop_strings: list[str], echo: bool = False,
                  tools_ctx: str | None = None) -> None:
@@ -503,10 +528,7 @@ class OpenAIServer:
             # not a text/event-stream carrying finish_reason "error".
             first = req.outputs.get()
             if first.finished and first.finish_reason == "error":
-                if first.error == "context_length_exceeded":
-                    return self._context_length_error(
-                        h, first.num_prompt_tokens, self.engine.max_prompt_len)
-                return h._error(400, first.error or "request rejected")
+                return self._request_error(h, first)
             include_usage = bool(
                 (body.get("stream_options") or {}).get("include_usage"))
             if tools_ctx is not None and chat:
@@ -669,10 +691,7 @@ class OpenAIServer:
                 # siblings' slots instead of decoding for nobody.
                 for r in reqs:
                     self.engine.abort(r.request_id)
-                if fin.error == "context_length_exceeded":
-                    return self._context_length_error(
-                        h, fin.num_prompt_tokens, self.engine.max_prompt_len)
-                return h._error(400, fin.error or "request rejected")
+                return self._request_error(h, fin)
             if chat:
                 message, finish_reason = self._chat_message(
                     text, finish_reason, tools_ctx)
@@ -737,11 +756,9 @@ class OpenAIServer:
             text = echo_prefix + text
         if finish_reason == "error":
             # Engine-level rejection (defense for direct add_request users;
-            # the HTTP path normally pre-checks).
-            if fin.error == "context_length_exceeded":
-                return self._context_length_error(
-                    h, fin.num_prompt_tokens, self.engine.max_prompt_len)
-            return h._error(400, fin.error or "request rejected")
+            # the HTTP path normally pre-checks) or a fault-quarantined
+            # request (engine_fault -> 500).
+            return self._request_error(h, fin)
         usage = {
             "prompt_tokens": fin.num_prompt_tokens,
             "completion_tokens": fin.num_generated_tokens,

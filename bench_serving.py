@@ -272,6 +272,70 @@ def _run_moderate_phase(port: int, slots: int, seconds: float,
     }
 
 
+def _measure_recovery(engine, port: int) -> dict:
+    """Fault-recovery probe: with a few live streams decoding, arm a
+    one-shot injected decode fault (the engine's ARKS_FAULT_INJECT
+    machinery, armed programmatically) and measure the fault-to-resumed
+    window the engine reports (engine_recovery_seconds) plus client-side
+    stream integrity — every stream must still finish completely."""
+    import json as _json
+    import threading as _threading
+    import urllib.request as _urllib
+
+    n = int(os.environ.get("ARKS_BENCH_RECOVERY_STREAMS", "4"))
+    max_toks = int(os.environ.get("ARKS_BENCH_RECOVERY_MAX_TOKENS", "64"))
+    results: list = []
+
+    def stream(i: int) -> None:
+        body = _json.dumps({
+            "model": "bench", "prompt": [3 + i] * 16,
+            "max_tokens": max_toks, "temperature": 0.0,
+            "ignore_eos": True, "stream": True}).encode()
+        req = _urllib.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            finish = None
+            with _urllib.urlopen(req, timeout=600) as r:
+                for raw in r:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or line.endswith("[DONE]"):
+                        continue
+                    p = _json.loads(line[len("data: "):])
+                    for c in p.get("choices", []):
+                        finish = c.get("finish_reason") or finish
+            results.append(finish)
+        except Exception as e:  # recorded; the probe reports it
+            results.append(f"{type(e).__name__}: {e}")
+
+    threads = [_threading.Thread(target=stream, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 60
+    while engine.num_running < n and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # Kill the next decode dispatch; the engine quarantines nobody (first
+    # fault, default retry budget) and token-replays every stream.
+    engine._faults.arm("decode:1:runtime")
+    for t in threads:
+        t.join(timeout=600)
+    hist = engine.metrics.engine_recovery_seconds
+    with hist._lock:
+        data = dict(hist._data)
+    _counts, total, cnt = data.get((), ([], 0.0, 0))
+    recovered = sum(
+        engine.metrics.requests_recovered_total._values.values())
+    return {
+        "recovery_seconds": round(total / cnt, 4) if cnt else None,
+        "recovery_events": cnt,
+        "recovery_requests_recovered": int(recovered),
+        "recovery_streams_completed": sum(1 for f in results
+                                          if f == "length"),
+        "recovery_streams_total": n,
+    }
+
+
 def run_serving_bench(model: str | None = None) -> dict:
     """Build the production engine+server, run the load, return results.
     Importable so bench.py can fold the numbers into its JSON line."""
@@ -427,6 +491,19 @@ def run_serving_bench(model: str | None = None) -> dict:
                 import traceback
                 traceback.print_exc()
                 moderate = {"serving_moderate_error": f"{type(e).__name__}: {e}"}
+        # Third phase: fault-recovery probe (ARKS_BENCH_RECOVERY=0 skips).
+        # Failure-isolated like the moderate phase.
+        if os.environ.get("ARKS_BENCH_RECOVERY", "1") != "0":
+            try:
+                rec = _measure_recovery(engine, server.port)
+                moderate = {**(moderate or {}), **rec}
+                print(f"# recovery probe: {rec}", file=sys.stderr,
+                      flush=True)
+            except Exception as e:
+                import traceback
+                traceback.print_exc()
+                moderate = {**(moderate or {}),
+                            "recovery_error": f"{type(e).__name__}: {e}"}
     finally:
         if proc.poll() is None:
             proc.kill()

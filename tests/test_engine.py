@@ -464,3 +464,22 @@ def test_priority_admission_order():
             break
     # One slot: completions happen in admission order.
     assert order == ["high", "low-1", "low-2"]
+
+
+def test_abort_after_finish_does_not_linger(engine):
+    """An abort that loses the race with _finish (or targets a request id
+    that never existed) must not sit in the abort set forever — the idle
+    scheduler purges it (regression: the purge used to run only while
+    slots existed, so an idle engine leaked every late abort)."""
+    req = Request("late-abort", [5, 6], SamplingParams(
+        max_tokens=2, temperature=0.0, ignore_eos=True))
+    engine.add_request(req)
+    _drive(engine)
+    _, out = _collect(req)
+    assert out.finish_reason == "length"
+    engine.abort("late-abort")      # after _finish: nothing to abort
+    engine.abort("never-existed")   # garbage id
+    for _ in range(5):
+        engine.step(block_s=0.01)   # idle steps run the purge
+    with engine._abort_lock:
+        assert not engine._aborted, "stale abort ids leaked"

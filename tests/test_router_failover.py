@@ -1,0 +1,170 @@
+"""Router failover: a request moves to the next ready decode backend on
+connection error or 503 — iff no response bytes have been streamed yet —
+with one bounded backoff round and Retry-After passthrough."""
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from arks_tpu.router import Discovery, Router
+
+
+class _FakeBackend:
+    """A scriptable decode backend: each element of ``script`` handles one
+    request — "ok", "503", or ("503", retry_after).  Past the script's
+    end the last entry repeats."""
+
+    def __init__(self, script):
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                i = min(backend.calls, len(backend.script) - 1)
+                backend.calls += 1
+                action = backend.script[i]
+                retry_after = None
+                if isinstance(action, tuple):
+                    action, retry_after = action
+                if action == "503":
+                    data = b'{"error":{"message":"draining","code":503}}'
+                    self.send_response(503)
+                    if retry_after is not None:
+                        self.send_header("Retry-After", str(retry_after))
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                data = json.dumps({
+                    "id": "ok", "object": "text_completion",
+                    "served_by": backend.name, "choices": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.script = script
+        self.calls = 0
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.addr = f"127.0.0.1:{self._httpd.server_port}"
+        self.name = self.addr
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+def _free_port_addr() -> str:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def _mk_router(monkeypatch, decode_addrs, prefill_addr="127.0.0.1:1"):
+    monkeypatch.setenv("ARKS_PREFILL_ADDRS", prefill_addr)
+    monkeypatch.setenv("ARKS_DECODE_ADDRS", ",".join(decode_addrs))
+    monkeypatch.setenv("ARKS_ROUTER_RETRY_BACKOFF_S", "0.01")
+    router = Router(Discovery(None), "tiny", host="127.0.0.1", port=0,
+                    policy="round_robin")
+    router.start(background=True)
+    return router
+
+
+def _post(router, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/v1/completions",
+        data=json.dumps(body or {"model": "tiny", "prompt": "x"}).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_failover_on_503_to_next_backend(monkeypatch):
+    bad = _FakeBackend(["503"])
+    good = _FakeBackend(["ok"])
+    router = _mk_router(monkeypatch, [bad.addr, good.addr])
+    try:
+        with _post(router) as r:
+            out = json.load(r)
+        assert out["served_by"] == good.addr
+        assert bad.calls == 1 and good.calls == 1
+        assert router.retries_total.get(reason="backend_503") >= 1
+    finally:
+        router.stop()
+        bad.stop()
+        good.stop()
+
+
+def test_failover_on_connection_error(monkeypatch):
+    dead = _free_port_addr()  # nothing listening: connection refused
+    good = _FakeBackend(["ok"])
+    router = _mk_router(monkeypatch, [dead, good.addr])
+    try:
+        with _post(router) as r:
+            out = json.load(r)
+        assert out["served_by"] == good.addr
+        assert router.retries_total.get(reason="connect_error") >= 1
+    finally:
+        router.stop()
+        good.stop()
+
+
+def test_flapping_backend_recovers_on_backoff_round(monkeypatch):
+    """Every backend 503s on the first pass; one comes back on the single
+    bounded backoff round — the request still succeeds."""
+    flapper = _FakeBackend(["503", "ok"])
+    router = _mk_router(monkeypatch, [flapper.addr])
+    try:
+        with _post(router) as r:
+            out = json.load(r)
+        assert out["served_by"] == flapper.addr
+        assert flapper.calls == 2
+    finally:
+        router.stop()
+        flapper.stop()
+
+
+def test_all_backends_503_passes_retry_after_through(monkeypatch):
+    a = _FakeBackend([("503", 7)])
+    b = _FakeBackend([("503", 31)])
+    router = _mk_router(monkeypatch, [a.addr, b.addr])
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router)
+        assert ei.value.code == 503
+        # Passthrough from a backend (either one's value is legitimate —
+        # the router keeps the last seen).
+        assert ei.value.headers.get("Retry-After") in ("7", "31")
+        # Both backends were tried in both rounds: 2 backends x 2 rounds.
+        assert a.calls == 2 and b.calls == 2
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_no_backends_still_503s(monkeypatch):
+    monkeypatch.setenv("ARKS_PREFILL_ADDRS", "")
+    monkeypatch.setenv("ARKS_DECODE_ADDRS", "")
+    router = Router(Discovery(None), "tiny", host="127.0.0.1", port=0)
+    router.start(background=True)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router)
+        assert ei.value.code == 503
+    finally:
+        router.stop()

@@ -249,10 +249,12 @@ def test_stream_batch_prompt_400(server):
         assert e.code == 400
 
 
-def test_sigterm_drains_in_flight_requests():
-    """Graceful drain: SIGTERM mid-request flips readiness to 503, rejects
-    NEW completions, lets the in-flight streamed request finish, and the
-    process exits cleanly — what makes rolling updates request-lossless."""
+def _run_drain_scenario(extra_env=None):
+    """Shared SIGTERM-drain scenario: start a serving subprocess, stream a
+    long request, SIGTERM mid-stream, assert readiness/admission 503
+    during the drain, the in-flight stream finishes to its LAST byte, and
+    the process exits 0.  ``extra_env`` overrides engine env knobs (the
+    pipelined-decode variant rides this)."""
     import json as _json
     import os
     import signal
@@ -268,12 +270,14 @@ def test_sigterm_drains_in_flight_requests():
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
+    env = dict(os.environ)
+    env.update(extra_env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "arks_tpu.server",
          "--model", "tiny", "--port", str(port), "--platform", "cpu",
          "--num-slots", "2", "--max-model-len", "64",
          "--steps-per-dispatch", "1", "--drain-timeout", "30"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
     base = f"http://127.0.0.1:{port}"
     try:
         for _ in range(120):
@@ -330,7 +334,8 @@ def test_sigterm_drains_in_flight_requests():
         except urllib.error.HTTPError as e:
             assert e.code == 503
 
-        # The in-flight stream finishes completely and the process exits 0.
+        # The in-flight stream finishes COMPLETELY (to its last byte: the
+        # finish frame carries finish_reason) and the process exits 0.
         t.join(timeout=120)
         assert not err, f"in-flight stream died during drain: {err}"
         assert frames[-1] == "[DONE]"
@@ -338,11 +343,30 @@ def test_sigterm_drains_in_flight_requests():
         text = "".join(c["text"] for p in payloads
                        for c in p.get("choices", []) if "text" in c)
         assert len(text) > 0
+        finishes = [c["finish_reason"] for p in payloads
+                    for c in p.get("choices", []) if c.get("finish_reason")]
+        assert finishes == ["length"], finishes
         assert proc.wait(timeout=60) == 0
     finally:
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_sigterm_drains_in_flight_requests():
+    """Graceful drain: SIGTERM mid-request flips readiness to 503, rejects
+    NEW completions, lets the in-flight streamed request finish, and the
+    process exits cleanly — what makes rolling updates request-lossless."""
+    _run_drain_scenario()
+
+
+def test_sigterm_drains_under_pipelined_decode():
+    """The same drain contract with ARKS_PIPELINE_DEPTH=2: SIGTERM with
+    pipelined dispatches in flight must flip readiness, resolve/drain the
+    in-flight pipeline, finish every live stream to its last byte, and
+    exit within --drain-timeout.  (The conftest pins depth 0 for the
+    suite; this subprocess re-enables the production default.)"""
+    _run_drain_scenario({"ARKS_PIPELINE_DEPTH": "2"})
 
 
 def test_logprobs_completions_and_chat(server):
