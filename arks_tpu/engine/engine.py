@@ -241,6 +241,23 @@ class _ChunkState:
 
 
 @dataclasses.dataclass
+class _RestoreState:
+    """A tier-1 (host-RAM) prefix restore in flight: the request parks
+    here (mirroring the guide_wait park) while its H2D scatter dispatch
+    rides the device stream behind the pipelined decode; once the marker
+    resolves, only the un-hit prompt tail goes through chunked prefill."""
+
+    request: Request
+    ids: list[int]
+    digests: list        # full prompt digest chain (computed at match)
+    shared: list[int]    # tier-0 device pages (caller refs held by us)
+    pages: list[int]     # freshly-allocated pages the scatter writes
+    marker: object       # device scalar from the last scatter dispatch
+    seed: int
+    t0: float
+
+
+@dataclasses.dataclass
 class _Survivor:
     """An in-flight request's replayable state, snapshotted at a step
     fault (engine._recover_from_fault).  ``generated`` empty = the request
@@ -372,9 +389,26 @@ class EngineMetrics:
             "prefix_cache_hit_tokens_total",
             "Prompt tokens served from the prefix cache")
         self.prefix_cache_usage_bytes = r.gauge(
-            "prefix_cache_usage_bytes", "Host bytes held by the prefix cache")
+            "prefix_cache_usage_bytes",
+            "Bytes held by the prefix cache, by tier (device = retained "
+            "pool pages, host = host-RAM blocks)")
         self.prefix_cache_hit_rate = r.gauge(
             "prefix_cache_hit_rate", "Lifetime prefix-cache token hit rate")
+        # Hierarchical prefix cache (paged engines): tier 0 is the
+        # allocator's on-device page index, tier 1 the host-RAM spill
+        # store — the families that make HBM-pressure thrash (spill storm)
+        # and restore latency visible on a dashboard.
+        self.prefix_spill_blocks_total = r.counter(
+            "prefix_spill_blocks_total",
+            "KV pages spilled from the device prefix index to the host tier")
+        self.prefix_restore_blocks_total = r.counter(
+            "prefix_restore_blocks_total",
+            "KV pages restored from the host tier into fresh pool pages")
+        self.prefix_restore_seconds = r.histogram(
+            "prefix_restore_seconds",
+            "Host-tier restore latency (scatter issue -> request unparked)",
+            buckets=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1, 2.5])
         self.guided_requests_total = r.counter(
             "guided_requests_total",
             "Admitted guided-decoding requests by guide kind")
@@ -712,6 +746,42 @@ class InferenceEngine:
             self._prefix = PrefixKVCache(
                 self._chunk, engine_cfg.prefix_cache_mb * 2**20)
 
+        # ---- Host-RAM spill tier behind the paged prefix index ---------
+        # Tier 0 = the allocator's on-device page index (zero-copy hits);
+        # tier 1 = HostPrefixTier, fed by ASYNC spills of pages the index
+        # evicts under pool pressure (the pool used to DESTROY them) and
+        # consulted at admission: a tier-1 hit restores the blocks with
+        # one H2D scatter dispatch instead of re-prefilling them, while
+        # the request parks in awaiting_restore.  Host RAM is 10-100x
+        # HBM, so the shared-prefix working set a production fleet sees
+        # (system prompts, few-shot preambles, multi-turn histories)
+        # survives far beyond the pool's retention surplus.
+        from collections import deque as _deque
+        self._host = None
+        self._spill_victims: list = []      # (digest, page) since last flush
+        self._spills: "_deque" = _deque()   # in-flight D2H spill records
+        self._awaiting_restore: list[_RestoreState] = []
+        _hmb = os.environ.get("ARKS_PREFIX_HOST_MB", "256")
+        try:
+            host_mb = int(_hmb)
+        except ValueError:
+            raise ValueError(
+                f"ARKS_PREFIX_HOST_MB={_hmb!r}: expected an integer >= 0")
+        if host_mb < 0:
+            raise ValueError(
+                f"ARKS_PREFIX_HOST_MB={host_mb}: must be >= 0")
+        self._host_mb = host_mb if (self._paged and self._chunk
+                                    and host_mb) else 0
+        if self._host_mb:
+            from arks_tpu.engine.prefix_cache import HostPrefixTier
+            self._host = HostPrefixTier(self._page_size(),
+                                        self._host_mb * 2**20)
+            self._alloc.on_evict = self._note_evicted
+        # Fixed spill/restore group sizes: each is ONE compiled program
+        # shape (short groups pad), keeping the variant budget flat.
+        self._spill_group = min(8, max(self._max_pages, 1))
+        self._restore_group = min(8, max(self._max_pages, 1))
+
         # Speculative decoding: draft model params + its own slot cache.
         self._draft_cfg = None
         self._draft_params = None
@@ -913,6 +983,7 @@ class InferenceEngine:
             "model": self.ecfg.model,
             "mixed_step": str(bool(self._mixed)).lower(),
             "pipeline_depth": str(self._pipe_depth),
+            "prefix_host_mb": str(self._host_mb),
         }
         self.metrics.engine_config_info.set(1, **self.resolved_config)
         log.info("engine resolved config: %s",
@@ -1055,6 +1126,19 @@ class InferenceEngine:
         if self._paged:
             self._insert_pages_fn = jax.jit(tf.insert_pages,
                                             donate_argnums=(0,))
+            # Host-tier spill/restore: gather evicted pages into a D2H
+            # staging block; scatter host blocks back into fresh pool
+            # pages.  The restore returns a marker READ FROM the written
+            # pool, so marker.is_ready() == "the scatter landed" (a
+            # passed-through input would alias and read ready instantly).
+            self._spill_gather_fn = jax.jit(tf.gather_pool_pages)
+
+            def restore_scatter(cache, kb, vb, ksb, vsb, pages, n_valid):
+                cache = tf.scatter_pool_pages(cache, kb, vb, pages, n_valid,
+                                              k_scale=ksb, v_scale=vsb)
+                return cache, cache.k[0, 0, 0, 0, 0]
+
+            self._restore_fn = jax.jit(restore_scatter, donate_argnums=(0,))
 
         def sample_one(logits, temperature, top_p, top_k, key,
                        bias_ids, bias_vals, sup_ids, min_first,
@@ -1527,7 +1611,8 @@ class InferenceEngine:
         the drain gate (servers must not poke at privates)."""
         return (not self._slots and self._queue.empty()
                 and not self._prefilling and not self._pending_admits
-                and not self._awaiting_guide)
+                and not self._awaiting_guide
+                and not self._awaiting_restore)
 
     # ------------------------------------------------------------------
     # Scheduler loop
@@ -1596,6 +1681,9 @@ class InferenceEngine:
                 new = self._alloc.alloc(need - len(row))
                 self._tables[slot, len(row): len(row) + len(new)] = new
                 row.extend(new)
+        # Any eviction the allocations caused must spill BEFORE the
+        # caller's dispatch can write the recycled pages (stream order).
+        self._spill_flush()
 
     def _resolve_kv_layout(self) -> bool:
         layout = self.ecfg.kv_layout
@@ -1698,6 +1786,7 @@ class InferenceEngine:
             # _pending_admits/_pending_n/_free.
             self._abort_pending_admits()
             self._abort_awaiting_guide()
+            self._abort_awaiting_restores()
 
     def _run_loop(self) -> None:
         while self._running:
@@ -1785,6 +1874,15 @@ class InferenceEngine:
                 survivors.append(_Survivor(
                     request=req, seed=self._resolve_seed(req),
                     num_prompt=len(ids)))
+        for rst in self._awaiting_restore:
+            # Restore-parked requests emitted nothing: plain re-queue.
+            # The host tier SURVIVES the device reset, so the re-run's
+            # admission hits tier 1 again instead of re-prefilling.
+            self.metrics.num_requests_waiting.inc(-1)
+            survivors.append(_Survivor(
+                request=rst.request, seed=rst.seed,
+                num_prompt=len(rst.ids)))
+        self._awaiting_restore = []
         self._slots.clear()
         self._prefilling.clear()
         self._pending_admits.clear()
@@ -1901,6 +1999,7 @@ class InferenceEngine:
         active |= {req.request_id for rec in self._pending_admits
                    for req, _, _ in rec[0]}
         active |= {req.request_id for req, _ in self._awaiting_guide}
+        active |= {rec.request.request_id for rec in self._awaiting_restore}
         with self._abort_lock:
             self._aborted -= set(consumed)
             self._aborted &= active | self._queued_rids
@@ -1948,6 +2047,8 @@ class InferenceEngine:
             live |= {req.request_id for rec in self._pending_admits
                      for req, _, _ in rec[0]}
             live |= {req.request_id for req, _ in self._awaiting_guide}
+            live |= {rec.request.request_id
+                     for rec in self._awaiting_restore}
             with self._abort_lock:
                 live |= self._queued_rids
             self._replaying &= live
@@ -1971,9 +2072,15 @@ class InferenceEngine:
                 num_prompt_tokens=len(st.ids)))
         self._prefilling.clear()
         self._abort_pending_admits()
+        self._abort_awaiting_restores()
         if self._prefix is not None:
             # Deep clean: cached prefix KV may itself be the poison.
             self._prefix.clear()
+        if self._host is not None:
+            # Same deep clean for the host tier: spilled blocks may carry
+            # the poisoned KV back on the next restore.
+            self._host.clear()
+            self.metrics.prefix_cache_usage_bytes.set(0, tier="host")
         self._fault_counts.clear()
         self._consec_faults = 0
         self._reset_device_state()
@@ -1984,6 +2091,12 @@ class InferenceEngine:
         # buffers; drop them rather than resolve (their requests were
         # already aborted by the fault path).
         self._pipe_reset()
+        # In-flight spill gathers may share the fault's poisoned stream;
+        # drop them (losing a spill costs one future re-prefill).  The
+        # host tier itself SURVIVES the reset — that is the "warm across
+        # restarts" property the tier exists for.
+        self._spill_victims.clear()
+        self._spills.clear()
         # Followers rebuild too (their _run path never sees the exception).
         if self.dispatcher is not None:
             self._emit("reset")
@@ -1998,6 +2111,8 @@ class InferenceEngine:
             if self.mesh is not None:
                 self._cache = self._shard_paged(self._cache)
             self._alloc = PageAllocator(self._alloc.num_pages, page)
+            if self._host is not None:
+                self._alloc.on_evict = self._note_evicted
             self._tables[:] = 0
             self._slot_pages.clear()
         else:
@@ -2078,6 +2193,17 @@ class InferenceEngine:
             td = time.monotonic()
             self.metrics.scheduler_seconds_total.inc(td - t0, phase="decode")
             t0 = td
+        if self._awaiting_restore:
+            # Host-tier restores whose scatter landed unpark into the
+            # chunked-tail path (needs authoritative mirrors — the
+            # pipeline drained above); in-flight ones stay parked.
+            worked = self._resolve_restores() or worked
+            tr = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(tr - t0,
+                                                     phase="restore")
+            t0 = tr
+        if self._spills:
+            worked = self._resolve_spills() or worked
         pending = None
         issued = False
         if self._mixed:
@@ -2141,6 +2267,12 @@ class InferenceEngine:
             worked = self._drain_ready_admits(force_one=not worked) or worked
             self.metrics.scheduler_seconds_total.inc(
                 time.monotonic() - t4, phase="admit")
+        if not worked and (self._awaiting_restore or self._spills):
+            # Parked restores / in-flight spills resolve on DEVICE time,
+            # not queue arrivals: poll again shortly instead of blocking
+            # on the admission queue for block_s.
+            time.sleep(0.001)
+            return True
         if not worked:
             # Idle housekeeping: an abort that raced _finish (or targeted
             # a request that never existed) must not linger in the set
@@ -2382,10 +2514,25 @@ class InferenceEngine:
             digests = chain_digests(ids, page, nfull) if nfull else []
             shared = self._alloc.match(digests)
             plen = len(shared) * page
-            self._alloc.record_query(len(ids), plen)
+            # Tier 1: blocks beyond the device hit that survive in host
+            # RAM (spilled on eviction, or published by a disagg prefill
+            # peer) — restored asynchronously instead of re-prefilled.
+            host_blocks: list = []
+            if self._host_tier_on() and len(shared) < nfull:
+                host_blocks = self._host.match_blocks(digests, len(shared))
+            hlen = len(host_blocks) * page
+            self._alloc.record_query(len(ids), plen + hlen)
             self.metrics.prefix_cache_query_tokens_total.inc(len(ids))
-            self.metrics.prefix_cache_hit_tokens_total.inc(plen)
+            if plen:
+                self.metrics.prefix_cache_hit_tokens_total.inc(
+                    plen, tier="device")
+            if hlen:
+                self.metrics.prefix_cache_hit_tokens_total.inc(
+                    hlen, tier="host")
             self.metrics.prefix_cache_hit_rate.set(self._alloc.hit_rate)
+            if host_blocks:
+                return self._issue_restore(req, ids, digests, shared,
+                                           host_blocks)
             if plen:
                 return self._start_chunked(req, ids, prefix_len=plen,
                                            prefix_pages=shared,
@@ -2395,7 +2542,7 @@ class InferenceEngine:
                        (len(ids) - 1) // self._chunk * self._chunk)
             self._prefix.record_query(len(ids), plen)
             self.metrics.prefix_cache_query_tokens_total.inc(len(ids))
-            self.metrics.prefix_cache_hit_tokens_total.inc(plen)
+            self.metrics.prefix_cache_hit_tokens_total.inc(plen, tier="host")
             self.metrics.prefix_cache_hit_rate.set(self._prefix.hit_rate)
             if plen:
                 return self._start_chunked(req, ids, prefix_len=plen)
@@ -2597,7 +2744,7 @@ class InferenceEngine:
                     self._prefix.put(ids, np.asarray(ks[:, :, :nfull]),
                                      np.asarray(vs[:, :, :nfull]), nfull)
                     self.metrics.prefix_cache_usage_bytes.set(
-                        self._prefix.bytes_used)
+                        self._prefix.bytes_used, tier="host")
 
     def _assign_slot_pages(self, slot: int, total: int,
                            head_pages=()) -> np.ndarray:
@@ -2610,6 +2757,9 @@ class InferenceEngine:
         row = np.zeros((self._max_pages,), np.int32)
         row[: len(pages)] = pages
         self._tables[slot] = row
+        # Evictions the alloc caused spill before the caller's dispatch
+        # can write the recycled pages (stream order).
+        self._spill_flush()
         return row
 
     def _register_prompt_pages(self, ids, pages, digests=None) -> None:
@@ -2621,7 +2771,250 @@ class InferenceEngine:
                 digests = chain_digests(ids, page, nreg)
             self._alloc.register(digests[:nreg], pages[:nreg])
             self.metrics.prefix_cache_usage_bytes.set(
-                self._alloc.retained_pages * self._page_bytes)
+                self._alloc.retained_pages * self._page_bytes, tier="device")
+
+    # ------------------------------------------------------------------
+    # Hierarchical prefix cache: host-RAM spill tier (tier 1)
+    # ------------------------------------------------------------------
+
+    def _host_tier_on(self) -> bool:
+        """Tier 1 active: paged+chunk engine with an ARKS_PREFIX_HOST_MB
+        budget on a SINGLE host.  Followers would need the spill/restore
+        dispatches mirrored for no benefit — the blocks are host-side
+        state only the leader consults — so a dispatcher turns it off
+        (same restriction as the legacy slot-layout host cache)."""
+        return self._host is not None and self.dispatcher is None
+
+    def _note_evicted(self, digest: bytes, page: int) -> None:
+        """PageAllocator.on_evict hook: queue the victim for an async D2H
+        spill.  Runs mid-alloc on the engine thread — bookkeeping only;
+        _spill_flush issues the gather before any dispatch can reuse the
+        page."""
+        self._spill_victims.append((digest, page))
+
+    def _spill_flush(self) -> None:
+        """Issue spill gathers for every page evicted since the last
+        flush: gather the victim pages into a device staging block and
+        start the D2H drain (copy_to_host_async) — the engine thread
+        never waits; _resolve_spills harvests the bytes one lagged step
+        later.  MUST run after the evicting alloc and before the next
+        dispatch that could write the recycled pages: both order on the
+        device stream, so the gather reads the pre-overwrite bytes."""
+        if not self._spill_victims:
+            return
+        victims, self._spill_victims = self._spill_victims, []
+        if not self._host_tier_on():
+            return
+        victims = [(d, p) for d, p in victims if not self._host.has(d)]
+        G = self._spill_group
+        for i in range(0, len(victims), G):
+            grp = victims[i: i + G]
+            self._faults.fire("spill")
+            # Short groups pad by repeating a real page (one compiled
+            # shape); the host side drops the padded entries.
+            pages = [p for _, p in grp] + [grp[0][1]] * (G - len(grp))
+            out = self._spill_gather_fn(self._cache,
+                                        jnp.asarray(pages, jnp.int32))
+            for arr in out:
+                if arr is None:
+                    continue
+                try:
+                    arr.copy_to_host_async()
+                except Exception as e:  # platform without async host copies
+                    faults_mod.swallowed("copy_to_host_async", e)
+            self._spills.append(([d for d, _ in grp], out))
+
+    @staticmethod
+    def _dev_ready(arr) -> bool:
+        try:
+            return arr.is_ready()
+        except AttributeError:  # platform without readiness polling
+            return True
+
+    def _resolve_spills(self, force: bool = False) -> bool:
+        """Harvest completed spill gathers into the host tier (FIFO;
+        non-blocking unless forced).  Spills are best-effort cache
+        warmth: a failed gather is dropped via the fault API, never
+        escalated — losing a spill costs one future re-prefill, while
+        faulting the engine for it would cost every in-flight stream a
+        recovery round."""
+        did = False
+        while self._spills:
+            digests, out = self._spills[0]
+            if not force and not self._dev_ready(out[0]):
+                break
+            self._spills.popleft()
+            did = True
+            try:
+                k, v, ks, vs = [None if a is None else np.asarray(a)
+                                for a in out]
+            except Exception as e:
+                faults_mod.swallowed("spill_resolve", e)
+                continue
+            stored = 0
+            for j, d in enumerate(digests):
+                # Contiguous copies: a view would pin the whole staging
+                # block in host RAM for the lifetime of one page entry.
+                blk = {"k": np.ascontiguousarray(k[:, j]),
+                       "v": np.ascontiguousarray(v[:, j])}
+                if ks is not None:
+                    blk["k_scale"] = np.ascontiguousarray(ks[:, j])
+                    blk["v_scale"] = np.ascontiguousarray(vs[:, j])
+                if self._host.put(d, blk):
+                    stored += 1
+            if stored:
+                self.metrics.prefix_spill_blocks_total.inc(stored)
+            self.metrics.prefix_cache_usage_bytes.set(
+                self._host.bytes_used, tier="host")
+        return did
+
+    def _issue_restore(self, req: Request, ids: list[int], digests: list,
+                       shared: list[int], blocks: list) -> None:
+        """Tier-1 hit at admission: allocate fresh pool pages for the
+        host blocks and issue the H2D scatter-into-pool dispatch(es)
+        ASYNCHRONOUSLY — just another dispatch on the stream, so decode
+        pipelining keeps its full depth while the restore is in flight.
+        The request parks in awaiting_restore (mirroring the guide_wait
+        park); _resolve_restores unparks it into the ordinary
+        chunked-tail path once the marker lands."""
+        seed = self._resolve_seed(req)
+        try:
+            self._faults.fire("restore")
+            pages = self._alloc.alloc(len(blocks))
+            # The alloc may have evicted tier-0 pages; their spill
+            # gathers must precede our scatter (which may write those
+            # very pages).
+            self._spill_flush()
+            marker = None
+            G = self._restore_group
+            for i in range(0, len(blocks), G):
+                marker = self._dispatch_restore_group(
+                    blocks[i: i + G], pages[i: i + G], G)
+        except Exception as e:
+            # Page/alloc state is rebuilt wholesale by the recovery
+            # reset; the survivor re-queues with its pinned seed and
+            # retries admission (the host tier survives the reset, so
+            # the retry hits tier 1 again).
+            if isinstance(e, StepFault):
+                raise
+            raise StepFault(
+                "restore", faults_mod.classify(e),
+                culprits=[req.request_id],
+                survivors=[_Survivor(request=req, seed=seed,
+                                     num_prompt=len(ids))]) from e
+        self._awaiting_restore.append(_RestoreState(
+            request=req, ids=ids, digests=digests, shared=shared,
+            pages=pages, marker=marker, seed=seed, t0=time.monotonic()))
+        self.metrics.num_requests_waiting.inc(1)
+
+    def _dispatch_restore_group(self, blocks: list, pages: list[int],
+                                G: int):
+        """One scatter dispatch: stack up to G host blocks into the
+        padded staging shape (ONE compiled program) and write them into
+        ``pages``.  Returns the dispatch's readiness marker."""
+        nb = len(blocks)
+
+        def staged(field):
+            first = blocks[0][field]
+            out = np.zeros((first.shape[0], G) + first.shape[1:],
+                           first.dtype)
+            for j, b in enumerate(blocks):
+                out[:, j] = b[field]
+            return jnp.asarray(out)
+
+        ksb = vsb = None
+        if "k_scale" in blocks[0]:
+            ksb, vsb = staged("k_scale"), staged("v_scale")
+        pg = list(pages) + [pages[0]] * (G - nb)
+        self._cache, marker = self._restore_fn(
+            self._cache, staged("k"), staged("v"), ksb, vsb,
+            jnp.asarray(pg, jnp.int32), jnp.asarray(nb, jnp.int32))
+        return marker
+
+    def _restore_ready_any(self) -> bool:
+        return any(self._dev_ready(rec.marker)
+                   for rec in self._awaiting_restore)
+
+    def _resolve_restores(self) -> bool:
+        """Unpark restore-parked requests whose scatter landed (and a
+        free slot exists): register the restored digests into the device
+        index (tier-1 hits repopulate tier 0) and continue through the
+        ordinary chunked-tail path.  Aborts raised while parked release
+        the pages; a failed restore dispatch faults the restoring
+        request ALONE (phase "restore")."""
+        did = False
+        pending = self._awaiting_restore
+        i = 0
+        while i < len(pending):
+            rec = pending[i]
+            rid = rec.request.request_id
+            with self._abort_lock:
+                was_aborted = rid in self._aborted
+                if was_aborted:
+                    self._aborted.discard(rid)
+            if was_aborted:
+                pending.pop(i)
+                did = True
+                self.metrics.num_requests_waiting.inc(-1)
+                # The scatter may still be in flight toward these pages;
+                # freeing them is safe — any re-allocation's write
+                # dispatch queues behind our scatter on the stream.
+                self._alloc.decref(rec.shared)
+                self._alloc.decref(rec.pages)
+                self._unpin_guide(rec.request)
+                rec.request.outputs.put(RequestOutput(
+                    request_id=rid, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(rec.ids)))
+                continue
+            if not self._free or not self._dev_ready(rec.marker):
+                i += 1
+                continue
+            pending.pop(i)  # before any fault path, so recovery cannot
+            did = True      # double-count the record as a survivor
+            self.metrics.num_requests_waiting.inc(-1)
+            try:
+                self._faults.fire("restore")
+                np.asarray(rec.marker)  # surfaces async dispatch failures
+            except Exception as e:
+                raise StepFault(
+                    "restore", faults_mod.classify(e),
+                    culprits=[rid],
+                    survivors=[_Survivor(request=rec.request, seed=rec.seed,
+                                         num_prompt=len(rec.ids))]) from e
+            page = self._page_size()
+            start = len(rec.shared)
+            # Register BEFORE _start_chunked: if the tail alloc faults,
+            # its cleanup decrefs only our caller refs and the restored
+            # pages survive as index-retained.
+            self._alloc.register(
+                rec.digests[start: start + len(rec.pages)], rec.pages)
+            if self._host is not None:
+                self._host.restored_blocks += len(rec.pages)
+            self.metrics.prefix_restore_blocks_total.inc(len(rec.pages))
+            self.metrics.prefix_restore_seconds.observe(
+                time.monotonic() - rec.t0)
+            self.metrics.prefix_cache_usage_bytes.set(
+                self._alloc.retained_pages * self._page_bytes,
+                tier="device")
+            self._start_chunked(
+                rec.request, rec.ids,
+                prefix_len=(start + len(rec.pages)) * page,
+                prefix_pages=rec.shared + rec.pages,
+                digests=rec.digests)
+        return did
+
+    def _abort_awaiting_restores(self) -> None:
+        """Fail every restore-parked request (engine exit / blanket
+        abort): no scheduler remains to unpark them.  Page bookkeeping is
+        moot — both callers precede a device reset or process exit."""
+        for rec in self._awaiting_restore:
+            self.metrics.num_requests_waiting.inc(-1)
+            self._unpin_guide(rec.request)
+            rec.request.outputs.put(RequestOutput(
+                request_id=rec.request.request_id, token_ids=[],
+                finished=True, finish_reason="abort",
+                num_prompt_tokens=len(rec.ids)))
+        self._awaiting_restore = []
 
     def _admit_prefilled(self, req: Request) -> None:
         """Admit a request whose prefill ran on another engine (disaggregated
@@ -2708,6 +3101,27 @@ class InferenceEngine:
                             first_lp=pf.first_lp
                             if req.params.logprobs is not None else None,
                             seed=pf.seed)
+        if self._paged and self._chunk and pf.prompt_ids:
+            # Disaggregated publish: the transferred prefill's pages are
+            # now in the pool — register their digests (tier 0, zero
+            # cost) and spill them into the host tier, so a decode-side
+            # restart (or later eviction) keeps the prefill peer's warm
+            # prefixes without another wire transfer.  The spill path
+            # reads the pages the insert dispatch just wrote, so the
+            # stored bytes are the pool-canonical form (quantization
+            # included) — no host-side conversion to drift.
+            ids_full = [int(t) for t in pf.prompt_ids]
+            pages_row = list(self._slot_pages.get(slot, []))
+            self._register_prompt_pages(ids_full, pages_row)
+            if self._host_tier_on():
+                from arks_tpu.engine.paged import chain_digests
+                page = self._page_size()
+                nreg = min(len(ids_full) // page, len(pages_row))
+                digs = chain_digests(ids_full, page, nreg)
+                for d, pg in zip(digs, pages_row[:nreg]):
+                    if not self._host.has(d):
+                        self._spill_victims.append((d, pg))
+                self._spill_flush()
 
     @staticmethod
     def _lp_entry(clp, vals, lids, n: int):
@@ -3181,7 +3595,8 @@ class InferenceEngine:
                 # max_cache_len slot.
                 self._prefix.put(st.ids, np.asarray(k[:, :, :nfull]),
                                  np.asarray(v[:, :, :nfull]), nfull)
-                self.metrics.prefix_cache_usage_bytes.set(self._prefix.bytes_used)
+                self.metrics.prefix_cache_usage_bytes.set(
+                    self._prefix.bytes_used, tier="host")
 
     def prefill_detached(self, prompt_ids: list[int],
                          params) -> PrefilledState:
@@ -3263,7 +3678,8 @@ class InferenceEngine:
                               seed=seed, k=np.asarray(ks), v=np.asarray(vs),
                               first_lp=first_lp,
                               guide_row=(self.guides.next_row(grow0, first)
-                                         - grow0 if gid >= 0 else 0))
+                                         - grow0 if gid >= 0 else 0),
+                              prompt_ids=list(ids))
 
     # ------------------------------------------------------------------
     # Pipelined decode (ARKS_PIPELINE_DEPTH)
@@ -3293,6 +3709,13 @@ class InferenceEngine:
         if not self._pipe_depth or not self._slots:
             return False
         if self._prefilling or self._pending_admits:
+            return False
+        if self._awaiting_restore and self._free \
+                and self._restore_ready_any():
+            # A host-tier restore LANDED: drain so the unpark can take a
+            # slot with authoritative mirrors.  Restores still in flight
+            # keep pipelining at full depth — that is the point of
+            # issuing them as ordinary stream dispatches.
             return False
         if self._free and not self._queue.empty():
             # Admission is possible RIGHT NOW; with no free slot the queue
@@ -3407,6 +3830,10 @@ class InferenceEngine:
             while self._pipe_inflight and self._pipe_rec_ready(
                     self._pipe_inflight[0]):
                 self._pipe_resolve_one()
+        if self._spills:
+            # Harvest landed spill gathers (steady-state evictions come
+            # from _pipe_issue's page growth); ready-only, never blocks.
+            self._resolve_spills()
 
     @staticmethod
     def _pipe_rec_ready(rec) -> bool:

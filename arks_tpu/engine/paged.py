@@ -73,7 +73,7 @@ def pages_needed(length: int, rows: int, page: int, max_pages: int) -> int:
 
 
 class PageAllocator:
-    def __init__(self, num_pages: int, page: int) -> None:
+    def __init__(self, num_pages: int, page: int, on_evict=None) -> None:
         self.page = page
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, -1, -1))
@@ -82,6 +82,13 @@ class PageAllocator:
         # reference on each registered page.
         self._index: "OrderedDict[bytes, int]" = OrderedDict()
         self._page_digest: dict[int, bytes] = {}
+        # Spill hook: called as on_evict(digest, page) the moment an
+        # index-retained page is evicted, BEFORE the page can reach the
+        # free list — the engine uses it to queue the page for an async
+        # D2H spill into the host-RAM prefix tier while its content is
+        # still guaranteed un-overwritten on device.  Must not raise and
+        # must not call back into the allocator (it runs mid-alloc).
+        self.on_evict = on_evict
         # Stats (mirrored into EngineMetrics by the engine).
         self.hit_tokens = 0
         self.query_tokens = 0
@@ -114,6 +121,8 @@ class PageAllocator:
     def _evict_lru(self) -> None:
         digest, pg = self._index.popitem(last=False)
         del self._page_digest[pg]
+        if self.on_evict is not None:
+            self.on_evict(digest, pg)
         self._ref[pg] -= 1
         if self._ref[pg] == 0:
             self._free.append(pg)
@@ -149,10 +158,17 @@ class PageAllocator:
         """Put (digest, page) pairs into the index.  The index takes ONE
         reference per newly-registered page; already-indexed digests keep
         their existing page (the caller's duplicate page stays owned by the
-        caller alone and is freed on its decref)."""
+        caller alone and is freed on its decref).  A page already indexed
+        under a DIFFERENT digest is skipped: _page_digest is a one-to-one
+        reverse map, and overwriting it would leave the old digest's index
+        entry stale — evicting either digest would then delete the other's
+        reverse entry and a later eviction would KeyError mid-alloc (and
+        the refcount held for the old entry would leak)."""
         for d, pg in zip(digests, pages):
             if d in self._index:
                 self._index.move_to_end(d)
+                continue
+            if self._page_digest.get(pg, d) != d:
                 continue
             self._index[d] = pg
             self._page_digest[pg] = d

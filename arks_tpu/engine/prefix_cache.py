@@ -142,3 +142,96 @@ class PrefixKVCache:
     @property
     def hit_rate(self) -> float:
         return self.hit_tokens / self.query_tokens if self.query_tokens else 0.0
+
+
+class HostPrefixTier:
+    """Tier-1 host-RAM block store for the PAGED engine's prefix cache.
+
+    Tier 0 is the page allocator's on-device index (engine.paged): hits
+    there cost nothing — the pages are already in HBM.  This class is the
+    spill target behind it: when the device index evicts a page under
+    pool pressure, the engine gathers the page's pool-native KV
+    (``[L, Hkv, page, D]`` per array, int8 + per-token scales when the
+    pool is kv-quantized) and parks it HERE, keyed by the SAME chain
+    digest (paged.iter_chain_digests).  A later prompt whose prefix fell
+    out of HBM restores the blocks with one H2D scatter instead of
+    re-prefilling them.
+
+    Blocks are byte-exact copies of pool pages, so a restore reproduces
+    the device state the original prefill wrote — which is what keeps
+    token streams byte-identical with the tier enabled or disabled.
+
+    LRU eviction by byte budget (``ARKS_PREFIX_HOST_MB``).  A lock guards
+    the map: the engine thread spills/restores, and the disaggregated
+    decode path publishes transferred prefixes from server threads.
+    """
+
+    def __init__(self, page_tokens: int, capacity_bytes: int) -> None:
+        if page_tokens <= 0:
+            raise ValueError("page_tokens must be positive")
+        self.page = page_tokens
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        # digest -> block dict {"k","v"[,"k_scale","v_scale"]}, LRU order
+        # (oldest first).
+        self._blocks: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._bytes = 0
+        # Stats (mirrored into EngineMetrics by the engine).
+        self.spilled_blocks = 0
+        self.restored_blocks = 0
+
+    @staticmethod
+    def _block_bytes(block: dict) -> int:
+        return sum(a.nbytes for a in block.values() if a is not None)
+
+    def has(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._blocks
+
+    def put(self, digest: bytes, block: dict) -> bool:
+        """Store one pool-native page block (no-op if present; LRU-touches
+        it instead).  Returns True when the block was newly stored."""
+        block = {k: v for k, v in block.items() if v is not None}
+        with self._lock:
+            if digest in self._blocks:
+                self._blocks.move_to_end(digest)
+                return False
+            self._blocks[digest] = block
+            self._bytes += self._block_bytes(block)
+            self.spilled_blocks += 1
+            while self._bytes > self.capacity and self._blocks:
+                _, old = self._blocks.popitem(last=False)
+                self._bytes -= self._block_bytes(old)
+            return digest in self._blocks
+
+    def match_blocks(self, digests: list[bytes], start: int) -> list[dict]:
+        """The longest run of consecutively-cached blocks for
+        ``digests[start:]``, LRU-touched, under ONE lock hold (a racing
+        disagg publish could otherwise evict between a probe and the
+        read).  The returned dicts are the stored arrays — callers must
+        not mutate them."""
+        out: list[dict] = []
+        with self._lock:
+            for d in digests[start:]:
+                blk = self._blocks.get(d)
+                if blk is None:
+                    break
+                self._blocks.move_to_end(d)
+                out.append(blk)
+        return out
+
+    def clear(self) -> None:
+        """Drop every block (fault recovery's blanket deep clean — spilled
+        KV may itself be the poison)."""
+        with self._lock:
+            self._blocks.clear()
+            self._bytes = 0
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @property
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._blocks)

@@ -65,6 +65,13 @@ class PrefilledState:
     # the decode engine rebases onto its own table — absolute rows would
     # break when the two engines compiled guides in different orders).
     guide_row: int = 0
+    # Prompt token ids (rides the kv_transfer meta).  The decode side
+    # needs them to key the transferred KV by chain digest: paged engines
+    # register the inserted pages into the device prefix index and
+    # publish them into the host spill tier, so a decode-side restart
+    # keeps the prefill peer's warm prefixes.  None/[] from a pre-upgrade
+    # prefill peer simply skips the publish.
+    prompt_ids: list | None = None
 
 
 @dataclasses.dataclass

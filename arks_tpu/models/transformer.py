@@ -702,6 +702,41 @@ def insert_pages_batch(cache: PagedKVCache, k_new: jnp.ndarray,
     return PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
 
 
+def gather_pool_pages(cache: PagedKVCache, pages: jnp.ndarray):
+    """Whole pool pages as contiguous pool-NATIVE staging blocks for the
+    host prefix tier's spill path: ``(k, v, k_scale, v_scale)``, each
+    ``[L, G, Hkv, P, D]`` (scales ``[L, G, Hkv, P]``; None when the pool
+    is not kv-quantized).  Raw pool bytes — int8 stays int8 — so a later
+    scatter_pool_pages restore reproduces the device state bit-exactly."""
+    from arks_tpu.ops.paged_attention import paged_pool_gather
+    k = paged_pool_gather(cache.k, pages)
+    v = paged_pool_gather(cache.v, pages)
+    if cache.quantized:
+        return (k, v, paged_pool_gather(cache.k_scale, pages),
+                paged_pool_gather(cache.v_scale, pages))
+    return k, v, None, None
+
+
+def scatter_pool_pages(cache: PagedKVCache, k_blocks: jnp.ndarray,
+                       v_blocks: jnp.ndarray, pages: jnp.ndarray,
+                       n_valid: jnp.ndarray, k_scale=None,
+                       v_scale=None) -> PagedKVCache:
+    """Restore pool-native page blocks (the inverse of gather_pool_pages)
+    into the first ``n_valid`` pages listed in ``pages`` — the host
+    prefix tier's H2D scatter.  Blocks arrive already in pool layout and
+    dtype (incl. kv-quantized int8 + per-token scales), so no transpose
+    or re-quantization happens on device: the written pages are byte
+    copies of what the original prefill wrote."""
+    from arks_tpu.ops.paged_attention import paged_pool_scatter
+    kc = paged_pool_scatter(cache.k, k_blocks, pages, n_valid)
+    vc = paged_pool_scatter(cache.v, v_blocks, pages, n_valid)
+    ksc, vsc = cache.k_scale, cache.v_scale
+    if cache.quantized:
+        ksc = paged_pool_scatter(ksc, k_scale, pages, n_valid)
+        vsc = paged_pool_scatter(vsc, v_scale, pages, n_valid)
+    return PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+
+
 def gather_pages(cache: PagedKVCache, tables_row: jnp.ndarray,
                  layer: jnp.ndarray):
     """One slot's cache as contiguous per-layer views: returns

@@ -771,3 +771,44 @@ def paged_kv_update_quant(
     )(layer_arr, write_idx.astype(jnp.int32), tables.astype(jnp.int32),
       kq[:, :, None, :], vq[:, :, None, :], ks, vs,
       k_pool, v_pool, k_scale, v_scale)
+
+
+# ---------------------------------------------------------------------------
+# Host-tier spill/restore: whole-page pool gather / scatter
+# ---------------------------------------------------------------------------
+#
+# The hierarchical prefix cache moves WHOLE pages between the device pool
+# and host RAM: a spill gathers evicted pages into a contiguous staging
+# block drained D2H with copy_to_host_async, and a restore scatters
+# host-resident blocks back into freshly-allocated pool pages.  Unlike the
+# per-row update kernels above, a page is already a dense (layer-major)
+# stripe, so each transfer is one aligned whole-page DMA — XLA lowers
+# take/dynamic_update_slice on the page axis to exactly that, and a Pallas
+# formulation would buy nothing (no read-modify-write, no masking).  Both
+# carry raw pool bytes (int8 + scales for quantized pools): spill->restore
+# round-trips are bit-exact by construction.
+
+
+def paged_pool_gather(pool: jnp.ndarray, pages: jnp.ndarray) -> jnp.ndarray:
+    """Gather whole pool pages into a contiguous staging block:
+    ``[L, N, Hkv, P, ...] x [G] int32 -> [L, G, Hkv, P, ...]``.  Duplicate
+    page ids (host-side padding of a short spill group) are benign — the
+    host drops the padded entries."""
+    return jnp.take(pool, pages.astype(jnp.int32), axis=1)
+
+
+def paged_pool_scatter(pool: jnp.ndarray, blocks: jnp.ndarray,
+                       pages: jnp.ndarray, n_valid: jnp.ndarray) -> jnp.ndarray:
+    """Write the first ``n_valid`` staged page blocks
+    (``[L, G, Hkv, P, ...]``) into the pool pages listed in ``pages``
+    ([G] int32, entries past n_valid ignored).  The counterpart of
+    ``paged_pool_gather`` and the restore path's one device write; G is a
+    fixed group size so the jitted program compiles ONCE (n_valid is the
+    dynamic fill)."""
+
+    def body(j, p):
+        blk = jax.lax.dynamic_slice_in_dim(blocks, j, 1, axis=1)
+        at = (0, pages[j].astype(jnp.int32)) + (0,) * (pool.ndim - 2)
+        return jax.lax.dynamic_update_slice(p, blk.astype(p.dtype), at)
+
+    return jax.lax.fori_loop(0, n_valid.astype(jnp.int32), body, pool)

@@ -86,6 +86,11 @@ class PrefillServer(OpenAIServer):
             return True
         meta = {"first_token": pf.first_token, "num_prompt": pf.num_prompt,
                 "seed": pf.seed}
+        if pf.prompt_ids:
+            # The decode side keys the transferred KV by chain digest
+            # (device prefix index + host spill tier) — digests need the
+            # prompt ids, which only this side has.
+            meta["prompt_ids"] = [int(t) for t in pf.prompt_ids]
         if pf.guide_row:
             # Guided decoding: the post-first-token DFA state, relative to
             # the guide's start row (the decode side rebases onto its own
@@ -160,7 +165,9 @@ class DecodeServer(OpenAIServer):
                 first_token=int(meta["first_token"]),
                 num_prompt=int(meta["num_prompt"]),
                 seed=int(meta["seed"]), k=k, v=v, first_lp=first_lp,
-                guide_row=int(meta.get("guide_row", 0))))
+                guide_row=int(meta.get("guide_row", 0)),
+                prompt_ids=[int(t)
+                            for t in meta.get("prompt_ids") or []]))
         self.engine.add_request(req)
         self._respond(h, req, chat, model, body, stop_strings)
 

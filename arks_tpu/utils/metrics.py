@@ -52,6 +52,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def total(self) -> float:
+        """Sum over every label combination (e.g. a tier-labeled family
+        read as one number — what an unlabeled scrape used to return)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def collect(self) -> list[str]:
         with self._lock:
             items = list(self._values.items())
@@ -80,6 +86,11 @@ class Gauge(_Metric):
         key = tuple(sorted(labels.items()))
         with self._lock:
             return self._values.get(key, 0.0)
+
+    def total(self) -> float:
+        """Sum over every label combination (see Counter.total)."""
+        with self._lock:
+            return sum(self._values.values())
 
     def collect(self) -> list[str]:
         with self._lock:

@@ -214,6 +214,13 @@ def _scrape(port: int, names: tuple[str, ...]) -> dict[str, float]:
     return out
 
 
+def _series_sum(scraped: dict[str, float], name: str) -> float:
+    """A family summed across label combinations (tier-labeled counters
+    read as one number)."""
+    return sum(v for k, v in scraped.items()
+               if k == name or k.startswith(name + "{"))
+
+
 def _run_moderate_phase(port: int, slots: int, seconds: float,
                         max_tokens: int, prompt_len: int, probe_len: int,
                         n_chips: int, names: tuple[str, ...],
@@ -552,8 +559,8 @@ def run_serving_bench(model: str | None = None) -> dict:
     occ_sum = (s1.get("pipeline_depth_occupancy_sum", 0.0)
                - s0.get("pipeline_depth_occupancy_sum", 0.0))
     occupancy = round(occ_sum / occ_n, 3) if occ_n else None
-    hit0 = s0.get("prefix_cache_hit_tokens_total", 0.0)
-    hit1 = s1.get("prefix_cache_hit_tokens_total", 0.0)
+    hit0 = _series_sum(s0, "prefix_cache_hit_tokens_total")
+    hit1 = _series_sum(s1, "prefix_cache_hit_tokens_total")
     return {
         # Which engine path produced these numbers (kv layout, decode
         # impl, overlap...) — the resolved config, not the requested one.
@@ -583,7 +590,153 @@ def run_serving_bench(model: str | None = None) -> dict:
     }
 
 
+def run_shared_prefix_bench() -> dict:
+    """``--workload shared-prefix``: a common system prompt plus
+    per-client multi-turn histories that GROW each turn — the serving
+    shape the hierarchical prefix cache exists for.  The paged pool is
+    configured with zero retention surplus so a client's history pages
+    are evicted (and spilled to the host tier) while other clients run;
+    its next turn then restores them instead of re-prefilling.
+
+    Requests are driven sequentially through the engine API and each is
+    classified by hit depth from the per-tier hit-token deltas:
+    tier0 (device pages), tier1 (host-tier restore), miss.  Reports
+    per-tier hit tokens and the TTFT split by class — the number that
+    decides whether a restore actually beats a re-prefill.
+
+    Env knobs: ARKS_BENCH_SP_MODEL (default tiny — the CPU-mechanics
+    shape), ARKS_BENCH_SP_CLIENTS, ARKS_BENCH_SP_TURNS,
+    ARKS_PREFIX_HOST_MB (the tier-1 budget under test)."""
+    import random
+
+    import numpy as np
+
+    from arks_tpu.engine import (EngineConfig, InferenceEngine, Request,
+                                 SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+
+    model = os.environ.get("ARKS_BENCH_SP_MODEL", "tiny")
+    # Enough clients that the combined history working set OVERFLOWS the
+    # pool (4 slots x 8 pages): later turns then find their history
+    # evicted from the device index and restore it from the host tier.
+    clients = int(os.environ.get("ARKS_BENCH_SP_CLIENTS", "10"))
+    turns = int(os.environ.get("ARKS_BENCH_SP_TURNS", "4"))
+    cfg = get_config(model)
+    chunk = 16
+    # prefix_cache_mb=0 and a 2-slot pool: no retention surplus, so the
+    # combined client histories cannot stay device-resident — finished
+    # histories are evicted (-> spilled) by later admissions, the
+    # smallest pool that still decodes, i.e. the worst case tier 1 must
+    # absorb.
+    ecfg = EngineConfig(model=model, num_slots=2, max_cache_len=128,
+                        prefill_buckets=(16, 32), steps_per_dispatch=4,
+                        prefill_chunk=chunk, kv_layout="paged",
+                        prefix_cache_mb=0)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+
+    rng = random.Random(42)
+    vocab = cfg.vocab_size
+    system = [rng.randrange(3, min(200, vocab)) for _ in range(2 * chunk)]
+    histories = [list(system) for _ in range(clients)]
+    rows = []
+
+    def _measure(rid, prompt):
+        d0 = eng.metrics.prefix_cache_hit_tokens_total.get(tier="device")
+        h0 = eng.metrics.prefix_cache_hit_tokens_total.get(tier="host")
+        req = Request(rid, prompt,
+                      SamplingParams(max_tokens=4, temperature=0.0,
+                                     ignore_eos=True))
+        eng.add_request(req)
+        toks, ttft = [], None
+        while True:
+            out = req.outputs.get(timeout=300)
+            if out.ttft_s is not None and ttft is None:
+                ttft = out.ttft_s
+            toks.extend(out.token_ids)
+            if out.finished:
+                break
+        ddev = eng.metrics.prefix_cache_hit_tokens_total.get(
+            tier="device") - d0
+        dhost = eng.metrics.prefix_cache_hit_tokens_total.get(
+            tier="host") - h0
+        return toks, ttft, ddev, dhost
+
+    try:
+        # Prime every compiled program the workload hits (mixed step,
+        # admit/chunk, restore scatter stays cold — it compiles on the
+        # first tier-1 hit below, which is why the FIRST restore is not
+        # the number to read) so the TTFT split measures serving, not
+        # jit compiles.
+        _measure("sp-prime",
+                 [rng.randrange(3, min(200, vocab)) for _ in range(44)])
+        for turn in range(turns):
+            for ci in range(clients):
+                prompt = histories[ci] + [
+                    rng.randrange(3, min(200, vocab))
+                    for _ in range(chunk - 4)]
+                toks, ttft, ddev, dhost = _measure(
+                    f"sp-{ci}-{turn}", prompt)
+                depth = ("tier1" if dhost > 0
+                         else "tier0" if ddev > 0 else "miss")
+                rows.append({"client": ci, "turn": turn, "depth": depth,
+                             "hit_dev": ddev, "hit_host": dhost,
+                             "prompt_tokens": len(prompt),
+                             "ttft_s": ttft})
+                histories[ci] = prompt + toks
+        # Cold misses at full warmth: never-seen prompts of tier-1-hit
+        # length, so the miss TTFT is a compiled-path prefill number (the
+        # apples-to-apples baseline a restore must beat).
+        for i in range(max(clients // 2, 3)):
+            plen = len(histories[i % clients]) if histories else 76
+            prompt = [rng.randrange(3, min(200, vocab))
+                      for _ in range(min(plen, 90))]
+            _, ttft, ddev, dhost = _measure(f"sp-cold-{i}", prompt)
+            depth = ("tier1" if dhost > 0
+                     else "tier0" if ddev > 0 else "miss")
+            rows.append({"client": -1, "turn": -1, "depth": depth,
+                         "hit_dev": ddev, "hit_host": dhost,
+                         "prompt_tokens": len(prompt), "ttft_s": ttft})
+    finally:
+        eng.stop()
+
+    def _ttfts(depth):
+        return [r["ttft_s"] for r in rows
+                if r["depth"] == depth and r["ttft_s"] is not None]
+
+    out = {
+        "workload": "shared-prefix",
+        "sp_model": model, "sp_clients": clients, "sp_turns": turns,
+        "sp_requests": len(rows),
+        "sp_prefix_host_mb": eng.resolved_config["prefix_host_mb"],
+        "sp_hit_tokens_tier0": sum(r["hit_dev"] for r in rows),
+        "sp_hit_tokens_tier1": sum(r["hit_host"] for r in rows),
+        "sp_spilled_blocks": int(
+            eng.metrics.prefix_spill_blocks_total.total()),
+        "sp_restored_blocks": int(
+            eng.metrics.prefix_restore_blocks_total.total()),
+        "sp_requests_by_depth": {
+            d: sum(1 for r in rows if r["depth"] == d)
+            for d in ("tier0", "tier1", "miss")},
+    }
+    for depth in ("tier0", "tier1", "miss"):
+        ts = _ttfts(depth)
+        out[f"sp_ttft_{depth}_mean_ms"] = (
+            round(float(np.mean(ts)) * 1e3, 2) if ts else None)
+    return out
+
+
 def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", choices=("default", "shared-prefix"),
+                    default="default")
+    args, _ = ap.parse_known_args()
+    if args.workload == "shared-prefix":
+        print(json.dumps({"metric": "shared_prefix_serving",
+                          **run_shared_prefix_bench()}))
+        return
     print(json.dumps({
         "metric": "serving_throughput",
         "unit": "tok/s/chip",

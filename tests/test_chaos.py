@@ -370,6 +370,85 @@ def test_follower_applies_recover_op(monkeypatch):
     assert follower._pipe_state is None and follower._pipe_cols is None
 
 
+def _restore_scenario(monkeypatch, inject=None, retries=None):
+    """Shared-prefix workload on the tiered cache: a warm prompt, churn
+    that evicts it into the host tier, a co-resident decoding stream,
+    then the warm prompt again — whose admission goes through the tier-1
+    RESTORE path (the injectable "restore" phase)."""
+    monkeypatch.setenv("ARKS_PREFIX_HOST_MB", "64")
+    cfg, eng = _mk_engine(monkeypatch, 0, "auto", inject=inject,
+                          retries=retries, prefill_chunk=16,
+                          kv_layout="paged", prefix_cache_mb=0)
+    assert eng._host is not None
+    warm = [int(x) % cfg.vocab_size for x in range(3, 36)]  # 2 pages + tail
+    outs = []
+
+    def run_one(req):
+        eng.add_request(req)
+        _drive(eng)
+        return req
+
+    # Warm the prefix, then churn it out of the device index (spilled).
+    run_one(Request("w1", warm, SamplingParams(
+        max_tokens=4, temperature=0.0, ignore_eos=True)))
+    for i in range(5):
+        run_one(Request(f"ch{i}", [(9 + i) % cfg.vocab_size] * 33,
+                        SamplingParams(max_tokens=3, temperature=0.0,
+                                       ignore_eos=True)))
+    # A long-lived innocent stream decodes while the restore happens.
+    bystander = Request("by", [5, 6, 7], SamplingParams(
+        max_tokens=20, temperature=0.9, top_p=0.9, top_k=40, seed=11,
+        ignore_eos=True))
+    eng.add_request(bystander)
+    for _ in range(60):
+        try:
+            eng.step(block_s=0.01)
+        except Exception as e:  # noqa: BLE001 — routed like _run_loop
+            eng._recover_from_fault(e)
+        if eng._slots:
+            break
+    victim = Request("w2", warm, SamplingParams(
+        max_tokens=4, temperature=0.0, ignore_eos=True))
+    eng.add_request(victim)
+    _drive(eng)
+    outs = [_collect(bystander), _collect(victim)]
+    return outs, eng
+
+
+def test_restore_fault_is_isolated_to_the_restoring_request(monkeypatch):
+    """A fault injected at the tier-1 restore phase must recover: within
+    the retry budget the restoring request re-queues (its retry hits the
+    host tier again — it survives the device reset), and the co-resident
+    decoding stream is byte-identical to the fault-free run."""
+    base, beng = _restore_scenario(monkeypatch)
+    assert beng.metrics.prefix_restore_blocks_total.total() > 0, \
+        "scenario never exercised the restore path"
+    got, eng = _restore_scenario(monkeypatch, inject="restore:1:runtime")
+    assert [f.finish_reason for _, f in got] == ["length", "length"]
+    assert got == base, "streams diverged after the restore fault"
+    assert sum(eng.metrics.engine_faults_total._values.values()) == 1
+    assert eng.metrics.engine_faults_total.get(
+        phase="restore", kind="injected") == 1
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+def test_restore_fault_quarantines_only_the_culprit(monkeypatch):
+    """With a zero retry budget, the restore fault fails the restoring
+    request ALONE (finish_reason="error"/engine_fault); the innocent
+    decoding stream still finishes byte-identical to the fault-free
+    run."""
+    base, _ = _restore_scenario(monkeypatch)
+    got, eng = _restore_scenario(monkeypatch, inject="restore:1:runtime",
+                                 retries=0)
+    (by_ids, by_fin), (_, v_fin) = got
+    assert v_fin.finish_reason == "error"
+    assert v_fin.error.startswith("engine_fault")
+    assert (by_ids, by_fin.finish_reason) == (base[0][0], "length")
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 1
+    assert eng.state == "serving"
+
+
 def test_decode_fault_while_another_request_prefills(monkeypatch):
     """A decode fault with a long prompt mid-chunked-prefill: the decoding
     stream token-replays, the prefilling one re-runs from the top, both

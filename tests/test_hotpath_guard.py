@@ -27,6 +27,13 @@ HOT_PATH_FUNCTIONS = (
     "_issue_decode",
     "_issue_mixed",
     "_issue_admit_batch",
+    # Hierarchical prefix cache: spills and restores are ISSUE-side too —
+    # eviction must never block the engine thread, and a restore is just
+    # another async dispatch the pipelined decode overlaps.  Their host
+    # syncs live in _resolve_spills / _resolve_restores.
+    "_spill_flush",
+    "_issue_restore",
+    "_dispatch_restore_group",
 )
 
 # Sanctioned exceptions, keyed (function, unparsed argument).  Each entry
@@ -93,5 +100,6 @@ def test_resolve_tails_exist():
     """The guard above is only meaningful while the sanctioned sync tails
     exist under their expected names."""
     for name in ("_resolve_decode", "_resolve_mixed", "_pipe_resolve_one",
-                 "_resolve_admit_batch"):
+                 "_resolve_admit_batch", "_resolve_spills",
+                 "_resolve_restores"):
         assert callable(getattr(engine_mod.InferenceEngine, name)), name

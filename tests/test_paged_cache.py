@@ -309,6 +309,103 @@ def test_page_allocator_refcounts_and_eviction():
     a.decref(held)
 
 
+def test_page_allocator_reregister_under_new_digest_is_skipped():
+    """register() must not hijack a page already indexed under another
+    digest: _page_digest is one-to-one, so the overwrite left a stale
+    index entry whose eviction deleted the NEW digest's reverse mapping
+    (a later eviction then KeyErrors mid-alloc) and leaked a refcount."""
+    from arks_tpu.engine.paged import PageAllocator, chain_digests
+
+    a = PageAllocator(num_pages=4, page=4)
+    pg = a.alloc(1)
+    d1 = chain_digests(list(range(4)), 4, 1)
+    d2 = chain_digests(list(range(100, 104)), 4, 1)
+    a.register(d1, pg)
+    ref_before = a._ref[pg[0]]
+    a.register(d2, pg)  # same page, different digest: skipped
+    assert d2[0] not in a._index
+    assert a._page_digest[pg[0]] == d1[0]
+    assert a._ref[pg[0]] == ref_before  # no leaked index reference
+    # Both digests evictable paths stay consistent: drain everything.
+    a.decref(pg)
+    while a.retained_pages:
+        a._evict_lru()
+    assert a.free_pages == a.num_pages
+    assert not a._page_digest and not a._index
+
+
+def test_page_allocator_interleaved_invariants():
+    """Property-style interleaving of match/register/evict/decref: after
+    every operation the refcount and free-list invariants must hold —
+    every page is free XOR referenced, the index holds exactly one ref
+    per entry, and _page_digest mirrors _index exactly."""
+    import random
+
+    from arks_tpu.engine.paged import (OutOfPagesError, PageAllocator,
+                                       chain_digests)
+
+    rng = random.Random(7)
+    a = PageAllocator(num_pages=8, page=4)
+    held: list[list[int]] = []     # caller-owned page lists
+
+    def check():
+        # _page_digest is the exact inverse of _index.
+        assert {pg: d for d, pg in a._index.items()} == a._page_digest
+        # Refcount per page == caller holds + index holds; free list is
+        # exactly the zero-ref pages, each listed once.
+        for pg in range(a.num_pages):
+            expect = sum(row.count(pg) for row in held)
+            expect += 1 if pg in a._page_digest else 0
+            assert a._ref[pg] == expect, (pg, a._ref[pg], expect)
+            assert (a._free.count(pg) == 1) == (expect == 0)
+
+    for step in range(400):
+        op = rng.choice(["alloc", "match", "register", "decref", "evict"])
+        if op == "alloc":
+            try:
+                held.append(a.alloc(rng.randint(1, 3)))
+            except OutOfPagesError:
+                pass
+        elif op == "match" and a._index:
+            digs = list(a._index)[: rng.randint(1, len(a._index))]
+            got = a.match(digs)
+            if got:
+                held.append(got)
+        elif op == "register" and held:
+            row = rng.choice(held)
+            ids = list(range(step * 10, step * 10 + 4 * len(row)))
+            a.register(chain_digests(ids, 4, len(row)), row)
+        elif op == "decref" and held:
+            a.decref(held.pop(rng.randrange(len(held))))
+        elif op == "evict" and a._index:
+            a._evict_lru()
+        check()
+    while held:
+        a.decref(held.pop())
+    while a.retained_pages:
+        a._evict_lru()
+    check()
+    assert a.free_pages == a.num_pages
+
+
+def test_page_allocator_on_evict_hook_fires_before_free():
+    """The spill hook sees every evicted (digest, page) pair, and fires
+    while the page is still un-reusable (not yet on the free list) —
+    the ordering the async D2H spill's correctness rides on."""
+    from arks_tpu.engine.paged import PageAllocator, chain_digests
+
+    seen = []
+    a = PageAllocator(num_pages=2, page=4)
+    a.on_evict = lambda d, pg: seen.append((d, pg, pg in a._free))
+    pages = a.alloc(2)
+    digs = chain_digests(list(range(8)), 4, 2)
+    a.register(digs, pages)
+    a.decref(pages)
+    a.alloc(2)  # forces both evictions
+    assert [(d, pg) for d, pg, _ in seen] == list(zip(digs, pages))
+    assert all(not was_free for _, _, was_free in seen)
+
+
 def test_engine_paged_multihost_gang_prefix_cache():
     """The paged prefix cache must work under a dispatch leader (the round-2
     single-host restriction is lifted): leader decisions replicate as plain
