@@ -87,13 +87,19 @@ class EngineConfig:
     # indexing would gather the stage-sharded cache).
     pipeline_parallel: int = 1
     # Speculative decoding: a small draft model proposes draft_len-1 tokens
-    # per dispatch, the target verifies ALL of them in ONE multi-token pass
-    # (transformer.verify_step).  Greedy slots keep the longest argmax-
-    # matching prefix plus one bonus token — emitted tokens IDENTICAL to
-    # target-only greedy decoding.  Sampled slots use rejection sampling
-    # (sampler.speculative_accept) — exact in DISTRIBUTION against the
-    # engine's own effective sampling dist.  Multi-host gangs mirror the
-    # draft-prefill and spec dispatches like any other op; dp/pp-exclusive.
+    # per dispatch and the target verifies them as RAGGED q_len=draft_len
+    # rows of the SAME mixed dispatch that carries decode feeds and
+    # prefill chunks (transformer.mixed_step / paged_mixed_attention) —
+    # draft propose + verify + acceptance run inside ONE program per
+    # scheduler iteration, and the spec engine keeps the mixed engine's
+    # pipelining, guided decoding, and token-replay fault recovery.
+    # Greedy slots keep the longest argmax-matching prefix plus one bonus
+    # token — emitted tokens IDENTICAL to target-only greedy decoding.
+    # Sampled slots use rejection sampling (sampler.speculative_accept) —
+    # exact in DISTRIBUTION against the engine's own effective sampling
+    # dist.  Requires the mixed scheduler (paged KV layout + chunked
+    # prefill); dp/pp-exclusive.  Multi-host gangs mirror the
+    # draft-prefill and spec_mixed dispatches like any other op.
     draft_model: str | None = None
     draft_len: int = 4
     dtype: str | None = None   # default: model config dtype
@@ -116,9 +122,12 @@ class EngineConfig:
     # works on multi-host gangs.  "auto" = paged on TPU whenever the
     # engine shape allows (no pp / dp, lane-aligned head_dim,
     # chunk == page alignment); slot elsewhere — the slot layout remains
-    # the fallback for those paths.  Speculative decoding rides paged
-    # (the target cache pages, the draft mirror stays slot-contiguous),
-    # and so does context parallelism (one-shot prefill rides the ring;
+    # the fallback for those paths.  Speculative decoding REQUIRES paged
+    # (verify blocks are ragged rows of the mixed dispatch; the draft
+    # mirror stays slot-contiguous — it is num_slots x draft-model sized,
+    # where paging buys nothing), so "auto" resolves to paged for draft
+    # engines on every backend whose shape allows it.
+    # Context parallelism pages too (one-shot prefill rides the ring;
     # the pool is seq-replicated, so tables/pages are unaffected — chunk
     # tails run unsharded over seq, as they do on the slot layout).
     # Pipeline parallelism pages too: the pool shards over 'stage' on its
@@ -206,11 +215,18 @@ class _Slot:
     generated: list[int] = dataclasses.field(default_factory=list)
     num_emitted: int = 0  # tokens already streamed to the request queue
     first_token_time: float | None = None
-    # Speculative decoding: the draft cache mirrors this slot's rows.  A
-    # fused-loop dispatch advances the target cache only, so the mirror
-    # goes stale and the slot must ride the fused loop for the rest of its
-    # life (correct either way; the spec path would just mispredict).
+    # Speculative decoding: the draft cache mirrors this slot's rows
+    # (prompt draft-prefilled at registration).  The spec-mixed dispatch
+    # feeds the draft the REAL last token every step, so the mirror stays
+    # in sync for the slot's whole life whether or not it speculates.
     draft_synced: bool = False
+    # Spec eligibility, frozen at registration (pure function of the
+    # request): draft-synced, penalty-free, no logprobs/bias/min_tokens.
+    # Guided slots ARE eligible — verify-aware DFA advancement
+    # (sampler.speculative_accept) keeps the grammar exact.  Frozen
+    # eligibility is what makes spec engines replay-safe: a lane's PRNG
+    # key advances by the same per-dispatch structure on every re-run.
+    spec_ok: bool = False
     # Per-token logprob entries parallel to ``generated`` (only populated
     # when the request asked for logprobs): (chosen_lp, [(id, lp), ...]).
     logprobs: list = dataclasses.field(default_factory=list)
@@ -443,6 +459,15 @@ class EngineMetrics:
         self.spec_decode_acceptance_rate = r.gauge(
             "spec_decode_acceptance_rate",
             "Lifetime draft-token acceptance rate")
+        # Per-dispatch accepted-block length (1 = nothing accepted, just
+        # the normally-sampled token; draft_len = full block + bonus).
+        # The distribution — not just the lifetime rate — is what shows an
+        # acceptance COLLAPSE (histogram mass sliding to 1) before
+        # throughput falls over (docs/monitoring.md).
+        self.spec_decode_accepted_length = r.histogram(
+            "spec_decode_accepted_length",
+            "Tokens landed per speculating request per spec dispatch",
+            buckets=[1, 2, 3, 4, 6, 8, 12, 16])
         # Mixed-step scheduling (ARKS_MIXED_STEP): one token-budget dispatch
         # per iteration carrying decode tokens + prefill-chunk tokens.
         self.mixed_batch_tokens = r.histogram(
@@ -862,15 +887,14 @@ class InferenceEngine:
         # step) keeps the engine thread issuing decode dispatches instead
         # of blocking on every admit program's round-trip — the r04 bench
         # measured 92% of engine wall in blocking admit resolves at
-        # saturation.  Spec engines stay synchronous (their dispatch
-        # eligibility logic assumes registered slots).
+        # saturation.
         from collections import deque
         self._pending_admits: "deque" = deque()
         # Request count across the deque, maintained by the engine thread
         # at every mutation: num_running reads it cross-thread (iterating
         # the deque there would race popleft/extend).
         self._pending_n = 0
-        self._defer_admits = engine_cfg.draft_model is None
+        self._defer_admits = True
         # Decode/admission overlap: issue the decode dispatch async and do
         # admission host work while the device computes.  Pays off where
         # device compute and host logistics are truly parallel (TPU);
@@ -890,20 +914,26 @@ class InferenceEngine:
         # tokens spread round-robin across ALL prefilling sequences, sampled
         # in the same program.  Replaces the admit_batch x chunk_step x
         # decode_loop program family for paged engines — default ON where
-        # supported; spec-decode, non-paged, and no-chunk (pp) engines stay
-        # on the legacy paths.
+        # supported; non-paged and no-chunk (pp) engines stay on the legacy
+        # paths.  Speculative engines RIDE the mixed step (verify lanes are
+        # q_len=draft_len rows of the same dispatch) and nothing else.
         _mx = os.environ.get("ARKS_MIXED_STEP", "auto")
         if _mx not in ("auto", "0", "1"):
             raise ValueError(f"ARKS_MIXED_STEP={_mx!r}: expected auto|0|1")
-        mixed_capable = (self._paged and bool(self._chunk)
-                         and engine_cfg.draft_model is None)
+        mixed_capable = self._paged and bool(self._chunk)
         self._mixed = mixed_capable and _mx != "0"
         if _mx == "1" and not mixed_capable:
             log.warning(
                 "ARKS_MIXED_STEP=1 requested but unsupported here "
-                "(paged=%s chunk=%s draft=%s); staying on the legacy "
-                "scheduler", self._paged, self._chunk,
-                engine_cfg.draft_model)
+                "(paged=%s chunk=%s); staying on the legacy scheduler",
+                self._paged, self._chunk)
+        if engine_cfg.draft_model and not self._mixed:
+            raise ValueError(
+                "speculative decoding rides the mixed scheduler and "
+                "requires the paged KV layout with chunked prefill "
+                f"(resolved kv_layout={'paged' if self._paged else 'slot'}, "
+                f"prefill_chunk={self._chunk or None}, "
+                f"ARKS_MIXED_STEP={_mx})")
         self._mixed_budget = 0
         if self._mixed:
             budget = int(os.environ.get("ARKS_MIXED_CHUNK_TOKENS",
@@ -921,9 +951,12 @@ class InferenceEngine:
         # async copies and resolve one full pipeline slot later.  Dead
         # slots self-mask (pad token, KV writes dropped at the slot
         # sentinel) until the host retires them at resolve.  0 disables
-        # (pure sequential issue/resolve); speculative engines fall back
-        # exactly like ARKS_MIXED_STEP's unsupported shapes — their
-        # dispatch eligibility needs host token values every step.
+        # (pure sequential issue/resolve).  Speculative engines pipeline
+        # too: the spec_pipe program threads accepted-length/last-token
+        # state on device (draft propose + ragged verify + accept inside
+        # every in-flight dispatch), so the draft's propose dispatches
+        # fill the bubble the resolve queue exposes instead of forcing
+        # depth 0.
         _pd = os.environ.get("ARKS_PIPELINE_DEPTH", "2")
         try:
             pipe_depth = int(_pd)
@@ -933,16 +966,17 @@ class InferenceEngine:
         if pipe_depth < 0:
             raise ValueError(
                 f"ARKS_PIPELINE_DEPTH={pipe_depth}: must be >= 0")
-        pipe_capable = engine_cfg.draft_model is None
-        self._pipe_depth = pipe_depth if pipe_capable else 0
-        # Rows a pipelined dispatch writes per slot: mixed engines pipeline
-        # their own one-token mixed step (kernel parity across the
-        # pipeline boundary); legacy engines pipeline the K-step fused
-        # loop.  Also the cache-cap margin for dead_len.
-        self._pipe_rows = 1 if self._mixed else engine_cfg.steps_per_dispatch
-        if pipe_depth and not pipe_capable:
-            log.info("pipelined decode disabled: speculative engines "
-                     "resolve their dispatches inline")
+        self._pipe_depth = pipe_depth
+        # Rows a pipelined dispatch writes per slot: spec engines write a
+        # draft_len verify block, mixed engines pipeline their own
+        # one-token mixed step (kernel parity across the pipeline
+        # boundary), legacy engines the K-step fused loop.  Also the
+        # cache-cap margin for dead_len.
+        if self._draft_cfg is not None:
+            self._pipe_rows = engine_cfg.draft_len
+        else:
+            self._pipe_rows = (1 if self._mixed
+                               else engine_cfg.steps_per_dispatch)
         # In-flight dispatch records (FIFO), the threaded device state,
         # and the per-run device stop columns.  Engine-thread-only.
         self._pipe_inflight: "deque" = deque()
@@ -984,6 +1018,11 @@ class InferenceEngine:
             "mixed_step": str(bool(self._mixed)).lower(),
             "pipeline_depth": str(self._pipe_depth),
             "prefix_host_mb": str(self._host_mb),
+            # Spec engines run draft+verify inside the mixed dispatch (the
+            # legacy fused spec loop is gone) — "true" whenever a draft
+            # model is configured, since the mixed scheduler is a hard
+            # requirement for speculation.
+            "spec_mixed": str(self._draft_cfg is not None).lower(),
         }
         self.metrics.engine_config_info.set(1, **self.resolved_config)
         log.info("engine resolved config: %s",
@@ -1402,6 +1441,9 @@ class InferenceEngine:
         if self._draft_cfg is not None:
             dcfg = self._draft_cfg
             DK = self.ecfg.draft_len
+            B = self.ecfg.num_slots
+            lane = jnp.arange(B, dtype=jnp.int32)
+            blk = jnp.arange(DK, dtype=jnp.int32)
 
             def draft_prefill_insert(dparams, dcache, tokens, length, slot):
                 _, ks, vs = tf.prefill(dparams, dcfg, tokens, length, mesh)
@@ -1410,15 +1452,16 @@ class InferenceEngine:
             self._draft_prefill_fn = jax.jit(draft_prefill_insert,
                                              donate_argnums=(1,))
 
-            def spec_loop(params, dparams, cache, dcache, tokens, lengths,
-                          sstate, enable, tables, gtables, want_lp: bool):
-                # Feed-time counting (as in the fused loop): spec-DISABLED
-                # penalized slots advance one normally-sampled token per
-                # dispatch, so their counts must evolve; eligible slots are
-                # penalty-free and reset at slot reuse.
-                sstate = sampler_mod.count_tokens(sstate, tokens)
-                # Draft DK-1 proposals (greedy slots argmax, sampled slots
-                # draw from their effective filtered distribution)...
+            def draft_propose(dparams, dcache, tokens, lengths, sstate):
+                """DK-step draft scan: propose DK-1 tokens per lane (greedy
+                lanes argmax, sampled lanes draw from their effective
+                filtered distribution).  DK steps, not DK-1: the extra
+                step writes the LAST draft token's KV row, so after a
+                fully-accepted block the next dispatch's draft attends a
+                complete prefix (without it, row L+DK-1 is garbage and
+                the draft mispredicts every DK-th token even when
+                draft == target).  Parked lanes (lengths at the sentinel)
+                drop their slot-cache writes like any other decode."""
                 def body(carry, _):
                     dcache, tok, ln, keys = carry
                     logits, dcache = tf.decode_step(dparams, dcfg, dcache,
@@ -1427,11 +1470,6 @@ class InferenceEngine:
                         logits, sstate, keys)
                     return (dcache, tok, ln + 1, keys), (tok, q, qp, qi)
 
-                # DK steps, not DK-1: the extra step writes the LAST draft
-                # token's KV row, so after a fully-accepted block the next
-                # dispatch's draft attends a complete prefix (without it,
-                # row L+DK-1 is garbage and the draft mispredicts every
-                # DK-th token even when draft == target).
                 (dcache, _, _, keys), (toks, qs, qps, qis) = jax.lax.scan(
                     body, (dcache, tokens, lengths, sstate.key), None,
                     length=DK)
@@ -1439,38 +1477,178 @@ class InferenceEngine:
                 q_sel = jnp.swapaxes(qs, 0, 1)[:, : DK - 1]
                 q_probs = jnp.swapaxes(qps, 0, 1)[:, : DK - 1]   # [B,DK-1,W]
                 q_idx = jnp.swapaxes(qis, 0, 1)[:, : DK - 1]
-                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
-                # ...then verify the whole block in ONE target pass and
-                # accept by rejection sampling (exact in distribution;
-                # greedy slots reduce to argmax prefix matching).  The
-                # per-slot enable mask lets penalized/logprob/desynced
-                # slots ride position 0 normally while the rest speculate.
-                # Target cache may be PAGED (the production default layout):
-                # verify writes ride the block tables; the draft mirror
-                # stays slot-contiguous — it is num_slots x draft-model
-                # sized, where paging buys nothing.
-                vlogits, cache = tf.verify_step(params, cfg, cache, block,
-                                                lengths, mesh, tables=tables)
-                out, counts, keys, grow = sampler_mod.speculative_accept(
-                    drafts, q_sel, q_probs, q_idx, vlogits, sstate, keys,
-                    enable=enable, lengths=lengths, guide_tables=gtables)
-                sstate = sstate._replace(key=keys, guide_row=grow)
+                return dcache, drafts, q_sel, q_probs, q_idx, keys
+
+            # Ragged spec-mixed program: draft propose + multi-token
+            # verify + acceptance INSIDE the one mixed dispatch that also
+            # carries prefill chunks.  Every decoding lane owns a fixed
+            # q_len=DK verify block (rows [b*DK, (b+1)*DK) of the flat
+            # batch — row 0 its last token, rows 1.. the draft's
+            # proposals, scattered in ON DEVICE so no host sync touches
+            # them); the chunk region starts at B*DK.  Verify logits are
+            # just DK extra sample positions of the same tf.mixed_step
+            # call — the per-spec verify program family is gone.
+            spec_rows = (lane[:, None] * DK + 1
+                         + jnp.arange(DK - 1, dtype=jnp.int32)[None, :]
+                         ).reshape(-1)
+            vsrc = jnp.arange(B * DK, dtype=jnp.int32)
+
+            def spec_mixed_prog(params, dparams, cache, dcache, sampling,
+                                tokens, token_slot, token_pos, tables,
+                                feed_tokens, feed_active, lengths,
+                                sample_src, seq_q_start, seq_q_len,
+                                seq_pos_start, spec_enable, ov_mask,
+                                ov_temp, ov_top_p, ov_top_k, ov_key,
+                                ov_bias_ids, ov_bias_vals, ov_sup,
+                                ov_min_until, ov_guide, ov_guide_row,
+                                gtables, want_lp: bool):
+                # Feed-time counting: spec-DISABLED penalized lanes
+                # advance one normally-sampled token per dispatch, so
+                # their counts must evolve; eligible lanes are
+                # penalty-free and reset at slot reuse.
+                sampling = sampler_mod.count_tokens(sampling, feed_tokens,
+                                                    feed_active)
+                dcache, drafts, q_sel, q_probs, q_idx, dkeys = \
+                    draft_propose(dparams, dcache, feed_tokens, lengths,
+                                  sampling)
+                # Proposals land in every lane's verify block; lanes that
+                # are not decoding this step keep padding rows
+                # (token_slot=-1), so the scattered values write nothing.
+                tokens = tokens.at[spec_rows].set(drafts.reshape(-1))
+                src = jnp.concatenate([vsrc, sample_src])
+                logits_all, cache = tf.mixed_step(
+                    params, cfg, cache, tables, tokens, token_slot,
+                    token_pos, src, seq_q_start, seq_q_len, seq_pos_start,
+                    mesh)
+                vlogits = logits_all[: B * DK].reshape(B, DK, -1)
+                samp_logits = logits_all[B * DK:]               # [B, V]
+                # Prompt-completing lanes: transient first-token sampling
+                # with the override columns — identical semantics to the
+                # plain mixed program (their persistent rows are written
+                # by set_slot at registration).
+                ovc = ov_mask[:, None]
+                eff = sampling._replace(
+                    temperature=jnp.where(ov_mask, ov_temp,
+                                          sampling.temperature),
+                    top_p=jnp.where(ov_mask, ov_top_p, sampling.top_p),
+                    top_k=jnp.where(ov_mask, ov_top_k, sampling.top_k),
+                    key=jnp.where(ovc, ov_key, sampling.key),
+                    presence=jnp.where(ov_mask, 0.0, sampling.presence),
+                    frequency=jnp.where(ov_mask, 0.0, sampling.frequency),
+                    bias_ids=jnp.where(ovc, ov_bias_ids, sampling.bias_ids),
+                    bias_vals=jnp.where(ovc, ov_bias_vals,
+                                        sampling.bias_vals),
+                    suppress_ids=jnp.where(ovc, ov_sup,
+                                           sampling.suppress_ids),
+                    min_until=jnp.where(ov_mask, ov_min_until,
+                                        sampling.min_until),
+                    guide=jnp.where(ov_mask, ov_guide, sampling.guide),
+                    guide_row=jnp.where(ov_mask, ov_guide_row,
+                                        sampling.guide_row))
+                comp_ids, _ = sampler_mod.sample(samp_logits, eff, ov_mask,
+                                                 lengths,
+                                                 guide_tables=gtables)
+                # Decoding lanes (enabled AND disabled) advance through
+                # the rejection kernel — verify-aware guide advancement
+                # included, so guided lanes speculate instead of being
+                # carved out.
+                out, counts, carry_keys, grow = \
+                    sampler_mod.speculative_accept(
+                        drafts, q_sel, q_probs, q_idx, vlogits, sampling,
+                        dkeys, enable=spec_enable, lengths=lengths,
+                        guide_tables=gtables)
+                sampling = sampling._replace(
+                    key=jnp.where(feed_active[:, None], carry_keys,
+                                  sampling.key),
+                    guide_row=jnp.where(feed_active, grow,
+                                        sampling.guide_row))
+                counts = jnp.maximum(counts, 1)
                 if want_lp:
                     # Raw-distribution logprobs for the ONE token each
-                    # disabled lp slot advanced (enabled slots never carry
-                    # logprobs — eligibility excludes them).
+                    # disabled lp lane advanced (enabled lanes never carry
+                    # logprobs — eligibility excludes them) and for
+                    # completing lanes' first tokens, in one call.
+                    lane_logits = jnp.where(ovc, samp_logits,
+                                            vlogits[:, 0])
+                    chosen = jnp.where(ov_mask, comp_ids, out[:, 0])
+                    clp, vals, lids = sampler_mod.top_logprobs(lane_logits,
+                                                               chosen)
+                    return (out, counts, comp_ids, clp, vals, lids, cache,
+                            dcache, sampling)
+                return out, counts, comp_ids, cache, dcache, sampling
+
+            self._spec_mixed_fn = jax.jit(
+                functools.partial(spec_mixed_prog, want_lp=False),
+                donate_argnums=(2, 3, 4))
+            self._spec_mixed_lp_fn = jax.jit(
+                functools.partial(spec_mixed_prog, want_lp=True),
+                donate_argnums=(2, 3, 4))
+
+            # Device-state spec variant (ARKS_PIPELINE_DEPTH): the
+            # steady-state (decode-only) spec step consuming threaded
+            # token/length/liveness arrays — draft propose + ragged verify
+            # + accept per dispatch with NO host values, so the draft's
+            # propose work fills the resolve-queue bubble instead of
+            # forcing spec engines sequential.  Same tf.mixed_step kernel
+            # as the fresh-entry program (per-row math is lane-local, so
+            # streams stay byte-identical across depths).
+            def spec_pipe(params, dparams, cache, dcache, tokens, lengths,
+                          alive, stop_ids, dead_len, spec_col, sstate,
+                          tables, gtables, want_lp: bool):
+                eff = jnp.where(alive, lengths, jnp.int32(sentinel))
+                sstate = sampler_mod.count_tokens(sstate, tokens, alive)
+                dcache, drafts, q_sel, q_probs, q_idx, dkeys = \
+                    draft_propose(dparams, dcache, tokens, eff, sstate)
+                block = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                flat_slot = jnp.repeat(
+                    jnp.where(alive, lane, jnp.int32(-1)), DK)
+                flat_pos = (eff[:, None] + blk[None, :]).reshape(-1)
+                src = jnp.concatenate([vsrc, lane * DK])
+                logits_all, cache = tf.mixed_step(
+                    params, cfg, cache, tables, block.reshape(-1),
+                    flat_slot, flat_pos, src, lane * DK,
+                    jnp.where(alive, DK, 0).astype(jnp.int32), eff, mesh)
+                vlogits = logits_all[: B * DK].reshape(B, DK, -1)
+                out, counts, carry_keys, grow = \
+                    sampler_mod.speculative_accept(
+                        drafts, q_sel, q_probs, q_idx, vlogits, sstate,
+                        dkeys, enable=spec_col & alive, lengths=eff,
+                        guide_tables=gtables)
+                sstate = sstate._replace(
+                    key=jnp.where(alive[:, None], carry_keys, sstate.key),
+                    guide_row=jnp.where(alive, grow, sstate.guide_row))
+                counts = jnp.maximum(counts, 1)
+                # Liveness over the ACCEPTED prefix only: tokens past
+                # counts are rejected drafts the host never sees — they
+                # must not trip the stop check.
+                valid = blk[None, :] < counts[:, None]
+                masked = jnp.where(valid & alive[:, None], out,
+                                   jnp.int32(-1))
+                lengths = lengths + jnp.where(alive, counts, jnp.int32(1))
+                alive = sampler_mod.advance_liveness(
+                    jnp.swapaxes(masked, 0, 1), alive, lengths, stop_ids,
+                    dead_len)
+                last = jnp.take_along_axis(out, (counts - 1)[:, None],
+                                           axis=1)[:, 0]
+                tokens_out = jnp.where(alive, last, jnp.int32(0))
+                toks = jnp.swapaxes(out, 0, 1)              # [DK, B]
+                if want_lp:
                     clp, vals, lids = sampler_mod.top_logprobs(
                         vlogits[:, 0], out[:, 0])
-                    return (cache, dcache, out, counts,
-                            sstate, clp, vals, lids)
-                return cache, dcache, out, counts, sstate
+                    # [1, B]-shaped so the resolve fanout shares the
+                    # K-step record format (lp lanes always land c == 1).
+                    return (cache, dcache, sstate, toks, counts,
+                            clp[None], vals[None], lids[None], tokens_out,
+                            lengths, alive)
+                return (cache, dcache, sstate, toks, counts, tokens_out,
+                        lengths, alive)
 
-            self._spec_fn = jax.jit(
-                functools.partial(spec_loop, want_lp=False),
-                donate_argnums=(2, 3, 6))
-            self._spec_lp_fn = jax.jit(
-                functools.partial(spec_loop, want_lp=True),
-                donate_argnums=(2, 3, 6))
+            self._spec_pipe_fn = jax.jit(
+                functools.partial(spec_pipe, want_lp=False),
+                donate_argnums=(2, 3, 4, 5, 6, 10))
+            self._spec_pipe_lp_fn = jax.jit(
+                functools.partial(spec_pipe, want_lp=True),
+                donate_argnums=(2, 3, 4, 5, 6, 10))
 
     # ------------------------------------------------------------------
     # Public API
@@ -1717,9 +1895,14 @@ class InferenceEngine:
         # auto: paged wherever supported — it measured faster than the
         # slot layout at production shapes and adds on-device prefix
         # sharing (tools/bench_kernels.py).  CPU stays on the slot layout
-        # (interpret-mode kernels are test-only).
-        if blockers or jax.default_backend() != "tpu":
+        # (interpret-mode kernels are test-only) EXCEPT for draft engines:
+        # speculation requires the mixed scheduler, whose CPU path runs
+        # the XLA oracle — resolving slot there would turn a valid spec
+        # config into an init error.
+        if blockers:
             return False
+        if jax.default_backend() != "tpu":
+            return self.ecfg.draft_model is not None and bool(self._chunk)
         return True
 
     def _shard_cache(self, cache):
@@ -1914,15 +2097,6 @@ class InferenceEngine:
                             self._fault_counts[rid], err)
                 self._fail_survivor(sv, "error", err)
                 continue
-            if ((sv.generated or isinstance(sv.request.outputs, _ReplayGate))
-                    and not self._replay_ok()):
-                # No replay on this engine shape: speculative decoding's
-                # key stream advances per DISPATCH, so a re-run is not
-                # reproducible from the token record — the stream cannot
-                # resume without risking duplicated or changed tokens.
-                # Fail it alone rather than corrupt.
-                self._fail_survivor(sv, "error", err)
-                continue
             keep.append(sv)
 
         # ---- re-admit survivors ----------------------------------------
@@ -2003,14 +2177,6 @@ class InferenceEngine:
         with self._abort_lock:
             self._aborted -= set(consumed)
             self._aborted &= active | self._queued_rids
-
-    def _replay_ok(self) -> bool:
-        """Token-replay rides deterministic re-execution: valid wherever
-        a request's stream is a pure function of (prompt, params, seed) —
-        every engine shape except speculative decoding, whose key stream
-        advances per DISPATCH (schedule-dependent, not reproducible from
-        the token record)."""
-        return self._draft_cfg is None
 
     def _fail_survivor(self, sv: "_Survivor", reason: str,
                        error: str | None) -> None:
@@ -2154,10 +2320,13 @@ class InferenceEngine:
         chunk dispatch, not one whole prefill.  Returns True if any work
         was done.
 
-        Speculative engines keep the sequential order (the spec dispatch
-        resolves inline).  Phase-seconds note: with the overlap, waits on
-        the shared device stream land in whichever phase fetches first —
-        the breakdown attributes WALL time, not device time."""
+        Speculative engines ride the mixed branch like any other mixed
+        engine — their dispatch is the spec-mixed program (draft propose +
+        ragged verify + accept), issued async and resolved after the
+        overlapped admission work exactly like a plain mixed dispatch.
+        Phase-seconds note: with the overlap, waits on the shared device
+        stream land in whichever phase fetches first — the breakdown
+        attributes WALL time, not device time."""
         t0 = time.monotonic()
         self._maybe_finish_recovery()
         self._ensure_guides_uploaded()
@@ -2212,24 +2381,30 @@ class InferenceEngine:
             # sequences' chunk tokens — admission host work overlaps the
             # in-flight dispatch exactly as in the legacy issue/resolve
             # split.
+            spec = self._draft_cfg is not None
+            phase = "spec" if spec else "mixed"
             if self._slots or self._prefilling:
-                pending = self._issue_mixed()
+                pending = (self._issue_spec_mixed() if spec
+                           else self._issue_mixed())
                 issued = pending is not None
             t1 = time.monotonic()
             if issued:
                 self.metrics.scheduler_seconds_total.inc(t1 - t0,
-                                                         phase="mixed")
+                                                         phase=phase)
             worked = self._admit() or worked or issued
             t2 = time.monotonic()
             if t2 - t1 > 1e-4:
                 self.metrics.scheduler_seconds_total.inc(t2 - t1,
                                                          phase="admit")
             if pending is not None:
-                self._resolve_mixed(pending, exclude_s=t2 - t1)
+                if spec:
+                    self._resolve_spec_mixed(pending, exclude_s=t2 - t1)
+                else:
+                    self._resolve_mixed(pending, exclude_s=t2 - t1)
                 self.metrics.scheduler_seconds_total.inc(
-                    time.monotonic() - t2, phase="mixed")
+                    time.monotonic() - t2, phase=phase)
         else:
-            if self._slots and self._draft_cfg is None and self._overlap:
+            if self._slots and self._overlap:
                 pending = self._issue_decode()  # may retire/abort even if None
                 issued = True
             t1 = time.monotonic()
@@ -2249,10 +2424,9 @@ class InferenceEngine:
                 self._resolve_decode(pending, exclude_s=t2 - t1)
                 self.metrics.scheduler_seconds_total.inc(
                     time.monotonic() - t2, phase="decode")
-            elif self._slots and (self._draft_cfg is not None
-                                  or not self._overlap):
-                # Sequential order: speculative engines, and platforms where
-                # the overlap cannot pay (see _overlap above).
+            elif self._slots and not self._overlap:
+                # Sequential order: platforms where the overlap cannot pay
+                # (see _overlap above).
                 self._decode_dispatch()
                 self.metrics.scheduler_seconds_total.inc(
                     time.monotonic() - t2, phase="decode")
@@ -2318,9 +2492,7 @@ class InferenceEngine:
         all batches go out back-to-back (async); first tokens are fetched
         DEFERRED (self._pending_admits, resolved by step() as they become
         ready) so the engine thread never blocks on an admit program's
-        device round-trip while decode work is available.  Spec engines
-        resolve inline (their eligibility logic assumes registered
-        slots)."""
+        device round-trip while decode work is available."""
         admitted = False
         groups: dict[tuple[int, bool], list] = {}
         recs = []
@@ -3310,8 +3482,18 @@ class InferenceEngine:
                                          num_prompt=num_prompt)]) from e
             draft_synced = True
         now = time.monotonic()
+        p_ = req.params
+        # Spec eligibility, frozen for the slot's lifetime (see _Slot):
+        # per-lane and params-pure, which keeps the key-advance structure
+        # schedule-independent — the property token-replay recovery needs.
+        spec_ok = (draft_synced
+                   and p_.presence_penalty == 0
+                   and p_.frequency_penalty == 0
+                   and p_.logprobs is None
+                   and not p_.logit_bias
+                   and p_.min_tokens == 0)
         st = _Slot(request=req, num_prompt=num_prompt,
-                   draft_synced=draft_synced, seed=seed)
+                   draft_synced=draft_synced, spec_ok=spec_ok, seed=seed)
         self._fault_counts.pop(req.request_id, None)
         replaying = req.request_id in self._replaying
         if replaying:
@@ -3749,17 +3931,26 @@ class InferenceEngine:
         state = (jnp.asarray(np.zeros((n,), np.int32)),
                  jnp.asarray(np.zeros((n,), np.int32)),
                  jnp.asarray(np.zeros((n,), bool)))
-        cols = (jnp.asarray(np.full((n, sampler_mod.STOP_IDS_MAX), -1,
+        cols = [jnp.asarray(np.full((n, sampler_mod.STOP_IDS_MAX), -1,
                                     np.int32)),
-                jnp.asarray(np.zeros((n,), np.int32)))
+                jnp.asarray(np.zeros((n,), np.int32))]
+        if self._draft_cfg is not None:
+            cols.append(jnp.asarray(np.zeros((n,), bool)))
         tables = jnp.asarray(self._tables) if self._paged else None
-        args = (self.params, self._cache, *state, *cols, self._sampling,
-                tables, self._guide_dev)
+        if self._draft_cfg is not None:
+            args = (self.params, self._draft_params, self._cache,
+                    self._draft_cache, *state, *cols, self._sampling,
+                    tables, self._guide_dev)
+        else:
+            args = (self.params, self._cache, *state, *cols, self._sampling,
+                    tables, self._guide_dev)
         return jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=x.sharding), args)
 
     def _pipe_jit_fn(self, want_lp: bool):
+        if self._draft_cfg is not None:
+            return self._spec_pipe_lp_fn if want_lp else self._spec_pipe_fn
         if self._mixed:
             return self._mixed_pipe_lp_fn if want_lp else self._mixed_pipe_fn
         return self._decode_pipe_lp_fn if want_lp else self._decode_pipe_fn
@@ -3859,23 +4050,34 @@ class InferenceEngine:
                     self._finish(slot, "length")
             if not self._slots:
                 return
+        spec = self._draft_cfg is not None
         if self._paged:
             self._grow_slot_pages(K, ahead=len(self._pipe_inflight))
         self._ensure_guides_uploaded()
-        self._faults.fire("decode")
+        self._faults.fire("spec" if spec else "decode")
         if fresh:
             n = self.ecfg.num_slots
             alive = np.zeros((n,), bool)
             stop_ids = np.full((n, sampler_mod.STOP_IDS_MAX), -1, np.int32)
             dead_len = np.zeros((n,), np.int32)
+            spec_col = np.zeros((n,), bool)
             for slot, st in self._slots.items():
                 alive[slot] = True
                 stop_ids[slot] = st.stop_col
                 dead_len[slot] = st.dead_len
+                spec_col[slot] = st.spec_ok
             state = (jnp.asarray(self._last_token),
                      jnp.asarray(self._lengths), jnp.asarray(alive))
-            self._pipe_cols = (jnp.asarray(stop_ids), jnp.asarray(dead_len))
-            self._pipe_cols_np = (stop_ids, dead_len)
+            cols = [jnp.asarray(stop_ids), jnp.asarray(dead_len)]
+            cols_np = [stop_ids, dead_len]
+            if spec:
+                # Spec eligibility is per-slot device data too: the
+                # threaded spec_pipe dispatches gate acceptance on it
+                # without any host value.
+                cols.append(jnp.asarray(spec_col))
+                cols_np.append(spec_col)
+            self._pipe_cols = tuple(cols)
+            self._pipe_cols_np = tuple(cols_np)
         else:
             state = self._pipe_state
         want_lp = any(st.request.params.logprobs is not None
@@ -3890,28 +4092,47 @@ class InferenceEngine:
                            alive=alive.copy(),
                            stop_ids=self._pipe_cols_np[0].copy(),
                            dead_len=self._pipe_cols_np[1].copy())
+            if spec:
+                payload.update(spec_enable=self._pipe_cols_np[2].copy())
         self._emit("decode_pipe", **payload)
         t0 = time.monotonic()
-        out = self._pipe_call(want_lp, self.params, self._cache, *state,
-                              *self._pipe_cols, self._sampling, tables_arg,
-                              self._guide_dev)
-        if want_lp:
-            (self._cache, self._sampling, toks, clps, lvals, lids,
-             ntok, nlen, nalive) = out
-            lp_devs = (clps, lvals, lids)
+        if spec:
+            out = self._pipe_call(want_lp, self.params, self._draft_params,
+                                  self._cache, self._draft_cache, *state,
+                                  *self._pipe_cols, self._sampling,
+                                  tables_arg, self._guide_dev)
+            if want_lp:
+                (self._cache, self._draft_cache, self._sampling, toks,
+                 counts, clps, lvals, lids, ntok, nlen, nalive) = out
+                lp_devs = (clps, lvals, lids)
+            else:
+                (self._cache, self._draft_cache, self._sampling, toks,
+                 counts, ntok, nlen, nalive) = out
+                lp_devs = None
         else:
-            self._cache, self._sampling, toks, ntok, nlen, nalive = out
-            lp_devs = None
+            counts = None
+            out = self._pipe_call(want_lp, self.params, self._cache, *state,
+                                  *self._pipe_cols, self._sampling,
+                                  tables_arg, self._guide_dev)
+            if want_lp:
+                (self._cache, self._sampling, toks, clps, lvals, lids,
+                 ntok, nlen, nalive) = out
+                lp_devs = (clps, lvals, lids)
+            else:
+                self._cache, self._sampling, toks, ntok, nlen, nalive = out
+                lp_devs = None
         self._pipe_state = (ntok, nlen, nalive)
         # Start the device->host copies NOW so the lagged resolve finds
         # them materialized instead of blocking the engine thread.
-        for arr in (toks,) + (lp_devs or ()):
+        for arr in (toks,) + (() if counts is None else (counts,)) \
+                + (lp_devs or ()):
             try:
                 arr.copy_to_host_async()
             except Exception as e:  # platform without async host copies
                 faults_mod.swallowed("copy_to_host_async", e)
         snapshot = [(s, int(self._slot_gen[s])) for s in self._slots]
-        self._pipe_inflight.append((snapshot, want_lp, toks, lp_devs, K, t0))
+        self._pipe_inflight.append(
+            (snapshot, want_lp, toks, lp_devs, K, t0, counts))
         self.metrics.pipeline_depth_occupancy.observe(
             len(self._pipe_inflight))
 
@@ -3921,10 +4142,12 @@ class InferenceEngine:
         max_tokens truncation, logprob formatting), and retire finished
         slots — whose overshoot tokens in NEWER in-flight dispatches are
         discarded by the (slot, gen) snapshot guard."""
-        snapshot, want_lp, toks, lp_devs, K, t0 = self._pipe_inflight.popleft()
+        (snapshot, want_lp, toks, lp_devs, K, t0,
+         counts_dev) = self._pipe_inflight.popleft()
         self._faults.fire("resolve")
         t_wait = time.monotonic()
         toks = np.asarray(toks)  # host sync point (async copy usually done)
+        counts = None if counts_dev is None else np.asarray(counts_dev)
         if lp_devs is not None:
             clps = np.asarray(lp_devs[0])    # [K, B]
             lvals = np.asarray(lp_devs[1])   # [K, B, L]
@@ -3940,14 +4163,35 @@ class InferenceEngine:
         self._pipe_last_resolve = now
         dt = max(now - (t0 if last is None else last), 1e-6)
         cols = toks.T.tolist()
+        n_spec = accepted = 0
         for slot, gen in snapshot:
             st = self._slots.get(slot)
             if st is None or int(self._slot_gen[slot]) != gen:
                 continue  # retired at an earlier resolve: overshoot dropped
+            col = cols[slot]
+            if counts is not None:
+                # Spec dispatch: only the accepted prefix of the verify
+                # block is real output; the rejected tail is garbage the
+                # device also never threaded forward.
+                c = max(1, min(int(counts[slot]), K))
+                col = col[:c]
+                if st.spec_ok:
+                    n_spec += 1
+                    accepted += c - 1
+                    self.metrics.spec_decode_accepted_length.observe(c)
             lp_rows = None
             if want_lp and st.request.params.logprobs is not None:
                 lp_rows = (clps[:, slot], lvals[:, slot], lids[:, slot])
-            self._fanout_decode_tokens(slot, cols[slot], lp_rows, dt)
+            self._fanout_decode_tokens(slot, col, lp_rows, dt)
+        if n_spec:
+            DK = self.ecfg.draft_len
+            self.metrics.spec_decode_proposed_tokens_total.inc(
+                (DK - 1) * n_spec)
+            self.metrics.spec_decode_accepted_tokens_total.inc(accepted)
+            self._spec_proposed += (DK - 1) * n_spec
+            self._spec_accepted += accepted
+            self.metrics.spec_decode_acceptance_rate.set(
+                self._spec_accepted / max(self._spec_proposed, 1))
 
     @_scoped("decode")
     def _pipe_drain(self) -> None:
@@ -3981,7 +4225,7 @@ class InferenceEngine:
     def _issue_decode(self):
         """Decode bookkeeping + ASYNC dispatch.  Returns the pending record
         for _resolve_decode, or None when nothing dispatched (no live
-        slots, or the speculative path ran synchronously).
+        slots).
 
         The issue/resolve split lets step() overlap admission host work
         with the in-flight decode: aborted/retired slots free their pages
@@ -4003,44 +4247,12 @@ class InferenceEngine:
         # guide-parked requests count as live (purging their flags would
         # lose aborts raised between issue and registration).
         self._purge_stale_aborts(consumed)
-        # Retire any slot that would overflow its cache this dispatch (the
-        # spec path writes draft_len rows, the fused loop K).
-        margin = max(K, self.ecfg.draft_len if self._draft_cfg else 0)
+        # Retire any slot that would overflow its cache this dispatch.
         for slot in list(self._slots):
-            if int(self._lengths[slot]) + 1 + margin > self.ecfg.max_cache_len:
+            if int(self._lengths[slot]) + 1 + K > self.ecfg.max_cache_len:
                 self._finish(slot, "length")
         if not self._slots:
             return None
-
-        # Speculative path: runs whenever ANY slot is eligible (draft-
-        # synced, penalty-free, no logprobs — greedy OR sampled, the
-        # rejection-sampled kernel is exact in distribution either way).
-        # Ineligible slots ride the dispatch DISABLED: they advance one
-        # normally-sampled token (penalties applied, logprobs emitted)
-        # while the rest keep speculating — one penalized client no longer
-        # turns speculation off for everyone.  Multi-host gangs mirror it
-        # like any other dispatch ("spec" op).
-        if self._draft_cfg is not None:
-            eligible = {
-                slot: (st.draft_synced
-                       and st.request.params.presence_penalty == 0
-                       and st.request.params.frequency_penalty == 0
-                       and st.request.params.logprobs is None
-                       and not st.request.params.logit_bias
-                       and st.request.params.min_tokens == 0
-                       # Guided slots ride the plain path: draft proposals
-                       # ignore the DFA mask, and multi-token acceptance
-                       # would need an in-kernel fold of the guide advance.
-                       and st.request.params.guide is None)
-                for slot, st in self._slots.items()}
-            if any(eligible.values()):
-                self._spec_dispatch(eligible)
-                return None
-            # Nobody can speculate: the fused loop advances the target
-            # cache only — every live slot's draft mirror is stale from
-            # here on.
-            for st in self._slots.values():
-                st.draft_synced = False
 
         if self._paged:
             self._grow_slot_pages(K)
@@ -4148,11 +4360,12 @@ class InferenceEngine:
     # Mixed prefill+decode dispatch (ARKS_MIXED_STEP)
     # ------------------------------------------------------------------
 
-    def _mixed_abort_and_retire(self) -> None:
+    def _mixed_abort_and_retire(self, rows: int = 1) -> None:
         """Mixed-mode scheduling boundary: honor aborts for decoding AND
         prefilling sequences, purge stale abort flags, and retire slots
-        that would overflow the cache this dispatch (margin 1 — the mixed
-        step writes exactly one decode row per slot)."""
+        that would overflow the cache this dispatch (``rows`` decode rows
+        per slot: 1 for the plain mixed step, draft_len for a spec-mixed
+        verify block)."""
         with self._abort_lock:
             aborted = set(self._aborted)
         consumed = set()
@@ -4174,8 +4387,106 @@ class InferenceEngine:
                 consumed.add(rid)
         self._purge_stale_aborts(consumed)
         for slot in list(self._slots):
-            if int(self._lengths[slot]) + 2 > self.ecfg.max_cache_len:
+            if int(self._lengths[slot]) + 1 + rows > self.ecfg.max_cache_len:
                 self._finish(slot, "length")
+
+    def _mixed_batch_arrays(self, t_budget: int) -> dict:
+        """Empty host-side arrays for one mixed/spec-mixed batch: the flat
+        token view, the per-lane sampler view, and the completion-override
+        columns — ONE definition, so the plain and spec builders cannot
+        drift on padding conventions."""
+        num_slots = self.ecfg.num_slots
+        sentinel = self._park_sentinel()
+        return dict(
+            tokens=np.zeros((t_budget,), np.int32),
+            token_slot=np.full((t_budget,), -1, np.int32),
+            token_pos=np.full((t_budget,), sentinel, np.int32),
+            sample_src=np.zeros((num_slots,), np.int32),
+            feed_tokens=np.zeros((num_slots,), np.int32),
+            feed_active=np.zeros((num_slots,), bool),
+            seq_q_start=np.zeros((num_slots,), np.int32),
+            seq_q_len=np.zeros((num_slots,), np.int32),
+            seq_pos_start=np.zeros((num_slots,), np.int32),
+            ov_mask=np.zeros((num_slots,), bool),
+            ov_temp=np.zeros((num_slots,), np.float32),
+            ov_top_p=np.ones((num_slots,), np.float32),
+            ov_top_k=np.zeros((num_slots,), np.int32),
+            ov_key=np.zeros((num_slots, 2), np.uint32),
+            ov_bias_ids=np.full((num_slots, sampler_mod.LOGIT_BIAS_MAX), -1,
+                                np.int32),
+            ov_bias_vals=np.zeros((num_slots, sampler_mod.LOGIT_BIAS_MAX),
+                                  np.float32),
+            ov_sup=np.full((num_slots, sampler_mod.SUPPRESS_MAX), -1,
+                           np.int32),
+            ov_min_until=np.zeros((num_slots,), np.int32),
+            ov_guide=np.full((num_slots,), -1, np.int32),
+            ov_guide_row=np.zeros((num_slots,), np.int32))
+
+    def _fill_chunk_lanes(self, a: dict, t: int):
+        """Round-robin prefill-chunk fill starting at flat index ``t``: an
+        even quota per prefilling sequence first, FIFO greedy for the
+        leftover — a burst of long prompts shares the budget instead of
+        serializing.  Sequences whose prompt completes inside this batch
+        get transient first-token sampling columns packed into their lane
+        (same key and shaping semantics as the legacy sample_one).
+        Returns (completing, chunk_take, t)."""
+        completing: list = []
+        chunk_take: list[tuple[int, int]] = []
+        pre = list(self._prefilling.items())
+        if not pre or not self._mixed_budget:
+            return completing, chunk_take, t
+        budget = self._mixed_budget
+        quota = max(budget // len(pre), 1)
+        takes: dict[int, int] = {}
+        for slot, st in pre:
+            if budget <= 0:
+                break
+            take = min(len(st.ids) - st.pos, quota, budget)
+            if take > 0:
+                takes[slot] = take
+                budget -= take
+        for slot, st in pre:
+            if budget <= 0:
+                break
+            extra = min(len(st.ids) - st.pos - takes.get(slot, 0),
+                        budget)
+            if extra > 0:
+                takes[slot] = takes.get(slot, 0) + extra
+                budget -= extra
+        for slot, st in pre:
+            take = takes.get(slot, 0)
+            if not take:
+                continue
+            a["tokens"][t: t + take] = st.ids[st.pos: st.pos + take]
+            a["token_slot"][t: t + take] = slot
+            a["token_pos"][t: t + take] = np.arange(st.pos, st.pos + take)
+            a["seq_q_start"][slot] = t
+            a["seq_q_len"][slot] = take
+            a["seq_pos_start"][slot] = st.pos
+            chunk_take.append((slot, take))
+            if st.pos + take == len(st.ids):
+                a["sample_src"][slot] = t + take - 1
+                p = st.request.params
+                gid, grow0 = self._guide_cols(p)
+                bias_ids, bias_vals, sup, min_first, _mu = \
+                    self._shape_cols(p, 0)
+                a["ov_mask"][slot] = True
+                a["ov_temp"][slot] = p.temperature
+                a["ov_top_p"][slot] = p.top_p
+                a["ov_top_k"][slot] = p.top_k
+                a["ov_key"][slot] = np.asarray(st.key)
+                a["ov_bias_ids"][slot] = bias_ids
+                a["ov_bias_vals"][slot] = bias_vals
+                a["ov_sup"][slot] = sup
+                # lengths[slot] carries len(ids) while prefilling; +1
+                # makes ``lengths < min_until`` read as min_first.
+                a["ov_min_until"][slot] = \
+                    len(st.ids) + 1 if min_first else 0
+                a["ov_guide"][slot] = gid
+                a["ov_guide_row"][slot] = grow0
+                completing.append((slot, st, gid, grow0))
+            t += take
+        return completing, chunk_take, t
 
     @_scoped("mixed")
     def _issue_mixed(self):
@@ -4194,106 +4505,23 @@ class InferenceEngine:
         self._grow_slot_pages(1)
         self._faults.fire("decode")
         num_slots = self.ecfg.num_slots
-        t_budget = num_slots + self._mixed_budget
-        sentinel = self._park_sentinel()
-        tokens = np.zeros((t_budget,), np.int32)
-        token_slot = np.full((t_budget,), -1, np.int32)
-        token_pos = np.full((t_budget,), sentinel, np.int32)
-        sample_src = np.zeros((num_slots,), np.int32)
-        feed_tokens = np.zeros((num_slots,), np.int32)
-        feed_active = np.zeros((num_slots,), bool)
-        seq_q_start = np.zeros((num_slots,), np.int32)
-        seq_q_len = np.zeros((num_slots,), np.int32)
-        seq_pos_start = np.zeros((num_slots,), np.int32)
-        ov_mask = np.zeros((num_slots,), bool)
-        ov_temp = np.zeros((num_slots,), np.float32)
-        ov_top_p = np.ones((num_slots,), np.float32)
-        ov_top_k = np.zeros((num_slots,), np.int32)
-        ov_key = np.zeros((num_slots, 2), np.uint32)
-        ov_bias_ids = np.full((num_slots, sampler_mod.LOGIT_BIAS_MAX), -1,
-                              np.int32)
-        ov_bias_vals = np.zeros((num_slots, sampler_mod.LOGIT_BIAS_MAX),
-                                np.float32)
-        ov_sup = np.full((num_slots, sampler_mod.SUPPRESS_MAX), -1, np.int32)
-        ov_min_until = np.zeros((num_slots,), np.int32)
-        ov_guide = np.full((num_slots,), -1, np.int32)
-        ov_guide_row = np.zeros((num_slots,), np.int32)
+        a = self._mixed_batch_arrays(num_slots + self._mixed_budget)
 
         t = 0
         dec_slots = list(self._slots.keys())
         for slot in dec_slots:
-            tokens[t] = self._last_token[slot]
-            token_slot[t] = slot
-            token_pos[t] = self._lengths[slot]
-            sample_src[slot] = t
-            feed_tokens[slot] = self._last_token[slot]
-            feed_active[slot] = True
-            seq_q_start[slot] = t
-            seq_q_len[slot] = 1
-            seq_pos_start[slot] = self._lengths[slot]
+            a["tokens"][t] = self._last_token[slot]
+            a["token_slot"][t] = slot
+            a["token_pos"][t] = self._lengths[slot]
+            a["sample_src"][slot] = t
+            a["feed_tokens"][slot] = self._last_token[slot]
+            a["feed_active"][slot] = True
+            a["seq_q_start"][slot] = t
+            a["seq_q_len"][slot] = 1
+            a["seq_pos_start"][slot] = self._lengths[slot]
             t += 1
 
-        completing: list = []
-        chunk_take: list[tuple[int, int]] = []
-        pre = list(self._prefilling.items())
-        if pre and self._mixed_budget:
-            # Round-robin fill: an even quota per prefilling sequence
-            # first, FIFO greedy for the leftover — a burst of long
-            # prompts shares the budget instead of serializing.
-            budget = self._mixed_budget
-            quota = max(budget // len(pre), 1)
-            takes: dict[int, int] = {}
-            for slot, st in pre:
-                if budget <= 0:
-                    break
-                take = min(len(st.ids) - st.pos, quota, budget)
-                if take > 0:
-                    takes[slot] = take
-                    budget -= take
-            for slot, st in pre:
-                if budget <= 0:
-                    break
-                extra = min(len(st.ids) - st.pos - takes.get(slot, 0),
-                            budget)
-                if extra > 0:
-                    takes[slot] = takes.get(slot, 0) + extra
-                    budget -= extra
-            for slot, st in pre:
-                take = takes.get(slot, 0)
-                if not take:
-                    continue
-                tokens[t: t + take] = st.ids[st.pos: st.pos + take]
-                token_slot[t: t + take] = slot
-                token_pos[t: t + take] = np.arange(st.pos, st.pos + take)
-                seq_q_start[slot] = t
-                seq_q_len[slot] = take
-                seq_pos_start[slot] = st.pos
-                chunk_take.append((slot, take))
-                if st.pos + take == len(st.ids):
-                    # Prompt completes inside this batch: its lane samples
-                    # the FIRST token with the transient columns (same key
-                    # and shaping semantics as the legacy sample_one).
-                    sample_src[slot] = t + take - 1
-                    p = st.request.params
-                    gid, grow0 = self._guide_cols(p)
-                    bias_ids, bias_vals, sup, min_first, _mu = \
-                        self._shape_cols(p, 0)
-                    ov_mask[slot] = True
-                    ov_temp[slot] = p.temperature
-                    ov_top_p[slot] = p.top_p
-                    ov_top_k[slot] = p.top_k
-                    ov_key[slot] = np.asarray(st.key)
-                    ov_bias_ids[slot] = bias_ids
-                    ov_bias_vals[slot] = bias_vals
-                    ov_sup[slot] = sup
-                    # lengths[slot] carries len(ids) while prefilling; +1
-                    # makes ``lengths < min_until`` read as min_first.
-                    ov_min_until[slot] = \
-                        len(st.ids) + 1 if min_first else 0
-                    ov_guide[slot] = gid
-                    ov_guide_row[slot] = grow0
-                    completing.append((slot, st, gid, grow0))
-                t += take
+        completing, chunk_take, t = self._fill_chunk_lanes(a, t)
 
         want_lp = any(self._slots[s].request.params.logprobs is not None
                       for s in dec_slots)
@@ -4306,30 +4534,21 @@ class InferenceEngine:
         self.metrics.mixed_batch_tokens.observe(t)
         if n_chunk:
             self.metrics.mixed_chunk_tokens_total.inc(n_chunk)
-        self._emit("mixed", tokens=tokens, token_slot=token_slot,
-                   token_pos=token_pos, tables=tables,
-                   feed_tokens=feed_tokens, feed_active=feed_active,
-                   lengths=lengths, sample_src=sample_src,
-                   seq_q_start=seq_q_start, seq_q_len=seq_q_len,
-                   seq_pos_start=seq_pos_start, ov_mask=ov_mask,
-                   ov_temp=ov_temp, ov_top_p=ov_top_p, ov_top_k=ov_top_k,
-                   ov_key=ov_key, ov_bias_ids=ov_bias_ids,
-                   ov_bias_vals=ov_bias_vals, ov_sup=ov_sup,
-                   ov_min_until=ov_min_until, ov_guide=ov_guide,
-                   ov_guide_row=ov_guide_row, lp=want_lp)
+        self._emit("mixed", tables=tables, lengths=lengths, lp=want_lp,
+                   **a)
         t0 = time.monotonic()
         args = (self.params, self._cache, self._sampling,
-                jnp.asarray(tokens), jnp.asarray(token_slot),
-                jnp.asarray(token_pos), jnp.asarray(tables),
-                jnp.asarray(feed_tokens), jnp.asarray(feed_active),
-                jnp.asarray(lengths), jnp.asarray(sample_src),
-                jnp.asarray(seq_q_start), jnp.asarray(seq_q_len),
-                jnp.asarray(seq_pos_start), jnp.asarray(ov_mask),
-                jnp.asarray(ov_temp), jnp.asarray(ov_top_p),
-                jnp.asarray(ov_top_k), jnp.asarray(ov_key),
-                jnp.asarray(ov_bias_ids), jnp.asarray(ov_bias_vals),
-                jnp.asarray(ov_sup), jnp.asarray(ov_min_until),
-                jnp.asarray(ov_guide), jnp.asarray(ov_guide_row),
+                jnp.asarray(a["tokens"]), jnp.asarray(a["token_slot"]),
+                jnp.asarray(a["token_pos"]), jnp.asarray(tables),
+                jnp.asarray(a["feed_tokens"]), jnp.asarray(a["feed_active"]),
+                jnp.asarray(lengths), jnp.asarray(a["sample_src"]),
+                jnp.asarray(a["seq_q_start"]), jnp.asarray(a["seq_q_len"]),
+                jnp.asarray(a["seq_pos_start"]), jnp.asarray(a["ov_mask"]),
+                jnp.asarray(a["ov_temp"]), jnp.asarray(a["ov_top_p"]),
+                jnp.asarray(a["ov_top_k"]), jnp.asarray(a["ov_key"]),
+                jnp.asarray(a["ov_bias_ids"]), jnp.asarray(a["ov_bias_vals"]),
+                jnp.asarray(a["ov_sup"]), jnp.asarray(a["ov_min_until"]),
+                jnp.asarray(a["ov_guide"]), jnp.asarray(a["ov_guide_row"]),
                 self._guide_dev)
         lp_devs = None
         if want_lp:
@@ -4387,12 +4606,20 @@ class InferenceEngine:
             st = self._prefilling.get(slot)
             if st is not None:
                 st.pos += take
+        self._promote_completing(completing, ids, want_lp,
+                                 lp_devs and (clps, lvals, lids))
+
+    def _promote_completing(self, completing, ids, want_lp, lp_host) -> None:
+        """Promote sequences whose prompt completed inside a mixed (or
+        spec-mixed) batch: set_slot + registration — the same tail as the
+        legacy final chunk, minus its extra sample_one dispatch."""
         for slot, st, gid, grow0 in completing:
             del self._prefilling[slot]
             p = st.request.params
             first = int(ids[slot])
             first_lp = None
-            if want_lp and p.logprobs is not None:
+            if want_lp and p.logprobs is not None and lp_host is not None:
+                clps, lvals, lids = lp_host
                 first_lp = self._lp_entry(clps[slot], lvals[slot],
                                           lids[slot], p.logprobs)
             grow1 = self.guides.next_row(grow0, first) if gid >= 0 else 0
@@ -4417,101 +4644,143 @@ class InferenceEngine:
                                         self._slot_pages.get(slot, []),
                                         st.digests)
 
+    # ------------------------------------------------------------------
+    # Speculative decoding: draft+verify as a ragged mixed dispatch
+    # ------------------------------------------------------------------
+
     @_scoped("spec")
-    def _spec_dispatch(self, eligible: dict[int, bool]) -> None:
-        """One speculative step: draft proposes, target verifies, each
-        ELIGIBLE slot advances 1..draft_len tokens; disabled slots advance
-        exactly one normally-sampled token (penalties/logprobs served).
-        Greedy slots are byte-exact vs the target-only path; sampled slots
-        are exact in distribution (the rejection kernel's guarantee)."""
+    def _issue_spec_mixed(self):
+        """Build and issue ONE spec-mixed dispatch: every decoding slot
+        owns a fixed q_len=draft_len verify block (row 0 its last token —
+        the draft's proposals are scattered into rows 1.. ON DEVICE), and
+        prefill-chunk tokens ride the region after the blocks, so one
+        program per iteration serves decode feeds + prefill chunks + spec
+        verify.  ELIGIBLE slots advance 1..draft_len tokens by rejection
+        sampling; disabled slots advance exactly one normally-sampled
+        token (penalties/logprobs served); greedy slots are byte-exact vs
+        the target-only mixed path, sampled slots exact in distribution.
+        Returns the pending record for _resolve_spec_mixed."""
         DK = self.ecfg.draft_len
-        enable = np.zeros((self.ecfg.num_slots,), bool)
-        for slot, ok in eligible.items():
-            enable[slot] = ok
-        if self._paged:
-            self._grow_slot_pages(DK)
-        tables_arg = jnp.asarray(self._tables) if self._paged else None
-        want_lp = any(st.request.params.logprobs is not None
-                      for st in self._slots.values())
+        self._mixed_abort_and_retire(rows=DK)
+        if not self._slots and not self._prefilling:
+            return None
+        self._ensure_guides_uploaded()
+        self._grow_slot_pages(DK)
         self._faults.fire("spec")
+        num_slots = self.ecfg.num_slots
+        spec_t = num_slots * DK
+        a = self._mixed_batch_arrays(spec_t + self._mixed_budget)
+        spec_enable = np.zeros((num_slots,), bool)
+
+        dec_slots = list(self._slots.keys())
+        for slot in dec_slots:
+            st = self._slots[slot]
+            r0 = slot * DK
+            a["tokens"][r0] = self._last_token[slot]
+            a["token_slot"][r0: r0 + DK] = slot
+            a["token_pos"][r0: r0 + DK] = np.arange(
+                self._lengths[slot], self._lengths[slot] + DK)
+            a["sample_src"][slot] = r0
+            a["feed_tokens"][slot] = self._last_token[slot]
+            a["feed_active"][slot] = True
+            a["seq_q_start"][slot] = r0
+            a["seq_q_len"][slot] = DK
+            a["seq_pos_start"][slot] = self._lengths[slot]
+            spec_enable[slot] = st.spec_ok
+
+        completing, chunk_take, t = self._fill_chunk_lanes(a, spec_t)
+
+        want_lp = any(self._slots[s].request.params.logprobs is not None
+                      for s in dec_slots)
+        want_lp = want_lp or any(
+            st.request.params.logprobs is not None
+            for _, st, _, _ in completing)
+        lengths = np.array(self._lengths)
+        tables = self._tables.copy()
+        n_chunk = sum(take for _, take in chunk_take)
+        self.metrics.mixed_batch_tokens.observe(
+            len(dec_slots) * DK + n_chunk)
+        if n_chunk:
+            self.metrics.mixed_chunk_tokens_total.inc(n_chunk)
+        self._emit("spec_mixed", tables=tables, lengths=lengths,
+                   lp=want_lp, spec_enable=spec_enable.copy(), **a)
         t0 = time.monotonic()
-        self._emit("spec", tokens=np.array(self._last_token),
-                   lengths=np.array(self._lengths), enable=enable.copy(),
-                   lp=want_lp,
-                   tables=self._tables.copy() if self._paged else None)
         args = (self.params, self._draft_params, self._cache,
-                self._draft_cache, jnp.asarray(self._last_token),
-                jnp.asarray(self._lengths), self._sampling,
-                jnp.asarray(enable), tables_arg, self._guide_dev)
-        # The wait timer starts AFTER the async dispatch returns but
-        # BEFORE the first host fetch — in the lp branch the clps
-        # conversion is that first fetch, not np.asarray(a) (a later
-        # fetch of an already-materialized stream reads as ~0 wait, and
-        # timing the jit call itself would fold trace/compile into the
-        # "pure device wait" contract).
+                self._draft_cache, self._sampling,
+                jnp.asarray(a["tokens"]), jnp.asarray(a["token_slot"]),
+                jnp.asarray(a["token_pos"]), jnp.asarray(tables),
+                jnp.asarray(a["feed_tokens"]), jnp.asarray(a["feed_active"]),
+                jnp.asarray(lengths), jnp.asarray(a["sample_src"]),
+                jnp.asarray(a["seq_q_start"]), jnp.asarray(a["seq_q_len"]),
+                jnp.asarray(a["seq_pos_start"]), jnp.asarray(spec_enable),
+                jnp.asarray(a["ov_mask"]), jnp.asarray(a["ov_temp"]),
+                jnp.asarray(a["ov_top_p"]), jnp.asarray(a["ov_top_k"]),
+                jnp.asarray(a["ov_key"]), jnp.asarray(a["ov_bias_ids"]),
+                jnp.asarray(a["ov_bias_vals"]), jnp.asarray(a["ov_sup"]),
+                jnp.asarray(a["ov_min_until"]), jnp.asarray(a["ov_guide"]),
+                jnp.asarray(a["ov_guide_row"]), self._guide_dev)
+        lp_devs = None
         if want_lp:
-            (self._cache, self._draft_cache, a, counts, self._sampling,
-             clps, lvals, lids) = self._spec_lp_fn(*args)
-            t_wait = time.monotonic()
-            clps = np.asarray(clps)
-            lvals = np.asarray(lvals)
-            lids = np.asarray(lids)
+            (out_dev, counts_dev, comp_dev, clps, lvals, lids, self._cache,
+             self._draft_cache, self._sampling) = self._spec_mixed_lp_fn(
+                 *args)
+            lp_devs = (clps, lvals, lids)
         else:
-            (self._cache, self._draft_cache, a, counts,
-             self._sampling) = self._spec_fn(*args)
-            t_wait = time.monotonic()
-        a = np.asarray(a).tolist()   # [B][DK] python ints — host sync point
-        counts = np.asarray(counts).tolist()
+            (out_dev, counts_dev, comp_dev, self._cache, self._draft_cache,
+             self._sampling) = self._spec_mixed_fn(*args)
+        return (dec_slots, completing, chunk_take, want_lp, out_dev,
+                counts_dev, comp_dev, lp_devs, t0)
+
+    @_scoped("spec")
+    def _resolve_spec_mixed(self, rec, exclude_s: float = 0.0) -> None:
+        """Host-sync tail of a spec-mixed dispatch: fan each decoding
+        slot's accepted block out (1..draft_len tokens), account the
+        acceptance metrics, advance the prefilling sequences, and promote
+        completed prompts — the same tail shape as _resolve_mixed."""
+        (dec_slots, completing, chunk_take, want_lp, out_dev, counts_dev,
+         comp_dev, lp_devs, t0) = rec
+        self._faults.fire("resolve")
+        DK = self.ecfg.draft_len
+        t_wait = time.monotonic()
+        out = np.asarray(out_dev)        # [B, DK] — host sync point
+        counts = np.asarray(counts_dev)  # [B]
+        comp = np.asarray(comp_dev)      # [B]
         self.metrics.decode_resolve_wait_seconds_total.inc(
             time.monotonic() - t_wait, mode="sequential")
-        dt = time.monotonic() - t0
-
-        n_spec = sum(1 for s in self._slots if enable[s])
-        accepted = sum(counts[s] - 1 for s in self._slots if enable[s])
-        self.metrics.spec_decode_proposed_tokens_total.inc((DK - 1) * n_spec)
-        self.metrics.spec_decode_accepted_tokens_total.inc(accepted)
-        self._spec_proposed += (DK - 1) * n_spec
-        self._spec_accepted += accepted
-        self.metrics.spec_decode_acceptance_rate.set(
-            self._spec_accepted / max(self._spec_proposed, 1))
-
-        for slot in list(self._slots):
+        lp_host = None
+        if lp_devs is not None:
+            lp_host = (np.asarray(lp_devs[0]), np.asarray(lp_devs[1]),
+                       np.asarray(lp_devs[2]))
+        dt = max(time.monotonic() - t0 - exclude_s, 1e-6)
+        n_spec = accepted = 0
+        for slot in dec_slots:
             st = self._slots[slot]
-            c = counts[slot]
-            row = a[slot]
-            n_lp = st.request.params.logprobs
-            finished = False
-            new_tokens = 0
-            for i in range(c):
-                tok = row[i]
-                st.generated.append(tok)
-                if want_lp and n_lp is not None:
-                    # Disabled lp slots advance exactly one token (i == 0);
-                    # its entry comes from the position-0 verifier logits.
-                    st.logprobs.append(self._lp_entry(
-                        clps[slot], lvals[slot], lids[slot], n_lp))
-                new_tokens += 1
-                if (self._is_stop(st, tok)
-                        or len(st.generated) >= st.request.params.max_tokens):
-                    finished = True
-                    break
-            # Cache rows valid through the accepted prefix (t0 + c-1 drafts).
-            self._lengths[slot] += c
-            self._last_token[slot] = row[c - 1]
-            self.metrics.generation_tokens_total.inc(new_tokens)
-            self.metrics.time_per_output_token_seconds.observe(
-                dt / max(new_tokens, 1))
-            if finished:
-                self._finish(slot, self._finish_reason(st))
-            else:
-                delta = st.generated[st.num_emitted:]
-                lp_delta = (st.logprobs[st.num_emitted:]
-                            if n_lp is not None else None)
-                st.num_emitted = len(st.generated)
-                st.request.outputs.put(RequestOutput(
-                    request_id=st.request.request_id, token_ids=delta,
-                    num_prompt_tokens=st.num_prompt,
-                    logprobs=lp_delta))
+            c = max(1, min(int(counts[slot]), DK))
+            if st.spec_ok:
+                n_spec += 1
+                accepted += c - 1
+                self.metrics.spec_decode_accepted_length.observe(c)
+            lp_rows = None
+            if want_lp and st.request.params.logprobs is not None:
+                # Disabled lp slots advance exactly one token (c == 1);
+                # the entry comes from the position-0 verifier logits.
+                lp_rows = ([lp_host[0][slot]], [lp_host[1][slot]],
+                           [lp_host[2][slot]])
+            self._fanout_decode_tokens(
+                slot, [int(x) for x in out[slot][:c]], lp_rows, dt)
+        if n_spec:
+            self.metrics.spec_decode_proposed_tokens_total.inc(
+                (DK - 1) * n_spec)
+            self.metrics.spec_decode_accepted_tokens_total.inc(accepted)
+            self._spec_proposed += (DK - 1) * n_spec
+            self._spec_accepted += accepted
+            self.metrics.spec_decode_acceptance_rate.set(
+                self._spec_accepted / max(self._spec_proposed, 1))
+        for slot, take in chunk_take:
+            st = self._prefilling.get(slot)
+            if st is not None:
+                st.pos += take
+        self._promote_completing(completing, comp, want_lp, lp_host)
 
     # ------------------------------------------------------------------
     # Stop handling

@@ -470,21 +470,35 @@ class DispatchFollower:
                 self._pipe_state = (jnp.asarray(p["tokens"]),
                                     jnp.asarray(p["lengths"], jnp.int32),
                                     jnp.asarray(p["alive"]))
-                self._pipe_cols = (jnp.asarray(p["stop_ids"]),
-                                   jnp.asarray(p["dead_len"], jnp.int32))
+                cols = [jnp.asarray(p["stop_ids"]),
+                        jnp.asarray(p["dead_len"], jnp.int32)]
+                if "spec_enable" in p:
+                    cols.append(jnp.asarray(p["spec_enable"]))
+                self._pipe_cols = tuple(cols)
             elif self._pipe_state is None:
                 raise RuntimeError(
                     "decode_pipe without fresh state: leader/follower "
                     "pipeline streams diverged")
             tables = p.get("tables")
+            tables = None if tables is None else jnp.asarray(tables)
             # Same program resolution as the leader (_pipe_call prefers
             # this process's warmed executable when one exists).
-            out = eng._pipe_call(bool(p.get("lp")), eng.params, eng._cache,
-                                 *self._pipe_state, *self._pipe_cols,
-                                 eng._sampling,
-                                 None if tables is None else
-                                 jnp.asarray(tables), eng._guide_dev)
-            eng._cache, eng._sampling = out[0], out[1]
+            if eng._draft_cfg is not None:
+                # Spec engines thread the draft cache too; the program
+                # returns (cache, dcache, sampling, ...).
+                out = eng._pipe_call(bool(p.get("lp")), eng.params,
+                                     eng._draft_params, eng._cache,
+                                     eng._draft_cache, *self._pipe_state,
+                                     *self._pipe_cols, eng._sampling,
+                                     tables, eng._guide_dev)
+                eng._cache, eng._draft_cache, eng._sampling = \
+                    out[0], out[1], out[2]
+            else:
+                out = eng._pipe_call(bool(p.get("lp")), eng.params,
+                                     eng._cache, *self._pipe_state,
+                                     *self._pipe_cols, eng._sampling,
+                                     tables, eng._guide_dev)
+                eng._cache, eng._sampling = out[0], out[1]
             self._pipe_state = out[-3:]
         elif op == "mixed":
             # Unified mixed prefill+decode dispatch (ARKS_MIXED_STEP): the
@@ -522,21 +536,38 @@ class DispatchFollower:
                 jnp.asarray(p["tokens"]),
                 jnp.asarray([p["length"]], jnp.int32),
                 jnp.asarray(p["slot"]))
-        elif op == "spec":
-            # Key lockstep rides the shared _sampling state: both sides
-            # evolve it with the kernel's deterministic splits.
-            fn = eng._spec_lp_fn if p.get("lp") else eng._spec_fn
-            tables = p.get("tables")
-            out = fn(
-                eng.params, eng._draft_params, eng._cache, eng._draft_cache,
-                jnp.asarray(p["tokens"]), jnp.asarray(p["lengths"]),
-                eng._sampling, jnp.asarray(p["enable"]),
-                None if tables is None else jnp.asarray(tables),
-                eng._guide_dev)
-            eng._cache, eng._draft_cache = out[0], out[1]
-            counts = out[3]
-            eng._sampling = out[4]
-            jax.block_until_ready(counts)
+        elif op == "spec_mixed":
+            # Spec-mixed dispatch (draft propose + ragged verify + accept
+            # inside the mixed program): the whole batch description
+            # arrives by value like "mixed"; key lockstep rides the shared
+            # _sampling state, which both sides evolve with the kernel's
+            # deterministic splits.
+            fn = (eng._spec_mixed_lp_fn if p.get("lp")
+                  else eng._spec_mixed_fn)
+            out = fn(eng.params, eng._draft_params, eng._cache,
+                     eng._draft_cache, eng._sampling,
+                     jnp.asarray(p["tokens"]), jnp.asarray(p["token_slot"]),
+                     jnp.asarray(p["token_pos"]), jnp.asarray(p["tables"]),
+                     jnp.asarray(p["feed_tokens"]),
+                     jnp.asarray(p["feed_active"]),
+                     jnp.asarray(p["lengths"]),
+                     jnp.asarray(p["sample_src"]),
+                     jnp.asarray(p["seq_q_start"]),
+                     jnp.asarray(p["seq_q_len"]),
+                     jnp.asarray(p["seq_pos_start"]),
+                     jnp.asarray(p["spec_enable"]),
+                     jnp.asarray(p["ov_mask"]), jnp.asarray(p["ov_temp"]),
+                     jnp.asarray(p["ov_top_p"]), jnp.asarray(p["ov_top_k"]),
+                     jnp.asarray(p["ov_key"]),
+                     jnp.asarray(p["ov_bias_ids"]),
+                     jnp.asarray(p["ov_bias_vals"]),
+                     jnp.asarray(p["ov_sup"]),
+                     jnp.asarray(p["ov_min_until"]),
+                     jnp.asarray(p["ov_guide"]),
+                     jnp.asarray(p["ov_guide_row"]), eng._guide_dev)
+            eng._cache, eng._draft_cache, eng._sampling = \
+                out[-3], out[-2], out[-1]
+            jax.block_until_ready(out[1])
         elif op == "guides":
             # Guide-table sync: load the leader's host tables and refresh
             # the device copies NOW — ops after this one in the channel

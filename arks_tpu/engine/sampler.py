@@ -528,9 +528,7 @@ def speculative_accept(
     enable: jnp.ndarray | None = None,  # [B] bool; False = no speculation
     lengths: jnp.ndarray | None = None,  # [B] — min_tokens gating for the
                                          # disabled slots' plain sample
-    guide_tables=None,                   # guided slots are always DISABLED
-                                         # (host eligibility) — their one
-                                         # token rides the plain path
+    guide_tables=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rejection-sampled acceptance (Leviathan et al.): accept draft i with
     prob min(1, p_i(d_i)/q_i(d_i)); at the first rejection sample from the
@@ -547,18 +545,68 @@ def speculative_accept(
     penalties included — so one such request no longer drops the whole
     batch off the speculative path.
 
+    Guided slots SPECULATE (``guide_tables``): the DFA is threaded through
+    the draft prefix — position i's candidate row is the current row
+    advanced by drafts[0..i-1] — and each position's TARGET logits are
+    masked with that row's dead transitions before the acceptance
+    distribution is formed.  A draft token the grammar forbids has p = 0
+    at its own position, so it is always rejected and the residual (masked
+    target) distribution resamples a legal one — exactness is untouched
+    because only the target side defines the emitted distribution.  The
+    returned rows are rolled back to the ACCEPTED prefix: row after the
+    accepted drafts, advanced once more by the bonus/residual token.
+    Draft proposals themselves stay unmasked (the draft model has no DFA),
+    costing only acceptance rate, never correctness.
+
     Returns (tokens [B, K] — first counts[b] are valid, counts [B] in
     1..K, advanced keys, advanced guide rows [B])."""
     b, km1 = drafts.shape
     kk = km1 + 1
     greedy = state.temperature <= 0.0
 
+    # Guided lanes: candidate DFA rows per position + per-position target
+    # masks.  The [B, V] class gathers are cond-gated like guide_mask so
+    # unguided batches skip them.
+    rows_arr = None
+    if guide_tables is not None:
+        class_ids, trans = guide_tables
+        guided = state.guide >= 0
+
+        def _row_next(row, toks):
+            cls = class_ids[jnp.maximum(state.guide, 0), toks]    # [B]
+            nxt = trans[jnp.maximum(row, 0), cls]                 # [B]
+            # Dead transition holds the row (degenerate grammar), exactly
+            # like guide_advance.
+            return jnp.where(guided & (nxt >= 0), nxt, row)
+
+        rows = [state.guide_row]
+        for i in range(km1):
+            rows.append(_row_next(rows[-1], drafts[:, i]))
+        rows_arr = jnp.stack(rows, axis=1)                        # [B, K]
+
+        def _with_guides(tl):
+            cls_all = class_ids[jnp.maximum(state.guide, 0)]      # [B, V]
+
+            def mask_pos(lg, row):
+                r = trans[jnp.maximum(row, 0)]                    # [B, C]
+                nxt = jnp.take_along_axis(r, cls_all, axis=1)     # [B, V]
+                bad = (nxt < 0) & guided[:, None]
+                return jnp.where(bad, jnp.float32(-1e30), lg)
+
+            return jnp.stack([mask_pos(tl[:, i], rows_arr[:, i])
+                              for i in range(kk)], axis=1)
+
+        target_eff = jax.lax.cond(jnp.any(guided), _with_guides,
+                                  lambda tl: tl, target_logits)
+    else:
+        target_eff = target_logits
+
     # Target filtered dist per position: [B, K, W].
     def per_pos(logits_i):
         return filtered_probs(logits_i, state)
 
-    p_probs, p_idx, _ = jax.vmap(per_pos, in_axes=1, out_axes=1)(target_logits)
-    g_t = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # [B, K]
+    p_probs, p_idx, _ = jax.vmap(per_pos, in_axes=1, out_axes=1)(target_eff)
+    g_t = jnp.argmax(target_eff, axis=-1).astype(jnp.int32)  # [B, K]
 
     new_keys = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
     u_keys, r_keys, carry_keys = new_keys[:, 0], new_keys[:, 1], new_keys[:, 2]
@@ -596,6 +644,12 @@ def speculative_accept(
     out = out.at[jnp.arange(b), j].set(y)
 
     guide_row = state.guide_row
+    if rows_arr is not None:
+        # Roll back to the accepted prefix's row, then advance by the
+        # bonus/residual token — the state the NEXT dispatch's position-0
+        # mask (and the engine's persistent guide_row) must carry.
+        row_j = jnp.take_along_axis(rows_arr, j[:, None], axis=1)[:, 0]
+        guide_row = _row_next(row_j, y)
     if enable is not None:
         # Disabled slots: one token via the regular sampler (which applies
         # penalties / logit_bias / min_tokens / guide shaping) from the
@@ -605,7 +659,5 @@ def speculative_accept(
                                lengths=lengths, guide_tables=guide_tables)
         out = jnp.where(enable[:, None], out, out.at[:, 0].set(plain))
         counts = jnp.where(enable, counts, 1)
-        # Guided slots are never spec-ENABLED, so the plain path's advance
-        # is the only one that matters.
-        guide_row = jnp.where(enable, state.guide_row, pstate.guide_row)
+        guide_row = jnp.where(enable, guide_row, pstate.guide_row)
     return out, counts, carry_keys, guide_row

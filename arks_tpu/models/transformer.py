@@ -1036,10 +1036,14 @@ def verify_step(
 ) -> tuple[jnp.ndarray, KVCache | PagedKVCache]:
     """Multi-token decode: advance every slot K tokens in ONE pass.
 
-    The speculative-decoding verifier (and a general batched multi-token
-    scorer): token k of slot b sits at position lengths[b]+k, its KV is
-    written there, and it attends the cache prefix plus the earlier tokens
-    of its own block (causal).  Returns (logits [B, K, V] f32, cache).
+    A general batched multi-token scorer and the REFERENCE oracle for
+    speculative verify (the serving path now expresses verify blocks as
+    ragged q_len=K rows of ``mixed_step`` — one dispatch per iteration
+    carries decode feeds, prefill chunks, AND spec verify; the parity
+    between the two is closed in tests/test_paged_attention.py).  Token k
+    of slot b sits at position lengths[b]+k, its KV is written there, and
+    it attends the cache prefix plus the earlier tokens of its own block
+    (causal).  Returns (logits [B, K, V] f32, cache).
     Rows written for later-rejected draft tokens become garbage beyond the
     accepted length — every read path masks by position, and the next
     dispatch overwrites them (the same invariant as decode_step's padding

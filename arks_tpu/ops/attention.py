@@ -410,7 +410,10 @@ def paged_mixed_update_and_attend(
     )
     interpret = jax.default_backend() != "tpu"
     b_lanes = seq_q_start.shape[0]
-    qmax = max(t_flat - b_lanes, 1)
+    # Widest possible per-lane query span.  +1 covers the spec_pipe batch
+    # shape (EVERY lane a q_len=K block, t_flat == b_lanes * K): with one
+    # lane, t_flat - b_lanes would undershoot its own block width.
+    qmax = max(t_flat - b_lanes + 1, 1)
 
     def local(qg, kn, vn, kp, vp, ks, vs, tbl, tok_tbl, widx, q_start,
               qlen, pos0, lyr):

@@ -385,10 +385,17 @@ def run_serving_bench(model: str | None = None) -> dict:
     n_chips = max(len(jax.devices()), 1)
 
     cfg = get_config(model)
+    # Spec ladder rung (ARKS_BENCH_DRAFT_MODEL=tiny-gqa etc.): the same
+    # load through a spec-mixed engine, emitting spec_acceptance_rate +
+    # spec_goodput_tok_s_chip alongside the plain numbers — the goodput
+    # delta vs the no-draft rung is the speculation win under load.
+    draft_model = os.environ.get("ARKS_BENCH_DRAFT_MODEL") or None
+    draft_len = int(os.environ.get("ARKS_BENCH_DRAFT_LEN", "4"))
     ecfg = EngineConfig(
         model=model, num_slots=slots, max_cache_len=cache_len,
         steps_per_dispatch=steps, weight_dtype=weight_dtype,
         prefill_buckets=(128, 256, 512, 1024),
+        draft_model=draft_model, draft_len=draft_len,
         tensor_parallel=n_chips if n_chips > 1 else None)
     engine = InferenceEngine(cfg, ecfg, ByteTokenizer())
     engine.start()
@@ -470,7 +477,9 @@ def run_serving_bench(model: str | None = None) -> dict:
              "prefix_cache_hit_tokens_total",
              "decode_resolve_wait_seconds_total",
              "pipeline_depth_occupancy_sum",
-             "pipeline_depth_occupancy_count")
+             "pipeline_depth_occupancy_count",
+             "spec_decode_proposed_tokens_total",
+             "spec_decode_accepted_tokens_total")
     moderate = None
     try:
         t_launch = time.monotonic()
@@ -561,6 +570,26 @@ def run_serving_bench(model: str | None = None) -> dict:
     occupancy = round(occ_sum / occ_n, 3) if occ_n else None
     hit0 = _series_sum(s0, "prefix_cache_hit_tokens_total")
     hit1 = _series_sum(s1, "prefix_cache_hit_tokens_total")
+    # Speculative decoding under LOAD: the window's draft acceptance rate
+    # and the goodput it buys (emitted tokens/s/chip already counts every
+    # accepted token — DeepServe's acceptance-rate-driven throughput
+    # argument).  Only emitted on spec engines; a collapsing acceptance
+    # rate here is the same signal docs/monitoring.md alerts on.
+    spec = None
+    prop = (s1.get("spec_decode_proposed_tokens_total", 0.0)
+            - s0.get("spec_decode_proposed_tokens_total", 0.0))
+    if prop > 0:
+        acc = (s1.get("spec_decode_accepted_tokens_total", 0.0)
+               - s0.get("spec_decode_accepted_tokens_total", 0.0))
+        spec = {
+            "spec_acceptance_rate": round(acc / prop, 3),
+            "spec_proposed_tok_s": round(prop / (t1 - t0), 1),
+            "spec_accepted_tok_s": round(acc / (t1 - t0), 1),
+            # Goodput = emitted tokens/s/chip under load; with spec on,
+            # the gap between this and a no-draft run of the same ladder
+            # is the speculation win at the measured acceptance rate.
+            "spec_goodput_tok_s_chip": round(tok_s_chip, 1),
+        }
     return {
         # Which engine path produced these numbers (kv layout, decode
         # impl, overlap...) — the resolved config, not the requested one.
@@ -586,6 +615,7 @@ def run_serving_bench(model: str | None = None) -> dict:
         "serving_device_wait_fraction": device_wait,
         "decode_resolve_wait_fraction": resolve_wait,
         "pipeline_depth_occupancy": occupancy,
+        **(spec or {}),
         **(moderate or {}),
     }
 

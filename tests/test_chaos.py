@@ -27,6 +27,10 @@ pytestmark = pytest.mark.chaos
 
 SLOT = ("0", {})
 MIXED = ("auto", dict(prefill_chunk=16, kv_layout="paged"))
+# Speculative engines ride the mixed scheduler (draft+verify inside the
+# mixed dispatch) and join token-replay recovery like everyone else.
+SPEC = ("auto", dict(prefill_chunk=16, kv_layout="paged",
+                     draft_model="tiny", draft_len=3))
 
 
 def _mk_engine(monkeypatch, depth=0, mixed="0", inject=None, retries=None,
@@ -116,6 +120,43 @@ def test_decode_fault_recovers_all_streams_byte_identical(
     assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
     assert eng.metrics.engine_recovery_seconds._data, \
         "recovery latency never observed"
+    assert eng.state == "serving"
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_spec_fault_recovers_all_streams_byte_identical(monkeypatch, depth):
+    """A fault injected in the SPEC phase (the spec-mixed dispatch issue,
+    or the pipelined spec issue at depth 2) must recover every in-flight
+    stream byte-identically via token replay — spec engines joined the
+    recovery contract when the fused spec loop was retired."""
+    base, _ = _run(monkeypatch, depth, *SPEC)
+    got, eng = _run(monkeypatch, depth, *SPEC, inject="spec:3:runtime")
+    assert [f.finish_reason for _, f in got] == ["length", "length"]
+    assert got == base, "surviving spec streams diverged from the fault-free run"
+    faults = sum(eng.metrics.engine_faults_total._values.values())
+    assert faults == 1
+    assert sum(eng.metrics.requests_recovered_total._values.values()) == 2
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+def test_spec_repeated_fault_quarantines_only_the_culprit(monkeypatch):
+    """Spec phase fault -> everyone replays; the FIRST replay operation
+    then faults too -> that request fails ALONE while the other spec
+    stream finishes byte-identical to the fault-free run."""
+    base, _ = _run(monkeypatch, 0, *SPEC)
+    got, eng = _run(monkeypatch, 0, *SPEC,
+                    inject="spec:3:runtime,replay:1:runtime")
+    reasons = [f.finish_reason for _, f in got]
+    assert reasons.count("error") == 1, reasons
+    errs = [f for _, f in got if f.finish_reason == "error"]
+    assert errs[0].error.startswith("engine_fault")
+    base_streams = {f.request_id: (ids, f.finish_reason) for ids, f in base}
+    for ids, f in got:
+        if f.finish_reason != "error":
+            assert (ids, f.finish_reason) == base_streams[f.request_id], \
+                "survivor stream diverged from the fault-free run"
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 1
     assert eng.state == "serving"
 
 

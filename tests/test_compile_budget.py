@@ -73,3 +73,60 @@ def test_mixed_workload_compile_variant_budget(monkeypatch):
     # The mixed program itself is ONE variant per lp flavor.
     assert variants.get("_mixed_fn", 0) == 1, variants
     assert variants.get("_mixed_lp_fn", 0) <= 1, variants
+
+
+# Spec engines add the draft-prefill program (one per bucket) and the
+# spec-mixed program pair on top of the mixed engine's set; the point is
+# that draft+verify is ONE budget-shaped program per lp flavor — no
+# per-draft-len/per-batch verify family, no fused-loop twins.
+SPEC_TOTAL_BUDGET = 18
+
+
+def test_spec_workload_compile_variant_budget(monkeypatch):
+    """The spec program family collapsed into the mixed family: a spec
+    workload (several prompt lengths, greedy + sampled + logprobs +
+    penalized — enabled AND disabled lanes) compiles exactly one
+    spec-mixed program per lp flavor, no legacy decode/admit variants."""
+    monkeypatch.setenv("ARKS_MIXED_STEP", "auto")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=4, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        prefill_chunk=16, kv_layout="paged",
+                        draft_model="tiny-gqa", draft_len=4,
+                        prefix_cache_mb=0)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert eng._mixed
+
+    prompts = [[5, 6], [3] * 12, [7] * 20, list(range(3, 51)), [9] * 30]
+    reqs = []
+    for i, p in enumerate(prompts):
+        sp = SamplingParams(
+            max_tokens=4,
+            temperature=0.0 if i % 2 == 0 else 0.7,
+            seed=i, ignore_eos=True,
+            logprobs=1 if i == 1 else None,
+            frequency_penalty=0.5 if i == 2 else 0.0)
+        reqs.append(Request(f"sb{i}", [int(x) % cfg.vocab_size for x in p],
+                            sp))
+    for r in reqs:
+        eng.add_request(r)
+    for _ in range(600):
+        eng.step(block_s=0.01)
+        if (eng.num_running == 0 and eng._queue.empty()
+                and not eng._prefilling):
+            break
+    for r in reqs:
+        assert _drain(r).finished
+    assert eng._spec_proposed > 0
+
+    variants = eng.compiled_program_variants()
+    assert sum(variants.values()) <= SPEC_TOTAL_BUDGET, variants
+    # ONE spec-mixed program per lp flavor — the whole point: verify
+    # lanes are just ragged rows of the mixed dispatch, so there is no
+    # per-K (or per-enable-mask) recompile family.
+    assert variants.get("_spec_mixed_fn", 0) == 1, variants
+    assert variants.get("_spec_mixed_lp_fn", 0) <= 1, variants
+    # The legacy families are gone/dark.
+    assert variants.get("_decode_fn", 0) == 0, variants
+    assert variants.get("_admit_fn", 0) == 0, variants
+    assert "_spec_fn" not in variants, variants

@@ -26,6 +26,12 @@ HOT_PATH_FUNCTIONS = (
     "_pipe_issue",
     "_issue_decode",
     "_issue_mixed",
+    # Speculative decoding rides the mixed dispatch: the spec-mixed issue
+    # path (and the chunk-lane builder both mixed issuers share) must not
+    # grow a blocking fetch either — draft proposals are scattered into
+    # the verify blocks ON DEVICE precisely so no host sync is needed.
+    "_issue_spec_mixed",
+    "_fill_chunk_lanes",
     "_issue_admit_batch",
     # Hierarchical prefix cache: spills and restores are ISSUE-side too —
     # eviction must never block the engine thread, and a restore is just
@@ -38,11 +44,11 @@ HOT_PATH_FUNCTIONS = (
 
 # Sanctioned exceptions, keyed (function, unparsed argument).  Each entry
 # must stay justifiable as a NON-blocking read:
-#   - _issue_mixed / st.key: an 8-byte PRNG key materialized at
+#   - _fill_chunk_lanes / st.key: an 8-byte PRNG key materialized at
 #     _start_chunked, long before any in-flight dispatch could pin it.
 #   - _issue_admit_batch / slots_l: a host python list, not device data.
 ALLOWED = {
-    ("_issue_mixed", "st.key"),
+    ("_fill_chunk_lanes", "st.key"),
     ("_issue_admit_batch", "slots_l"),
 }
 
@@ -99,7 +105,7 @@ def test_no_blocking_fetches_on_the_issue_path():
 def test_resolve_tails_exist():
     """The guard above is only meaningful while the sanctioned sync tails
     exist under their expected names."""
-    for name in ("_resolve_decode", "_resolve_mixed", "_pipe_resolve_one",
-                 "_resolve_admit_batch", "_resolve_spills",
-                 "_resolve_restores"):
+    for name in ("_resolve_decode", "_resolve_mixed", "_resolve_spec_mixed",
+                 "_pipe_resolve_one", "_resolve_admit_batch",
+                 "_resolve_spills", "_resolve_restores"):
         assert callable(getattr(engine_mod.InferenceEngine, name)), name

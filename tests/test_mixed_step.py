@@ -14,8 +14,8 @@ from arks_tpu.models import get_config
 # Every op on the dispatch channel that runs the MODEL (admission state
 # writes like set_slot/clear_penalties are not dispatches of the model).
 MODEL_DISPATCH_OPS = {
-    "mixed", "decode", "chunk", "chunk_paged", "admit_batch",
-    "admit_batch_lp", "spec", "draft_prefill", "prefill_detached",
+    "mixed", "spec_mixed", "decode", "chunk", "chunk_paged", "admit_batch",
+    "admit_batch_lp", "draft_prefill", "prefill_detached",
     "prefill_detached_lp", "sample_one", "sample_one_lp",
 }
 
@@ -265,8 +265,10 @@ def test_mixed_guided_request_publishes_mid_batches(monkeypatch):
 
 
 def test_mixed_disabled_for_unsupported_engines(monkeypatch):
-    """Spec-decode and non-paged engines stay on the legacy scheduler even
-    when ARKS_MIXED_STEP=1 asks for mixed (with a warning, not a crash)."""
+    """Non-paged engines stay on the legacy scheduler even when
+    ARKS_MIXED_STEP=1 asks for mixed (with a warning, not a crash).
+    Spec engines are different: they REQUIRE mixed and raise instead
+    (tests/test_spec_decode.py::test_spec_decode_config_validation)."""
     monkeypatch.setenv("ARKS_MIXED_STEP", "1")
     cfg = get_config("tiny")
     ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,

@@ -178,6 +178,96 @@ def test_paged_mixed_attention_matches_oracle(quantized, block_q):
                     rtol=2e-2 if quantized else 2e-5)
 
 
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_mixed_attention_verify_rows_match_oracle(quantized):
+    """Speculative verify as ragged rows: mixed batches carrying q_len=K
+    verify blocks ALONGSIDE q_len=1 decode lanes and chunk rows — the
+    exact shape a spec-mixed dispatch sends — including a verify block
+    that CROSSES a page boundary, on bf16 and int8-quantized pools."""
+    page = 128 if quantized else 16
+    q, kp, vp, kps, vps, tables, _ = _setup(quantized=quantized, page=page)
+    b, hkv, g, d = q.shape
+    K = 4
+    qmax = 8
+    qm = jax.random.normal(jax.random.PRNGKey(7), (b, hkv, g, qmax, d),
+                           jnp.float32)
+    # Lane 0: q_len=1 decode row.  Lane 1: q_len=K verify block CROSSING
+    # the page boundary (starts K//2 before the page edge).  Lane 2:
+    # q_len=K verify block inside page 0.  Lane 3: a chunk row span.
+    pos_start = jnp.asarray([5, page - K // 2, 2, 0], jnp.int32)
+    q_len = jnp.asarray([1, K, K, qmax], jnp.int32)
+    for layer in (0, 1):
+        out = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len,
+                                    layer, k_scale=kps, v_scale=vps,
+                                    block_q=4, interpret=True)
+        ref = _mixed_ref(qm, kp, vp, kps, vps, tables, pos_start, q_len,
+                         layer)
+        for s in range(b):
+            for i in range(int(q_len[s])):
+                np.testing.assert_allclose(
+                    np.asarray(out[s, :, :, i], np.float32),
+                    ref[s, :, :, i],
+                    atol=2e-2 if quantized else 2e-5,
+                    rtol=2e-2 if quantized else 2e-5)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_mixed_step_verify_rows_match_verify_step(quantized):
+    """Model-level closure: a spec-mixed flat batch's verify-block logits
+    (tf.mixed_step with q_len=K rows) match tf.verify_step — the retired
+    dedicated verify dispatch, kept as the oracle — on the same paged
+    pool, with one block crossing a page boundary."""
+    from arks_tpu.models import get_config, transformer as tf
+
+    cfg = get_config("tiny")
+    params = tf.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    B, K, PAGE, MAXP = 2, 4, 16, 4
+    pool_a = tf.init_paged_cache(cfg, B * MAXP, PAGE, jnp.float32,
+                                 quantized=quantized)
+    pool_b = tf.init_paged_cache(cfg, B * MAXP, PAGE, jnp.float32,
+                                 quantized=quantized)
+    tables = jnp.arange(B * MAXP, dtype=jnp.int32).reshape(B, MAXP)
+    # Slot 1's block crosses the page boundary (14 -> 18 with page 16).
+    lengths = jnp.asarray([3, PAGE - 2], jnp.int32)
+    key = jax.random.PRNGKey(2)
+    for slot in range(B):
+        plen = int(lengths[slot])
+        pk = jax.random.normal(jax.random.fold_in(key, slot),
+                               (cfg.num_layers, 1, plen, cfg.num_kv_heads,
+                                cfg.head_dim), jnp.float32)
+        pv = pk * 0.5 + 1.0
+        n_pages = -(-plen // PAGE)
+        pad = n_pages * PAGE - plen
+        pkp = jnp.pad(pk, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        pvp = jnp.pad(pv, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        pool_a = tf.insert_pages(pool_a, pkp, pvp, tables[slot],
+                                 jnp.asarray(n_pages))
+        pool_b = tf.insert_pages(pool_b, pkp, pvp, tables[slot],
+                                 jnp.asarray(n_pages))
+    blocks = jax.random.randint(jax.random.PRNGKey(5), (B, K), 2, 200,
+                                jnp.int32)
+    ref, pool_a = tf.verify_step(params, cfg, pool_a, blocks, lengths,
+                                 tables=tables)
+    # The same blocks as a spec-mixed flat batch: lane b owns rows
+    # [b*K, (b+1)*K); logits gathered at every row.
+    flat_tokens = blocks.reshape(-1)
+    flat_slot = jnp.repeat(jnp.arange(B, dtype=jnp.int32), K)
+    flat_pos = (lengths[:, None]
+                + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+    src = jnp.arange(B * K, dtype=jnp.int32)
+    got, pool_b = tf.mixed_step(
+        params, cfg, pool_b, tables, flat_tokens, flat_slot, flat_pos,
+        src, jnp.arange(B, dtype=jnp.int32) * K,
+        jnp.full((B,), K, jnp.int32), lengths)
+    got = got.reshape(B, K, -1)
+    tol = 2e-2 if quantized else 2e-4
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=tol, rtol=tol)
+    # The written KV rows agree too (the next dispatch reads them).
+    np.testing.assert_allclose(np.asarray(pool_b.k), np.asarray(pool_a.k),
+                               atol=1e-5)
+
+
 def test_paged_mixed_attention_decode_lane_matches_decode_kernel():
     """A q_len=1 lane through the mixed kernel equals the dedicated decode
     kernel on the same pool/tables — the two paths must never diverge."""

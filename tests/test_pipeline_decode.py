@@ -321,17 +321,22 @@ def test_pipeline_survives_parked_guide_compile(monkeypatch):
     assert lfin.finish_reason == "length"
 
 
-def test_pipeline_disabled_for_spec_engines(monkeypatch):
-    """Speculative engines resolve dispatches inline: the pipelined path
-    must resolve to depth 0 regardless of the env."""
+def test_pipeline_enabled_for_spec_engines(monkeypatch):
+    """Speculative engines PIPELINE (the spec_pipe program threads
+    accepted-length/last-token state on device): the env depth sticks and
+    the per-slot write margin is the draft_len verify block.
+    Byte-identity across depths is asserted in
+    tests/test_spec_decode.py::test_pipeline_depth_parity."""
     monkeypatch.setenv("ARKS_PIPELINE_DEPTH", "2")
     cfg = get_config("tiny")
     ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
                         prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        prefill_chunk=16, kv_layout="paged",
                         draft_model="tiny", draft_len=3)
     eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
-    assert eng._pipe_depth == 0
-    assert eng.resolved_config["pipeline_depth"] == "0"
+    assert eng._pipe_depth == 2
+    assert eng.resolved_config["pipeline_depth"] == "2"
+    assert eng._pipe_rows == 3
 
 
 def test_pipeline_env_validation(monkeypatch):
