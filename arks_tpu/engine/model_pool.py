@@ -1,0 +1,310 @@
+"""Device-HBM weight pool for multi-model serving.
+
+One live engine process serves several models; the pool owns their weights
+as a first-class budgeted resource (``ARKS_MODEL_POOL_HBM_MB``).  Each
+registered model is resident / loading / evicted; residency is guarded by
+refcounts against in-flight use (the engine holds a ref on the active
+model) plus a ``pinned`` flag for the flagship and small co-resident
+models (draft, guide models) that must never be evicted.  Eviction is LRU
+over unpinned refcount-0 entries.
+
+The pool deliberately mirrors the guide-compiler discipline
+(``guides.GuideCompiler``): ``ensure()`` is a NON-BLOCKING claim — it
+returns the resident entry or a ``LoadTicket`` whose ``event`` fires when
+a background loader thread finishes.  The engine's scheduler polls the
+ticket from its step loop (the ``awaiting_model`` parked state), so
+pipelined decode of the current model keeps full depth while the next
+model's weights stream host→device.
+
+Budget accounting covers WEIGHTS only (logical bytes over the param tree
+leaves).  KV caches and per-model scheduler state live with the engine's
+model context and are not pool-budgeted; a model's first-ever load may
+transiently overshoot the budget (its size is unknown until the leaves
+exist) — the pool then evicts or fails the load immediately after.  Once
+a model has been resident its size is remembered, and later reloads make
+room BEFORE streaming.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("arks_tpu.model_pool")
+
+
+class PoolFullError(RuntimeError):
+    """The HBM budget cannot fit the model even after evicting every
+    unpinned idle entry.  Surfaces to clients as ``model_pool_exhausted``
+    (HTTP 503 + Retry-After)."""
+
+
+@dataclasses.dataclass
+class LoadTicket:
+    """Returned by ``ensure()`` when the model is not resident: ``event``
+    fires when the background load finishes; ``error`` is set on failure
+    (``model_pool_exhausted: ...`` when the budget can't fit it)."""
+
+    name: str
+    event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    error: str | None = None
+    t0: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    name: str
+    cfg: object                      # models.config.ModelConfig
+    model_path: str | None = None
+    loader: object | None = None     # zero-arg callable -> params
+    params: object | None = None
+    nbytes: int = 0                  # logical bytes; remembered across evictions
+    pinned: bool = False
+    refcount: int = 0
+    state: str = "evicted"           # "resident" | "loading" | "evicted"
+    last_used: float = 0.0
+    cold_starts: int = 0
+
+
+def tree_bytes(params) -> int:
+    """Logical bytes over the param-tree leaves (sharded arrays count
+    their GLOBAL size — the pool budgets the model, not one shard)."""
+    import jax
+    return sum(int(getattr(x, "nbytes", 0)) for x in jax.tree_util.tree_leaves(params))
+
+
+class ModelPool:
+    """Thread-safe registry of models sharing one device's weight HBM."""
+
+    def __init__(self, hbm_budget_mb: int | None = None):
+        if hbm_budget_mb is None:
+            raw = os.environ.get("ARKS_MODEL_POOL_HBM_MB", "0")
+            try:
+                hbm_budget_mb = int(raw)
+            except ValueError:
+                raise ValueError(f"ARKS_MODEL_POOL_HBM_MB={raw!r} (want an integer)")
+        if hbm_budget_mb < 0:
+            raise ValueError(f"ARKS_MODEL_POOL_HBM_MB={hbm_budget_mb} (want >= 0)")
+        self.budget_bytes = hbm_budget_mb * (1 << 20)  # 0 = unlimited
+        self._lock = threading.Lock()
+        self._entries: dict[str, ModelEntry] = {}
+        self._tickets: dict[str, LoadTicket] = {}
+        # Fired (outside the lock) with the evicted model's name; the
+        # engine drops its saved per-model context so the HBM actually
+        # frees (the context holds a params reference).
+        self.on_evict = None
+        # Optional namespace with .resident_bytes gauge / .cold_starts
+        # counter (labelled by model); the engine wires this up.
+        self.metrics = None
+
+    # ---- registration ------------------------------------------------
+
+    def register(self, name: str, cfg, *, model_path: str | None = None,
+                 loader=None, pinned: bool = False) -> ModelEntry:
+        """Declare a model the pool may serve.  ``loader`` is a zero-arg
+        callable returning the (device-resident, sharded) params; when
+        omitted the registrant must ``adopt()`` params later."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                e = ModelEntry(name=name, cfg=cfg, model_path=model_path)
+                self._entries[name] = e
+            if loader is not None:
+                e.loader = loader
+            if model_path is not None:
+                e.model_path = model_path
+            e.pinned = e.pinned or pinned
+            return e
+
+    def adopt(self, name: str, cfg, params, *, pinned: bool = False) -> ModelEntry:
+        """Attach already-loaded params (e.g. the flagship the process
+        booted with, or a draft model loaded at startup) as resident."""
+        e = self.register(name, cfg, pinned=pinned)
+        with self._lock:
+            e.params = params
+            e.nbytes = tree_bytes(params)
+            e.state = "resident"
+            e.last_used = time.monotonic()
+        self._publish_metrics()
+        return e
+
+    # ---- residency ---------------------------------------------------
+
+    def ensure(self, name: str) -> ModelEntry | LoadTicket:
+        """Non-blocking: resident entry, or a ticket for an in-flight /
+        newly-kicked background load.  Raises KeyError on unknown models."""
+        evicted = []
+        try:
+            with self._lock:
+                e = self._entries[name]
+                if e.state == "resident":
+                    e.last_used = time.monotonic()
+                    return e
+                t = self._tickets.get(name)
+                if t is not None:
+                    return t
+                if e.loader is None:
+                    raise KeyError(f"model {name!r} has no loader and no params")
+                # Known size from a previous residency: make room BEFORE
+                # the load streams, so we never overshoot the budget.
+                if e.nbytes:
+                    evicted = self._make_room_locked(e.nbytes, exclude=name)
+                e.state = "loading"
+                t = self._tickets[name] = LoadTicket(name=name)
+                threading.Thread(target=self._load, args=(e, t),
+                                 name=f"model-load-{name}", daemon=True).start()
+                return t
+        finally:
+            self._notify_evicted(evicted)
+
+    def load(self, name: str, timeout: float | None = None):
+        """Blocking convenience wrapper over ``ensure`` (startup, tests).
+        Returns the params; raises on load failure/timeout."""
+        got = self.ensure(name)
+        if isinstance(got, LoadTicket):
+            if not got.event.wait(timeout):
+                raise TimeoutError(f"model {name!r} load timed out")
+            if got.error:
+                raise PoolFullError(got.error) if "model_pool_exhausted" in got.error \
+                    else RuntimeError(got.error)
+        with self._lock:
+            e = self._entries[name]
+            if e.state != "resident":
+                raise RuntimeError(f"model {name!r} not resident after load")
+            e.last_used = time.monotonic()
+            return e.params
+
+    def _load(self, e: ModelEntry, t: LoadTicket) -> None:
+        evicted = []
+        try:
+            params = e.loader()
+            nbytes = tree_bytes(params)
+            with self._lock:
+                try:
+                    evicted = self._make_room_locked(nbytes, exclude=e.name)
+                except PoolFullError as pf:
+                    e.state = "evicted"
+                    t.error = f"model_pool_exhausted: {pf}"
+                    return
+                e.params = params
+                e.nbytes = nbytes
+                e.state = "resident"
+                e.last_used = time.monotonic()
+                e.cold_starts += 1
+            if self.metrics is not None:
+                self.metrics.cold_starts.inc(1, model=e.name)
+            log.info("model %s loaded (%.1f MiB) in %.2fs", e.name,
+                     nbytes / (1 << 20), time.monotonic() - t.t0)
+        except Exception as exc:  # noqa: BLE001 — surfaces via the ticket
+            with self._lock:
+                e.state = "evicted"
+            t.error = f"{type(exc).__name__}: {exc}"
+            log.error("model %s load failed: %s", e.name, t.error)
+        finally:
+            self._notify_evicted(evicted)
+            self._publish_metrics()
+            with self._lock:
+                self._tickets.pop(e.name, None)
+            t.event.set()
+
+    def _make_room_locked(self, need: int, exclude: str) -> list[str]:
+        """Evict LRU unpinned refcount-0 entries until ``need`` fits the
+        budget.  Returns evicted names (caller notifies outside the lock);
+        raises PoolFullError when eviction can't make room."""
+        if not self.budget_bytes:
+            return []
+        evicted: list[str] = []
+
+        def resident_bytes():
+            return sum(x.nbytes for x in self._entries.values()
+                       if x.state == "resident")
+
+        victims = sorted((x for x in self._entries.values()
+                          if x.state == "resident" and not x.pinned
+                          and x.refcount == 0 and x.name != exclude),
+                         key=lambda x: x.last_used)
+        vi = iter(victims)
+        while resident_bytes() + need > self.budget_bytes:
+            v = next(vi, None)
+            if v is None:
+                raise PoolFullError(
+                    f"need {need >> 20} MiB but only "
+                    f"{(self.budget_bytes - resident_bytes()) >> 20} MiB free "
+                    f"of {self.budget_bytes >> 20} MiB budget "
+                    f"(pinned/in-use models cannot be evicted)")
+            v.params = None
+            v.state = "evicted"
+            evicted.append(v.name)
+        return evicted
+
+    def _notify_evicted(self, names: list[str]) -> None:
+        for n in names:
+            log.info("model %s evicted (LRU)", n)
+            if self.on_evict is not None:
+                self.on_evict(n)
+        if names:
+            self._publish_metrics()
+
+    # ---- refcounts ---------------------------------------------------
+
+    def acquire(self, name: str) -> ModelEntry:
+        """Pin ``name`` against eviction while in use.  Raises if the
+        model is not resident — callers go through ``ensure`` first."""
+        with self._lock:
+            e = self._entries[name]
+            if e.state != "resident":
+                raise RuntimeError(f"model {name!r} is {e.state}, not resident")
+            e.refcount += 1
+            e.last_used = time.monotonic()
+            return e
+
+    def release(self, name: str) -> None:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is not None and e.refcount > 0:
+                e.refcount -= 1
+
+    # ---- introspection -----------------------------------------------
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def params_of(self, name: str):
+        with self._lock:
+            e = self._entries[name]
+            if e.state != "resident":
+                raise RuntimeError(f"model {name!r} is {e.state}, not resident")
+            return e.params
+
+    def entry(self, name: str) -> ModelEntry:
+        with self._lock:
+            return self._entries[name]
+
+    def snapshot(self) -> list[dict]:
+        """Residency listing for ``/v1/models``."""
+        with self._lock:
+            return [{
+                "name": e.name,
+                "state": e.state,
+                "resident_bytes": e.nbytes if e.state == "resident" else 0,
+                "pinned": e.pinned,
+                "refcount": e.refcount,
+                "cold_starts": e.cold_starts,
+            } for e in self._entries.values()]
+
+    def _publish_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        with self._lock:
+            rows = [(e.name, e.nbytes if e.state == "resident" else 0)
+                    for e in self._entries.values()]
+        for name, nbytes in rows:
+            self.metrics.resident_bytes.set(nbytes, model=name)

@@ -91,6 +91,10 @@ class Request:
     # re-admit/replay it with the identical key stream — a fresh counter
     # draw on replay would silently change the resumed stream's tokens.
     assigned_seed: int | None = None
+    # Multi-model serving: which pool model this request targets.  None =
+    # the engine's primary model.  Requests for a non-active model park in
+    # the ``awaiting_model`` state until the scheduler switches to it.
+    model: str | None = None
 
 
 @dataclasses.dataclass

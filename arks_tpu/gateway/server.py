@@ -29,6 +29,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import os
 import random
 import threading
 import time
@@ -70,9 +71,13 @@ HDR_USER = "x-arks-username"
 
 
 class _ApiError(Exception):
-    def __init__(self, code: int, message: str, stage: str = ""):
+    def __init__(self, code: int, message: str, stage: str = "",
+                 retry_after: int | None = None):
         super().__init__(message)
         self.code, self.message, self.stage = code, message, stage
+        # Emitted as a Retry-After header on the error response (cold-start
+        # backpressure: retry, don't fail the request class).
+        self.retry_after = retry_after
 
 
 class PyUsageScanner:
@@ -198,6 +203,12 @@ class Gateway:
         self.rate = RequestRateTracker()
         self.max_body_bytes = max_body_bytes
         self.process_timeout_s = process_timeout_s
+        # Cold-start-aware admission: while a model has no ready backend
+        # (scale-from-zero, weights still loading into a pool), QUEUE the
+        # request — poll routing for up to this many seconds — instead of
+        # an instant 503.  Past the window, 503 + Retry-After.
+        self.cold_start_wait_s = float(
+            os.environ.get("ARKS_GW_COLD_START_WAIT_S", "10"))
         self._httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
@@ -211,17 +222,23 @@ class Gateway:
             def log_message(self, fmt, *args):
                 pass
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _error(self, code: int, message: str) -> None:
+            def _error(self, code: int, message: str,
+                       retry_after: int | None = None) -> None:
                 # error body parity (util.go:40-77)
-                self._json(code, {"error": {"message": message, "code": code}})
+                hdrs = {"Retry-After": retry_after} if retry_after else None
+                self._json(code, {"error": {"message": message, "code": code}},
+                           headers=hdrs)
 
             def do_GET(self):
                 if self.path == "/v1/models":
@@ -390,6 +407,23 @@ class Gateway:
     # ------------------------------------------------------------------
 
     def _pick_backends(self, namespace: str, model: str) -> list[str]:
+        """Weighted-ordered backend candidates; cold-start-aware: a model
+        with routes but no ready backend yet (scale-from-zero, weight pool
+        still streaming) is POLLED for up to cold_start_wait_s before the
+        503 — the request queues on the gateway instead of bouncing.
+        Unknown models (404) fail fast."""
+        deadline = time.monotonic() + self.cold_start_wait_s
+        while True:
+            try:
+                return self._pick_backends_once(namespace, model)
+            except _ApiError as e:
+                if e.code != 503 or time.monotonic() >= deadline:
+                    if e.code == 503 and e.retry_after is None:
+                        e.retry_after = max(int(self.cold_start_wait_s), 1)
+                    raise
+            time.sleep(0.25)
+
+    def _pick_backends_once(self, namespace: str, model: str) -> list[str]:
         ep = self.qos.get_endpoint(namespace, model)
         if ep is None:
             raise _ApiError(404, f"model {model!r} not found", "route")
@@ -428,7 +462,8 @@ class Gateway:
             status = e.code
             self.metrics.errors_total.inc(stage=e.stage or "other")
             try:
-                handler._error(e.code, e.message)
+                handler._error(e.code, e.message,
+                               retry_after=getattr(e, "retry_after", None))
             except Exception:
                 pass
         except Exception as e:
@@ -490,7 +525,8 @@ class Gateway:
                 return resp.status
             finally:
                 conn.close()
-        raise _ApiError(503, f"all backends unreachable: {last_err}", "route")
+        raise _ApiError(503, f"all backends unreachable: {last_err}", "route",
+                        retry_after=5)
 
     def _relay_full(self, handler, resp, account) -> None:
         data = resp.read()
@@ -506,6 +542,11 @@ class Gateway:
         handler.send_header("Content-Type",
                             resp.headers.get("Content-Type", "application/json"))
         handler.send_header("Content-Length", str(len(data)))
+        # Cold-start backpressure travels end-to-end: the serving pod's
+        # Retry-After (model_pool_exhausted) reaches the client.
+        ra = resp.headers.get("Retry-After")
+        if ra:
+            handler.send_header("Retry-After", ra)
         handler.end_headers()
         handler.wfile.write(data)
 

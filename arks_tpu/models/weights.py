@@ -258,6 +258,77 @@ def load_orbax(cfg: ModelConfig, model_path: str, mesh=None,
     return params
 
 
+def _shard_put_fns(cfg: ModelConfig, template, mesh=None):
+    """Per-leaf H2D placement fns (the make_shard_and_gather_fns idiom):
+    one closure per param leaf that converts the host value to the leaf's
+    dtype and issues a NON-BLOCKING ``jax.device_put`` — sharded onto the
+    mesh when given, whole-array otherwise.  Because each put is async,
+    walking the tree overlaps the host read/convert of leaf N+1 with the
+    device transfer of leaf N."""
+    if mesh is not None:
+        tp = mesh.shape.get(tf.AXIS_MODEL, 1)
+        specs = tf.param_pspecs(cfg, tp)
+
+        def make(s, spec):
+            sh = jax.sharding.NamedSharding(mesh, spec)
+            return lambda x: jax.device_put(jnp.asarray(x, s.dtype), sh)
+
+        return jax.tree.map(make, template, specs)
+
+    def make_local(s):
+        return lambda x: jax.device_put(jnp.asarray(x, s.dtype))
+
+    return jax.tree.map(make_local, template)
+
+
+def stream_params_to_device(cfg: ModelConfig, host_params, mesh=None,
+                            dtype: Any = None) -> tf.Params:
+    """Stream a host-resident params tree to device leaf-by-leaf with
+    async H2D puts (no blocking between leaves, no tree-level barrier).
+    The returned arrays are in flight; the caller's first dispatch — an
+    ordinary stream op, exactly the restore mechanics — orders after them,
+    so a live engine keeps issuing pipelined decode for the CURRENT model
+    while the NEXT model's weights fly."""
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    template = jax.eval_shape(
+        lambda: tf.init_params(cfg, jax.random.PRNGKey(0), dtype))
+    fns = _shard_put_fns(cfg, template, mesh)
+    return jax.tree.map(lambda fn, x: fn(x), fns, host_params)
+
+
+def load_orbax_streaming(cfg: ModelConfig, model_path: str, mesh=None,
+                         dtype: Any = None,
+                         weight_dtype: str = "bf16") -> tf.Params:
+    """Shard-streaming Orbax load for live model switches: restore the
+    checkpoint to HOST memory, then scatter it to device with per-leaf
+    async puts (``stream_params_to_device``).  Unlike ``load_orbax`` —
+    which restores directly into device shardings and synchronizes the
+    restore — every device-facing op here is an async stream dispatch, so
+    it is safe to run from the model-pool loader thread while the engine
+    keeps full pipeline depth on the resident model.
+
+    Quantized loads fall back to ``load_orbax`` (its bounded-peak
+    leaf-quantize path is already host-staged)."""
+    import orbax.checkpoint as ocp
+
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    if _weight_bits(weight_dtype):
+        return load_orbax(cfg, model_path, mesh, dtype, weight_dtype)
+    path = os.path.abspath(orbax_path(model_path))
+    template = jax.eval_shape(
+        lambda: tf.init_params(cfg, jax.random.PRNGKey(0), dtype))
+    cpu = jax.devices("cpu")[0]
+    host_template = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype,
+            sharding=jax.sharding.SingleDeviceSharding(cpu)),
+        template)
+    ckptr = ocp.StandardCheckpointer()
+    host_params = ckptr.restore(path, host_template)
+    return stream_params_to_device(
+        cfg, jax.tree.map(np.asarray, host_params), mesh, dtype)
+
+
 def convert_hf_to_orbax(cfg: ModelConfig, model_path: str,
                         dtype: Any = None) -> str:
     """One-shot conversion after model download (the ArksModel 'Loading'
@@ -273,13 +344,34 @@ def convert_hf_to_orbax(cfg: ModelConfig, model_path: str,
 # Entry point used by the serving pod
 # ---------------------------------------------------------------------------
 
+def weights_kind(model_path: str | None) -> str | None:
+    """Classify what ``load_params`` would load with ONE directory scan:
+    ``"orbax"`` > ``"safetensors"`` > ``None`` (random init).
+
+    This is the model-switch hot path: ``has_real_weights`` and
+    ``load_params`` both used to stat the Orbax subdir AND list the
+    directory, doubling the filesystem reads per switch.  ``os.scandir``
+    gives entry types from the directory read itself (no per-entry stat
+    on mainstream filesystems), so classification costs one opendir."""
+    if not model_path:
+        return None
+    kind = None
+    try:
+        with os.scandir(model_path) as it:
+            for e in it:
+                if e.name == ORBAX_SUBDIR and e.is_dir():
+                    return "orbax"
+                if e.name.endswith(".safetensors"):
+                    kind = "safetensors"
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    return kind
+
+
 def has_real_weights(model_path: str | None) -> bool:
     """True when ``load_params`` would load actual weights (Orbax or
     safetensors) rather than falling back to random init."""
-    if not model_path or not os.path.isdir(model_path):
-        return False
-    return os.path.isdir(orbax_path(model_path)) or any(
-        f.endswith(".safetensors") for f in os.listdir(model_path))
+    return weights_kind(model_path) is not None
 
 
 def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
@@ -292,11 +384,11 @@ def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
     dtype = jnp.dtype(dtype or cfg.dtype)
     quantize = _weight_bits(weight_dtype)
     if model_path:
-        if os.path.isdir(orbax_path(model_path)):
+        kind = weights_kind(model_path)
+        if kind == "orbax":
             log.info("loading Orbax checkpoint from %s", orbax_path(model_path))
             return load_orbax(cfg, model_path, mesh, dtype, weight_dtype)
-        if os.path.isdir(model_path) and any(
-                f.endswith(".safetensors") for f in os.listdir(model_path)):
+        if kind == "safetensors":
             log.info("loading HF safetensors from %s", model_path)
             params = params_from_hf(
                 cfg, model_path, dtype, weight_dtype,
@@ -316,3 +408,21 @@ def load_params(cfg: ModelConfig, model_path: str | None, mesh=None,
     if mesh is not None:
         params = tf.shard_params(params, cfg, mesh)
     return params
+
+
+def load_params_streaming(cfg: ModelConfig, model_path: str | None, mesh=None,
+                          dtype: Any = None,
+                          weight_dtype: str = "bf16") -> tf.Params:
+    """``load_params`` for LIVE model switches: every device-facing op is
+    an async stream dispatch (per-leaf puts), never a blocking restore —
+    the model-pool loader thread can run this under a serving engine
+    without stalling its pipelined decode.  Same weight preference order
+    as ``load_params`` (Orbax > safetensors > random init), same single
+    directory scan."""
+    kind = weights_kind(model_path)
+    if kind == "orbax":
+        log.info("streaming Orbax checkpoint from %s", orbax_path(model_path))
+        return load_orbax_streaming(cfg, model_path, mesh, dtype, weight_dtype)
+    # params_from_hf already streams leaf-by-leaf via _leaves_to_device;
+    # the random-init fallback is device-side and cheap.
+    return load_params(cfg, model_path, mesh, dtype, weight_dtype)

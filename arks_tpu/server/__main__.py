@@ -82,6 +82,19 @@ def main() -> None:
     p.add_argument("--draft-len", type=int, default=4,
                    help="tokens per speculative dispatch (draft proposes "
                         "draft-len - 1, target verifies all in one pass)")
+    p.add_argument("--extra-model", action="append", default=None,
+                   metavar="NAME[=PATH]",
+                   help="register an additional model with the shared "
+                        "weight pool (repeatable): NAME is a config name "
+                        "or a model dir, =PATH an optional weights dir. "
+                        "Requests route by their 'model' field; the engine "
+                        "streams the weights in and switches at drained "
+                        "boundaries (single-host only)")
+    p.add_argument("--model-pool-hbm-mb", type=int, default=None,
+                   help="HBM budget for pooled model weights in MiB "
+                        "(ARKS_MODEL_POOL_HBM_MB; 0/unset = unlimited). "
+                        "LRU-evicts idle unpinned models; the primary and "
+                        "draft are pinned")
     p.add_argument("--drain-timeout", type=float,
                    default=float(os.environ.get("ARKS_DRAIN_TIMEOUT", "20")),
                    help="SIGTERM grace: finish in-flight requests up to "
@@ -223,6 +236,15 @@ def main() -> None:
         kv_layout=args.kv_layout,
         draft_model=args.draft_model, draft_len=args.draft_len,
     )
+    # Shared weight pool: created whenever anything multi-model is in play
+    # (extra models, an explicit budget, or a draft — the draft is served
+    # FROM the pool rather than a second standalone load_params, so its
+    # residency shows in /v1/models and counts against the budget).
+    pool = None
+    if args.extra_model or args.model_pool_hbm_mb is not None or args.draft_model:
+        from arks_tpu.engine.model_pool import ModelPool
+        pool = ModelPool(hbm_budget_mb=args.model_pool_hbm_mb)
+
     draft_cfg = draft_params = None
     if args.draft_model:
         if os.path.isdir(args.draft_model):
@@ -236,16 +258,23 @@ def main() -> None:
             draft_cfg = get_config(args.draft_model)
             draft_path = args.draft_model_path
         if draft_path:
-            from arks_tpu.models.weights import load_params
-            draft_params = load_params(draft_cfg, draft_path,
-                                       mesh=mesh, dtype=args.dtype)
+            from arks_tpu.models.weights import load_params_streaming
+
+            def _draft_loader(dc=draft_cfg, dp=draft_path):
+                return load_params_streaming(dc, dp, mesh=mesh,
+                                             dtype=args.dtype)
+
+            pool.register(draft_cfg.name, draft_cfg, model_path=draft_path,
+                          loader=_draft_loader, pinned=True)
+            draft_params = pool.load(draft_cfg.name)
     # Real weights without tokenizer assets = broken mount; fail fast then.
     from arks_tpu.models.weights import has_real_weights
     tokenizer = load_tokenizer(
         model_path if model_path and os.path.isdir(model_path) else None,
         strict=has_real_weights(model_path))
     engine = InferenceEngine(cfg, ecfg, tokenizer, params=params, mesh=mesh,
-                             draft_params=draft_params, draft_cfg=draft_cfg)
+                             draft_params=draft_params, draft_cfg=draft_cfg,
+                             pool=pool)
 
     served = args.served_model_name or cfg.name
 
@@ -269,6 +298,17 @@ def main() -> None:
             DispatchFollower(engine, dhost, dport).run()
             return
         engine.dispatcher = DispatchLeader("0.0.0.0", dport, nproc - 1)
+
+    # Extra pool models (after the multihost wiring so the single-host-only
+    # check in register_model sees the dispatcher).
+    for spec in args.extra_model or []:
+        name, _, path = spec.partition("=")
+        if os.path.isdir(name):
+            engine.register_model(
+                ModelConfig.from_hf_config(name, name=os.path.basename(name)),
+                model_path=path or name)
+        else:
+            engine.register_model(name, model_path=path or None)
 
     if args.disagg == "prefill":
         from arks_tpu.server.disagg import PrefillServer

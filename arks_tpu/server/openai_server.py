@@ -232,11 +232,14 @@ class OpenAIServer:
             # backlog must absorb connection bursts (hundreds of clients
             # reconnecting at once) — see request_queue_size below.
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(self, code: int, payload: dict,
+                      headers: dict | None = None) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -381,10 +384,31 @@ class OpenAIServer:
     # ------------------------------------------------------------------
 
     def _models_payload(self) -> dict:
-        return {"object": "list", "data": [{
+        data = [{
             "id": self.served_model_name, "object": "model",
             "created": int(time.time()), "owned_by": "arks-tpu",
-        }]}
+        }]
+        pool = getattr(self.engine, "pool", None)
+        if pool is not None:
+            # Pool residency listing: every registered model is routable by
+            # its ``model`` field; the served_model_name stays the public
+            # alias of the engine's primary.  The "arks" block is extra
+            # metadata OpenAI clients ignore.
+            primary = getattr(self.engine, "_primary_model", None)
+            for row in pool.snapshot():
+                if row["name"] == primary:
+                    data[0]["arks"] = {
+                        "state": row["state"], "pinned": row["pinned"],
+                        "resident_bytes": row["resident_bytes"],
+                        "cold_starts": row["cold_starts"]}
+                    continue
+                data.append({
+                    "id": row["name"], "object": "model",
+                    "created": int(time.time()), "owned_by": "arks-tpu",
+                    "arks": {"state": row["state"], "pinned": row["pinned"],
+                             "resident_bytes": row["resident_bytes"],
+                             "cold_starts": row["cold_starts"]}})
+        return {"object": "list", "data": data}
 
     def _prompt_ids_batch(self, body: dict, chat: bool,
                           tools: list | None = None) -> list[list[int]]:
@@ -414,8 +438,17 @@ class OpenAIServer:
 
     def _handle_completion(self, h, body: dict, chat: bool) -> None:
         model = body.get("model") or self.served_model_name
+        # Multi-model routing: served_model_name is the primary's public
+        # alias; any other pool-registered name rides the request into the
+        # engine's awaiting_model machinery.  engine_model None = primary.
+        engine_model = None
         if model != self.served_model_name:
-            return h._error(404, f"model {model!r} not found")
+            served = getattr(self.engine, "served_models", None)
+            pool_names = served() if served is not None else []
+            if model not in pool_names:
+                return h._error(404, f"model {model!r} not found")
+            if model != pool_names[0]:
+                engine_model = model
         try:
             from arks_tpu.server import tools as tools_mod
             tools = None
@@ -478,7 +511,8 @@ class OpenAIServer:
                 if n > 1 and params.seed is not None:
                     p = _dc.replace(params, seed=params.seed + j)
                 req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
-                              prompt_ids=list(prompt_ids), params=p)
+                              prompt_ids=list(prompt_ids), params=p,
+                              model=engine_model)
                 self.engine.add_request(req)
                 reqs.append(req)
 
@@ -513,6 +547,24 @@ class OpenAIServer:
                 "type": "server_error",
                 "code": "engine_fault",
             }})
+        if fin.error and fin.error.startswith("model_pool_exhausted"):
+            # Capacity, not client error: the pool can't fit the model
+            # right now (pinned/in-use residents).  503 + Retry-After so
+            # clients and the gateway queue-and-retry instead of failing
+            # the request class permanently.
+            return h._json(503, {"error": {
+                "message": f"model is not loadable right now ({fin.error})",
+                "type": "server_error",
+                "code": "model_pool_exhausted",
+            }}, headers={"Retry-After": "5"})
+        if fin.error and fin.error.startswith("model_load_failed"):
+            return h._json(500, {"error": {
+                "message": f"model failed to load ({fin.error})",
+                "type": "server_error",
+                "code": "model_load_failed",
+            }})
+        if fin.error and fin.error.startswith("model_not_found"):
+            return h._error(404, fin.error)
         return h._error(400, fin.error or "request rejected")
 
     def _respond(self, h, req: Request, chat: bool, model: str, body: dict,

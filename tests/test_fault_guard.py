@@ -42,9 +42,14 @@ FAULT_API = {
 #   - engine.py/_recover_from_fault: the retry loop OF the fault API —
 #     the caught exception feeds the next recovery round or the blanket
 #     fallback; nothing is dropped.
+#   - model_pool.py/_load: lands the error on the LoadTicket (the guide
+#     _compile_job pattern) — every waiter (blocking load() callers and
+#     model-parked requests polled by _issue_model_load) receives it as
+#     a per-request failure.
 ALLOWED = {
     ("guides.py", "_compile_job"),
     ("engine.py", "_recover_from_fault"),
+    ("model_pool.py", "_load"),
 }
 
 

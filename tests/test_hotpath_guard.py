@@ -40,6 +40,13 @@ HOT_PATH_FUNCTIONS = (
     "_spill_flush",
     "_issue_restore",
     "_dispatch_restore_group",
+    # Multi-model serving: the switch issue path runs every step while
+    # another model's weights stream in the background — a blocking fetch
+    # here would stall the pipelined decode the overlap exists to protect.
+    # The load itself happens on a pool thread; the switch executes only
+    # at a fully drained boundary (nothing in flight to stall).
+    "_issue_model_load",
+    "_park_awaiting_model",
 )
 
 # Sanctioned exceptions, keyed (function, unparsed argument).  Each entry
@@ -100,6 +107,26 @@ def test_no_blocking_fetches_on_the_issue_path():
     assert not violations, (
         "blocking device fetch on the issue-side hot path (move it into a "
         f"_resolve_* tail or justify it in ALLOWED): {violations}")
+
+
+def test_no_blocking_fetches_in_stream_scatter_helpers():
+    """The weight-streaming scatter path (models.weights) issues its H2D
+    puts as ordinary async dispatches while the live engine keeps
+    decoding; a blocking fetch there would serialize the overlap the
+    streaming switch exists for."""
+    from arks_tpu.models import weights as weights_mod
+    src = inspect.getsource(weights_mod)
+    module = ast.parse(src)
+    funcs = {n.name: n for n in module.body
+             if isinstance(n, ast.FunctionDef)}
+    guarded = ("_shard_put_fns", "stream_params_to_device")
+    missing = [f for f in guarded if f not in funcs]
+    assert not missing, f"stream-scatter helpers renamed/removed: {missing}"
+    violations = []
+    for name in guarded:
+        violations += _blocking_calls(name, funcs[name])
+    assert not violations, (
+        f"blocking device fetch in the weight-streaming path: {violations}")
 
 
 def test_resolve_tails_exist():

@@ -1,0 +1,156 @@
+"""Unit tests for the device-HBM weight pool (engine.model_pool).
+
+The pool is pure host-side bookkeeping — params here are plain numpy
+trees, so these tests exercise the budget/LRU/refcount/ticket state
+machine without touching a device.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from arks_tpu.engine.model_pool import (
+    LoadTicket, ModelPool, PoolFullError, tree_bytes)
+
+MB = 1 << 20
+
+
+def _params(mb):
+    """A param tree of exactly ``mb`` MiB of logical bytes."""
+    return {"w": np.zeros((mb, MB // 4), dtype=np.float32)}
+
+
+def test_tree_bytes_counts_logical_leaf_bytes():
+    assert tree_bytes(_params(3)) == 3 * MB
+    assert tree_bytes({"a": _params(1), "b": _params(2)}) == 3 * MB
+
+
+def test_register_is_idempotent_and_adopt_makes_resident():
+    pool = ModelPool(hbm_budget_mb=0)
+    e1 = pool.register("m", cfg="cfg-a", model_path="/p")
+    e2 = pool.register("m", cfg="ignored", pinned=True)
+    assert e1 is e2 and e1.pinned and e1.model_path == "/p"
+
+    pool.adopt("m", "cfg-a", _params(2))
+    snap = {s["name"]: s for s in pool.snapshot()}
+    assert snap["m"]["state"] == "resident"
+    assert snap["m"]["resident_bytes"] == 2 * MB
+    assert snap["m"]["pinned"] is True
+    assert pool.params_of("m")["w"].shape[0] == 2
+
+
+def test_ensure_returns_ticket_then_resident_entry():
+    pool = ModelPool(hbm_budget_mb=0)
+    gate = threading.Event()
+
+    def loader():
+        gate.wait(10)
+        return _params(1)
+
+    pool.register("m", "cfg", loader=loader)
+    t = pool.ensure("m")
+    assert isinstance(t, LoadTicket) and not t.event.is_set()
+    # Re-ensuring while the load is in flight returns the SAME ticket —
+    # the engine polls it from the step loop.
+    assert pool.ensure("m") is t
+    gate.set()
+    assert t.event.wait(10) and t.error is None
+    e = pool.ensure("m")
+    assert not isinstance(e, LoadTicket)
+    assert e.state == "resident" and e.cold_starts == 1
+    # load() is the blocking wrapper over the same path.
+    assert pool.load("m", timeout=10)["w"].shape[0] == 1
+
+
+def test_ensure_unknown_model_raises_keyerror():
+    pool = ModelPool(hbm_budget_mb=0)
+    with pytest.raises(KeyError):
+        pool.ensure("nope")
+    pool.register("m", "cfg")  # registered but no loader and no params
+    with pytest.raises(KeyError):
+        pool.ensure("m")
+
+
+def test_loader_failure_surfaces_on_the_ticket():
+    pool = ModelPool(hbm_budget_mb=0)
+
+    def boom():
+        raise OSError("disk gone")
+
+    pool.register("m", "cfg", loader=boom)
+    t = pool.ensure("m")
+    assert t.event.wait(10)
+    assert "disk gone" in t.error
+    assert pool.entry("m").state == "evicted"
+    with pytest.raises(RuntimeError, match="disk gone"):
+        pool.load("m", timeout=10)
+
+
+def test_budget_evicts_lru_idle_unpinned():
+    pool = ModelPool(hbm_budget_mb=3)
+    evicted = []
+    pool.on_evict = evicted.append
+    pool.adopt("old", "cfg", _params(1))
+    time.sleep(0.01)
+    pool.adopt("new", "cfg", _params(1))
+    pool.register("big", "cfg", loader=lambda: _params(2))
+    assert pool.load("big", timeout=10)["w"].shape[0] == 2
+    # Only the LRU entry goes; "new" still fits next to "big".
+    assert evicted == ["old"]
+    snap = {s["name"]: s["state"] for s in pool.snapshot()}
+    assert snap == {"old": "evicted", "new": "resident", "big": "resident"}
+    # The evicted entry remembers its size, so a reload makes room
+    # BEFORE streaming (and can evict in turn).
+    assert pool.entry("old").nbytes == 1 * MB
+
+
+def test_pinned_and_in_use_models_never_evicted():
+    pool = ModelPool(hbm_budget_mb=3)
+    pool.adopt("flag", "cfg", _params(1), pinned=True)
+    pool.adopt("busy", "cfg", _params(1))
+    pool.acquire("busy")  # engine is decoding with it
+    pool.register("big", "cfg", loader=lambda: _params(2))
+    with pytest.raises(PoolFullError):
+        pool.load("big", timeout=10)
+    snap = {s["name"]: s["state"] for s in pool.snapshot()}
+    assert snap["flag"] == "resident" and snap["busy"] == "resident"
+    # Releasing the refcount frees "busy" for eviction; the reload works.
+    pool.release("busy")
+    assert pool.load("big", timeout=10)["w"].shape[0] == 2
+    assert pool.entry("busy").state == "evicted"
+
+
+def test_pool_full_error_rides_the_ticket_as_exhausted():
+    pool = ModelPool(hbm_budget_mb=1)
+    pool.adopt("flag", "cfg", _params(1), pinned=True)
+    pool.register("big", "cfg", loader=lambda: _params(2))
+    t = pool.ensure("big")
+    assert t.event.wait(10)
+    assert "model_pool_exhausted" in t.error
+
+
+def test_acquire_requires_resident_and_refcounts_nest():
+    pool = ModelPool(hbm_budget_mb=0)
+    pool.register("m", "cfg", loader=lambda: _params(1))
+    with pytest.raises(RuntimeError, match="not resident"):
+        pool.acquire("m")
+    pool.load("m", timeout=10)
+    pool.acquire("m")
+    pool.acquire("m")
+    assert pool.entry("m").refcount == 2
+    pool.release("m")
+    pool.release("m")
+    pool.release("m")  # over-release is a no-op, never negative
+    assert pool.entry("m").refcount == 0
+
+
+def test_budget_env_validation(monkeypatch):
+    monkeypatch.setenv("ARKS_MODEL_POOL_HBM_MB", "not-a-number")
+    with pytest.raises(ValueError, match="ARKS_MODEL_POOL_HBM_MB"):
+        ModelPool()
+    monkeypatch.setenv("ARKS_MODEL_POOL_HBM_MB", "64")
+    assert ModelPool().budget_bytes == 64 * MB
+    with pytest.raises(ValueError):
+        ModelPool(hbm_budget_mb=-1)

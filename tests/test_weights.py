@@ -137,3 +137,69 @@ def test_load_orbax_int8_single_chip(tmp_path):
     deq = quant.dequantize(q["layers"]["wq"], jnp.float32)
     err = np.abs(np.asarray(deq) - np.asarray(params["layers"]["wq"])).max()
     assert err < np.abs(np.asarray(params["layers"]["wq"])).max() / 100
+
+
+def test_weights_kind_single_directory_read(tmp_path, monkeypatch):
+    """Classification costs exactly ONE opendir.  has_real_weights and
+    load_params used to stat the Orbax subdir AND list the directory —
+    on a network filesystem that doubled the metadata reads on every
+    model switch."""
+    (tmp_path / w.ORBAX_SUBDIR).mkdir()
+    (tmp_path / "model.safetensors").write_bytes(b"")
+    calls = []
+    real = w.os.scandir
+    monkeypatch.setattr(w.os, "scandir",
+                        lambda p: (calls.append(p), real(p))[1])
+
+    assert w.weights_kind(str(tmp_path)) == "orbax"
+    assert len(calls) == 1
+    calls.clear()
+    assert w.has_real_weights(str(tmp_path)) is True
+    assert len(calls) == 1
+    calls.clear()
+    assert w.weights_kind(str(tmp_path / "missing")) is None
+    assert len(calls) == 1
+
+
+def test_weights_kind_prefers_orbax_over_safetensors(tmp_path):
+    assert w.weights_kind(None) is None
+    assert w.weights_kind(str(tmp_path)) is None  # empty dir
+    (tmp_path / "model.safetensors").write_bytes(b"")
+    assert w.weights_kind(str(tmp_path)) == "safetensors"
+    (tmp_path / w.ORBAX_SUBDIR).mkdir()
+    assert w.weights_kind(str(tmp_path)) == "orbax"
+
+
+def test_load_params_classifies_once(tmp_path, monkeypatch):
+    """load_params branches on one weights_kind call instead of probing
+    the directory per format."""
+    cfg = get_config("tiny")
+    save_file(_rng_tensors(cfg), str(tmp_path / "model.safetensors"))
+    n = {"calls": 0}
+    real = w.weights_kind
+
+    def counting(p):
+        n["calls"] += 1
+        return real(p)
+
+    monkeypatch.setattr(w, "weights_kind", counting)
+    p = w.load_params(cfg, str(tmp_path), dtype=jnp.float32)
+    assert n["calls"] == 1
+    assert p["embed"].shape[0] == cfg.vocab_size
+
+
+@pytest.mark.parametrize("kind", ["safetensors", "orbax"])
+def test_load_params_streaming_matches_blocking(tmp_path, kind):
+    """The async per-leaf streaming loader (live model switches) must
+    produce the exact tree the blocking loader does."""
+    cfg = get_config("tiny")
+    if kind == "safetensors":
+        save_file(_rng_tensors(cfg), str(tmp_path / "model.safetensors"))
+    else:
+        params = tf.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+        w.save_orbax(params, str(tmp_path))
+    ref = w.load_params(cfg, str(tmp_path), dtype=jnp.float32)
+    got = w.load_params_streaming(cfg, str(tmp_path), dtype=jnp.float32)
+    assert jax.tree.structure(got) == jax.tree.structure(ref)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
