@@ -743,6 +743,16 @@ class InferenceEngine:
         self._model_attr_names = tuple(sorted(set(vars(self)) - pre))
         self._primary_model = cfg.name
         self._primary_ecfg = engine_cfg
+        # Prefix-digest sketch exporter (cache-aware routing): summarizes
+        # tier-0/tier-1 digest membership for GET /v1/cache/sketch.  One
+        # per engine PROCESS, not per model — its epoch tracks this
+        # engine's boot/reset lifecycle, which is what routers key sketch
+        # staleness on.  Deliberately outside the _model_attr_names diff:
+        # a model switch must not resurrect a pre-switch epoch.
+        self._sketch = None
+        if self._paged and self._chunk:
+            from arks_tpu.prefix_sketch import SketchExporter
+            self._sketch = SketchExporter(self._page_size())
         if self.pool is not None:
             from types import SimpleNamespace as _NS
             self.pool.adopt(cfg.name, cfg, self.params, pinned=True)
@@ -2410,6 +2420,12 @@ class InferenceEngine:
         # restarts" property the tier exists for.
         self._spill_victims.clear()
         self._spills.clear()
+        # The rebuilt allocator starts with an EMPTY tier-0 index: move
+        # the sketch epoch so routers drop the pre-reset sketch the
+        # moment they next poll, instead of keeping this backend winning
+        # placement on membership it no longer holds.
+        if self._sketch is not None:
+            self._sketch.bump_epoch()
         # Followers rebuild too (their _run path never sees the exception).
         if self.dispatcher is not None:
             self._emit("reset")
@@ -3116,6 +3132,50 @@ class InferenceEngine:
             self._alloc.register(digests[:nreg], pages[:nreg])
             self.metrics.prefix_cache_usage_bytes.set(
                 self._alloc.retained_pages * self._page_bytes, tier="device")
+
+    # ------------------------------------------------------------------
+    # Prefix-digest sketch export (cache-aware routing)
+    # ------------------------------------------------------------------
+
+    def cache_sketch(self) -> dict:
+        """The prefix-digest sketch payload for ``GET /v1/cache/sketch``.
+        Server threads only.  Reads host-side membership snapshots (the
+        allocator's locked mirror, the host tier's map under its own
+        lock) and host counters — never device data — so an export can
+        never add a blocking fetch to the dispatch stream; the build
+        itself is cached inside the exporter until tier membership (or
+        the epoch) actually changes."""
+        sk = self._sketch
+        alloc = self._alloc
+        if sk is None or alloc is None:
+            return {"enabled": False}
+        device, dver = alloc.index_snapshot()
+        host_list: list = []
+        hver = -1
+        host = self._host
+        if host is not None:
+            host_list, hver = host.snapshot()
+        # id(alloc) keys the build cache across resets/model switches,
+        # where a FRESH allocator restarts its version counter.
+        hits = self.metrics.prefix_cache_hit_tokens_total
+        return sk.build(
+            device, (id(alloc), dver), host_list, hver,
+            hit_tokens={"device": hits.get(tier="device"),
+                        "host": hits.get(tier="host")},
+            query_tokens=self.metrics.prefix_cache_query_tokens_total.total(),
+            extra={"model": self.cfg.name})
+
+    def note_prompt_text(self, body: dict, ids) -> None:
+        """Record one request's text->token digest alignment in the
+        sketch exporter's ledger (the text-domain side of tokenize-free
+        router scoring).  Server threads; pure host hashing."""
+        sk = self._sketch
+        if sk is None:
+            return
+        from arks_tpu.prefix_sketch import canonical_prompt_text
+        text = canonical_prompt_text(body)
+        if text:
+            sk.link(text, ids)
 
     # ------------------------------------------------------------------
     # Hierarchical prefix cache: host-RAM spill tier (tier 1)

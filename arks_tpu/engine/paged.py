@@ -24,38 +24,23 @@ the disaggregated prefill path never touches the allocator.
 
 from __future__ import annotations
 
-import hashlib
+import threading
 from collections import OrderedDict
 
 import numpy as np
 
+# THE one hash-chaining implementation lives in arks_tpu.prefix_sketch
+# (jax-free, so the router can share it for tokenize-free scoring); the
+# allocator's prefix index and the host PrefixKVCache keep keying the
+# same bytes through these re-exports.
+from arks_tpu.prefix_sketch import chain_digests, iter_chain_digests
+
+__all__ = ["OutOfPagesError", "iter_chain_digests", "chain_digests",
+           "pages_needed", "PageAllocator"]
+
 
 class OutOfPagesError(RuntimeError):
     pass
-
-
-def iter_chain_digests(ids, page: int):
-    """Lazily yield chained content digests: digest j covers
-    ids[: (j+1)*page].  THE one hash-chaining implementation — the paged
-    allocator's prefix index and the host PrefixKVCache key the same bytes
-    through here, and lazy yielding lets a matcher stop hashing at the
-    first missing block instead of digesting a whole long prompt on what
-    may be a first-block miss."""
-    h = hashlib.sha1()
-    arr = np.asarray(ids, np.int32)
-    for j in range(len(arr) // page):
-        h.update(arr[j * page:(j + 1) * page].tobytes())
-        yield h.digest()
-
-
-def chain_digests(ids, page: int, nblocks: int) -> list[bytes]:
-    """First ``nblocks`` chained digests as a list (see iter_chain_digests)."""
-    out = []
-    for j, d in enumerate(iter_chain_digests(ids, page)):
-        if j >= nblocks:
-            break
-        out.append(d)
-    return out
 
 
 def pages_needed(length: int, rows: int, page: int, max_pages: int) -> int:
@@ -89,6 +74,15 @@ class PageAllocator:
         # still guaranteed un-overwritten on device.  Must not raise and
         # must not call back into the allocator (it runs mid-alloc).
         self.on_evict = on_evict
+        # Membership mirror for the routing sketch: server threads need a
+        # consistent view of WHICH digests are indexed, while _index stays
+        # engine-thread-only.  The mirror tracks membership changes
+        # (register/evict), not recency touches — so the hot decode path
+        # (match's move_to_end) never takes the lock, and the version only
+        # moves when an exported sketch would actually change.
+        self._mirror_lock = threading.Lock()
+        self._mirror: "OrderedDict[bytes, None]" = OrderedDict()
+        self.index_version = 0
         # Stats (mirrored into EngineMetrics by the engine).
         self.hit_tokens = 0
         self.query_tokens = 0
@@ -121,6 +115,9 @@ class PageAllocator:
     def _evict_lru(self) -> None:
         digest, pg = self._index.popitem(last=False)
         del self._page_digest[pg]
+        with self._mirror_lock:
+            self._mirror.pop(digest, None)
+            self.index_version += 1
         if self.on_evict is not None:
             self.on_evict(digest, pg)
         self._ref[pg] -= 1
@@ -173,6 +170,18 @@ class PageAllocator:
             self._index[d] = pg
             self._page_digest[pg] = d
             self._ref[pg] += 1
+            with self._mirror_lock:
+                self._mirror[d] = None
+                self._mirror.move_to_end(d)
+                self.index_version += 1
+
+    def index_snapshot(self) -> tuple[list[bytes], int]:
+        """Indexed digests (registration order, oldest first) plus the
+        membership version — the tier-0 input to the routing sketch.
+        Safe from any thread; the engine thread only pays the mirror lock
+        on membership changes, never per match."""
+        with self._mirror_lock:
+            return list(self._mirror), self.index_version
 
     # -- stats ---------------------------------------------------------
 

@@ -176,6 +176,10 @@ class HostPrefixTier:
         # (oldest first).
         self._blocks: "OrderedDict[bytes, dict]" = OrderedDict()
         self._bytes = 0
+        # Membership version for the routing sketch: bumped on every
+        # insert/evict/clear (not on LRU touches), so a cached sketch
+        # build stays valid exactly as long as membership does.
+        self.version = 0
         # Stats (mirrored into EngineMetrics by the engine).
         self.spilled_blocks = 0
         self.restored_blocks = 0
@@ -199,9 +203,11 @@ class HostPrefixTier:
             self._blocks[digest] = block
             self._bytes += self._block_bytes(block)
             self.spilled_blocks += 1
+            self.version += 1
             while self._bytes > self.capacity and self._blocks:
                 _, old = self._blocks.popitem(last=False)
                 self._bytes -= self._block_bytes(old)
+                self.version += 1
             return digest in self._blocks
 
     def match_blocks(self, digests: list[bytes], start: int) -> list[dict]:
@@ -226,6 +232,13 @@ class HostPrefixTier:
         with self._lock:
             self._blocks.clear()
             self._bytes = 0
+            self.version += 1
+
+    def snapshot(self) -> tuple[list[bytes], int]:
+        """Resident digests (LRU order, oldest first) plus the membership
+        version — the tier-1 input to the routing sketch."""
+        with self._lock:
+            return list(self._blocks), self.version
 
     @property
     def bytes_used(self) -> int:

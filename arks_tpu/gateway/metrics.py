@@ -32,3 +32,40 @@ class GatewayMetrics:
         self.quota_limit = r.gauge("gateway_quota_limit", "Quota limit")
         self.errors_total = r.counter(
             "gateway_errors_total", "Gateway errors by stage")
+
+
+class RouterMetrics:
+    """Routing-layer families (the router is part of the same data-plane
+    metrics surface as the gateway).  Besides the request/backend basics,
+    this carries the sketch-routing observability set: per-backend sketch
+    age, route decisions by reason, and the expected-vs-actual hit-depth
+    pair that makes a mis-scoring sketch visible in monitoring."""
+
+    def __init__(self, registry: prom.Registry | None = None):
+        self.registry = registry or prom.Registry()
+        r = self.registry
+        self.requests_total = r.counter(
+            "router_requests_total", "Routed requests")
+        self.backends = r.gauge("router_backends", "Known backends")
+        self.retries_total = r.counter(
+            "router_retries_total",
+            "Requests retried on another backend (by reason)")
+        self.sketch_age = r.gauge(
+            "router_sketch_age_seconds",
+            "Seconds since each backend's sketch was last accepted")
+        self.route_decisions_total = r.counter(
+            "router_route_decisions_total",
+            "Routing decisions by reason "
+            '(sketch_hit|tie_fallback|stale_sketch|no_key)')
+        self.expected_hit_blocks_total = r.counter(
+            "router_expected_hit_blocks_total",
+            "Sketch-predicted prefix hit depth in blocks, by backend/tier "
+            "(compare against the actual router_backend_hit_tokens)")
+        self.backend_hit_tokens = r.gauge(
+            "router_backend_hit_tokens",
+            "Actual cumulative per-tier prefix hit tokens each backend "
+            "reports in its sketch")
+        self.sketch_epoch_drops_total = r.counter(
+            "router_sketch_epoch_drops_total",
+            "Sketches dropped because the backend's epoch changed "
+            "(restart/reset)")

@@ -20,12 +20,20 @@ Routing policies (the reference router's ``--policy`` flag, default
 ``cache_aware`` in its generated command line):
 
 - ``round_robin``: rotate over ready backends.
-- ``cache_aware``: rendezvous-hash the request's prompt *prefix* (system
-  prompt / few-shot preamble) to a backend, so requests sharing a prefix
-  land on the same prefill AND decode engines — whose prefix KV caches
-  (arks_tpu.engine.prefix_cache) then serve the shared blocks without
-  recompute.  Rendezvous hashing keeps remapping minimal when backends
-  come and go (only the moved backend's keys reshuffle).
+- ``cache_aware``: prefer the backend whose prefix caches ACTUALLY hold
+  the request's prefix.  Decode backends export a prefix-digest sketch
+  (``GET /v1/cache/sketch`` — a versioned bloom/top-K summary of the
+  chain digests resident in tier 0 and tier 1, see
+  arks_tpu.prefix_sketch); an async poller keeps a per-backend copy, and
+  ``_pick`` scores candidates by *expected hit depth*: walk the
+  request's digest chain against each sketch — tokenize-free, in the
+  token domain for pre-tokenized prompts and the text domain otherwise —
+  and take the deepest hit, tier-0 weighted.  Fallback ladder when
+  sketches are stale/absent or scores tie: least-loaded, then
+  rendezvous-hashing the prompt *prefix* (which also keeps remapping
+  minimal when backends come and go — only the moved backend's keys
+  reshuffle).  ``ARKS_ROUTER_SKETCH=0`` turns scoring off entirely
+  (rendezvous-only, the pre-sketch behavior).
 """
 
 from __future__ import annotations
@@ -40,7 +48,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from arks_tpu.utils import metrics as prom
+from arks_tpu import prefix_sketch as sketch_mod
+from arks_tpu.gateway.metrics import RouterMetrics
 
 log = logging.getLogger("arks_tpu.router")
 
@@ -191,44 +200,39 @@ def _prefix_key(body: bytes) -> bytes | None:
         obj = json.loads(body)
     except (ValueError, UnicodeDecodeError):
         return None
+    return _prefix_key_obj(obj)
+
+
+def _prefix_key_obj(obj) -> bytes | None:
+    """Locality key from a parsed body.  Text extraction (content-part
+    joining, stop-at-unknown-shape so later turns never leak into the
+    key) lives in prefix_sketch.canonical_prompt_text — the SAME scan the
+    sketch's text-domain digests use, so the rendezvous key and the
+    scoring chain always agree on what "the prompt text" is.  Prompts
+    with no usable text get no key (round-robin — never pin them all to
+    one backend via a shared empty key), EXCEPT pre-tokenized token-id
+    prompts, which key on their leading id window."""
     if not isinstance(obj, dict):
         return None
-    if isinstance(obj.get("messages"), list):
-        parts = []
-        total = 0
-        for m in obj["messages"]:
-            c = m.get("content") if isinstance(m, dict) else None
-            if isinstance(c, list):
-                # OpenAI content parts: serialize the text parts so
-                # part-based requests key on their REAL prefix instead of
-                # skipping ahead to a later turn's text (which would pin
-                # different prefixes to one backend).
-                c = "".join(t for p in c
-                            if isinstance(p, dict) and p.get("type") == "text"
-                            for t in (p.get("text"),) if isinstance(t, str))
-                if not c:
-                    # No usable text (image-only parts): same rule as any
-                    # other unknown shape — never key on later turns.
-                    break
-            if not isinstance(c, str):
-                # Unknown content shape: stop scanning — keying on LATER
-                # turns would defeat the prefix-affinity intent.
-                break
-            parts.append(c)
-            total += len(c)
-            if total >= _PREFIX_KEY_CHARS:
-                break
-        text = "\x00".join(parts)
-    elif isinstance(obj.get("prompt"), str):
-        text = obj["prompt"]
-    else:
-        return None
-    if not text:
-        # Prompts with no usable text (empty, or content parts carrying no
-        # text) get no key — round-robin, don't pin them all to one backend
-        # via a shared empty key.
-        return None
-    return text[:_PREFIX_KEY_CHARS].encode("utf-8", "surrogatepass")
+    text = sketch_mod.canonical_prompt_text(obj)
+    if text:
+        return text[:_PREFIX_KEY_CHARS].encode("utf-8", "surrogatepass")
+    ids = _token_prompt(obj)
+    if ids:
+        return json.dumps(ids[:64]).encode()
+    return None
+
+
+def _token_prompt(obj) -> list | None:
+    """The request's pre-tokenized prompt ids, or None.  These score in
+    the token domain — the engine's exact chain digests — with no
+    tokenizer anywhere near the router."""
+    p = obj.get("prompt") if isinstance(obj, dict) else None
+    if (isinstance(p, list) and p
+            and all(isinstance(t, int) and not isinstance(t, bool)
+                    for t in p)):
+        return p
+    return None
 
 
 def _rendezvous(key: bytes, backends: list[str]) -> str:
@@ -238,26 +242,149 @@ def _rendezvous(key: bytes, backends: list[str]) -> str:
                key=lambda b: hashlib.sha1(key + b"\x00" + b.encode()).digest())
 
 
+class _SketchPoller:
+    """Per-backend prefix-digest sketch cache, refreshed by one
+    background thread off the request path (requests only ever read the
+    last accepted copy — a slow backend degrades to a stale sketch and
+    the fallback ladder, never to requests blocking on a poll).
+
+    Epoch discipline: a backend that restarts or fault-resets comes back
+    with a new epoch; the poller replaces its copy wholesale on every
+    successful fetch (counting epoch changes), and the forward path's
+    connection errors invalidate eagerly — a dead backend's pre-restart
+    sketch must not keep winning placement until the poll interval
+    catches up."""
+
+    def __init__(self, router: "Router", interval_s: float, stale_s: float):
+        self.router = router
+        self.interval = interval_s
+        self.stale = stale_s
+        self._lock = threading.Lock()
+        self._state: dict[str, dict] = {}   # addr -> {"sketch", "at"}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="router-sketch", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:
+                log.warning("sketch poll failed", exc_info=True)
+
+    def poll_once(self) -> None:
+        """One refresh round over the current decode set (also the test/
+        bench entry point — deterministic, no thread required)."""
+        _, decode = self.router.discovery.backends()
+        m = self.router.metrics
+        now = time.monotonic()
+        for addr in decode:
+            payload = self._fetch(addr)
+            if payload is None:
+                # Unreachable or malformed: keep the last accepted copy
+                # until the staleness deadline retires it in get().
+                continue
+            bs = sketch_mod.BackendSketch.from_payload(payload)
+            with self._lock:
+                prev = self._state.get(addr)
+                if not bs.enabled:
+                    self._state[addr] = {"sketch": None, "at": now}
+                    continue
+                if (prev is not None and prev["sketch"] is not None
+                        and prev["sketch"].epoch != bs.epoch):
+                    # Backend restarted/reset between polls: the old
+                    # sketch described a cache that no longer exists.
+                    m.sketch_epoch_drops_total.inc(backend=addr)
+                self._state[addr] = {"sketch": bs, "at": now}
+            for tier, v in bs.hit_tokens.items():
+                m.backend_hit_tokens.set(v, backend=addr, tier=tier)
+        with self._lock:
+            for addr in list(self._state):
+                if addr not in decode:
+                    del self._state[addr]
+            ages = {a: max(0.0, now - st["at"])
+                    for a, st in self._state.items()}
+        for addr, age in ages.items():
+            m.sketch_age.set(age, backend=addr)
+
+    def _fetch(self, addr: str) -> dict | None:
+        host, _, port = addr.partition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port or 80),
+                                              timeout=2.0)
+            try:
+                conn.request("GET", "/v1/cache/sketch")
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status != 200:
+                    return None
+                obj = json.loads(data)
+                return obj if isinstance(obj, dict) else None
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
+
+    def get(self, addr: str) -> "sketch_mod.BackendSketch | None":
+        """The backend's sketch if fresh; None when absent, disabled, or
+        past the ARKS_ROUTER_SKETCH_STALE_S deadline."""
+        with self._lock:
+            st = self._state.get(addr)
+            if st is None or st["sketch"] is None:
+                return None
+            if time.monotonic() - st["at"] > self.stale:
+                return None
+            return st["sketch"]
+
+    def invalidate(self, addr: str) -> None:
+        with self._lock:
+            self._state.pop(addr, None)
+
+
 class Router:
     def __init__(self, discovery: Discovery, served_model_name: str,
                  host: str = "0.0.0.0", port: int = 8080,
-                 policy: str = "cache_aware"):
+                 policy: str = "cache_aware", unified: bool = False):
         if policy not in ("round_robin", "cache_aware"):
             raise ValueError(f"unknown policy {policy!r}")
         self.discovery = discovery
         self.served_model_name = served_model_name
         self.host, self.port = host, port
         self.policy = policy
+        # Unified mode: backends are plain OpenAI servers (no prefill/
+        # decode split) — only the decode list is consulted, and requests
+        # forward to the ordinary path with no prefill header.
+        self.unified = unified or os.environ.get(
+            "ARKS_ROUTER_UNIFIED", "") not in ("", "0", "false")
         self._rr = itertools.count()
         self._httpd: ThreadingHTTPServer | None = None
-        self.registry = prom.Registry()
-        self.requests_total = self.registry.counter(
-            "router_requests_total", "Routed requests")
-        self.backends_gauge = self.registry.gauge(
-            "router_backends", "Known backends")
-        self.retries_total = self.registry.counter(
-            "router_retries_total",
-            "Requests retried on another backend (by reason)")
+        self.metrics = RouterMetrics()
+        self.registry = self.metrics.registry
+        self.requests_total = self.metrics.requests_total
+        self.backends_gauge = self.metrics.backends
+        self.retries_total = self.metrics.retries_total
+        # Sketch scoring (cache_aware only; ARKS_ROUTER_SKETCH=0 restores
+        # the rendezvous-only behavior).
+        self.sketch_on = (policy == "cache_aware" and os.environ.get(
+            "ARKS_ROUTER_SKETCH", "1") not in ("0", "false"))
+        self._t0_weight = float(os.environ.get(
+            "ARKS_ROUTER_SKETCH_T0_WEIGHT", "1.0"))
+        self._max_blocks = int(os.environ.get(
+            "ARKS_ROUTER_SKETCH_MAX_BLOCKS", "64"))
+        poll_s = float(os.environ.get("ARKS_ROUTER_SKETCH_POLL_S", "2.0"))
+        stale_s = float(os.environ.get("ARKS_ROUTER_SKETCH_STALE_S", "10"))
+        self.sketches = _SketchPoller(self, poll_s, stale_s)
+        # In-flight forwards per decode backend (least-loaded fallback).
+        self._load_lock = threading.Lock()
+        self._inflight: dict[str, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -298,7 +425,7 @@ class Router:
                     self._json(200, {"status": "ok"})
                 elif self.path == "/readiness":
                     pre, dec = router.discovery.backends()
-                    if pre and dec:
+                    if dec and (pre or router.unified):
                         self._json(200, {"status": "ready"})
                     else:
                         self._error(503, "no prefill/decode backends yet")
@@ -312,6 +439,8 @@ class Router:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_port
+        if self.sketch_on:
+            self.sketches.start()
         if background:
             threading.Thread(target=self._httpd.serve_forever, name="router",
                              daemon=True).start()
@@ -319,6 +448,7 @@ class Router:
             self._httpd.serve_forever()
 
     def stop(self) -> None:
+        self.sketches.stop()
         if self._httpd:
             self._httpd.shutdown()
 
@@ -332,13 +462,19 @@ class Router:
         body = h.rfile.read(int(h.headers.get("Content-Length", 0)))
         try:
             prefill, decode = self.discovery.backends()
+            if self.unified:
+                # Unified deployments list their backends under "decode"
+                # (or only set ARKS_DECODE_ADDRS); there is no prefill
+                # tier to pick.
+                prefill = []
             self.backends_gauge.set(len(prefill), role="prefill")
             self.backends_gauge.set(len(decode), role="decode")
-            if not prefill or not decode:
+            if not decode or (not prefill and not self.unified):
                 status = 503
                 return h._error(503, "no ready prefill/decode backends")
-            p, d = self._pick(body, prefill, decode)
-            status = self._forward_failover(h, body, p, d, decode, started)
+            p, candidates = self._pick(body, prefill, decode)
+            status = self._forward_failover(h, body, p, candidates[0],
+                                            candidates, started)
         except (BrokenPipeError, ConnectionResetError):
             status = 499
         except Exception as e:
@@ -357,13 +493,108 @@ class Router:
             self.requests_total.inc(status=str(status))
 
     def _pick(self, body: bytes, prefill: list[str],
-              decode: list[str]) -> tuple[str, str]:
+              decode: list[str]) -> tuple[str, tuple[str, ...]]:
+        """(prefill addr, decode candidates in preference order).  The
+        failover path walks the decode tuple in exactly this order, so
+        sketch scoring shapes the retry sequence too — while the failover
+        semantics themselves (when to move on, backoff, Retry-After) stay
+        untouched.  Unified mode returns "" for prefill."""
         if self.policy == "cache_aware":
-            key = _prefix_key(body)
+            try:
+                obj = json.loads(body)
+            except (ValueError, UnicodeDecodeError):
+                obj = None
+            key = _prefix_key_obj(obj)
             if key is not None:
-                return _rendezvous(key, prefill), _rendezvous(key, decode)
+                p = _rendezvous(key, prefill) if prefill else ""
+                return p, tuple(self._order_decode(obj, key, decode))
+            if self.sketch_on:
+                self.metrics.route_decisions_total.inc(reason="no_key")
         n = next(self._rr)
-        return prefill[n % len(prefill)], decode[n % len(decode)]
+        p = prefill[n % len(prefill)] if prefill else ""
+        i = n % len(decode)
+        return p, tuple(decode[i:] + decode[:i])
+
+    def _order_decode(self, obj, key: bytes, decode: list[str]) -> list[str]:
+        """Decode candidates by expected prefix hit depth, deepest first.
+
+        Scoring walks the request's digest chain against each backend's
+        sketch (token domain for pre-tokenized prompts — the engine's
+        exact keys — else the text domain fed by the server's alignment
+        ledger) and weights tier-0 blocks by 1 + ARKS_ROUTER_SKETCH_T0_
+        WEIGHT over tier-1 blocks (a device hit is free; a host hit costs
+        one H2D restore).  Fallback ladder: no fresh sketch anywhere ->
+        rendezvous (reason stale_sketch); tied scores, including the
+        all-zero case -> least in-flight, then rendezvous among the still
+        tied (tie_fallback); a unique deepest hit wins (sketch_hit)."""
+        def rz(b: str) -> bytes:
+            return hashlib.sha1(key + b"\x00" + b.encode()).digest()
+
+        if not self.sketch_on:
+            return sorted(decode, key=rz, reverse=True)
+        m = self.metrics
+        ids = _token_prompt(obj)
+        text = None if ids is not None else sketch_mod.canonical_prompt_text(
+            obj)
+        scores: dict[str, tuple[int, int]] = {}
+        chains: dict[tuple, list[bytes]] = {}
+        saw_sketch = False
+        for b in decode:
+            bs = self.sketches.get(b)
+            if bs is None:
+                continue
+            saw_sketch = True
+            if ids is not None and bs.page_tokens > 0:
+                domain, block = "token", bs.page_tokens
+                if (domain, block) not in chains:
+                    nb = min(len(ids) // block, self._max_blocks)
+                    chains[(domain, block)] = sketch_mod.chain_digests(
+                        ids, block, nb)
+            elif text is not None and bs.text_chars > 0:
+                domain, block = "text", bs.text_chars
+                if (domain, block) not in chains:
+                    digs: list[bytes] = []
+                    for d in sketch_mod.iter_text_digests(text, block):
+                        digs.append(d)
+                        if len(digs) >= self._max_blocks:
+                            break
+                    chains[(domain, block)] = digs
+            else:
+                continue
+            chain = chains[(domain, block)]
+            if chain:
+                scores[b] = bs.score_chain(chain, domain)
+        if not saw_sketch:
+            m.route_decisions_total.inc(reason="stale_sketch")
+            return sorted(decode, key=rz, reverse=True)
+        w = self._t0_weight
+
+        def val(b: str) -> float:
+            dev, host = scores.get(b, (0, 0))
+            return dev * (1.0 + w) + host
+
+        best = max(val(b) for b in decode)
+        tied = [b for b in decode if val(b) == best]
+        if best > 0 and len(tied) == 1:
+            chosen = tied[0]
+            m.route_decisions_total.inc(reason="sketch_hit")
+            dev, host = scores[chosen]
+            if dev:
+                m.expected_hit_blocks_total.inc(dev, backend=chosen,
+                                                tier="device")
+            if host:
+                m.expected_hit_blocks_total.inc(host, backend=chosen,
+                                                tier="host")
+        else:
+            with self._load_lock:
+                load = {b: self._inflight.get(b, 0) for b in tied}
+            least = min(load.values())
+            quiet = [b for b in tied if load[b] == least]
+            chosen = max(quiet, key=rz)
+            m.route_decisions_total.inc(reason="tie_fallback")
+        rest = sorted((b for b in decode if b != chosen),
+                      key=lambda b: (val(b), rz(b)), reverse=True)
+        return [chosen] + rest
 
     def _forward_failover(self, h, body: bytes, prefill_addr: str,
                           decode_addr: str, decode: list[str],
@@ -384,9 +615,19 @@ class Router:
                 time.sleep(backoff)  # one bounded backoff round, then give up
             for cand in candidates:
                 try:
-                    status, ra = self._forward(h, body, prefill_addr, cand,
-                                               started)
+                    with self._load_lock:
+                        self._inflight[cand] = self._inflight.get(cand, 0) + 1
+                    try:
+                        status, ra = self._forward(h, body, prefill_addr,
+                                                   cand, started)
+                    finally:
+                        with self._load_lock:
+                            self._inflight[cand] -= 1
                 except (OSError, http.client.HTTPException) as e:
+                    # The backend may have restarted: its sketch is no
+                    # longer evidence of cache residency — drop it now
+                    # instead of waiting out the staleness deadline.
+                    self.sketches.invalidate(cand)
                     if started[0]:
                         # Bytes already reached the client: a retry would
                         # splice two streams — surface the truncation.
@@ -422,14 +663,17 @@ class Router:
         relaying, or (None, retry_after) for a 503 swallowed BEFORE any
         byte reached the client (the failover input).  Raises OSError /
         http.client.HTTPException on connection failure."""
-        path = "/v1/disagg" + h.path[len("/v1"):]
+        if self.unified:
+            path = h.path
+            headers = {"Content-Type": "application/json"}
+        else:
+            path = "/v1/disagg" + h.path[len("/v1"):]
+            headers = {"Content-Type": "application/json",
+                       HDR_PREFILL_ADDR: prefill_addr}
         host, _, port = decode_addr.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
         try:
-            conn.request("POST", path, body=body, headers={
-                "Content-Type": "application/json",
-                HDR_PREFILL_ADDR: prefill_addr,
-            })
+            conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
             if resp.status == 503:
                 resp.read()  # drain for keep-alive hygiene

@@ -36,9 +36,17 @@ def main() -> None:
                    help="apiserver base URL (default: in-cluster config)")
     p.add_argument("--policy", default="cache_aware",
                    choices=("round_robin", "cache_aware"),
-                   help="cache_aware pins shared prompt prefixes to one "
-                        "backend so engine prefix caches hit (reference "
-                        "router default)")
+                   help="cache_aware scores backends by expected prefix "
+                        "hit depth against their exported cache sketches "
+                        "(ARKS_ROUTER_SKETCH_* knobs; ARKS_ROUTER_SKETCH=0 "
+                        "falls back to rendezvous-only), pinning shared "
+                        "prompt prefixes to the backend that actually "
+                        "holds them (reference router default)")
+    p.add_argument("--unified", action="store_true",
+                   help="backends are plain OpenAI servers (no prefill/"
+                        "decode split): route over the decode list only "
+                        "and forward to the ordinary completion paths "
+                        "(also ARKS_ROUTER_UNIFIED=1)")
     args = p.parse_args()
 
     logging.basicConfig(
@@ -62,7 +70,8 @@ def main() -> None:
         discovery = Discovery(args.discovery_file)
 
     router = Router(discovery, args.served_model_name,
-                    host=args.host, port=args.port, policy=args.policy)
+                    host=args.host, port=args.port, policy=args.policy,
+                    unified=args.unified)
     router.start(background=False)
 
 

@@ -258,6 +258,13 @@ class OpenAIServer:
                     self.wfile.write(text)
                 elif self.path in ("/healthz", "/health"):
                     self._json(200, {"status": "ok"})
+                elif self.path == "/v1/cache/sketch":
+                    # Prefix-digest sketch for cache-aware routing: a
+                    # compact per-tier summary of the digest chains this
+                    # backend holds (engine.cache_sketch reads host-side
+                    # snapshots only — the export never touches device
+                    # data, same non-blocking discipline as spills).
+                    self._json(200, server._sketch_payload())
                 elif self.path == "/readiness":
                     # Multi-host gangs: only process 0 (the leader) accepts
                     # traffic — workers participate in collectives but must
@@ -286,7 +293,12 @@ class OpenAIServer:
                         if wedged:
                             self._error(503, wedged)
                         else:
-                            self._json(200, {"status": "ready"})
+                            # Sketch age/version metadata rides readiness
+                            # so operators (and the router's monitoring)
+                            # can spot a wedged/stale sketch export
+                            # without scraping the sketch itself.
+                            self._json(200, {"status": "ready",
+                                             "sketch": server._sketch_meta()})
                 else:
                     self._error(404, f"no route {self.path}")
 
@@ -382,6 +394,20 @@ class OpenAIServer:
         return False
 
     # ------------------------------------------------------------------
+
+    def _sketch_payload(self) -> dict:
+        fn = getattr(self.engine, "cache_sketch", None)
+        return fn() if callable(fn) else {"enabled": False}
+
+    def _sketch_meta(self) -> dict:
+        """Age/version metadata for /readiness (not the full sketch)."""
+        p = self._sketch_payload()
+        if not p.get("enabled"):
+            return {"enabled": False}
+        return {"enabled": True, "epoch": p.get("epoch"),
+                "version": p.get("version"),
+                "age_s": round(max(0.0, time.time()
+                                   - float(p.get("built_unix", 0.0))), 3)}
 
     def _models_payload(self) -> dict:
         data = [{
@@ -502,6 +528,13 @@ class OpenAIServer:
         for prompt_ids in batch:
             if len(prompt_ids) > limit:
                 return self._context_length_error(h, len(prompt_ids), limit)
+
+        # Routing-sketch text ledger: this is the one place that sees a
+        # text prompt NEXT TO its token ids, so record the alignment the
+        # tokenize-free router scoring depends on (host hashing only).
+        note = getattr(self.engine, "note_prompt_text", None)
+        if callable(note):
+            note(body, batch[0])
 
         import dataclasses as _dc
         reqs = []

@@ -757,6 +757,231 @@ def run_shared_prefix_bench() -> dict:
     return out
 
 
+def run_shared_prefix_router_bench(n_backends: int) -> dict:
+    """``--workload shared-prefix --backends N``: the multi-backend
+    routing comparison.  N in-process engines (each behind a real
+    OpenAIServer) sit behind a real Router in unified mode; the same
+    multi-turn shared-prefix workload runs once per routing policy —
+
+    - ``sketch``      cache_aware, sketch scoring on (the PR under test)
+    - ``rendezvous``  cache_aware with ARKS_ROUTER_SKETCH=0 (prefix-key
+                      rendezvous only, the pre-sketch behavior)
+    - ``random``      round_robin
+
+    — on a FRESH fleet each time, driving token-id prompts (token-domain
+    scoring, no tokenizer in the router) with streamed responses.  TTFT
+    is the first SSE content frame; re-prefilled tokens per policy =
+    prefix-query tokens minus per-tier hit tokens, summed over backends.
+    Asserts byte-identical generated streams per request across policies
+    (any replica must serve the same bytes) and that sketch routing
+    strictly beats random on BOTH aggregate TTFT and re-prefilled tokens.
+
+    CPU mechanics: the tiny model keeps compile budgets flat; the
+    numbers compare routing policies, not absolute hardware speed."""
+    import random
+    import urllib.request
+
+    import numpy as np
+
+    from arks_tpu.engine import (EngineConfig, InferenceEngine, Request,
+                                 SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.router import Discovery, Router
+    from arks_tpu.server import OpenAIServer
+
+    model = os.environ.get("ARKS_BENCH_SP_MODEL", "tiny")
+    clients = int(os.environ.get("ARKS_BENCH_SP_CLIENTS", "8"))
+    turns = int(os.environ.get("ARKS_BENCH_SP_TURNS", "3"))
+    chunk = 16
+    cfg = get_config(model)
+    policies = (("sketch", "cache_aware", "1"),
+                ("rendezvous", "cache_aware", "0"),
+                ("random", "round_robin", "1"))
+
+    def _workload():
+        """The identical request sequence every policy replays: a shared
+        system prefix, then per-client histories that each turn extend
+        the PREVIOUS prompt (so its pages are reusable) plus fresh
+        tokens.  Deterministic — byte-identity across policies depends
+        on it."""
+        rng = random.Random(42)
+        lo, hi = 3, min(200, cfg.vocab_size)
+        system = [rng.randrange(lo, hi) for _ in range(2 * chunk)]
+        histories = [list(system) for _ in range(clients)]
+        seq = []
+        for turn in range(turns):
+            # Shuffled arrival order: real traffic is not aligned to the
+            # fleet size, and without this a round-robin counter can land
+            # every client on the same backend each turn by arithmetic
+            # accident (clients % n_backends == 0), faking affinity.
+            for ci in rng.sample(range(clients), clients):
+                prompt = histories[ci] + [rng.randrange(lo, hi)
+                                          for _ in range(chunk)]
+                seq.append((f"c{ci}-t{turn}", turn, prompt))
+                histories[ci] = prompt
+        return seq
+
+    def _stream_one(port, rid, prompt):
+        """POST through the router, streamed.  Returns (ttft_s, text)."""
+        body = json.dumps({"model": model + "-bench", "prompt": prompt,
+                           "max_tokens": 4, "temperature": 0,
+                           "ignore_eos": True, "stream": True}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        ttft, text = None, []
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                frame = json.loads(payload)
+                piece = (frame.get("choices") or [{}])[0].get("text")
+                if piece:
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                    text.append(piece)
+        return ttft, "".join(text)
+
+    def _run_policy(name, policy, sketch_flag):
+        saved = {k: os.environ.get(k) for k in
+                 ("ARKS_PREFIX_HOST_MB", "ARKS_ROUTER_SKETCH",
+                  "ARKS_ROUTER_SKETCH_POLL_S", "ARKS_PREFILL_ADDRS",
+                  "ARKS_DECODE_ADDRS")}
+        engines, servers, router = [], [], None
+        try:
+            os.environ["ARKS_PREFIX_HOST_MB"] = "8"
+            os.environ["ARKS_ROUTER_SKETCH"] = sketch_flag
+            # The bench drives poll_once() itself between turns.
+            os.environ["ARKS_ROUTER_SKETCH_POLL_S"] = "600"
+            rngp = random.Random(7)
+            for _ in range(n_backends):
+                # prefix_cache_mb=1: a retention surplus, so a session's
+                # history STAYS device-resident on its home backend — the
+                # locality the routing policies are competing to exploit.
+                ecfg = EngineConfig(model=model, num_slots=2,
+                                    max_cache_len=128,
+                                    prefill_buckets=(16, 32),
+                                    steps_per_dispatch=4,
+                                    prefill_chunk=chunk, kv_layout="paged",
+                                    prefix_cache_mb=1)
+                eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+                eng.start()
+                srv = OpenAIServer(eng, served_model_name=model + "-bench",
+                                   host="127.0.0.1", port=0)
+                srv.start(background=True)
+                engines.append(eng)
+                servers.append(srv)
+                # Prime the compiled programs so TTFT measures serving.
+                prime = Request("prime", [rngp.randrange(3, 200)
+                                         for _ in range(44)],
+                                SamplingParams(max_tokens=4, temperature=0.0,
+                                               ignore_eos=True))
+                eng.add_request(prime)
+                while not prime.outputs.get(timeout=300).finished:
+                    pass
+            os.environ["ARKS_PREFILL_ADDRS"] = ""
+            os.environ["ARKS_DECODE_ADDRS"] = ",".join(
+                f"127.0.0.1:{s.port}" for s in servers)
+            router = Router(Discovery(None), model + "-bench",
+                            host="127.0.0.1", port=0, policy=policy,
+                            unified=True)
+            router.start(background=True)
+            base = [{
+                "query": e.metrics.prefix_cache_query_tokens_total.total(),
+                "dev": e.metrics.prefix_cache_hit_tokens_total.get(
+                    tier="device"),
+                "host": e.metrics.prefix_cache_hit_tokens_total.get(
+                    tier="host"),
+            } for e in engines]
+            ttfts, texts = [], {}
+            last_turn = -1
+            for rid, turn, prompt in _workload():
+                if turn != last_turn:
+                    if router.sketch_on:
+                        router.sketches.poll_once()
+                    last_turn = turn
+                ttft, text = _stream_one(router.port, rid, prompt)
+                ttfts.append(ttft)
+                texts[rid] = text
+            dev = sum(e.metrics.prefix_cache_hit_tokens_total.get(
+                tier="device") - b["dev"] for e, b in zip(engines, base))
+            host = sum(e.metrics.prefix_cache_hit_tokens_total.get(
+                tier="host") - b["host"] for e, b in zip(engines, base))
+            query = sum(
+                e.metrics.prefix_cache_query_tokens_total.total() - b["query"]
+                for e, b in zip(engines, base))
+            decisions = {
+                reason: int(router.metrics.route_decisions_total.get(
+                    reason=reason))
+                for reason in ("sketch_hit", "tie_fallback", "stale_sketch",
+                               "no_key")}
+            measured = [t for t in ttfts if t is not None]
+            return {
+                "texts": texts,
+                "ttft_sum_ms": round(float(np.sum(measured)) * 1e3, 1),
+                "ttft_mean_ms": round(float(np.mean(measured)) * 1e3, 2),
+                "ttft_samples": len(measured),
+                "hit_tokens_tier0": int(dev),
+                "hit_tokens_tier1": int(host),
+                "reprefill_tokens": int(query - dev - host),
+                "route_decisions": decisions,
+            }
+        finally:
+            if router is not None:
+                router.stop()
+            for s in servers:
+                s.stop()
+            for e in engines:
+                e.stop()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    results = {}
+    for name, policy, sketch_flag in policies:
+        results[name] = _run_policy(name, policy, sketch_flag)
+
+    # Byte-identity: every request's generated stream is identical no
+    # matter which replica (or policy) served it.
+    ref = results["sketch"]["texts"]
+    for name in ("rendezvous", "random"):
+        other = results[name]["texts"]
+        assert set(other) == set(ref)
+        diff = [rid for rid in ref if other[rid] != ref[rid]]
+        assert not diff, f"streams diverge between sketch and {name}: {diff}"
+    summary = {name: {k: v for k, v in r.items() if k != "texts"}
+               for name, r in results.items()}
+    assert (results["sketch"]["reprefill_tokens"]
+            < results["random"]["reprefill_tokens"]), (
+        "sketch routing must strictly reduce re-prefilled tokens vs "
+        f"random: {summary}")
+    assert (results["sketch"]["ttft_sum_ms"]
+            < results["random"]["ttft_sum_ms"]), (
+        "sketch routing must strictly reduce aggregate TTFT vs random: "
+        f"{summary}")
+
+    out = {
+        "workload": "shared-prefix-router",
+        "spr_model": model, "spr_backends": n_backends,
+        "spr_clients": clients, "spr_turns": turns,
+        "spr_requests": clients * turns,
+        "spr_identical_streams": True,
+    }
+    for name in results:
+        for k, v in results[name].items():
+            if k != "texts":
+                out[f"spr_{name}_{k}"] = v
+    return out
+
+
 def run_multi_model_bench() -> dict:
     """``--workload multi-model``: two models on ONE engine process with
     bursty alternating traffic — the serverless-LLM shape the weight pool
@@ -941,8 +1166,17 @@ def main() -> None:
     ap.add_argument("--workload",
                     choices=("default", "shared-prefix", "multi-model"),
                     default="default")
+    ap.add_argument("--backends", type=int, default=1,
+                    help="shared-prefix only: N>1 runs the multi-backend "
+                         "routing comparison (N engines behind a real "
+                         "Router; sketch vs rendezvous vs random)")
     args, _ = ap.parse_known_args()
     if args.workload == "shared-prefix":
+        if args.backends > 1:
+            print(json.dumps({"metric": "shared_prefix_router",
+                              **run_shared_prefix_router_bench(
+                                  args.backends)}))
+            return
         print(json.dumps({"metric": "shared_prefix_serving",
                           **run_shared_prefix_bench()}))
         return

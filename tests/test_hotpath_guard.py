@@ -47,6 +47,22 @@ HOT_PATH_FUNCTIONS = (
     # at a fully drained boundary (nothing in flight to stall).
     "_issue_model_load",
     "_park_awaiting_model",
+    # Routing-sketch membership maintenance rides these engine-thread
+    # paths (the allocator's mirror updates inside register/evict): they
+    # must stay pure host bookkeeping — the sketch EXPORT happens on
+    # server threads from the mirror, never by fetching device state here.
+    "_note_evicted",
+    "_register_prompt_pages",
+)
+
+# Sketch export surface: runs on SERVER threads, but the same contract
+# applies with more force — an export that fetched device data would
+# serialize against the dispatch stream from outside the engine thread.
+# Everything it reads (digest mirrors, host-tier maps, counters) is host
+# state by construction.
+SKETCH_EXPORT_FUNCTIONS = (
+    "cache_sketch",
+    "note_prompt_text",
 )
 
 # Sanctioned exceptions, keyed (function, unparsed argument).  Each entry
@@ -107,6 +123,44 @@ def test_no_blocking_fetches_on_the_issue_path():
     assert not violations, (
         "blocking device fetch on the issue-side hot path (move it into a "
         f"_resolve_* tail or justify it in ALLOWED): {violations}")
+
+
+def test_no_blocking_fetches_in_sketch_export():
+    """The sketch export path (GET /v1/cache/sketch -> engine.cache_sketch,
+    plus the server's note_prompt_text hook) must never grow a blocking
+    device fetch: it runs concurrently with the dispatch stream, with the
+    same non-blocking discipline as spills."""
+    src = inspect.getsource(engine_mod)
+    module = ast.parse(src)
+    cls = next(n for n in module.body
+               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
+    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+    missing = [f for f in SKETCH_EXPORT_FUNCTIONS if f not in funcs]
+    assert not missing, f"sketch export functions renamed/removed: {missing}"
+    violations = []
+    for name in SKETCH_EXPORT_FUNCTIONS:
+        violations += _blocking_calls(name, funcs[name])
+    assert not violations, (
+        f"blocking device fetch in the sketch export path: {violations}")
+
+
+def test_sketch_module_stays_jax_free():
+    """The router imports arks_tpu.prefix_sketch directly — a jax (or
+    arks_tpu.engine) import there would drag the full runtime into the
+    pure-I/O router process."""
+    import arks_tpu.prefix_sketch as sketch_mod
+    src = inspect.getsource(sketch_mod)
+    module = ast.parse(src)
+    for node in ast.walk(module):
+        names = []
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            names = [node.module or ""]
+        for n in names:
+            assert not n.startswith("jax"), f"jax import in prefix_sketch: {n}"
+            assert not n.startswith("arks_tpu.engine"), (
+                f"engine import in prefix_sketch: {n}")
 
 
 def test_no_blocking_fetches_in_stream_scatter_helpers():

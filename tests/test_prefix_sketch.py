@@ -1,0 +1,255 @@
+"""Prefix-digest sketches (arks_tpu.prefix_sketch) + engine export.
+
+Unit surface: bloom false-positive bound and determinism, exporter build
+caching / membership invalidation / epoch bumping, conservative
+text->token alignment, scoring determinism.  Integration surface: a real
+tiny paged engine exports its tier membership via GET /v1/cache/sketch
+and surfaces age/version metadata in /readiness.
+"""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from arks_tpu import prefix_sketch as ps
+
+PAGE = 16
+
+
+def _rand_digests(rng, n):
+    return [bytes(rng.getrandbits(8) for _ in range(20)) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Bloom filter
+# ---------------------------------------------------------------------------
+
+def test_bloom_false_positive_bound():
+    """m=16384, k=4, n=512 members: theory says ~2e-4 FP; assert the
+    observed rate over 20k absent probes stays under 0.5% — the bound the
+    router's deepest-hit scoring budgets for."""
+    rng = random.Random(7)
+    members = _rand_digests(rng, 512)
+    absent = _rand_digests(rng, 20000)
+    b = ps.BloomSketch(16384, 4)
+    for d in members:
+        b.add(d)
+    assert all(d in b for d in members), "bloom must never false-negative"
+    fp = sum(1 for d in absent if d in b) / len(absent)
+    assert fp < 0.005, f"observed false-positive rate {fp}"
+
+
+def test_bloom_serialization_probes_identically():
+    rng = random.Random(8)
+    members = _rand_digests(rng, 64)
+    probes = _rand_digests(rng, 512)
+    b = ps.BloomSketch(4096, 4)
+    for d in members:
+        b.add(d)
+    b2 = ps.BloomSketch.from_payload(json.loads(json.dumps(b.to_payload())))
+    assert all((d in b) == (d in b2) for d in members + probes)
+
+
+def test_chain_digests_shared_with_allocator():
+    """paged.py re-exports the one hashing implementation — the router's
+    token-domain probes and the engine's index keys must be bit-equal."""
+    from arks_tpu.engine import paged
+    ids = list(range(5, 70))
+    assert paged.chain_digests(ids, PAGE, 4) == ps.chain_digests(ids, PAGE, 4)
+    assert paged.iter_chain_digests is ps.iter_chain_digests
+
+
+# ---------------------------------------------------------------------------
+# Exporter
+# ---------------------------------------------------------------------------
+
+def _mk_exporter():
+    return ps.SketchExporter(PAGE)
+
+
+def test_build_is_cached_until_membership_changes():
+    ex = _mk_exporter()
+    rng = random.Random(9)
+    dev = _rand_digests(rng, 8)
+    host = _rand_digests(rng, 4)
+    p1 = ex.build(dev, ("a", 1), host, 1)
+    p2 = ex.build(dev, ("a", 1), host, 1)
+    assert p1["version"] == p2["version"] == 1
+    p3 = ex.build(dev + _rand_digests(rng, 1), ("a", 2), host, 1)
+    assert p3["version"] == 2
+    # Evicted members vanish from the summary.
+    p4 = ex.build(dev[1:], ("a", 3), host, 1)
+    bs = ps.BackendSketch.from_payload(p4)
+    assert bs.score_chain([dev[0]], "token") == (0, 0)
+    assert bs.score_chain([dev[1]], "token") == (1, 0)
+
+
+def test_hit_counters_ride_every_response_uncached():
+    ex = _mk_exporter()
+    p1 = ex.build([], ("a", 1), [], 1, hit_tokens={"device": 1}, query_tokens=2)
+    p2 = ex.build([], ("a", 1), [], 1, hit_tokens={"device": 9}, query_tokens=20)
+    assert p1["version"] == p2["version"]
+    assert p2["hit_tokens"]["device"] == 9 and p2["query_tokens"] == 20
+
+
+def test_epoch_bump_invalidates_and_renames():
+    ex = _mk_exporter()
+    p1 = ex.build([], ("a", 1), [], 1)
+    e1 = p1["epoch"]
+    ex.bump_epoch()
+    p2 = ex.build([], ("a", 1), [], 1)
+    assert p2["epoch"] != e1
+    assert p2["version"] > p1["version"]
+
+
+def test_scoring_is_deterministic_and_tier_split():
+    ex = _mk_exporter()
+    rng = random.Random(10)
+    chain = _rand_digests(rng, 6)
+    # Blocks 0-2 device-resident, 3-4 host-resident, 5 nowhere.
+    payload = ex.build(chain[:3], ("a", 1), chain[3:5], 1)
+    bs = ps.BackendSketch.from_payload(payload)
+    for _ in range(3):
+        assert bs.score_chain(chain, "token") == (3, 2)
+    # A hole in the device run stops tier-0 counting there; the host walk
+    # continues from the miss point only if resident.
+    holey = [chain[0], _rand_digests(rng, 1)[0]] + chain[1:]
+    dev, host = bs.score_chain(holey, "token")
+    assert dev == 1 and host == 0
+
+
+def test_text_alignment_rounds_token_depth_up():
+    """Text block j maps to the token depth that PROVABLY covers it:
+    claimed coverage must never exceed the proportional token estimate
+    rounded up to a page boundary."""
+    ex = _mk_exporter()
+    text = "x" * (ex.text_chars * 3)          # 3 full text blocks
+    ids = list(range(4 * PAGE))               # 4 full token pages
+    ex.link(text, ids)
+    toks = ps.chain_digests(ids, PAGE, 4)
+    tds = list(ps.iter_text_digests(text, ex.text_chars))
+    # Text block 0 covers 1/3 of the text -> ceil(4/3 pages)=2 pages; the
+    # sketch must demand token depth 2 resident before advertising it.
+    payload = ex.build(toks[:1], ("a", 1), [], 1)
+    bs = ps.BackendSketch.from_payload(payload)
+    assert bs.score_chain(tds, "text") == (0, 0)
+    payload = ex.build(toks[:2], ("a", 2), [], 1)
+    bs = ps.BackendSketch.from_payload(payload)
+    assert bs.score_chain(tds, "text")[0] == 1
+    payload = ex.build(toks, ("a", 3), [], 1)
+    bs = ps.BackendSketch.from_payload(payload)
+    assert bs.score_chain(tds, "text")[0] == 3
+
+
+def test_link_ledger_is_bounded(monkeypatch):
+    monkeypatch.setenv("ARKS_ROUTER_SKETCH_LINKS", "4")
+    ex = ps.SketchExporter(PAGE)
+    for i in range(10):
+        ex.link(f"{i:03d}" + "y" * ex.text_chars, list(range(PAGE)))
+    assert len(ex._links) <= 4
+
+
+def test_canonical_prompt_text_rules():
+    assert ps.canonical_prompt_text({"prompt": "abc"}) == "abc"
+    assert ps.canonical_prompt_text({"prompt": [1, 2, 3]}) is None
+    assert ps.canonical_prompt_text(
+        {"messages": [{"role": "u", "content": "a"},
+                      {"role": "a", "content": "b"}]}) == "a\x00b"
+    # Unknown content shape stops the scan — later turns never leak in.
+    assert ps.canonical_prompt_text(
+        {"messages": [{"role": "u", "content": {"x": 1}},
+                      {"role": "a", "content": "b"}]}) is None
+    assert ps.canonical_prompt_text(
+        {"messages": [{"role": "u", "content": [
+            {"type": "text", "text": "hi"}]}]}) == "hi"
+
+
+# ---------------------------------------------------------------------------
+# Engine + server integration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def sketch_server(monkeypatch):
+    from arks_tpu.engine import (EngineConfig, InferenceEngine, Request,
+                                 SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.server import OpenAIServer
+
+    monkeypatch.setenv("ARKS_PREFIX_HOST_MB", "64")
+    # ByteTokenizer is 1 char = 1 token and max_cache_len is 64: shrink
+    # the text block so a full block fits in one request.
+    monkeypatch.setenv("ARKS_ROUTER_SKETCH_CHARS", "16")
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        prefill_chunk=PAGE, kv_layout="paged",
+                        prefix_cache_mb=0)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    eng.start()
+    srv = OpenAIServer(eng, served_model_name="tiny-sk", host="127.0.0.1",
+                       port=0)
+    srv.start(background=True)
+    yield cfg, eng, srv, Request, SamplingParams
+    srv.stop()
+    eng.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=60) as r:
+        return json.load(r)
+
+
+def test_engine_exports_resident_chain(sketch_server):
+    cfg, eng, srv, Request, SamplingParams = sketch_server
+    warm = [int(x) % cfg.vocab_size for x in range(3, 36)]  # 2 pages + tail
+    req = Request("sk1", warm, SamplingParams(max_tokens=4, temperature=0.0,
+                                              ignore_eos=True))
+    eng.add_request(req)
+    while True:
+        if req.outputs.get(timeout=120).finished:
+            break
+    payload = _get(srv.port, "/v1/cache/sketch")
+    assert payload["enabled"] and payload["page_tokens"] == PAGE
+    bs = ps.BackendSketch.from_payload(payload)
+    digs = ps.chain_digests(warm, PAGE, 2)
+    dev, host = bs.score_chain(digs, "token")
+    assert dev + host == 2, "the warm prompt's pages are resident somewhere"
+    # Version metadata is stable while membership is.
+    again = _get(srv.port, "/v1/cache/sketch")
+    assert again["version"] == payload["version"]
+    assert again["epoch"] == payload["epoch"]
+
+
+def test_readiness_carries_sketch_metadata(sketch_server):
+    _, _, srv, _, _ = sketch_server
+    ready = _get(srv.port, "/readiness")
+    assert ready["status"] == "ready"
+    meta = ready["sketch"]
+    assert meta["enabled"] and meta["version"] >= 1
+    assert meta["age_s"] >= 0.0 and "." in meta["epoch"]
+
+
+def test_server_links_text_prompts(sketch_server):
+    """POSTing a text completion records the text->token alignment, so a
+    text-domain probe scores the resident chain without any tokenizer on
+    the probing side."""
+    cfg, eng, srv, _, _ = sketch_server
+    text = "the quick brown fox jumps over the lazy dog, twic"  # 49 chars
+    body = json.dumps({"model": "tiny-sk", "prompt": text, "max_tokens": 2,
+                       "temperature": 0, "ignore_eos": True}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        json.load(r)
+    payload = _get(srv.port, "/v1/cache/sketch")
+    bs = ps.BackendSketch.from_payload(payload)
+    chars = payload["text_chars"]
+    tds = list(ps.iter_text_digests(text, chars))
+    assert tds, "test text shorter than a text block"
+    dev, host = bs.score_chain(tds, "text")
+    assert dev + host >= 1, "text-domain membership never surfaced"
