@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import heapq
 import logging
 import os
 import queue
@@ -46,6 +47,7 @@ from arks_tpu.engine.types import PrefilledState, Request, RequestOutput
 from arks_tpu.models.config import ModelConfig
 from arks_tpu.models import transformer as tf
 from arks_tpu.utils import metrics as prom
+from arks_tpu import slo as slo_mod
 
 log = logging.getLogger("arks_tpu.engine")
 
@@ -272,6 +274,64 @@ class _RestoreState:
     marker: object       # device scalar from the last scatter dispatch
     seed: int
     t0: float
+
+
+@dataclasses.dataclass
+class _SwapRecord:
+    """A preempted request's host-side slot snapshot (ARKS_PREEMPT):
+    everything `_finish_resume` needs to rebuild the victim's `_Slot` and
+    host mirrors byte-identically once its KV pages scatter back.  The
+    device-side halves (KV page blocks, sampler row) live in the
+    SwapStore entry keyed by the same request id."""
+
+    request: Request
+    num_prompt: int
+    generated: list
+    num_emitted: int
+    logprobs: list
+    first_token_time: float | None
+    seed: int
+    length: int       # host lengths mirror at preempt (valid KV rows)
+    last_token: int   # host last-token mirror at preempt
+    stop_col: object
+    dead_len: int
+    n_pages: int      # pool pages covering rows [0, length)
+    priority: int
+    t0: float         # preempt issue time (preempt_swap_seconds)
+
+
+@dataclasses.dataclass
+class _SwapState:
+    """An in-flight preempt spill: the victim's slot is already freed
+    (stream order guarantees the gathers below read pre-reuse bytes) and
+    these D2H copies are draining."""
+
+    rec: _SwapRecord
+    staged: list   # [(n_valid, gather outputs)] per spill group
+    row: tuple     # (key[2], counts[V], guide_row) device arrays
+
+
+@dataclasses.dataclass
+class _ResumeState:
+    """A preempt-swap restore in flight: the resumed request holds
+    ``slot`` (popped from _free) while its page blocks scatter back; it
+    parks in ``_awaiting_restore`` beside the prefix ``_RestoreState``s
+    and lands via ``_finish_resume`` once the marker resolves — no
+    prefill, no first-token output, the stream just continues."""
+
+    rec: _SwapRecord
+    slot: int
+    pages: list[int]
+    marker: object
+    t0: float
+
+    @property
+    def request(self) -> Request:
+        return self.rec.request
+
+    @property
+    def ids(self) -> list[int]:
+        return self.rec.request.prompt_ids
 
 
 @dataclasses.dataclass
@@ -544,7 +604,28 @@ class EngineMetrics:
         self.requests_parked = r.gauge(
             "requests_parked",
             "Requests parked by reason: guide compile, host-tier KV "
-            "restore, or a pending model switch")
+            "restore, a pending model switch, or a preemptive KV swap")
+        # ---- SLO tiers + preemptive KV swap (arks_tpu.slo, ARKS_PREEMPT)
+        # Per-tier latency families carry the tier NAME as a label so one
+        # dashboard row per rung of the ladder can alert on its own
+        # target (docs/monitoring.md); without ARKS_SLO_TIERS everything
+        # lands in tier="default" and the families mirror the global
+        # TTFT/TPOT histograms.
+        self.ttft_seconds = r.histogram(
+            "ttft_seconds", "TTFT by SLO tier",
+            buckets=[0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8])
+        self.tpot_seconds = r.histogram(
+            "tpot_seconds", "TPOT by SLO tier",
+            buckets=[0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.16, 0.32, 0.64])
+        self.requests_preempted_total = r.counter(
+            "requests_preempted_total",
+            "Running requests preempted for a higher tier, by victim tier")
+        self.preempt_swap_seconds = r.histogram(
+            "preempt_swap_seconds",
+            "Preemptive-swap leg latency (issue -> host copy landed, and "
+            "resume issue -> slot live again)",
+            buckets=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1, 2.5])
 
 
 def _scoped(phase: str):
@@ -693,6 +774,58 @@ class InferenceEngine:
             raise ValueError(
                 f"ARKS_PIPELINE_DEPTH={pipe_depth}: must be >= 0")
         self._pipe_depth = pipe_depth
+
+        # ---- SLO tiers + preemptive KV swap (ARKS_PREEMPT) -------------
+        # Tier ladder (metric labels + admission semantics; arks_tpu.slo)
+        # and the preemption knobs, all engine-global: a queued request
+        # whose (aged) priority strictly outranks the lowest running tier
+        # may seize that victim's slot by swapping its full decode state
+        # to host RAM.  Default OFF — priority stays pure queue ordering.
+        self._slo = slo_mod.from_env()
+        self._preempt_on = os.environ.get("ARKS_PREEMPT", "0") == "1"
+        _pm = os.environ.get("ARKS_PREEMPT_MAX_INFLIGHT", "1")
+        try:
+            preempt_max = int(_pm)
+        except ValueError:
+            raise ValueError(
+                f"ARKS_PREEMPT_MAX_INFLIGHT={_pm!r}: expected an integer >= 1")
+        if preempt_max < 1:
+            raise ValueError(
+                f"ARKS_PREEMPT_MAX_INFLIGHT={preempt_max}: must be >= 1")
+        self._preempt_max = preempt_max
+        _pc = os.environ.get("ARKS_PREEMPT_COOLDOWN_S", "2")
+        try:
+            preempt_cooldown = float(_pc)
+        except ValueError:
+            raise ValueError(
+                f"ARKS_PREEMPT_COOLDOWN_S={_pc!r}: expected a number >= 0")
+        if preempt_cooldown < 0:
+            raise ValueError(
+                f"ARKS_PREEMPT_COOLDOWN_S={preempt_cooldown}: must be >= 0")
+        self._preempt_cooldown_s = preempt_cooldown
+        # Anti-thrash ledger: rid -> last preempt time; a victim inside
+        # the cooldown window is skipped so two tiers can't ping-pong one
+        # slot (swap-storm livelock).
+        self._preempt_last: dict[str, float] = {}
+        # Preempt-resumed rids mid-flight through replay-mode resume (the
+        # re-queue path): _register_slot suppresses their TTFT — the
+        # client saw the real first token long ago.
+        self._resuming: set[str] = set()
+        # ---- Priority-queue aging (ARKS_QUEUE_AGING_S) -----------------
+        # A queued request's EFFECTIVE priority decays by one tier per
+        # aging window, so sustained high-tier load cannot starve the
+        # batch tier forever.  0 = off.
+        _qa = os.environ.get("ARKS_QUEUE_AGING_S", "0")
+        try:
+            queue_aging = float(_qa)
+        except ValueError:
+            raise ValueError(
+                f"ARKS_QUEUE_AGING_S={_qa!r}: expected a number >= 0")
+        if queue_aging < 0:
+            raise ValueError(
+                f"ARKS_QUEUE_AGING_S={queue_aging}: must be >= 0")
+        self._queue_aging_s = queue_aging
+        self._queue_age_last = 0.0
 
         # ---- Multi-model pool (arks_tpu.engine.model_pool) -------------
         # Requests carry a model id; ones targeting a non-active pool
@@ -1008,6 +1141,22 @@ class InferenceEngine:
         self._spill_group = min(8, max(self._max_pages, 1))
         self._restore_group = min(8, max(self._max_pages, 1))
 
+        # ---- Preemptive KV swap state (ARKS_PREEMPT) -------------------
+        # Victim decode state (KV page blocks + sampler row) parks in a
+        # keyed SwapStore sharing the host tier's byte budget; swap-mode
+        # preemption therefore requires the host tier.  Engines without
+        # it (slot layout, pp>1, host tier off) and spec engines (the
+        # draft cache mirror has no cheap snapshot) preempt in REPLAY
+        # mode instead: the victim re-queues behind a _ReplayGate and
+        # deterministically re-executes (docs/application-usage.md has
+        # the fallback matrix).
+        self._swap = None
+        if self._host is not None:
+            from arks_tpu.engine.prefix_cache import SwapStore
+            self._swap = SwapStore(self._host)
+        self._swap_pending: list[_SwapState] = []   # in-flight D2H swaps
+        self._swapped: dict[str, _SwapRecord] = {}  # rid -> parked victim
+
         # Speculative decoding: draft model params + its own slot cache.
         self._draft_cfg = None
         self._draft_params = None
@@ -1152,6 +1301,13 @@ class InferenceEngine:
             # model is configured, since the mixed scheduler is a hard
             # requirement for speculation.
             "spec_mixed": str(self._draft_cfg is not None).lower(),
+            # "swap" = preemption spills victim decode state to host RAM;
+            # "replay" = victims re-queue and re-execute; "off" = priority
+            # is pure queue ordering (the fallback matrix in
+            # docs/application-usage.md).
+            "preempt": ("off" if not self._preempt_on else
+                        "swap" if self._preempt_swap_capable() else
+                        "replay"),
         }
         self.metrics.engine_config_info.set(1, **self.resolved_config)
         log.info("engine resolved config: %s",
@@ -1307,6 +1463,19 @@ class InferenceEngine:
                 return cache, cache.k[0, 0, 0, 0, 0]
 
             self._restore_fn = jax.jit(restore_scatter, donate_argnums=(0,))
+
+            # Preemptive swap (ARKS_PREEMPT): one victim slot's sampler
+            # row out (the D2H decode-state snapshot: PRNG key, penalty
+            # counts, DFA row — everything sample() evolves per slot) and
+            # its counts back on resume (key/guide_row ride set_slot,
+            # which RESETS counts — hence the separate restore).
+            self._sampler_row_fn = jax.jit(
+                lambda st, slot: (st.key[slot], st.counts[slot],
+                                  st.guide_row[slot]))
+            self._restore_counts_fn = jax.jit(
+                lambda st, slot, row: st._replace(
+                    counts=st.counts.at[slot].set(row)),
+                donate_argnums=(0,))
 
         def sample_one(logits, temperature, top_p, top_k, key,
                        bias_ids, bias_vals, sup_ids, min_first,
@@ -1938,7 +2107,8 @@ class InferenceEngine:
                 and not self._prefilling and not self._pending_admits
                 and not self._awaiting_guide
                 and not self._awaiting_restore
-                and not self._awaiting_model)
+                and not self._awaiting_model
+                and not self._swap_pending and not self._swapped)
 
     # ------------------------------------------------------------------
     # Scheduler loop
@@ -2119,6 +2289,7 @@ class InferenceEngine:
             self._abort_awaiting_guide()
             self._abort_awaiting_restores()
             self._abort_awaiting_model()
+            self._abort_swapped()
 
     def _run_loop(self) -> None:
         while self._running:
@@ -2207,14 +2378,38 @@ class InferenceEngine:
                     request=req, seed=self._resolve_seed(req),
                     num_prompt=len(ids)))
         for rst in self._awaiting_restore:
-            # Restore-parked requests emitted nothing: plain re-queue.
-            # The host tier SURVIVES the device reset, so the re-run's
-            # admission hits tier 1 again instead of re-prefilling.
             self.metrics.num_requests_waiting.inc(-1)
-            survivors.append(_Survivor(
-                request=rst.request, seed=rst.seed,
-                num_prompt=len(rst.ids)))
+            if isinstance(rst, _ResumeState):
+                # A mid-restore preempt resume replays like any decoding
+                # survivor — its generated prefix re-executes behind the
+                # gate (the safe backstop when the swap path itself may
+                # be what faulted).
+                survivors.append(self._swap_survivor(rst.rec))
+            else:
+                # Restore-parked requests emitted nothing: plain
+                # re-queue.  The host tier SURVIVES the device reset, so
+                # the re-run's admission hits tier 1 again instead of
+                # re-prefilling.
+                survivors.append(_Survivor(
+                    request=rst.request, seed=rst.seed,
+                    num_prompt=len(rst.ids)))
         self._awaiting_restore = []
+        # Preempted victims (spill in flight or parked in host RAM):
+        # token-replay instead of trusting a snapshot that may share the
+        # fault's poisoned stream.  Their SwapStore bytes come back.
+        for sw in self._swap_pending:
+            self.metrics.num_requests_waiting.inc(-1)
+            survivors.append(self._swap_survivor(sw.rec))
+        self._swap_pending = []
+        for rid_sw, rec_sw in self._swapped.items():
+            self.metrics.num_requests_waiting.inc(-1)
+            if self._swap is not None:
+                self._swap.discard(rid_sw)
+            survivors.append(self._swap_survivor(rec_sw))
+        self._swapped.clear()
+        if self._swap is not None:
+            self.metrics.prefix_cache_usage_bytes.set(
+                self._swap.bytes_used, tier="swap")
         self._slots.clear()
         self._prefilling.clear()
         self._pending_admits.clear()
@@ -2309,11 +2504,35 @@ class InferenceEngine:
             # nobody else was in flight (switches run fully drained).
             return [req.request_id for req, want, _ in self._awaiting_model
                     if want == self._switch_target]
+        if phase == "preempt":
+            # Preempt faults are raised with explicit single-victim
+            # culprits at every fire site; an unattributed one can only
+            # be host-side scheduling code — blame the in-flight swap
+            # traffic, not the decoding slots.
+            return ([sw.rec.request.request_id for sw in self._swap_pending]
+                    + list(self._swapped)
+                    + [r.request.request_id for r in self._awaiting_restore
+                       if isinstance(r, _ResumeState)])
         rids = [st.request.request_id for st in self._slots.values()]
         if phase == "mixed":
             rids += [cs.request.request_id
                      for cs in self._prefilling.values()]
         return rids
+
+    def _live_rids(self) -> set:
+        """Request ids somewhere in the engine's in-flight structures
+        (everything except the admission queue) — the abort-purge and
+        replay-liveness universe."""
+        live = {st.request.request_id for st in self._slots.values()}
+        live |= {st.request.request_id for st in self._prefilling.values()}
+        live |= {req.request_id for rec in self._pending_admits
+                 for req, _, _ in rec[0]}
+        live |= {req.request_id for req, _ in self._awaiting_guide}
+        live |= {rec.request.request_id for rec in self._awaiting_restore}
+        live |= {req.request_id for req, _, _ in self._awaiting_model}
+        live |= {sw.rec.request.request_id for sw in self._swap_pending}
+        live |= set(self._swapped)
+        return live
 
     def _purge_stale_aborts(self, consumed=()) -> None:
         """Drop abort flags that no live request can ever consume.  Aborts
@@ -2322,13 +2541,7 @@ class InferenceEngine:
         or never existed) is garbage — without this, an abort racing
         _finish would sit in the set forever (and the set could grow
         without bound under abort-heavy clients)."""
-        active = {st.request.request_id for st in self._slots.values()}
-        active |= {st.request.request_id for st in self._prefilling.values()}
-        active |= {req.request_id for rec in self._pending_admits
-                   for req, _, _ in rec[0]}
-        active |= {req.request_id for req, _ in self._awaiting_guide}
-        active |= {rec.request.request_id for rec in self._awaiting_restore}
-        active |= {req.request_id for req, _, _ in self._awaiting_model}
+        active = self._live_rids()
         with self._abort_lock:
             self._aborted -= set(consumed)
             self._aborted &= active | self._queued_rids
@@ -2362,15 +2575,7 @@ class InferenceEngine:
         if self._replaying:
             # Drop replayers that went terminal without re-registering
             # (an abort or per-request rejection raced the re-run).
-            live = {st.request.request_id for st in self._slots.values()}
-            live |= {cs.request.request_id
-                     for cs in self._prefilling.values()}
-            live |= {req.request_id for rec in self._pending_admits
-                     for req, _, _ in rec[0]}
-            live |= {req.request_id for req, _ in self._awaiting_guide}
-            live |= {rec.request.request_id
-                     for rec in self._awaiting_restore}
-            live |= {req.request_id for req, _, _ in self._awaiting_model}
+            live = self._live_rids()
             with self._abort_lock:
                 live |= self._queued_rids
             self._replaying &= live
@@ -2396,6 +2601,9 @@ class InferenceEngine:
         self._abort_pending_admits()
         self._abort_awaiting_restores()
         self._abort_awaiting_model()
+        # Preempted victims fail too, and their SwapStore entries go with
+        # them — swapped-out KV may carry the poison back on resume.
+        self._abort_swapped()
         if self._prefix is not None:
             # Deep clean: cached prefix KV may itself be the poison.
             self._prefix.clear()
@@ -2420,6 +2628,10 @@ class InferenceEngine:
         # restarts" property the tier exists for.
         self._spill_victims.clear()
         self._spills.clear()
+        # In-flight preempt swaps reference the same stream; their
+        # victims were snapshotted as replay survivors by _do_recovery
+        # (or aborted by _blanket_abort) — drop the device refs.
+        self._swap_pending = []
         # The rebuilt allocator starts with an EMPTY tier-0 index: move
         # the sketch epoch so routers drop the pre-reset sketch the
         # moment they next poll, instead of keeping this backend winning
@@ -2548,6 +2760,24 @@ class InferenceEngine:
             t0 = tr
         if self._spills:
             worked = self._resolve_spills() or worked
+        if self._swap_pending or self._swapped or self._preempt_on:
+            # Preemptive KV swap: harvest landed victim spills into the
+            # SwapStore, serve aborts / schedule resumes for swapped-out
+            # victims, then seize slots for outranking queued requests —
+            # all BEFORE the issue block, so a freed slot admits (and a
+            # resumed scatter dispatches) in this same step.
+            tp = time.monotonic()
+            self._queue_age_tick()
+            if self._swap_pending:
+                worked = self._resolve_preempt_swaps() or worked
+            if self._swapped:
+                worked = self._service_swapped() or worked
+            worked = self._maybe_preempt() or worked
+            dt = time.monotonic() - tp
+            if dt > 1e-4:
+                self.metrics.scheduler_seconds_total.inc(dt, phase="preempt")
+        elif self._queue_aging_s:
+            self._queue_age_tick()
         pending = None
         issued = False
         if self._mixed:
@@ -2617,6 +2847,7 @@ class InferenceEngine:
             self.metrics.scheduler_seconds_total.inc(
                 time.monotonic() - t4, phase="admit")
         if not worked and (self._awaiting_restore or self._spills
+                           or self._swap_pending or self._swapped
                            or self._awaiting_model or self._model_loads):
             # Parked restores / in-flight spills / pending model loads
             # resolve on DEVICE (or loader-thread) time, not queue
@@ -3337,7 +3568,26 @@ class InferenceEngine:
 
     def _restore_ready_any(self) -> bool:
         return any(self._dev_ready(rec.marker)
-                   for rec in self._awaiting_restore)
+                   for rec in self._awaiting_restore
+                   if not isinstance(rec, _ResumeState))
+
+    def _resume_ready_any(self) -> bool:
+        """A preempt-swap resume's scatter landed.  Unlike a prefix
+        restore it needs NO free slot — the resumed request already holds
+        one — so the pipelined fast path must drain for it even when
+        _free is empty."""
+        return any(self._dev_ready(rec.marker)
+                   for rec in self._awaiting_restore
+                   if isinstance(rec, _ResumeState))
+
+    def _swap_ready_any(self) -> bool:
+        """The oldest in-flight preempt spill's D2H copies landed (FIFO —
+        _resolve_preempt_swaps only ever harvests the head)."""
+        if not self._swap_pending:
+            return False
+        sw = self._swap_pending[0]
+        marker = sw.staged[-1][1][0] if sw.staged else sw.row[1]
+        return self._dev_ready(marker) and self._dev_ready(sw.row[1])
 
     def _resolve_restores(self) -> bool:
         """Unpark restore-parked requests whose scatter landed (and a
@@ -3356,6 +3606,42 @@ class InferenceEngine:
                 was_aborted = rid in self._aborted
                 if was_aborted:
                     self._aborted.discard(rid)
+            if isinstance(rec, _ResumeState):
+                # Preempt-swap resume: the request holds its slot already;
+                # only the scatter marker gates it (no free-slot wait).
+                if was_aborted:
+                    pending.pop(i)
+                    did = True
+                    self.metrics.num_requests_waiting.inc(-1)
+                    self._alloc.decref(rec.pages)
+                    self._free.append(rec.slot)
+                    self._unpin_guide(rec.request)
+                    rec.request.outputs.put(RequestOutput(
+                        request_id=rid, token_ids=[], finished=True,
+                        finish_reason="abort",
+                        num_prompt_tokens=rec.rec.num_prompt,
+                        num_generated_tokens=len(rec.rec.generated)))
+                    self._update_parked()
+                    continue
+                if not self._dev_ready(rec.marker):
+                    i += 1
+                    continue
+                pending.pop(i)
+                did = True
+                self.metrics.num_requests_waiting.inc(-1)
+                try:
+                    self._faults.fire("preempt")
+                    np.asarray(rec.marker)  # surfaces dispatch failures
+                except Exception as e:
+                    self._free.append(rec.slot)
+                    if isinstance(e, StepFault):
+                        raise
+                    raise StepFault(
+                        "preempt", faults_mod.classify(e), culprits=[rid],
+                        survivors=[self._swap_survivor(rec.rec)]) from e
+                self._finish_resume(rec)
+                self._update_parked()
+                continue
             if was_aborted:
                 pending.pop(i)
                 did = True
@@ -3419,6 +3705,525 @@ class InferenceEngine:
                 finished=True, finish_reason="abort",
                 num_prompt_tokens=len(rec.ids)))
         self._awaiting_restore = []
+
+    # ------------------------------------------------------------------
+    # SLO-tiered preemptive KV swap (ARKS_PREEMPT)
+    # ------------------------------------------------------------------
+    # Priority stops being mere queue ordering: when a queued request's
+    # (aged) priority strictly outranks the lowest running tier and no
+    # slot is free, the scheduler seizes a victim slot.  Two modes:
+    #
+    # - SWAP (paged + chunked + host tier, single-host, non-spec): the
+    #   victim's FULL decode state leaves the device — KV pages through
+    #   the same gather/stage path the prefix spill uses, plus the
+    #   sampler row (PRNG key, penalty counts, DFA row) — and parks in
+    #   the SwapStore.  Resume scatters it all back into a fresh slot and
+    #   the stream continues byte-identically: the key snapshot re-enters
+    #   the per-slot split chain exactly where sample() left it, the
+    #   counts row reproduces the penalty state, and pool pages are
+    #   byte-exact round trips (the PR 5 bit-exactness argument).
+    # - REPLAY (everything else): the victim re-queues behind a
+    #   _ReplayGate and deterministically re-executes — the PR 4 recovery
+    #   discipline, which also backstops swap mode when the host budget
+    #   is full.  docs/application-usage.md carries the fallback matrix.
+    #
+    # Freeing the victim's slot in the SAME step as the gathers is safe
+    # for the same reason _spill_flush is: every device op enqueues in
+    # order on one stream, so the gathers read pre-reuse bytes no matter
+    # when the next admission's dispatch lands.
+
+    def _preempt_swap_capable(self) -> bool:
+        """Swap-mode eligibility (engine-wide, decided at init): needs
+        the paged+chunk engine with the host tier on (the SwapStore
+        shares its budget) and no draft model — a spec victim's draft
+        cache mirror has no cheap snapshot, so spec engines preempt in
+        replay mode."""
+        return (self._host_tier_on() and self._swap is not None
+                and self._draft_cfg is None)
+
+    def _preempt_capable(self) -> bool:
+        """Preemption on at all: ARKS_PREEMPT=1 and single-host (the
+        follower dispatch protocol has no preempt op)."""
+        return self._preempt_on and self.dispatcher is None
+
+    @staticmethod
+    def _swap_survivor(rec: _SwapRecord) -> _Survivor:
+        """A swapped victim's replayable snapshot — any fault on the swap
+        path downgrades it to ordinary token-replay recovery."""
+        return _Survivor(request=rec.request, seed=rec.seed,
+                         num_prompt=rec.num_prompt,
+                         generated=list(rec.generated),
+                         num_emitted=rec.num_emitted,
+                         logprobs=list(rec.logprobs),
+                         first_token_time=rec.first_token_time)
+
+    def _queue_head_prio(self):
+        """Effective priority of the admission-queue head (None when
+        empty).  Reads the underlying heap under the queue's own mutex —
+        heap[0] IS the minimum, so this is O(1)."""
+        with self._queue.mutex:
+            if not self._queue.queue:
+                return None
+            return self._queue.queue[0][0]
+
+    def _queue_age_tick(self) -> None:
+        """Priority-queue aging (ARKS_QUEUE_AGING_S): rewrite queued
+        entries' effective priority to ``base - elapsed/aging_s`` (floored
+        at 0) so a starved batch request climbs one tier per window and
+        eventually admits under sustained latency-tier load.  Replay
+        re-queues (priority - 2**20) are skipped — they already outrank
+        everything.  Throttled to a fraction of the window so the heapify
+        cost stays off the per-step path."""
+        if not self._queue_aging_s:
+            return
+        now = time.monotonic()
+        if now - self._queue_age_last < min(1.0, self._queue_aging_s / 4):
+            return
+        self._queue_age_last = now
+        with self._queue.mutex:
+            heap = self._queue.queue
+            changed = False
+            for i, (prio, seq, req) in enumerate(heap):
+                if prio < 0:
+                    continue
+                base = req.params.priority
+                eff = max(0, base - int((now - req.arrival_time)
+                                        / self._queue_aging_s))
+                if eff != prio:
+                    heap[i] = (eff, seq, req)
+                    changed = True
+            if changed:
+                heapq.heapify(heap)
+
+    def _preempt_inflight(self) -> int:
+        """Victims preempted and not yet back in a slot, across both
+        modes — the ARKS_PREEMPT_MAX_INFLIGHT budget's denominator."""
+        if self._resuming:
+            # Replay-mode victims leave _resuming at re-registration;
+            # ones that died queued (abort/quarantine) must not pin the
+            # budget forever.
+            live = self._live_rids()
+            with self._abort_lock:
+                live |= self._queued_rids
+            self._resuming &= live
+        return (len(self._swap_pending) + len(self._swapped)
+                + sum(1 for r in self._awaiting_restore
+                      if isinstance(r, _ResumeState))
+                + len(self._resuming))
+
+    def _preempt_victims(self) -> list[int]:
+        """Victim slots, best-first: strictly lower tier than the queue
+        head (aged), lowest tier first, least progress within a tier
+        (cheapest swap, most re-usable work preserved), most recent
+        arrival on ties.  Never a replaying/resumed slot (their streams
+        are mid-verification), never one inside the anti-thrash cooldown
+        window."""
+        head = self._queue_head_prio()
+        if head is None:
+            return []
+        now = time.monotonic()
+        cands = []
+        for slot, st in self._slots.items():
+            prio = st.request.params.priority
+            if prio <= head:
+                continue
+            rid = st.request.request_id
+            if rid in self._replaying or rid in self._resuming:
+                continue
+            if now - self._preempt_last.get(rid, -1e9) < self._preempt_cooldown_s:
+                continue
+            cands.append((-prio, len(st.generated),
+                          -st.request.arrival_time, slot))
+        cands.sort()
+        return [c[-1] for c in cands]
+
+    def _preempt_wanted(self) -> bool:
+        """Cheap host-only check, safe on the pipelined fast path: a
+        queued request outranks a running victim, no free slot, budget
+        available.  The queue-empty test short-circuits the common case
+        to one attribute read."""
+        if self._queue.empty() or self._free or not self._slots:
+            return False
+        if not self._preempt_capable() or self._state != "serving":
+            return False
+        if self._preempt_inflight() >= self._preempt_max:
+            return False
+        return bool(self._preempt_victims())
+
+    def _maybe_preempt(self) -> bool:
+        """Seize slots for outranking queued requests (one victim per
+        queued seizer, capped by the in-flight budget).  Runs between
+        resolves and the issue block, so every freed slot admits in the
+        SAME scheduler step."""
+        if not self._preempt_wanted():
+            return False
+        budget = self._preempt_max - self._preempt_inflight()
+        n = min(budget, self._queue.qsize())
+        did = False
+        for slot in self._preempt_victims()[:n]:
+            if self._preempt_swap_capable() and self._slot_pages.get(slot):
+                self._issue_preempt_swap(slot)
+            else:
+                self._preempt_replay(slot)
+            did = True
+        if did:
+            self._update_parked()
+        return did
+
+    def _issue_preempt_swap(self, slot: int) -> None:
+        """Swap-mode preemption, issue side: gather the victim's valid KV
+        pages and its sampler row into device staging blocks, start the
+        D2H drain (copy_to_host_async — never a host wait), then free the
+        slot immediately (stream order keeps the gathers pre-reuse).
+        _resolve_preempt_swaps harvests the bytes into the SwapStore a
+        lagged step later."""
+        st = self._slots[slot]
+        rid = st.request.request_id
+        p = st.request.params
+        page = self._page_size()
+        length = int(self._lengths[slot])
+        pages_all = self._slot_pages.get(slot, [])
+        n_pages = min(-(-length // page), len(pages_all))
+        rec = _SwapRecord(
+            request=st.request, num_prompt=st.num_prompt,
+            generated=list(st.generated), num_emitted=st.num_emitted,
+            logprobs=list(st.logprobs),
+            first_token_time=st.first_token_time, seed=st.seed,
+            length=length, last_token=int(self._last_token[slot]),
+            stop_col=st.stop_col, dead_len=st.dead_len, n_pages=n_pages,
+            priority=p.priority, t0=time.monotonic())
+        try:
+            self._faults.fire("preempt")
+            staged = []
+            G = self._spill_group
+            victim_pages = pages_all[:n_pages]
+            for i in range(0, n_pages, G):
+                grp = victim_pages[i: i + G]
+                pg = grp + [grp[0]] * (G - len(grp))
+                out = self._spill_gather_fn(self._cache,
+                                            jnp.asarray(pg, jnp.int32))
+                for arr in out:
+                    if arr is None:
+                        continue
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception as e:
+                        faults_mod.swallowed("copy_to_host_async", e)
+                staged.append((len(grp), out))
+            row = self._sampler_row_fn(self._sampling,
+                                       jnp.asarray(slot, jnp.int32))
+            for arr in row:
+                try:
+                    arr.copy_to_host_async()
+                except Exception as e:
+                    faults_mod.swallowed("copy_to_host_async", e)
+        except Exception as e:
+            # Victim still registered: recovery snapshots it from _slots
+            # and token-replay preserves its stream.
+            if isinstance(e, StepFault):
+                raise
+            raise StepFault("preempt", faults_mod.classify(e),
+                            culprits=[rid]) from e
+        # Gathers are on the stream — the slot can be reused now.  The
+        # guide pin is deliberately KEPT: the snapshotted DFA row must
+        # stay valid until resume.
+        self._slots.pop(slot)
+        self._release_slot_pages(slot)
+        self._free.append(slot)
+        if (p.presence_penalty or p.frequency_penalty or p.logit_bias
+                or p.min_tokens or p.guide is not None):
+            self._emit("clear_penalties", slot=slot)
+            self._sampling = self._clear_pen_fn(self._sampling,
+                                                jnp.asarray(slot, jnp.int32))
+        self._swap_pending.append(_SwapState(rec=rec, staged=staged, row=row))
+        self._preempt_last[rid] = time.monotonic()
+        self.metrics.requests_preempted_total.inc(
+            1, tier=self._slo.tier_of(p.priority))
+        self.metrics.num_requests_running.set(len(self._slots))
+        self.metrics.num_requests_waiting.inc(1)
+        log.info("preempted %s (tier=%s, %d pages) for a higher tier",
+                 rid, self._slo.tier_of(p.priority), n_pages)
+
+    def _preempt_replay(self, slot: int) -> None:
+        """Replay-mode preemption (the fallback matrix rows): free the
+        victim's slot and re-queue it behind a _ReplayGate for
+        deterministic re-execution — no host KV needed; the cost is
+        re-prefilling and re-decoding the generated prefix on resume."""
+        st = self._slots[slot]
+        rid = st.request.request_id
+        p = st.request.params
+        try:
+            self._faults.fire("preempt")
+        except Exception as e:
+            # Victim untouched: recovery snapshots it from _slots.
+            raise StepFault("preempt", faults_mod.classify(e),
+                            culprits=[rid]) from e
+        rec = _SwapRecord(
+            request=st.request, num_prompt=st.num_prompt,
+            generated=list(st.generated), num_emitted=st.num_emitted,
+            logprobs=list(st.logprobs),
+            first_token_time=st.first_token_time, seed=st.seed,
+            length=int(self._lengths[slot]) if self._paged else 0,
+            last_token=int(self._last_token[slot]),
+            stop_col=st.stop_col, dead_len=st.dead_len, n_pages=0,
+            priority=p.priority, t0=time.monotonic())
+        self._slots.pop(slot)
+        self._release_slot_pages(slot)
+        self._free.append(slot)
+        self._unpin_guide(st.request)
+        if (p.presence_penalty or p.frequency_penalty or p.logit_bias
+                or p.min_tokens or p.guide is not None):
+            self._emit("clear_penalties", slot=slot)
+            self._sampling = self._clear_pen_fn(self._sampling,
+                                                jnp.asarray(slot, jnp.int32))
+        self._preempt_last[rid] = time.monotonic()
+        self.metrics.requests_preempted_total.inc(
+            1, tier=self._slo.tier_of(p.priority))
+        self.metrics.num_requests_running.set(len(self._slots))
+        self._preempt_requeue_replay(rec)
+        log.info("preempted %s (tier=%s) in replay mode",
+                 rid, self._slo.tier_of(p.priority))
+
+    def _preempt_requeue_replay(self, rec: _SwapRecord) -> None:
+        """Re-queue a preempted victim for deterministic re-execution at
+        its OWN priority (unlike fault replayers it is not urgent — it
+        was just outranked).  The gate suppresses the already-delivered
+        prefix and verifies byte-identity of the re-run."""
+        req = rec.request
+        rid = req.request_id
+        gate = req.outputs if isinstance(req.outputs, _ReplayGate) else None
+        if gate is None:
+            req.outputs = _ReplayGate(req.outputs, self, rid,
+                                      rec.generated, rec.num_emitted)
+        else:
+            gate.restart(rec.generated)
+        self._resuming.add(rid)
+        with self._abort_lock:
+            self._queued_rids.add(rid)
+            self._queue_seq += 1
+            seq = self._queue_seq
+        self.metrics.num_requests_waiting.inc(1)
+        self._queue.put((req.params.priority, seq, req))
+
+    def _resolve_preempt_swaps(self, force: bool = False) -> bool:
+        """Harvest completed preempt spills into the SwapStore (FIFO,
+        non-blocking unless forced).  Unlike prefix spills these are NOT
+        best-effort — the victim's only KV copy is in these staging
+        blocks — so a harvest failure faults the victim alone and
+        token-replay rebuilds its stream; a SwapStore refusal (budget
+        full) downgrades to replay mode without a fault."""
+        did = False
+        while self._swap_pending:
+            sw = self._swap_pending[0]
+            marker = sw.staged[-1][1][0] if sw.staged else sw.row[1]
+            if not force and not (self._dev_ready(marker)
+                                  and self._dev_ready(sw.row[1])):
+                break
+            self._swap_pending.pop(0)
+            did = True
+            rec = sw.rec
+            rid = rec.request.request_id
+            with self._abort_lock:
+                was_aborted = rid in self._aborted
+                if was_aborted:
+                    self._aborted.discard(rid)
+            if was_aborted:
+                self._finish_swapped_abort(rec)
+                self._update_parked()
+                continue
+            try:
+                self._faults.fire("preempt")
+                blocks = []
+                for n, out in sw.staged:
+                    k, v, ks, vs = [None if a is None else np.asarray(a)
+                                    for a in out]
+                    for j in range(n):
+                        blk = {"k": np.ascontiguousarray(k[:, j]),
+                               "v": np.ascontiguousarray(v[:, j])}
+                        if ks is not None:
+                            blk["k_scale"] = np.ascontiguousarray(ks[:, j])
+                            blk["v_scale"] = np.ascontiguousarray(vs[:, j])
+                        blocks.append(blk)
+                entry = {"blocks": blocks,
+                         "key": np.asarray(sw.row[0]),
+                         "counts": np.asarray(sw.row[1]),
+                         "guide_row": int(np.asarray(sw.row[2]))}
+            except Exception as e:
+                self.metrics.num_requests_waiting.inc(-1)
+                if isinstance(e, StepFault):
+                    raise
+                raise StepFault("preempt", faults_mod.classify(e),
+                                culprits=[rid],
+                                survivors=[self._swap_survivor(rec)]) from e
+            if self._swap is not None and self._swap.put(rid, entry):
+                self._swapped[rid] = rec
+                self.metrics.preempt_swap_seconds.observe(
+                    time.monotonic() - rec.t0)
+                self.metrics.prefix_cache_usage_bytes.set(
+                    self._swap.bytes_used, tier="swap")
+            else:
+                # Host budget cannot hold the snapshot — fall back to
+                # replay-mode resume (drop the bytes, re-execute later).
+                log.warning("swap store refused %s (%d blocks); falling "
+                            "back to replay-mode preemption", rid,
+                            len(entry["blocks"]))
+                self.metrics.num_requests_waiting.inc(-1)
+                self._unpin_guide(rec.request)
+                self._preempt_requeue_replay(rec)
+            self._update_parked()
+        return did
+
+    def _service_swapped(self) -> bool:
+        """Swapped-out victims: serve aborts (host bytes come straight
+        back) and schedule resumes — best victim first (highest tier,
+        earliest preempt), but only while the queue head does not
+        STRICTLY outrank it (admission wins ties are not allowed to
+        starve a victim of the same tier that already burned a prefill)."""
+        did = False
+        if not self._swapped:
+            return False
+        with self._abort_lock:
+            hit = [rid for rid in self._swapped if rid in self._aborted]
+            for rid in hit:
+                self._aborted.discard(rid)
+        for rid in hit:
+            rec = self._swapped.pop(rid)
+            if self._swap is not None:
+                self._swap.discard(rid)
+                self.metrics.prefix_cache_usage_bytes.set(
+                    self._swap.bytes_used, tier="swap")
+            self._finish_swapped_abort(rec)
+            did = True
+        while self._swapped and self._free:
+            rid, rec = min(self._swapped.items(),
+                           key=lambda kv: (kv[1].priority, kv[1].t0))
+            head = self._queue_head_prio()
+            if head is not None and head < rec.priority:
+                break
+            if (self._alloc.free_pages + self._alloc.retained_pages
+                    < rec.n_pages):
+                break  # pool pressure: wait for pages, don't fault
+            self._resume_swapped(rid)
+            did = True
+        if did:
+            self._update_parked()
+        return did
+
+    def _resume_swapped(self, rid: str) -> None:
+        """Swap-mode resume, issue side: take a free slot, scatter the
+        victim's page blocks back (async, padded restore groups — the
+        same program as prefix restores) and rebuild its sampler row
+        (snapshot key + DFA row through set_slot, counts through the
+        donated restore jit).  The request parks as a _ResumeState in
+        awaiting_restore; _finish_resume re-registers the slot once the
+        marker lands."""
+        rec = self._swapped[rid]
+        entry = self._swap.pop(rid) if self._swap is not None else None
+        self.metrics.prefix_cache_usage_bytes.set(
+            self._swap.bytes_used if self._swap is not None else 0,
+            tier="swap")
+        if entry is None:
+            # Entry vanished (blanket-abort clear raced a re-queue):
+            # replay mode still resumes the stream correctly.
+            del self._swapped[rid]
+            self.metrics.num_requests_waiting.inc(-1)
+            self._unpin_guide(rec.request)
+            self._preempt_requeue_replay(rec)
+            return
+        slot = self._free.pop()
+        try:
+            self._faults.fire("preempt")
+            pages = self._alloc.alloc(rec.n_pages)
+            # The alloc may have evicted tier-0 pages; their spill
+            # gathers must precede our scatter.
+            self._spill_flush()
+            marker = None
+            G = self._restore_group
+            blocks = entry["blocks"]
+            for i in range(0, len(blocks), G):
+                marker = self._dispatch_restore_group(
+                    blocks[i: i + G], pages[i: i + G], G)
+            gid = -1
+            if rec.request.params.guide is not None:
+                gid, _ = self._guide_cols(rec.request.params)
+            self._apply_set_slot(slot, rec.request.params,
+                                 jnp.asarray(entry["key"]),
+                                 num_prompt=rec.num_prompt, guide=gid,
+                                 guide_row=int(entry["guide_row"]))
+            self._sampling = self._restore_counts_fn(
+                self._sampling, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(entry["counts"]))
+        except Exception as e:
+            self._free.append(slot)
+            del self._swapped[rid]
+            self.metrics.num_requests_waiting.inc(-1)
+            self._unpin_guide(rec.request)
+            if isinstance(e, StepFault):
+                raise
+            raise StepFault("preempt", faults_mod.classify(e),
+                            culprits=[rid],
+                            survivors=[self._swap_survivor(rec)]) from e
+        del self._swapped[rid]
+        self._awaiting_restore.append(_ResumeState(
+            rec=rec, slot=slot, pages=pages, marker=marker,
+            t0=time.monotonic()))
+
+    def _finish_resume(self, res: _ResumeState) -> None:
+        """Swap-mode resume, landing side: the scatter resolved — rebuild
+        the victim's _Slot and host mirrors exactly as preempt recorded
+        them.  No first-token output, no TTFT: the stream simply
+        continues at the next decode dispatch (the restored key/counts/
+        DFA row make that continuation byte-identical to the
+        never-preempted run)."""
+        rec = res.rec
+        slot = res.slot
+        # One invariant owner for the table row: alloc(0) extra pages,
+        # head_pages = everything we restored.
+        self._assign_slot_pages(slot, len(res.pages),
+                                head_pages=res.pages)
+        st = _Slot(request=rec.request, num_prompt=rec.num_prompt,
+                   generated=list(rec.generated),
+                   num_emitted=rec.num_emitted,
+                   first_token_time=rec.first_token_time,
+                   draft_synced=False, spec_ok=False,
+                   logprobs=list(rec.logprobs), stop_col=rec.stop_col,
+                   dead_len=rec.dead_len, seed=rec.seed)
+        self._slot_gen[slot] += 1
+        self._slots[slot] = st
+        self._lengths[slot] = rec.length
+        self._last_token[slot] = rec.last_token
+        self.metrics.num_requests_waiting.inc(-1)
+        self.metrics.num_requests_running.set(len(self._slots))
+        self.metrics.preempt_swap_seconds.observe(
+            time.monotonic() - res.t0)
+        log.info("resumed %s after preempt swap (slot %d, %d pages)",
+                 rec.request.request_id, slot, len(res.pages))
+
+    def _finish_swapped_abort(self, rec: _SwapRecord) -> None:
+        """Terminal abort for a preempted victim (client went away while
+        its state was off-device)."""
+        self.metrics.num_requests_waiting.inc(-1)
+        self._unpin_guide(rec.request)
+        rec.request.outputs.put(RequestOutput(
+            request_id=rec.request.request_id, token_ids=[],
+            finished=True, finish_reason="abort",
+            num_prompt_tokens=rec.num_prompt,
+            num_generated_tokens=len(rec.generated)))
+
+    def _abort_swapped(self) -> None:
+        """Fail every preempted-but-unresumed victim (engine exit /
+        blanket abort) and release their host bytes."""
+        for sw in self._swap_pending:
+            self._finish_swapped_abort(sw.rec)
+        self._swap_pending = []
+        for rid, rec in list(self._swapped.items()):
+            if self._swap is not None:
+                self._swap.discard(rid)
+            self._finish_swapped_abort(rec)
+        self._swapped.clear()
+        if self._swap is not None:
+            self._swap.clear()
+            self.metrics.prefix_cache_usage_bytes.set(0, tier="swap")
 
     # ------------------------------------------------------------------
     # Multi-model serving (engine.model_pool)
@@ -3489,8 +4294,16 @@ class InferenceEngine:
         scattered across every park/unpark/abort path."""
         m = self.metrics.requests_parked
         m.set(len(self._awaiting_guide), reason="guide")
-        m.set(len(self._awaiting_restore), reason="restore")
+        m.set(len([r for r in self._awaiting_restore
+                   if not isinstance(r, _ResumeState)]), reason="restore")
         m.set(len(self._awaiting_model), reason="model")
+        # Preempted victims: spill in flight, parked in host RAM, or
+        # restoring back into a slot.  Set-from-len keeps the gauge
+        # non-negative across any abort interleaving (the regression in
+        # tests/test_preempt.py).
+        m.set(len(self._swap_pending) + len(self._swapped)
+              + len([r for r in self._awaiting_restore
+                     if isinstance(r, _ResumeState)]), reason="preempt")
 
     def _park_awaiting_model(self, req: Request, want: str) -> None:
         """Park a request until its model is active (mirrors the guide /
@@ -4111,6 +4924,12 @@ class InferenceEngine:
             # the re-run passes the delivered prefix).
             self._replaying.discard(req.request_id)
             self.metrics.requests_recovered_total.inc(1)
+        resumed = req.request_id in self._resuming
+        if resumed:
+            # Replay-mode preempt resume reached a slot again: same
+            # suppression as a fault replay (the gate drops the delivered
+            # prefix), but it is not a recovery — don't count it as one.
+            self._resuming.discard(req.request_id)
         st.generated.append(first)
         if first_lp is not None:
             st.logprobs.append(first_lp)
@@ -4129,11 +4948,13 @@ class InferenceEngine:
         self.metrics.prompt_tokens_total.inc(num_prompt)
         self.metrics.num_requests_running.set(len(self._slots))
         ttft = now - req.arrival_time
-        if not replaying:
+        if not replaying and not resumed:
             # A replay re-registration is not a first token — the client
             # got theirs long ago; observing it would poison the TTFT
             # histogram with fault-to-now spans.
             self.metrics.time_to_first_token_seconds.observe(ttft)
+            self.metrics.ttft_seconds.observe(
+                ttft, tier=self._slo.tier_of(p_.priority))
 
         if self._check_finished(slot):
             return
@@ -4511,6 +5332,16 @@ class InferenceEngine:
         if self._free and not self._queue.empty():
             # Admission is possible RIGHT NOW; with no free slot the queue
             # can only wait anyway, so saturation keeps pipelining.
+            return False
+        if self._swap_ready_any() or self._resume_ready_any():
+            # A preempt spill's D2H copies landed (its staging blocks
+            # hold the victim's only KV copy — harvest them), or a swap
+            # resume's scatter landed (its slot must re-register) — both
+            # are host mutations.  In-flight ones keep full depth.
+            return False
+        if self._preempt_wanted():
+            # A queued request outranks a running victim: drain so the
+            # preempt swap runs on authoritative host mirrors.
             return False
         if any(st.stop_col is None for st in self._slots.values()):
             return False
@@ -4961,6 +5792,8 @@ class InferenceEngine:
         self._last_token[slot] = col[K - 1]
         self.metrics.generation_tokens_total.inc(new_tokens)
         self.metrics.time_per_output_token_seconds.observe(dt / K)
+        self.metrics.tpot_seconds.observe(
+            dt / K, tier=self._slo.tier_of(st.request.params.priority))
         if finished:
             self._finish(slot, self._finish_reason(st))
         else:
@@ -5208,6 +6041,8 @@ class InferenceEngine:
             self._last_token[slot] = tok
             self.metrics.generation_tokens_total.inc(1)
             self.metrics.time_per_output_token_seconds.observe(dt)
+            self.metrics.tpot_seconds.observe(
+                dt, tier=self._slo.tier_of(st.request.params.priority))
             if (self._is_stop(st, tok)
                     or len(st.generated) >= st.request.params.max_tokens):
                 self._finish(slot, self._finish_reason(st))

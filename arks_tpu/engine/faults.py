@@ -20,7 +20,9 @@ vocabulary for it:
   ``resolve`` (their host-sync tails), ``admit`` / ``admit_resolve``
   (fused admissions), ``chunk`` (chunked-prefill dispatch), ``replay``
   (recovery re-admission), ``pages`` (page-table growth/alloc),
-  ``guide`` (guide-table upload), ``spec`` (speculative dispatch).
+  ``guide`` (guide-table upload), ``spec`` (speculative dispatch),
+  ``preempt`` (preemptive-swap spill issue/harvest and victim resume —
+  culprit is the preempted/resuming request only).
   Kinds: ``runtime``, ``value``, ``oom`` (RESOURCE_EXHAUSTED-shaped
   RuntimeError), ``hang`` (sleeps ``ARKS_FAULT_HANG_S``, default 3600 —
   the watchdog-escalation fixture).

@@ -171,6 +171,11 @@ class HostPrefixTier:
             raise ValueError("page_tokens must be positive")
         self.page = page_tokens
         self.capacity = capacity_bytes
+        # Bytes carved out of ``capacity`` by non-prefix tenants (the
+        # preempt SwapStore).  The LRU eviction loop honors
+        # ``capacity - reserved``: prefix blocks evict around reserved
+        # state, reserved state is never LRU-evicted.
+        self.reserved = 0
         self._lock = threading.Lock()
         # digest -> block dict {"k","v"[,"k_scale","v_scale"]}, LRU order
         # (oldest first).
@@ -204,11 +209,17 @@ class HostPrefixTier:
             self._bytes += self._block_bytes(block)
             self.spilled_blocks += 1
             self.version += 1
-            while self._bytes > self.capacity and self._blocks:
-                _, old = self._blocks.popitem(last=False)
-                self._bytes -= self._block_bytes(old)
-                self.version += 1
+            self._evict_to_budget()
             return digest in self._blocks
+
+    def _evict_to_budget(self) -> None:
+        """LRU-evict prefix blocks past the effective byte budget
+        (``capacity - reserved``).  Caller holds the lock."""
+        budget = max(self.capacity - self.reserved, 0)
+        while self._bytes > budget and self._blocks:
+            _, old = self._blocks.popitem(last=False)
+            self._bytes -= self._block_bytes(old)
+            self.version += 1
 
     def match_blocks(self, digests: list[bytes], start: int) -> list[dict]:
         """The longest run of consecutively-cached blocks for
@@ -248,3 +259,91 @@ class HostPrefixTier:
     def num_blocks(self) -> int:
         with self._lock:
             return len(self._blocks)
+
+
+class SwapStore:
+    """Host-RAM store for PREEMPTED requests' full decode state.
+
+    When an SLO-tier request seizes a running slot (ARKS_PREEMPT), the
+    victim's decode state — its pool-native KV page blocks plus the
+    sampler-row snapshot (PRNG key, penalty counts, DFA row) — parks
+    here, keyed by request id.  Unlike ``HostPrefixTier`` blocks these
+    entries are not content-addressed and are NEVER LRU-evicted: a
+    swapped-out request must stay resumable until it is resumed or
+    aborted.  Instead the store shares the host tier's byte budget by
+    accounting its bytes as ``tier.reserved`` — prefix blocks LRU-evict
+    around the swap state, and when even the whole budget cannot hold a
+    new entry ``put`` refuses and the engine falls back to replay-mode
+    preemption (re-queue + deterministic re-execution).
+
+    Entry layout (engine-authored, read back verbatim on resume)::
+
+        {"blocks": [page block dicts], "key": np.uint32[2],
+         "counts": np.int32[V], "guide_row": int}
+
+    The host tier's lock guards the budget handshake; the map itself is
+    engine-thread only.
+    """
+
+    def __init__(self, tier: HostPrefixTier) -> None:
+        self._tier = tier
+        # rid -> (entry, accounted bytes)
+        self._entries: dict[str, tuple[dict, int]] = {}
+
+    @staticmethod
+    def _entry_bytes(entry: dict) -> int:
+        n = 0
+        for blk in entry.get("blocks", ()):
+            n += sum(a.nbytes for a in blk.values() if a is not None)
+        for key in ("key", "counts"):
+            a = entry.get(key)
+            if a is not None and hasattr(a, "nbytes"):
+                n += a.nbytes
+        return n
+
+    def put(self, rid: str, entry: dict) -> bool:
+        """Reserve budget and store one victim's decode state.  Returns
+        False (storing nothing) when the tier's whole capacity cannot
+        cover existing reservations plus this entry."""
+        need = self._entry_bytes(entry)
+        t = self._tier
+        with t._lock:
+            if rid in self._entries:
+                return True
+            if t.reserved + need > t.capacity:
+                return False
+            t.reserved += need
+            t._evict_to_budget()
+        self._entries[rid] = (entry, need)
+        return True
+
+    def pop(self, rid: str) -> dict | None:
+        """Remove and return an entry, releasing its reserved bytes."""
+        rec = self._entries.pop(rid, None)
+        if rec is None:
+            return None
+        entry, need = rec
+        t = self._tier
+        with t._lock:
+            t.reserved = max(t.reserved - need, 0)
+        return entry
+
+    def discard(self, rid: str) -> bool:
+        """Drop an entry if present (abort-while-swapped-out: the host
+        bytes must come back).  Returns True when something was freed."""
+        return self.pop(rid) is not None
+
+    def clear(self) -> None:
+        """Drop every entry (blanket-abort deep clean)."""
+        for rid in list(self._entries):
+            self.pop(rid)
+
+    @property
+    def bytes_used(self) -> int:
+        return sum(need for _, need in self._entries.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, rid: str) -> bool:
+        return rid in self._entries

@@ -31,8 +31,11 @@ class SamplingParams:
     # until at least this many tokens have been generated.
     min_tokens: int = 0
     # Admission priority (vLLM semantics: LOWER value admits first; equal
-    # priorities stay FIFO).  Only ordering in the waiting queue changes —
-    # running slots are never preempted.
+    # priorities stay FIFO).  With SLO tiers configured (arks_tpu.slo)
+    # this is the tier index, and under ARKS_PREEMPT a queued lower value
+    # may seize a running higher-value slot via preemptive KV swap;
+    # ARKS_QUEUE_AGING_S decays a queued request's effective priority so
+    # the worst tier still admits under sustained load.
     priority: int = 0
     # Guided decoding: (kind, pattern) compiled by engine.guides —
     # ("json", "") for JSON mode, ("regex", pat) for a regex constraint.

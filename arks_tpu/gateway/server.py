@@ -35,6 +35,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from arks_tpu import slo as slo_mod
 from arks_tpu.control.store import Store
 from arks_tpu.gateway.metrics import GatewayMetrics
 from arks_tpu.gateway.qos import QosProvider, TokenQos
@@ -68,6 +69,11 @@ PROCESS_TIMEOUT_S = 5.0
 HDR_MODEL = "x-arks-model"
 HDR_NAMESPACE = "x-arks-namespace"
 HDR_USER = "x-arks-username"
+# SLO tier (arks_tpu.slo): validated against ARKS_SLO_TIERS at admission
+# (unknown tier -> 400), forwarded to the backend, where the OpenAI server
+# maps it onto the engine priority scale.  Echoed back on tier-capacity
+# 503s so clients know WHICH tier to back off.
+HDR_TIER = "x-arks-tier"
 
 
 class _ApiError(Exception):
@@ -209,6 +215,8 @@ class Gateway:
         # an instant 503.  Past the window, 503 + Retry-After.
         self.cold_start_wait_s = float(
             os.environ.get("ARKS_GW_COLD_START_WAIT_S", "10"))
+        # SLO-tier ladder (ARKS_SLO_TIERS).  Empty = tier headers rejected.
+        self.slo = slo_mod.from_env()
         self._httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
@@ -234,11 +242,14 @@ class Gateway:
                 self.wfile.write(data)
 
             def _error(self, code: int, message: str,
-                       retry_after: int | None = None) -> None:
+                       retry_after: int | None = None,
+                       headers: dict | None = None) -> None:
                 # error body parity (util.go:40-77)
-                hdrs = {"Retry-After": retry_after} if retry_after else None
+                hdrs = dict(headers or {})
+                if retry_after:
+                    hdrs["Retry-After"] = retry_after
                 self._json(code, {"error": {"message": message, "code": code}},
-                           headers=hdrs)
+                           headers=hdrs or None)
 
             def do_GET(self):
                 if self.path == "/v1/models":
@@ -353,6 +364,20 @@ class Gateway:
         if not model:
             raise _ApiError(400, "missing model field", "parse")
 
+        # SLO tier (after the body is drained so a 400 here keeps the
+        # keep-alive connection in sync).  Typos must not silently demote
+        # a latency-class request to the default tier — reject them.
+        tier = (handler.headers.get(HDR_TIER) or "").strip() or None
+        if tier is not None:
+            if not self.slo:
+                raise _ApiError(
+                    400, f"{HDR_TIER} header sent but no SLO tiers are "
+                    "configured (ARKS_SLO_TIERS)", "parse")
+            if self.slo.get(tier) is None:
+                raise _ApiError(
+                    400, f"unknown SLO tier {tier!r} (configured: "
+                    f"{', '.join(self.slo.names)})", "parse")
+
         qos = self.qos.get_qos_by_token(secret, model)
         if qos is None:
             if not self.qos.token_known(secret):
@@ -400,7 +425,7 @@ class Gateway:
         # Count the admitted request (rpm/rpd).
         self.limiter.do_limit(qos.namespace, qos.username, model,
                               {r: 1 for r in REQUEST_RULES})
-        return qos, body, limits
+        return qos, body, limits, tier
 
     # ------------------------------------------------------------------
     # Routing + proxy
@@ -453,17 +478,26 @@ class Gateway:
         t0 = time.monotonic()
         qos = None
         status = 500
+        tier = None
         try:
-            qos, body, limits = self._admit(handler)
+            qos, body, limits, tier = self._admit(handler)
             # Admitted demand feeds the autoscaler's per-endpoint rate.
             self.rate.record(qos.namespace, qos.endpoint)
-            status = self._proxy(handler, qos, body, limits)
+            status = self._proxy(handler, qos, body, limits, tier)
         except _ApiError as e:
             status = e.code
             self.metrics.errors_total.inc(stage=e.stage or "other")
+            ra = getattr(e, "retry_after", None)
+            hdrs = None
+            if e.code == 503 and tier is not None:
+                # Tier-capacity backpressure: tell the client WHICH tier
+                # is saturated and when to come back (satellite contract).
+                hdrs = {HDR_TIER: tier}
+                if ra is None:
+                    ra = 1
             try:
-                handler._error(e.code, e.message,
-                               retry_after=getattr(e, "retry_after", None))
+                handler._error(e.code, e.message, retry_after=ra,
+                               headers=hdrs)
             except Exception:
                 pass
         except Exception as e:
@@ -482,7 +516,7 @@ class Gateway:
             self.metrics.request_duration.observe(time.monotonic() - t0)
 
     def _proxy(self, handler, qos: TokenQos, body: dict,
-               limits: dict[str, int]) -> int:
+               limits: dict[str, int], tier: str | None = None) -> int:
         payload = json.dumps(body).encode()
         stream = bool(body.get("stream", False))
         last_err: Exception | None = None
@@ -496,6 +530,7 @@ class Gateway:
                     HDR_MODEL: qos.endpoint,
                     HDR_NAMESPACE: qos.namespace,
                     HDR_USER: qos.username,
+                    **({HDR_TIER: tier} if tier is not None else {}),
                 })
                 resp = conn.getresponse()
             except OSError as e:
@@ -547,6 +582,11 @@ class Gateway:
         ra = resp.headers.get("Retry-After")
         if ra:
             handler.send_header("Retry-After", ra)
+        # Tier-capacity 503s echo the tier so per-tier clients back off
+        # independently.
+        bt = resp.headers.get(HDR_TIER)
+        if bt:
+            handler.send_header(HDR_TIER, bt)
         handler.end_headers()
         handler.wfile.write(data)
 

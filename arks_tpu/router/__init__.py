@@ -54,6 +54,7 @@ from arks_tpu.gateway.metrics import RouterMetrics
 log = logging.getLogger("arks_tpu.router")
 
 HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
+HDR_TIER = "x-arks-tier"   # SLO tier (arks_tpu.slo), forwarded verbatim
 
 
 class Discovery:
@@ -670,6 +671,12 @@ class Router:
             path = "/v1/disagg" + h.path[len("/v1"):]
             headers = {"Content-Type": "application/json",
                        HDR_PREFILL_ADDR: prefill_addr}
+        # SLO tier rides through to the decode backend (arks_tpu.slo):
+        # the OpenAI server maps it onto the engine priority scale, where
+        # preemptive swap / queue aging act on it.
+        tier = h.headers.get(HDR_TIER)
+        if tier:
+            headers[HDR_TIER] = tier
         host, _, port = decode_addr.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
         try:

@@ -25,9 +25,14 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from arks_tpu import slo as slo_mod
 from arks_tpu.engine.engine import InferenceEngine
 from arks_tpu.engine.tokenizer import IncrementalDetokenizer
 from arks_tpu.engine.types import Request, SamplingParams
+
+# SLO tier header (gateway/router forward it; arks_tpu.gateway.server
+# validates it against the same ARKS_SLO_TIERS ladder).
+HDR_TIER = "x-arks-tier"
 
 
 def _find_stop(text: str, stop_strings: list[str], min_end: int = 0
@@ -204,6 +209,10 @@ class OpenAIServer:
         self.engine = engine
         self.served_model_name = served_model_name
         self.host, self.port = host, port
+        # SLO-tier ladder: x-arks-tier maps onto params.priority here (the
+        # header wins over a body "priority" — the gateway already
+        # validated it, but a direct-to-pod client gets the same 400).
+        self.slo = slo_mod.from_env()
         self._httpd: ThreadingHTTPServer | None = None
         self._ready = threading.Event()
         # Graceful drain (SIGTERM): readiness drops (Services/routes pull
@@ -486,6 +495,15 @@ class OpenAIServer:
                                            tools=tools if tools_on else None)
             params, stop_strings = _sampling_from_body(
                 body, self.engine.tokenizer, self.engine)
+            tier = (h.headers.get(HDR_TIER) or "").strip() or None
+            if tier is not None:
+                pri = self.slo.priority_of(tier) if self.slo else None
+                if pri is None:
+                    raise ValueError(
+                        f"unknown SLO tier {tier!r} (configured: "
+                        f"{', '.join(self.slo.names) or 'none'})")
+                import dataclasses as _dct
+                params = _dct.replace(params, priority=pri)
             tools_ctx = None
             if tools_on:
                 tools_ctx = os.environ.get("ARKS_TOOL_PARSER", "auto")

@@ -757,6 +757,156 @@ def run_shared_prefix_bench() -> dict:
     return out
 
 
+def run_slo_tiers_bench() -> dict:
+    """``--workload slo-tiers``: the preemptive-KV-swap acceptance bench
+    (CPU mechanics).  A mixed load — long batch-tier decodes occupying
+    every slot, latency-tier arrivals landing while the pool is full —
+    runs twice on identical tiny engines: ARKS_PREEMPT=1 (latency
+    arrivals seize slots by swapping batch decode state to host RAM) and
+    ARKS_PREEMPT=0 (they wait for a batch stream to finish).  Asserts
+    the two claims from the PR's acceptance criteria:
+
+    - latency-tier TTFT p50 with preemption is STRICTLY below the
+      preemption-off p50 under the same load;
+    - every preempted-and-resumed batch stream is byte-identical to its
+      unpreempted run (the swap is a pure schedule change).
+
+    Env knobs: ARKS_BENCH_SLO_MODEL (default tiny), ARKS_BENCH_SLO_WAVES
+    (latency-arrival waves, default 3), ARKS_PREFIX_HOST_MB (swap budget,
+    default 64 here — 0 exercises the replay fallback instead)."""
+    import numpy as np
+
+    from arks_tpu.engine import (EngineConfig, InferenceEngine, Request,
+                                 SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+
+    model = os.environ.get("ARKS_BENCH_SLO_MODEL", "tiny")
+    waves = int(os.environ.get("ARKS_BENCH_SLO_WAVES", "3"))
+    cfg = get_config(model)
+    os.environ.setdefault("ARKS_PREFIX_HOST_MB", "64")
+    os.environ["ARKS_SLO_TIERS"] = "latency:ttft_ms=300,batch:"
+    os.environ["ARKS_MIXED_STEP"] = "auto"
+
+    def _mk():
+        eng = InferenceEngine(cfg, EngineConfig(
+            model=model, num_slots=2, max_cache_len=128,
+            prefill_buckets=(16, 32), steps_per_dispatch=2,
+            prefill_chunk=16, kv_layout="paged", prefix_cache_mb=0),
+            ByteTokenizer())
+        return eng
+
+    def _drive(eng, n=20000):
+        for _ in range(n):
+            eng.step(block_s=0.01)
+            if eng.idle:
+                return
+        raise RuntimeError("slo-tiers workload did not drain")
+
+    def _collect(req):
+        toks, ttft, fin = [], None, None
+        while True:
+            out = req.outputs.get(timeout=300)
+            if out.ttft_s is not None and ttft is None:
+                ttft = out.ttft_s
+            toks.extend(out.token_ids)
+            if out.finished:
+                fin = out
+                break
+        return toks, ttft, fin.finish_reason
+
+    def _batch_req(rid, i):
+        return Request(rid, [3 + i, 5, 7 + i], SamplingParams(
+            max_tokens=48, temperature=0.9, top_p=0.9, top_k=40,
+            seed=11 + i, ignore_eos=True, priority=1))
+
+    def _lat_req(rid, i):
+        return Request(rid, [9, 9, 9, 2 + i], SamplingParams(
+            max_tokens=4, temperature=0.0, ignore_eos=True, priority=0))
+
+    def _run_mode(preempt: bool) -> dict:
+        os.environ["ARKS_PREEMPT"] = "1" if preempt else "0"
+        eng = _mk()
+        if preempt:
+            # Prime the swap/resume compiled paths (gather/scatter/sampler
+            # row jits) on a throwaway preempt cycle so the measured TTFTs
+            # are serving numbers, not jit compiles.
+            b = _batch_req("prime-b", 0)
+            eng.add_request(b)
+            for _ in range(10):
+                eng.step(block_s=0.01)
+            l = _lat_req("prime-l", 0)
+            eng.add_request(l)
+            _drive(eng)
+            _collect(b), _collect(l)
+        else:
+            b = _batch_req("prime-b", 0)
+            eng.add_request(b)
+            _drive(eng)
+            _collect(b)
+        batch_streams: dict[str, list] = {}
+        lat_ttfts: list[float] = []
+        for w in range(waves):
+            bts = [_batch_req(f"bt-{w}-{i}", i) for i in range(2)]
+            for r in bts:
+                eng.add_request(r)
+            # Let both batch requests admit and decode a few tokens so
+            # the pool is genuinely full when the latency wave lands.
+            for _ in range(12):
+                eng.step(block_s=0.01)
+            lts = [_lat_req(f"lt-{w}-{i}", i) for i in range(2)]
+            for r in lts:
+                eng.add_request(r)
+            _drive(eng)
+            for r in bts:
+                toks, _, reason = _collect(r)
+                batch_streams[r.request_id] = [toks, reason]
+            for r in lts:
+                toks, ttft, reason = _collect(r)
+                assert reason == "length", (r.request_id, reason)
+                lat_ttfts.append(ttft)
+        pre = eng.metrics.requests_preempted_total
+        out = {
+            "mode": eng.resolved_config.get("preempt", "off"),
+            "lat_ttft_p50_ms": round(
+                float(np.percentile(lat_ttfts, 50)) * 1e3, 2),
+            "lat_ttft_p95_ms": round(
+                float(np.percentile(lat_ttfts, 95)) * 1e3, 2),
+            "preempted_total": int(sum(pre._values.values())),
+            "batch_streams": batch_streams,
+        }
+        if preempt:
+            # Histogram internals: {labels: (bucket_counts, sum, count)}.
+            data = eng.metrics.preempt_swap_seconds._data.values()
+            total = sum(t for _, t, _ in data)
+            n = sum(c for _, _, c in data)
+            out["preempt_swap_s_mean"] = round(total / n, 4) if n else None
+        return out
+
+    on = _run_mode(True)
+    off = _run_mode(False)
+    assert on["preempted_total"] > 0, \
+        "preempt run never preempted — the workload is not exercising swap"
+    assert on["batch_streams"] == off["batch_streams"], \
+        "preempted batch streams diverged from the unpreempted run"
+    assert on["lat_ttft_p50_ms"] < off["lat_ttft_p50_ms"], (
+        f"preemption did not improve latency-tier TTFT p50: "
+        f"{on['lat_ttft_p50_ms']}ms (on) vs {off['lat_ttft_p50_ms']}ms (off)")
+    return {
+        "workload": "slo-tiers",
+        "slo_model": model, "slo_waves": waves,
+        "slo_mode": on["mode"],
+        "slo_prefix_host_mb": int(os.environ["ARKS_PREFIX_HOST_MB"]),
+        "slo_preempted_total": on["preempted_total"],
+        "slo_preempt_swap_s_mean": on.get("preempt_swap_s_mean"),
+        "slo_batch_streams_identical": True,
+        "lat_ttft_p50_preempt_ms": on["lat_ttft_p50_ms"],
+        "lat_ttft_p50_off_ms": off["lat_ttft_p50_ms"],
+        "lat_ttft_p95_preempt_ms": on["lat_ttft_p95_ms"],
+        "lat_ttft_p95_off_ms": off["lat_ttft_p95_ms"],
+    }
+
+
 def run_shared_prefix_router_bench(n_backends: int) -> dict:
     """``--workload shared-prefix --backends N``: the multi-backend
     routing comparison.  N in-process engines (each behind a real
@@ -1164,7 +1314,8 @@ def main() -> None:
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload",
-                    choices=("default", "shared-prefix", "multi-model"),
+                    choices=("default", "shared-prefix", "multi-model",
+                             "slo-tiers"),
                     default="default")
     ap.add_argument("--backends", type=int, default=1,
                     help="shared-prefix only: N>1 runs the multi-backend "
@@ -1183,6 +1334,10 @@ def main() -> None:
     if args.workload == "multi-model":
         print(json.dumps({"metric": "multi_model_serving",
                           **run_multi_model_bench()}))
+        return
+    if args.workload == "slo-tiers":
+        print(json.dumps({"metric": "slo_tiers_serving",
+                          **run_slo_tiers_bench()}))
         return
     print(json.dumps({
         "metric": "serving_throughput",

@@ -128,3 +128,35 @@ def test_fault_api_names_exist():
     assert callable(faults.swallowed)
     assert callable(faults.classify)
     assert callable(getattr(InferenceEngine, "_recover_from_fault"))
+
+
+def test_preempt_paths_carry_the_fault_phase():
+    """Preemptive-swap review row: every preemption entry point that
+    touches the device (spill issue, harvest, resume) must be reachable
+    by the 'preempt' chaos phase AND raise its failures as attributed
+    StepFaults — a preemption fault that escaped as a bare exception
+    would blanket-abort every innocent stream instead of quarantining
+    the one victim."""
+    import inspect
+
+    from arks_tpu.engine.engine import InferenceEngine
+
+    for name in ("_issue_preempt_swap", "_preempt_replay",
+                 "_resolve_preempt_swaps", "_resume_swapped"):
+        src = inspect.getsource(getattr(InferenceEngine, name))
+        tree = ast.parse("class _C:\n" + src if src.startswith("    ")
+                         else src)
+        fires = [n for n in ast.walk(tree) if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr == "fire"
+                 and n.args and isinstance(n.args[0], ast.Constant)
+                 and n.args[0].value == "preempt"]
+        assert fires, f"{name} lost its faults.fire('preempt') hook"
+        faults = [n for n in ast.walk(tree) if isinstance(n, ast.Call)
+                  and ((isinstance(n.func, ast.Name)
+                        and n.func.id == "StepFault")
+                       or (isinstance(n.func, ast.Attribute)
+                           and n.func.attr == "StepFault"))
+                  and n.args and isinstance(n.args[0], ast.Constant)
+                  and n.args[0].value == "preempt"]
+        assert faults, f"{name} no longer raises StepFault('preempt', ...)"

@@ -315,3 +315,67 @@ def test_slow_body_trickle_408(world):
         assert time.monotonic() - t0 < 5
     finally:
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO tiers (arks_tpu.slo): x-arks-tier validation, forwarding, 503 headers
+# ---------------------------------------------------------------------------
+
+
+def _post_tier(gw, body, tier, token="sk-alice"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "Authorization": f"Bearer {token}",
+                 "x-arks-tier": tier})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_tier_header_rejected_without_ladder(world):
+    """With no ARKS_SLO_TIERS configured, a tier header is a config
+    mismatch — reject it instead of silently ignoring the QoS ask."""
+    gw, _, _ = world
+    assert not gw.slo
+    code, body = _err(lambda: _post_tier(gw, {"model": "m1"}, "latency"))
+    assert code == 400 and "ARKS_SLO_TIERS" in body["error"]["message"]
+
+
+def test_tier_header_unknown_tier_400(world):
+    from arks_tpu import slo as slo_mod
+    gw, _, _ = world
+    gw.slo = slo_mod.parse_tiers("latency:ttft_ms=300,batch:")
+    code, body = _err(lambda: _post_tier(gw, {"model": "m1"}, "bogus"))
+    assert code == 400
+    assert "bogus" in body["error"]["message"]
+    assert "latency" in body["error"]["message"]  # lists the valid ladder
+
+
+def test_tier_header_forwarded_to_backend(world):
+    from arks_tpu import slo as slo_mod
+    gw, _, backend = world
+    gw.slo = slo_mod.parse_tiers("latency:ttft_ms=300,batch:")
+    with _post_tier(gw, {"model": "m1", "messages": []}, "latency") as r:
+        assert r.status == 200
+    assert backend.requests[-1]["headers"]["x-arks-tier"] == "latency"
+
+
+def test_tier_capacity_503_carries_retry_after_and_tier(world):
+    """A tier-carrying request that hits capacity (no ready backends)
+    gets 503 + Retry-After + x-arks-tier, so per-tier clients back off
+    independently (satellite contract)."""
+    from arks_tpu import slo as slo_mod
+    gw, store, _ = world
+    gw.slo = slo_mod.parse_tiers("latency:ttft_ms=300,batch:")
+    gw.cold_start_wait_s = 0.3
+    ep = store.get(res.Endpoint, "m1", "team-a")
+    ep.status = {"routes": []}
+    store.update(ep)
+    time.sleep(0.3)
+    try:
+        _post_tier(gw, {"model": "m1"}, "latency")
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert e.headers.get("Retry-After") is not None
+        assert e.headers.get("x-arks-tier") == "latency"

@@ -53,6 +53,18 @@ HOT_PATH_FUNCTIONS = (
     # server threads from the mirror, never by fetching device state here.
     "_note_evicted",
     "_register_prompt_pages",
+    # Preemptive KV swap: the seize path runs INSIDE a loaded step — the
+    # victim's KV gathers and sampler-row snapshot go out as async
+    # dispatches (copy_to_host_async) and the resume scatter is the same
+    # async restore program as prefix restores.  A blocking fetch here
+    # would stall every survivor's decode for the length of a D2H drain.
+    # Host syncs live in _resolve_preempt_swaps / _finish_resume (via
+    # _resolve_restores).
+    "_maybe_preempt",
+    "_issue_preempt_swap",
+    "_preempt_replay",
+    "_service_swapped",
+    "_resume_swapped",
 )
 
 # Sketch export surface: runs on SERVER threads, but the same contract
@@ -188,5 +200,6 @@ def test_resolve_tails_exist():
     exist under their expected names."""
     for name in ("_resolve_decode", "_resolve_mixed", "_resolve_spec_mixed",
                  "_pipe_resolve_one", "_resolve_admit_batch",
-                 "_resolve_spills", "_resolve_restores"):
+                 "_resolve_spills", "_resolve_restores",
+                 "_resolve_preempt_swaps", "_finish_resume"):
         assert callable(getattr(engine_mod.InferenceEngine, name)), name

@@ -755,3 +755,49 @@ def test_echo_parameter(server):
             raise AssertionError("expected HTTP 400")
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+
+def test_tier_header_maps_to_priority(server):
+    """x-arks-tier -> params.priority (arks_tpu.slo): the header wins
+    over a body "priority", and an unknown tier 400s even direct-to-pod
+    (the gateway normally validates first, but must not be the only
+    line)."""
+    from arks_tpu import slo as slo_mod
+    old = server.slo
+    server.slo = slo_mod.parse_tiers("latency:ttft_ms=300,batch:")
+    try:
+        seen = []
+        orig = server.engine.add_request
+
+        def spy(req):
+            seen.append(req.params.priority)
+            return orig(req)
+
+        server.engine.add_request = spy
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/completions",
+                data=json.dumps({"model": "tiny-serve", "prompt": "hi",
+                                 "max_tokens": 2, "ignore_eos": True,
+                                 "priority": 0}).encode(),
+                headers={"Content-Type": "application/json",
+                         "x-arks-tier": "batch"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == 200
+            assert seen == [1], seen  # batch = index 1, beats body 0
+        finally:
+            server.engine.add_request = orig
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps({"model": "tiny-serve", "prompt": "hi",
+                             "max_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-arks-tier": "bogus"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "bogus" in json.load(e)["error"]["message"]
+    finally:
+        server.slo = old

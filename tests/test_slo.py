@@ -1,0 +1,53 @@
+"""arks_tpu.slo: the ARKS_SLO_TIERS ladder parser and priority mapping."""
+
+import pytest
+
+from arks_tpu import slo
+
+
+def test_parse_ladder_and_targets():
+    t = slo.parse_tiers("latency:ttft_ms=300;tpot_ms=50,interactive:ttft_ms=1500,batch:")
+    assert t.names == ("latency", "interactive", "batch")
+    assert t.priority_of("latency") == 0
+    assert t.priority_of("batch") == 2
+    assert t.priority_of("nope") is None
+    assert t.get("latency").ttft_ms == 300.0
+    assert t.get("latency").tpot_ms == 50.0
+    assert t.get("interactive").tpot_ms is None
+    assert bool(t)
+
+
+def test_tier_of_clamps_into_the_ladder():
+    t = slo.parse_tiers("latency:,batch:")
+    assert t.tier_of(0) == "latency"
+    assert t.tier_of(1) == "batch"
+    # Past-the-end priorities clamp to the worst tier; replayers run at
+    # priority - 2**20 and clamp to the best.
+    assert t.tier_of(99) == "batch"
+    assert t.tier_of(-(1 << 20)) == "latency"
+
+
+def test_no_ladder_means_default_label():
+    t = slo.SloTiers()
+    assert not t
+    assert t.tier_of(0) == "default"
+    assert t.tier_of(7) == "default"
+
+
+@pytest.mark.parametrize("spec", [
+    "latency:bogus_key=1",          # unknown target key
+    "latency:ttft_ms=abc",          # non-numeric
+    "latency:ttft_ms=0",            # non-positive
+    "latency:,latency:",            # duplicate name
+    "bad name:",                    # invalid name
+])
+def test_malformed_specs_rejected(spec):
+    with pytest.raises(ValueError):
+        slo.parse_tiers(spec)
+
+
+def test_from_env(monkeypatch):
+    monkeypatch.delenv(slo.ENV_VAR, raising=False)
+    assert not slo.from_env()
+    monkeypatch.setenv(slo.ENV_VAR, "latency:,batch:")
+    assert slo.from_env().names == ("latency", "batch")
