@@ -106,10 +106,14 @@ class EngineConfig:
     draft_model: str | None = None
     draft_len: int = 4
     dtype: str | None = None   # default: model config dtype
-    # "auto"|"bf16"|"int8": int8 halves KV HBM traffic and doubles cache
-    # capacity (per-token scales, dequantized inside the attention kernel).
-    # auto = int8 on real TPU (the production default bench.py measures),
-    # engine dtype elsewhere (CPU tests stay full-width).
+    # "auto"|"bf16"|"int8"|"int4": int8 halves KV HBM traffic and doubles
+    # cache capacity (per-token scales, dequantized inside the attention
+    # kernel).  int4 packs token pairs into one byte (same per-token scale
+    # stripes) — half the page bytes again; requires the paged layout
+    # (dequant is fused on the mixed kernel's page stream; there is no
+    # int4 slot-cache kernel).  auto = int8 on real TPU (the production
+    # default bench.py measures), engine dtype elsewhere (CPU tests stay
+    # full-width).
     kv_cache_dtype: str = "auto"
     # "bf16"|"int8"|"int4": weight-only quantization (models.quant).
     # int8 = w8a16 (per-output-channel scales, dequant fused into the
@@ -150,8 +154,8 @@ class EngineConfig:
     seed: int = 0
 
     def resolve_kv_cache_dtype(self) -> str:
-        """Returns 'int8' | 'bf16' | 'engine' (= use the engine dtype)."""
-        if self.kv_cache_dtype not in ("auto", "bf16", "int8"):
+        """Returns 'int8' | 'int4' | 'bf16' | 'engine' (= engine dtype)."""
+        if self.kv_cache_dtype not in ("auto", "bf16", "int8", "int4"):
             raise ValueError(f"kv_cache_dtype={self.kv_cache_dtype!r}")
         if self.kv_cache_dtype == "auto":
             import jax
@@ -160,7 +164,13 @@ class EngineConfig:
 
     @property
     def kv_quantized(self) -> bool:
-        return self.resolve_kv_cache_dtype() == "int8"
+        return self.resolve_kv_cache_dtype() in ("int8", "int4")
+
+    @property
+    def kv_bits(self) -> int:
+        """Stored bits per KV element: 4 / 8 / 16."""
+        kvd = self.resolve_kv_cache_dtype()
+        return {"int4": 4, "int8": 8}.get(kvd, 16)
 
     def resolve_buckets(self) -> list[int]:
         """Prefill buckets clamped to the cache; never empty."""
@@ -538,6 +548,20 @@ class EngineMetrics:
         self.mixed_chunk_tokens_total = r.counter(
             "mixed_chunk_tokens_total",
             "Prefill-chunk tokens processed inside mixed dispatches")
+        # Ragged-grid padding waste (ops.paged_attention ragged work list):
+        # steps_total counts the page-compute steps the ACTIVE grid mode
+        # executes per mixed dispatch; ideal_total counts the per-sequence
+        # causal minimum (what the ragged work list runs).  Their ratio is
+        # the padding-waste factor — 1.0 under ARKS_MIXED_GRID=ragged,
+        # up to S*num_qb*max_pages/ideal under the dense fallback
+        # (docs/monitoring.md has the alert row).
+        self.mixed_grid_steps_total = r.counter(
+            "mixed_grid_steps_total",
+            "Page-compute grid steps executed by mixed dispatches")
+        self.mixed_grid_steps_ideal_total = r.counter(
+            "mixed_grid_steps_ideal_total",
+            "Per-sequence causal minimum page-compute steps for the same "
+            "mixed dispatches")
         # Scheduler phase breakdown (seconds of engine-thread wall time):
         # where a serving cycle actually goes — the counters bench_serving
         # scrapes to attribute throughput loss (admit vs chunk vs decode).
@@ -923,6 +947,14 @@ class InferenceEngine:
         tokenizer = self.tokenizer
         self.cfg = cfg
         self.ecfg = engine_cfg
+        # Per-model KV dtype preference: a checkpoint that ships
+        # kv_cache_dtype in its ModelConfig wins over the engine's "auto"
+        # (an explicit EngineConfig setting still overrides the model).
+        if (engine_cfg.kv_cache_dtype == "auto"
+                and getattr(cfg, "kv_cache_dtype", "auto") != "auto"):
+            engine_cfg.kv_cache_dtype = cfg.kv_cache_dtype
+            log.info("kv_cache_dtype=%s from the model config",
+                     cfg.kv_cache_dtype)
         # Under pp, chunked prefill (and with it the prefix cache) is off:
         # its dynamic layer indexing would gather the stage-sharded cache.
         # Derived locally — the caller's EngineConfig is not mutated.
@@ -1053,11 +1085,11 @@ class InferenceEngine:
             self._max_pages = max_pages
             # Worst case (every slot full) always fits; the prefix budget
             # adds retention headroom on top.
-            kv_bytes = 1 if engine_cfg.kv_quantized else jnp.dtype(
-                self._cache_dtype(dtype)).itemsize
+            kv_bits = (engine_cfg.kv_bits if engine_cfg.kv_quantized
+                       else jnp.dtype(self._cache_dtype(dtype)).itemsize * 8)
             d_store = tf.cache_head_dim(cfg, self._pad_head())
             page_bytes = (cfg.num_layers * cfg.num_kv_heads * page
-                          * d_store * kv_bytes * 2)
+                          * d_store * kv_bits // 8 * 2)
             if engine_cfg.kv_quantized:
                 page_bytes += cfg.num_layers * cfg.num_kv_heads * page * 4 * 2
             extra = 0
@@ -1074,7 +1106,8 @@ class InferenceEngine:
             self._cache = tf.init_paged_cache(
                 cfg, num_pages, page, self._cache_dtype(dtype),
                 quantized=engine_cfg.kv_quantized,
-                pad_head=self._pad_head())
+                pad_head=self._pad_head(),
+                kv_bits=min(engine_cfg.kv_bits, 8))
             if mesh is not None:
                 self._cache = self._shard_paged(self._cache)
             self._alloc = PageAllocator(num_pages, page)
@@ -1222,6 +1255,10 @@ class InferenceEngine:
                 f"prefill_chunk={self._chunk or None}, "
                 f"ARKS_MIXED_STEP={_mx})")
         self._mixed_budget = 0
+        # Per-qmax grid plans memoized for the padding-waste counters
+        # (_mixed_grid_counters): the plan is static per engine shape, so
+        # the issue path pays one dict hit per dispatch.
+        self._grid_plans: dict[int, dict] = {}
         if self._mixed:
             budget = int(os.environ.get("ARKS_MIXED_CHUNK_TOKENS",
                                         str(self._chunk)))
@@ -1283,6 +1320,8 @@ class InferenceEngine:
         # envelope this replica actually runs (round-3 verdict: the
         # kv_layout=auto decision was logged-only and invisible outside).
         from arks_tpu.ops.attention import default_decode_impl
+        from arks_tpu.ops import autotune
+        from arks_tpu.ops.paged_attention import mixed_grid_mode
         self._admit_sizes = self._admit_batch_sizes()
         self.resolved_config = {
             "kv_layout": "paged" if self._paged else "slot",
@@ -1291,6 +1330,9 @@ class InferenceEngine:
             "pad_head": str(bool(self._pad_head())).lower(),
             "overlap": str(bool(self._overlap)).lower(),
             "kv_cache_dtype": self.ecfg.resolve_kv_cache_dtype(),
+            "kv_dtype": self.ecfg.resolve_kv_cache_dtype(),
+            "kernel_tune": autotune.mode(),
+            "mixed_grid": mixed_grid_mode(),
             "weight_dtype": self.ecfg.weight_dtype or "native",
             "model": self.ecfg.model,
             "mixed_step": str(bool(self._mixed)).lower(),
@@ -1314,7 +1356,60 @@ class InferenceEngine:
                  " ".join(f"{k}={v}" for k, v in
                           sorted(self.resolved_config.items())))
 
+        # ARKS_KERNEL_TUNE=sweep benchmarks candidate kernel blocks for
+        # THIS shape now, so _build_programs (and every later dispatch)
+        # resolves tuned statics by pure table lookup only.
+        self._warm_autotune()
         self._build_programs()
+
+    def _warm_autotune(self) -> None:
+        """ARKS_KERNEL_TUNE=sweep warm-up: benchmark the mixed kernel's
+        (block_q, dma_depth) candidates at THIS engine's shape and persist
+        the winner (ops.autotune.sweep).  Runs once, before any program is
+        built — the serving step loop can only reach autotune.lookup (the
+        hot-path guard asserts this split), and the table entry resolves
+        to the same statics every time, so a persisted winner costs zero
+        extra compiled variants."""
+        from arks_tpu.ops import autotune
+        if autotune.mode() != "sweep" or not self._paged or not self._mixed:
+            return
+        from arks_tpu.ops.paged_attention import paged_mixed_attention
+        cfg = self.cfg
+        hkv = cfg.num_kv_heads
+        g = cfg.num_heads // hkv
+        d = tf.cache_head_dim(cfg, self._pad_head())
+        page = self._page_size()
+        qmax = self._mixed_budget + 1
+        kvd = self.ecfg.resolve_kv_cache_dtype()
+        kv = kvd if kvd in ("int8", "int4") else str(self._cache.k.dtype)
+        sig = autotune.mixed_signature(hkv=hkv, g=g, d=d, page=page,
+                                       qmax=qmax, kv=kv)
+        if autotune.lookup("paged_mixed", sig) is not None:
+            return
+        s = self.ecfg.num_slots
+        # Representative traffic on the engine's own (zeroed) pool: one
+        # full prefill chunk + decode lanes, tables pointing at real pages.
+        q = jnp.ones((s, hkv, g, qmax, d), jnp.float32)
+        tables = jnp.zeros((s, self._max_pages), jnp.int32)
+        pos = np.full((s,), page // 2, np.int32)
+        ql = np.ones((s,), np.int32)
+        ql[0] = qmax
+        pos[0] = 0
+        pos_j, ql_j = jnp.asarray(pos), jnp.asarray(ql)
+        layer = jnp.asarray(0, jnp.int32)
+        interpret = jax.default_backend() != "tpu"
+
+        def bench(block_q: int, dma_depth: int) -> None:
+            out = paged_mixed_attention(
+                q, self._cache.k, self._cache.v, tables, pos_j, ql_j,
+                layer, self._cache.k_scale, self._cache.v_scale,
+                block_q=block_q, interpret=interpret, dma_depth=dma_depth)
+            np.asarray(out)  # block until the kernel actually ran
+
+        cands = [{"block_q": bq, "dma_depth": dd}
+                 for bq in sorted({min(b, qmax) for b in (8, 16, 32)})
+                 for dd in (2, 4)]
+        autotune.sweep("paged_mixed", sig, cands, bench)
 
     # ------------------------------------------------------------------
     # Compiled programs
@@ -2185,7 +2280,13 @@ class InferenceEngine:
         layout = self.ecfg.kv_layout
         if layout not in ("auto", "slot", "paged"):
             raise ValueError(f"kv_layout={layout!r}")
+        int4 = self.ecfg.kv_bits == 4
         if layout == "slot":
+            if int4:
+                raise ValueError(
+                    "kv_cache_dtype=int4 requires the paged KV layout "
+                    "(packed pages + fused dequant live in the paged mixed "
+                    "kernel; there is no int4 slot cache)")
             return False
         from arks_tpu.parallel.mesh import AXIS_SLICE
         dp = (self.mesh.shape.get(tf.AXIS_DATA, 1)
@@ -2218,9 +2319,16 @@ class InferenceEngine:
         # the XLA oracle — resolving slot there would turn a valid spec
         # config into an init error.
         if blockers:
+            if int4:
+                raise ValueError(
+                    "kv_cache_dtype=int4 requires the paged KV layout, "
+                    "which this shape cannot use: " + ", ".join(blockers))
             return False
         if jax.default_backend() != "tpu":
-            return self.ecfg.draft_model is not None and bool(self._chunk)
+            # int4 forces paged wherever the shape allows it (there is no
+            # int4 slot cache — see the kv_cache_dtype=int4 ValueError).
+            return (int4 or (self.ecfg.draft_model is not None
+                             and bool(self._chunk)))
         return True
 
     def _shard_cache(self, cache):
@@ -2648,7 +2756,8 @@ class InferenceEngine:
             self._cache = tf.init_paged_cache(
                 self.cfg, self._alloc.num_pages, page,
                 self._cache_dtype(dtype), quantized=self.ecfg.kv_quantized,
-                pad_head=self._pad_head())
+                pad_head=self._pad_head(),
+                kv_bits=min(self.ecfg.kv_bits, 8))
             if self.mesh is not None:
                 self._cache = self._shard_paged(self._cache)
             self._alloc = PageAllocator(self._alloc.num_pages, page)
@@ -5938,6 +6047,34 @@ class InferenceEngine:
             t += take
         return completing, chunk_take, t
 
+    def _mixed_grid_counters(self, pos_start, q_len, qmax: int) -> None:
+        """Account the padding-waste counter pair for one mixed dispatch:
+        mixed_grid_steps_total (what the active grid mode executes) and
+        mixed_grid_steps_ideal_total (the per-sequence causal minimum).
+        The counters describe the grid PLAN — they are meaningful under
+        either attention impl, which is what lets the sparse-batch waste
+        test run on the XLA oracle.  Inputs are the host-side numpy batch
+        arrays — no device fetches here (hot-path guard covers this)."""
+        plan = self._grid_plans.get(qmax)
+        if plan is None:
+            from arks_tpu.ops.paged_attention import mixed_grid_plan
+            kvd = self.ecfg.resolve_kv_cache_dtype()
+            kv = kvd if kvd in ("int8", "int4") else str(self._cache.k.dtype)
+            plan = mixed_grid_plan(
+                qmax, hkv=self.cfg.num_kv_heads,
+                g=self.cfg.num_heads // self.cfg.num_kv_heads,
+                d=tf.cache_head_dim(self.cfg, self._pad_head()),
+                page=self._page_size(), kv=kv)
+            self._grid_plans[qmax] = plan
+        from arks_tpu.engine.paged import mixed_grid_steps
+        ideal, dense = mixed_grid_steps(
+            pos_start, q_len, page=self._page_size(),
+            block_q=plan["block_q"], num_qb=plan["num_qb"],
+            max_pages=self._max_pages)
+        actual = ideal if plan["grid"] == "ragged" else dense
+        self.metrics.mixed_grid_steps_total.inc(actual)
+        self.metrics.mixed_grid_steps_ideal_total.inc(ideal)
+
     @_scoped("mixed")
     def _issue_mixed(self):
         """Build and issue ONE mixed dispatch: every decoding slot's next
@@ -5984,6 +6121,9 @@ class InferenceEngine:
         self.metrics.mixed_batch_tokens.observe(t)
         if n_chunk:
             self.metrics.mixed_chunk_tokens_total.inc(n_chunk)
+        # qmax mirrors the dispatcher: t_flat - b_lanes + 1.
+        self._mixed_grid_counters(a["seq_pos_start"], a["seq_q_len"],
+                                  self._mixed_budget + 1)
         self._emit("mixed", tables=tables, lengths=lengths, lp=want_lp,
                    **a)
         t0 = time.monotonic()
@@ -6154,6 +6294,9 @@ class InferenceEngine:
             len(dec_slots) * DK + n_chunk)
         if n_chunk:
             self.metrics.mixed_chunk_tokens_total.inc(n_chunk)
+        self._mixed_grid_counters(
+            a["seq_pos_start"], a["seq_q_len"],
+            spec_t + self._mixed_budget - num_slots + 1)
         self._emit("spec_mixed", tables=tables, lengths=lengths,
                    lp=want_lp, spec_enable=spec_enable.copy(), **a)
         t0 = time.monotonic()

@@ -36,7 +36,7 @@ import numpy as np
 from arks_tpu.prefix_sketch import chain_digests, iter_chain_digests
 
 __all__ = ["OutOfPagesError", "iter_chain_digests", "chain_digests",
-           "pages_needed", "PageAllocator"]
+           "pages_needed", "mixed_grid_steps", "PageAllocator"]
 
 
 class OutOfPagesError(RuntimeError):
@@ -55,6 +55,34 @@ def pages_needed(length: int, rows: int, page: int, max_pages: int) -> int:
     slot before any write lands past max_cache_len — growing the table
     beyond its row width would corrupt the neighbouring slot's row."""
     return min((length + rows - 1) // page + 1, max_pages)
+
+
+def mixed_grid_steps(pos_start, q_len, *, page: int, block_q: int,
+                     num_qb: int, max_pages: int) -> tuple[int, int]:
+    """(ideal, dense) page-compute step counts for one mixed dispatch —
+    the host-side numpy mirror of ops.paged_attention.build_mixed_work_list.
+
+    ``ideal`` is what the ragged work-list grid executes: each active
+    (seq, q_block) item visits exactly its own causal page count, q_len=0
+    lanes and padding items visit zero.  ``dense`` is the legacy grid's
+    S * num_qb * max_pages (every lane pays the worst case).  The counter
+    pair metrics these feed (mixed_grid_steps_total vs _ideal_total)
+    describes the grid PLAN, so it is meaningful under either
+    ARKS_MIXED_GRID mode and either attention impl.
+
+    Inputs must already be host numpy arrays (the engine's issue path
+    holds them that way) — no device fetches happen here; the hot-path
+    guard covers this function."""
+    pos = pos_start.astype(np.int64, copy=False)
+    ql = q_len.astype(np.int64, copy=False)
+    q_lo = (np.arange(num_qb, dtype=np.int64) * block_q)[None, :]
+    active = q_lo < ql[:, None]
+    kv_end = np.where(active, pos[:, None] + np.minimum(q_lo + block_q,
+                                                        ql[:, None]), 0)
+    pages = np.minimum(-(-kv_end // page), max_pages)
+    ideal = int(pages.sum())
+    dense = int(pos.shape[0]) * num_qb * max_pages
+    return ideal, dense
 
 
 class PageAllocator:

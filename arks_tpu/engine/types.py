@@ -75,6 +75,12 @@ class PrefilledState:
     # keeps the prefill peer's warm prefixes.  None/[] from a pre-upgrade
     # prefill peer simply skips the publish.
     prompt_ids: list | None = None
+    # Informational: the dtype the k/v tensors are stored in ("bf16" /
+    # "float32" / ...).  Transferred KV is always full-width (the decode
+    # engine re-quantizes on insert — int8 or int4-packed per its own
+    # kv_cache_dtype); this marker lets a receiver sanity-check a peer
+    # rather than change behavior.
+    kv_dtype: str = "bf16"
 
 
 @dataclasses.dataclass

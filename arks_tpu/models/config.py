@@ -41,6 +41,12 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     shared_expert_intermediate_size: int = 0
     norm_topk_prob: bool = True
+    # Per-model KV-cache dtype preference ("auto"|"bf16"|"int8"|"int4"):
+    # consulted when EngineConfig.kv_cache_dtype is left at "auto" — a
+    # checkpoint known to tolerate int4 KV can ship that fact with its
+    # config instead of every deployment flagging it.  "auto" = no
+    # preference (the engine's backend default applies).
+    kv_cache_dtype: str = "auto"
 
     @property
     def q_dim(self) -> int:
@@ -115,6 +121,7 @@ class ModelConfig:
             shared_expert_intermediate_size=int(
                 d.get("shared_expert_intermediate_size", 0) or 0),
             norm_topk_prob=bool(d.get("norm_topk_prob", is_mixtral)),
+            kv_cache_dtype=str(d.get("kv_cache_dtype", "auto")),
         )
 
 

@@ -79,7 +79,10 @@ class PagedKVCache(NamedTuple):
     the engine, passed as dispatch args) map position p of slot b to pool
     page tables[b, p // page].  Two tables pointing at one page = zero-copy
     prefix sharing (arks_tpu.ops.paged_attention).  int8 pools carry
-    per-token scales [L, N, Hkv, page] float32.
+    per-token scales [L, N, Hkv, page] float32.  int4 pools pack token
+    pairs into nibble bytes along the page axis ([L, N, Hkv, page//2, D]
+    int8) while the scale stripes keep full token resolution — which is
+    also how int4-ness is detected (pool page rows != scale page).
     """
 
     k: jnp.ndarray
@@ -97,7 +100,17 @@ class PagedKVCache(NamedTuple):
 
     @property
     def page(self) -> int:
+        """Tokens per page (POSITION math everywhere uses this; the int4
+        pool's byte rows are page // 2)."""
+        if self.k_scale is not None:
+            return self.k_scale.shape[3]
         return self.k.shape[3]
+
+    @property
+    def kv_bits(self) -> int:
+        if self.k_scale is None:
+            return self.k.dtype.itemsize * 8
+        return 4 if self.k.shape[3] != self.k_scale.shape[3] else 8
 
 
 # ---------------------------------------------------------------------------
@@ -242,13 +255,20 @@ def cache_pspecs(cfg: ModelConfig, tp: int = 1, dp: int = 1,
 def init_paged_cache(cfg: ModelConfig, num_pages: int, page: int,
                      dtype: jnp.dtype | None = None,
                      quantized: bool = False,
-                     pad_head: bool = False) -> PagedKVCache:
+                     pad_head: bool = False,
+                     kv_bits: int = 8) -> PagedKVCache:
     dtype = dtype or jnp.dtype(cfg.dtype)
     shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page,
              cache_head_dim(cfg, pad_head))
     if quantized:
+        if kv_bits not in (4, 8):
+            raise ValueError(f"quantized kv_bits must be 4 or 8, got {kv_bits}")
+        if kv_bits == 4 and page % 2:
+            raise ValueError(f"int4 page size {page} must be even")
+        rows = page // 2 if kv_bits == 4 else page
+        vshape = shape[:3] + (rows, shape[4])
         return PagedKVCache(
-            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k=jnp.zeros(vshape, jnp.int8), v=jnp.zeros(vshape, jnp.int8),
             k_scale=jnp.zeros(shape[:-1], jnp.float32),
             v_scale=jnp.zeros(shape[:-1], jnp.float32))
     return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
@@ -581,13 +601,20 @@ def insert_pages(cache: PagedKVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
     ``n_pages`` are never touched — the engine only allocates what the
     prompt needs."""
     page = cache.page
+    int4 = cache.kv_bits == 4
+    rows = page // 2 if int4 else page
     kt = pad_heads(jnp.swapaxes(k_new, 2, 3), cache.k.shape[-1])
     vt = pad_heads(jnp.swapaxes(v_new, 2, 3), cache.v.shape[-1])
     quantized = cache.quantized
     if quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
-        kt, ks = quantize_kv(kt)    # int8 + [L, 1, Hkv, T] f32
-        vt, vs = quantize_kv(vt)
+        qm = 7 if int4 else 127
+        kt, ks = quantize_kv(kt, qmax=qm)   # int8 + [L, 1, Hkv, T] f32
+        vt, vs = quantize_kv(vt, qmax=qm)
+        if int4:
+            from arks_tpu.ops.paged_attention import pack_int4
+            kt = pack_int4(kt, axis=3)
+            vt = pack_int4(vt, axis=3)
     else:
         kt = kt.astype(cache.k.dtype)
         vt = vt.astype(cache.v.dtype)
@@ -596,9 +623,9 @@ def insert_pages(cache: PagedKVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
         kc, vc, ksc, vsc = c
         pg = pages[j]
         kb = jax.lax.dynamic_slice(
-            kt, (0, 0, 0, j * page, 0), kt.shape[:3] + (page, kt.shape[4]))
+            kt, (0, 0, 0, j * rows, 0), kt.shape[:3] + (rows, kt.shape[4]))
         vb = jax.lax.dynamic_slice(
-            vt, (0, 0, 0, j * page, 0), vt.shape[:3] + (page, vt.shape[4]))
+            vt, (0, 0, 0, j * rows, 0), vt.shape[:3] + (rows, vt.shape[4]))
         at = (0, pg, 0, 0, 0)
         kc = jax.lax.dynamic_update_slice(kc, kb, at)
         vc = jax.lax.dynamic_update_slice(vc, vb, at)
@@ -654,14 +681,21 @@ def insert_pages_batch(cache: PagedKVCache, k_new: jnp.ndarray,
     multiple) into their page lists ([M, T/page] int32, first n_pages[i]
     valid per prompt)."""
     page = cache.page
+    int4 = cache.kv_bits == 4
+    rows = page // 2 if int4 else page
     m = k_new.shape[1]
     kt = pad_heads(jnp.swapaxes(k_new, 2, 3), cache.k.shape[-1])
     vt = pad_heads(jnp.swapaxes(v_new, 2, 3), cache.v.shape[-1])
     quantized = cache.quantized
     if quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
-        kt, ksn = quantize_kv(kt)
-        vt, vsn = quantize_kv(vt)
+        qm = 7 if int4 else 127
+        kt, ksn = quantize_kv(kt, qmax=qm)
+        vt, vsn = quantize_kv(vt, qmax=qm)
+        if int4:
+            from arks_tpu.ops.paged_attention import pack_int4
+            kt = pack_int4(kt, axis=3)
+            vt = pack_int4(vt, axis=3)
     else:
         kt = kt.astype(cache.k.dtype)
         vt = vt.astype(cache.v.dtype)
@@ -681,11 +715,11 @@ def insert_pages_batch(cache: PagedKVCache, k_new: jnp.ndarray,
             pg = pages[i, j]
             at = (0, pg, 0, 0, 0)
             kb = jax.lax.dynamic_slice(
-                kti, (0, 0, 0, j * page, 0),
-                kti.shape[:3] + (page, kti.shape[4]))
+                kti, (0, 0, 0, j * rows, 0),
+                kti.shape[:3] + (rows, kti.shape[4]))
             vb = jax.lax.dynamic_slice(
-                vti, (0, 0, 0, j * page, 0),
-                vti.shape[:3] + (page, vti.shape[4]))
+                vti, (0, 0, 0, j * rows, 0),
+                vti.shape[:3] + (rows, vti.shape[4]))
             kc = jax.lax.dynamic_update_slice(kc, kb, at)
             vc = jax.lax.dynamic_update_slice(vc, vb, at)
             if quantized:
@@ -744,15 +778,19 @@ def gather_pages(cache: PagedKVCache, tables_row: jnp.ndarray,
     ``layer``, gathered through the slot's table row ([MaxP] int32).
     Chunked prefill's per-slot attention uses this — a full read of one
     slot's layer cache, which the attention itself would do anyway."""
-    from arks_tpu.ops.paged_attention import paged_gather_kv
+    from arks_tpu.ops.paged_attention import paged_gather_kv, unpack_int4
 
-    def per(pool):
+    int4 = cache.kv_bits == 4
+
+    def per(pool, unpack=False):
         # One pool-gather implementation (paged_attention.paged_gather_kv);
-        # a [1, MaxP] table row is a batch of one.
-        return paged_gather_kv(pool, tables_row[None], layer)[0]
+        # a [1, MaxP] table row is a batch of one.  int4 pools unpack AFTER
+        # the gather (only the slot's rows, never the whole pool).
+        g = paged_gather_kv(pool, tables_row[None], layer)[0]
+        return unpack_int4(g, axis=1) if unpack else g
 
-    k = per(cache.k)
-    v = per(cache.v)
+    k = per(cache.k, int4)
+    v = per(cache.v, int4)
     if cache.quantized:
         return k, v, per(cache.k_scale), per(cache.v_scale)
     return k, v, None, None
@@ -794,8 +832,14 @@ def prefill_chunk_paged(
         at = (layer, pg.astype(jnp.int32), 0, 0, 0)
         if quantized:
             from arks_tpu.ops.pallas_attention import quantize_kv
-            kq, ks = quantize_kv(kt)
-            vq, vs = quantize_kv(vt)
+            int4 = kc.shape[3] != ksc.shape[3]
+            qm = 7 if int4 else 127
+            kq, ks = quantize_kv(kt, qmax=qm)
+            vq, vs = quantize_kv(vt, qmax=qm)
+            if int4:
+                from arks_tpu.ops.paged_attention import pack_int4
+                kq = pack_int4(kq, axis=1)
+                vq = pack_int4(vq, axis=1)
             kc = jax.lax.dynamic_update_slice(kc, kq[None, None], at)
             vc = jax.lax.dynamic_update_slice(vc, vq[None, None], at)
             ksc = jax.lax.dynamic_update_slice(ksc, ks[None, None], at[:-1])

@@ -289,10 +289,13 @@ def paged_verify_update_and_attend(
     stay modest; under a TP mesh the partitioner splits the Hkv axis the
     same way the paged XLA decode fallback does."""
     del mesh, kv_sharded, model_axis
+    from arks_tpu.ops.paged_attention import (
+        is_int4_pool, pool_page_tokens, unpack_int4_pool)
     b, kk, h, d_model = q.shape
     hkv = k_pool.shape[2]
     g = h // hkv
-    page = k_pool.shape[3]
+    int4 = is_int4_pool(k_pool, k_scale)
+    page = pool_page_tokens(k_pool, k_scale)
     cover = tables.shape[1] * page
     # Lane padding (see decode_update_and_attend): pad to the pool's stored
     # head dim, prescale q to keep the effective 1/sqrt(d_model) scale.
@@ -308,8 +311,12 @@ def paged_verify_update_and_attend(
     kp, vp, ks, vs = paged_update_block_xla(
         k_pool, v_pool, k_scale, v_scale, k_new, v_new, positions, tables,
         layer)
-    kc = paged_gather_kv(kp, tables, layer)    # [B, Hkv, cover, D]
-    vc = paged_gather_kv(vp, tables, layer)
+    # int4 pools gather through the nibble unpack so the attend math below
+    # sees a plain per-token int8 view (scale math is unchanged).
+    kp_r = unpack_int4_pool(kp) if int4 else kp
+    vp_r = unpack_int4_pool(vp) if int4 else vp
+    kc = paged_gather_kv(kp_r, tables, layer)  # [B, Hkv, cover, D]
+    vc = paged_gather_kv(vp_r, tables, layer)
 
     scale = 1.0 / (d ** 0.5)
     qg = jnp.transpose(q.reshape(b, kk, hkv, g, d),
@@ -365,10 +372,13 @@ def paged_mixed_update_and_attend(
     XLA oracle; the per-lane view (seq_q_start/q_len/pos_start) drives the
     ragged Pallas kernel, which needs queries grouped by sequence.  Returns
     (out [T, H, D], k_pool, v_pool, k_scale, v_scale)."""
+    from arks_tpu.ops.paged_attention import (
+        is_int4_pool, pool_page_tokens, unpack_int4_pool)
     t_flat, h, d_model = q.shape
     hkv = k_pool.shape[2]
     g = h // hkv
-    page = k_pool.shape[3]
+    int4 = is_int4_pool(k_pool, k_scale)
+    page = pool_page_tokens(k_pool, k_scale)
     cover = tables.shape[1] * page
     d = k_pool.shape[-1]
     if d != d_model:
@@ -392,8 +402,12 @@ def paged_mixed_update_and_attend(
         kp, vp, ks, vs = paged_update_xla(
             k_pool, v_pool, k_scale, v_scale, k_new, v_new, write_idx,
             tables_tok, layer)
-        kc = paged_gather_kv(kp, tables_tok, layer)     # [T, Hkv, cover, D]
-        vc = paged_gather_kv(vp, tables_tok, layer)
+        # int4 pools gather through the nibble unpack — the oracle attend
+        # sees a plain per-token int8 view.
+        kc = paged_gather_kv(unpack_int4_pool(kp) if int4 else kp,
+                             tables_tok, layer)         # [T, Hkv, cover, D]
+        vc = paged_gather_kv(unpack_int4_pool(vp) if int4 else vp,
+                             tables_tok, layer)
         attend_lens = jnp.where(token_slot < 0, 0, token_pos + 1)
         if quantized:
             ksc = paged_gather_kv(ks, tables_tok, layer)
@@ -498,10 +512,13 @@ def paged_decode_update_and_attend(
     dp meshes are not supported (tables index one global pool); the engine
     falls back to the slot-contiguous layout there.
     """
+    from arks_tpu.ops.paged_attention import (
+        is_int4_pool, pool_page_tokens, unpack_int4_pool)
     b, h, d_model = q.shape
     hkv = k_pool.shape[2]
     g = h // hkv
-    page = k_pool.shape[3]
+    int4 = is_int4_pool(k_pool, k_scale)
+    page = pool_page_tokens(k_pool, k_scale)
     cover = tables.shape[1] * page
     # Lane padding (see the slot op): pad to the pool's stored head dim,
     # prescale q so the kernels' 1/sqrt(stored d) nets to 1/sqrt(d_model).
@@ -514,7 +531,11 @@ def paged_decode_update_and_attend(
     impl = impl or default_decode_impl()
     tp_trivial = mesh is None or mesh.shape.get(model_axis, 1) == 1
     lane_ok = d % 128 == 0 or jax.default_backend() != "tpu"
-    use_pallas = impl == "pallas" and (kv_sharded or tp_trivial) and lane_ok
+    # int4 pools have no standalone decode kernel (decode traffic rides the
+    # mixed kernel's fused dequant); this dedicated-decode entry falls back
+    # to the XLA oracle — see the fallback matrix in docs.
+    use_pallas = (impl == "pallas" and (kv_sharded or tp_trivial)
+                  and lane_ok and not int4)
     # Inactive slots attend nothing (their stale tables may point at pages
     # other slots now own — reading them is wasted bandwidth at best).
     attend_lens = jnp.where(write_idx >= cover, 0, write_idx + 1)
@@ -524,8 +545,10 @@ def paged_decode_update_and_attend(
         kp, vp, ks, vs = paged_update_xla(
             k_pool, v_pool, k_scale, v_scale, k_new, v_new, write_idx,
             tables, layer)
-        kc = paged_gather_kv(kp, tables, layer)
-        vc = paged_gather_kv(vp, tables, layer)
+        kc = paged_gather_kv(unpack_int4_pool(kp) if int4 else kp,
+                             tables, layer)
+        vc = paged_gather_kv(unpack_int4_pool(vp) if int4 else vp,
+                             tables, layer)
         if quantized:
             ksc = paged_gather_kv(ks, tables, layer)
             vsc = paged_gather_kv(vs, tables, layer)

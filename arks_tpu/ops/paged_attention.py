@@ -30,6 +30,7 @@ as the CPU-test reference and the fallback for unsupported shapes.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +45,153 @@ def _compiler_params(**kw):
     return cls(**kw)
 
 _NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# int4 KV pools: packed nibble pairs along the token axis
+# ---------------------------------------------------------------------------
+#
+# An int4 pool packs token pairs (2t, 2t+1) into one int8 byte along the
+# PAGE (token) axis: pool [L, N, Hkv, P//2, D] int8, low nibble = token 2t,
+# high nibble = token 2t+1.  Packing along P (not D) keeps the 128-lane D
+# axis dense, so every page DMA stays a full-lane stripe.  The per-token
+# scale stripes keep their int8 shape [L, N, Hkv, P] — which is also how
+# int4-ness is detected everywhere: pool page != scale page.  Values are
+# quantized to [-7, 7] (scale = amax/7); sign restoration is two arithmetic
+# shifts, fused on the page stream inside the kernels.
+
+
+def is_int4_pool(k_pool: jnp.ndarray, k_scale: jnp.ndarray | None) -> bool:
+    return k_scale is not None and k_pool.shape[3] != k_scale.shape[3]
+
+
+def pool_page_tokens(k_pool: jnp.ndarray,
+                     k_scale: jnp.ndarray | None) -> int:
+    """Tokens per page — the position-arithmetic page size (2x the packed
+    byte rows for int4 pools)."""
+    return k_scale.shape[3] if is_int4_pool(k_pool, k_scale) \
+        else k_pool.shape[3]
+
+
+def pack_int4(vals: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Pack int8 values in [-7, 7] into nibble pairs along ``axis`` (its
+    extent must be even): out[.., t, ..] = lo(2t) | hi(2t+1) << 4."""
+    axis = axis % vals.ndim
+    ns = vals.shape[:axis] + (vals.shape[axis] // 2, 2) + vals.shape[axis + 1:]
+    pr = vals.reshape(ns)
+    lo = jax.lax.index_in_dim(pr, 0, axis + 1, keepdims=False)
+    hi = jax.lax.index_in_dim(pr, 1, axis + 1, keepdims=False)
+    return jnp.bitwise_or(jnp.bitwise_and(lo, jnp.int8(15)),
+                          jnp.left_shift(hi, 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: int8 nibble pairs -> int8 values in
+    [-7, 7], doubling ``axis``.  Sign-extension is two arithmetic shifts."""
+    axis = axis % packed.ndim
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    out = jnp.stack([lo, hi], axis=axis + 1)
+    shape = list(packed.shape)
+    shape[axis] *= 2
+    return out.reshape(shape)
+
+
+def unpack_int4_pool(pool: jnp.ndarray) -> jnp.ndarray:
+    """[L, N, Hkv, P//2, D] packed -> [L, N, Hkv, P, D] int8 — the XLA
+    oracle's view (every int8 oracle then applies unchanged)."""
+    return unpack_int4(pool, axis=3)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-grid planning: block sizes, q padding, grid mode
+# ---------------------------------------------------------------------------
+
+
+def mixed_grid_mode() -> str:
+    """ARKS_MIXED_GRID: 'ragged' (work-list grid, default) | 'dense' (the
+    legacy (S, num_qb, max_pages) grid, kept as the byte-identity
+    reference and fallback)."""
+    m = os.environ.get("ARKS_MIXED_GRID", "ragged").lower()
+    if m not in ("ragged", "dense"):
+        raise ValueError(f"ARKS_MIXED_GRID={m!r} (expected ragged|dense)")
+    return m
+
+
+def mixed_grid_plan(qmax: int, *, hkv: int, g: int, d: int, page: int,
+                    kv: str, block_q: int | None = None,
+                    grid: str | None = None,
+                    dma_depth: int | None = None) -> dict:
+    """Resolve the mixed kernel's static launch parameters — ONE place, so
+    the kernel wrapper, the engine's grid-step counters, and bench.py can
+    never disagree on what actually launches.
+
+    block_q defaults to the autotune table entry for this shape signature
+    (arks_tpu.ops.autotune, pure lookup — never sweeps here) and falls
+    back to the min(qmax, 32) heuristic.  Non-divisible qmax is handled by
+    PADDING the q axis to the block (qpad), not by shrinking block_q to a
+    divisor — the old ``while qmax % block_q: block_q -= 1`` fallback
+    degraded to tiny odd blocks (qmax=33 -> block_q=11)."""
+    from arks_tpu.ops import autotune
+
+    qmax = max(int(qmax), 1)
+    tuned: dict = {}
+    if block_q is None or dma_depth is None:
+        tuned = autotune.lookup("paged_mixed", autotune.mixed_signature(
+            hkv=hkv, g=g, d=d, page=page, qmax=qmax, kv=kv)) or {}
+    if block_q is None:
+        block_q = int(tuned.get("block_q", 0)) or min(qmax, 32)
+    block_q = max(1, min(int(block_q), qmax))
+    if dma_depth is None:
+        dma_depth = int(tuned.get("dma_depth", 0)) or 2
+    dma_depth = max(2, int(dma_depth))
+    if grid is None:
+        grid = mixed_grid_mode()
+    qpad = -(-qmax // block_q) * block_q
+    return dict(block_q=block_q, qpad=qpad, num_qb=qpad // block_q,
+                dma_depth=dma_depth, grid=grid)
+
+
+def build_mixed_work_list(pos_start: jnp.ndarray, q_len: jnp.ndarray, *,
+                          page: int, block_q: int, num_qb: int,
+                          max_pages: int):
+    """Scalar-prefetch work list for the ragged mixed grid: one item per
+    REAL (sequence, q_block), compacted to the front of a fixed-length
+    [S*num_qb] list (Pallas grids are static; the page axis is what
+    actually scales with work).  Returns (seq, qb, pages), each int32
+    [S*num_qb]:
+
+    - real items: pages = ceil(causal kv end / page) clamped to the table
+      width — that sequence's OWN page count, not the pool-wide max;
+    - padding items (q_len=0 lanes, blocks past a lane's q_len): pages = 0
+      and (seq, qb) aliased to the LAST real item, so their grid step
+      re-flushes an already-written output block and computes nothing.
+
+    Built from fixed-shape jnp ops only: the device-state pipelined
+    dispatches derive q_len on device (zero-host-sync), so the list must
+    be traceable — no host round trip."""
+    s = q_len.shape[0]
+    seq = jnp.repeat(jnp.arange(s, dtype=jnp.int32), num_qb)
+    qb = jnp.tile(jnp.arange(num_qb, dtype=jnp.int32), s)
+    qlen_i = q_len.astype(jnp.int32)[seq]
+    q_lo = qb * block_q
+    active = q_lo < qlen_i
+    kv_end = jnp.where(
+        active,
+        pos_start.astype(jnp.int32)[seq] + jnp.minimum(q_lo + block_q,
+                                                       qlen_i),
+        0)
+    pages = jnp.minimum(-(-kv_end // page), max_pages)
+    order = jnp.argsort(jnp.logical_not(active).astype(jnp.int32),
+                        stable=True)
+    seq, qb, pages = seq[order], qb[order], pages[order]
+    n_real = jnp.sum(active.astype(jnp.int32))
+    last = jnp.maximum(n_real - 1, 0)
+    pad = jnp.arange(s * num_qb, dtype=jnp.int32) >= n_real
+    seq = jnp.where(pad, seq[last], seq)
+    qb = jnp.where(pad, qb[last], qb)
+    pages = jnp.where(pad, 0, pages)
+    return seq, qb, pages
 
 
 # ---------------------------------------------------------------------------
@@ -69,8 +217,11 @@ def paged_update_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
                      write_idx, tables, layer):
     """Scatter one KV row per slot through the block table (oracle path —
     lowers to a full-pool rewrite in XLA, which is why the Pallas kernel
-    exists)."""
-    p = k_pool.shape[3]
+    exists).  int4 pools (detected by pool page != scale page) get a
+    nibble merge at the target byte; all position math stays in TOKEN
+    units."""
+    int4 = is_int4_pool(k_pool, k_scale)
+    p = pool_page_tokens(k_pool, k_scale)
     n = k_pool.shape[1]
     b, hkv, d = k_new.shape
     # write_idx beyond the table's coverage = inactive slot: route the
@@ -88,12 +239,32 @@ def paged_update_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
     quantized = k_scale is not None
     if quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
-        kq, ks = quantize_kv(k_new)
-        vq, vs = quantize_kv(v_new)
-        k_pool = k_pool.at[l_idx[:, None], page[:, None], h_idx,
-                           off[:, None]].set(kq)
-        v_pool = v_pool.at[l_idx[:, None], page[:, None], h_idx,
-                           off[:, None]].set(vq)
+        kq, ks = quantize_kv(k_new, qmax=7 if int4 else 127)
+        vq, vs = quantize_kv(v_new, qmax=7 if int4 else 127)
+        if int4:
+            # Two parity passes: positions 2t and 2t+1 share a byte, so a
+            # single scatter of whole merged bytes would let pair-mates in
+            # the same dispatch clobber each other's nibble.  Within one
+            # parity every target byte is unique (distinct positions).
+            boff = (off // 2)[:, None]
+            for parity, vals_k, vals_v in ((0, kq, vq), (1, kq, vq)):
+                sel = (off % 2) == parity
+                pg_sel = jnp.where(sel, page, n)[:, None]
+                oldk = k_pool[l_idx[:, None], page[:, None], h_idx, boff]
+                oldv = v_pool[l_idx[:, None], page[:, None], h_idx, boff]
+                if parity == 0:
+                    mk = (oldk & -16) | (vals_k & 15)
+                    mv = (oldv & -16) | (vals_v & 15)
+                else:
+                    mk = (oldk & 15) | (vals_k << 4)
+                    mv = (oldv & 15) | (vals_v << 4)
+                k_pool = k_pool.at[l_idx[:, None], pg_sel, h_idx, boff].set(mk)
+                v_pool = v_pool.at[l_idx[:, None], pg_sel, h_idx, boff].set(mv)
+        else:
+            k_pool = k_pool.at[l_idx[:, None], page[:, None], h_idx,
+                               off[:, None]].set(kq)
+            v_pool = v_pool.at[l_idx[:, None], page[:, None], h_idx,
+                               off[:, None]].set(vq)
         k_scale = k_scale.at[l_idx[:, None], page[:, None], h_idx,
                              off[:, None]].set(ks)
         v_scale = v_scale.at[l_idx[:, None], page[:, None], h_idx,
@@ -114,7 +285,8 @@ def paged_update_block_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
     (which may cross a page boundary mid-block).  Positions at/past the
     table's coverage are dropped — the inactive-slot sentinel, same
     out-of-bounds-page guard as ``paged_update_xla``."""
-    p = k_pool.shape[3]
+    int4 = is_int4_pool(k_pool, k_scale)
+    p = pool_page_tokens(k_pool, k_scale)
     n = k_pool.shape[1]
     b, kk, hkv, d = k_new.shape
     cover = tables.shape[1] * p
@@ -130,10 +302,29 @@ def paged_update_block_xla(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
     quantized = k_scale is not None
     if quantized:
         from arks_tpu.ops.pallas_attention import quantize_kv
-        kq, ksn = quantize_kv(k_new)
-        vq, vsn = quantize_kv(v_new)
-        k_pool = k_pool.at[l_idx, pg, h_idx, of].set(kq)
-        v_pool = v_pool.at[l_idx, pg, h_idx, of].set(vq)
+        kq, ksn = quantize_kv(k_new, qmax=7 if int4 else 127)
+        vq, vsn = quantize_kv(v_new, qmax=7 if int4 else 127)
+        if int4:
+            # Same two-parity nibble merge as paged_update_xla: a verify
+            # block writes consecutive positions, so pair-mates (2t, 2t+1)
+            # in one dispatch target the SAME byte.
+            bof = (off // 2)[:, :, None]
+            for parity in (0, 1):
+                sel = (off % 2) == parity
+                pg_sel = jnp.where(sel, page, n)[:, :, None]
+                oldk = k_pool[l_idx, pg, h_idx, bof]
+                oldv = v_pool[l_idx, pg, h_idx, bof]
+                if parity == 0:
+                    mk = (oldk & -16) | (kq & 15)
+                    mv = (oldv & -16) | (vq & 15)
+                else:
+                    mk = (oldk & 15) | (kq << 4)
+                    mv = (oldv & 15) | (vq << 4)
+                k_pool = k_pool.at[l_idx, pg_sel, h_idx, bof].set(mk)
+                v_pool = v_pool.at[l_idx, pg_sel, h_idx, bof].set(mv)
+        else:
+            k_pool = k_pool.at[l_idx, pg, h_idx, of].set(kq)
+            v_pool = v_pool.at[l_idx, pg, h_idx, of].set(vq)
         k_scale = k_scale.at[l_idx, pg, h_idx, of].set(ksn)
         v_scale = v_scale.at[l_idx, pg, h_idx, of].set(vsn)
     else:
@@ -299,10 +490,22 @@ def paged_decode_attention(
     page = k_pool.shape[3]
     max_pages = tables.shape[1]
     quantized = k_scale is not None
+    if is_int4_pool(k_pool, k_scale):
+        raise ValueError(
+            "int4 pools route through the mixed kernel (fused nibble "
+            "dequant) or the XLA oracle; the standalone decode kernel is "
+            "bf16/int8 only")
     if block_b is None:
-        # VMEM budget: double-buffered k+v page tiles must fit beside the
-        # accumulators.  int8 pages are half the bytes of bf16.
-        block_b = 16 if k_pool.dtype == jnp.int8 else 8
+        from arks_tpu.ops import autotune
+        kvd = "int8" if quantized else str(k_pool.dtype)
+        tuned = autotune.lookup("paged_decode", autotune.decode_signature(
+            b=b, hkv=hkv, g=g, d=d, page=page, kv=kvd)) or {}
+        # Heuristic fallback (VMEM budget: double-buffered k+v page tiles
+        # must fit beside the accumulators; int8 pages are half the bytes
+        # of bf16) — exactly the pre-autotune behavior when no table entry
+        # exists for this signature.
+        block_b = int(tuned.get("block_b", 0)) or (
+            16 if k_pool.dtype == jnp.int8 else 8)
     block_b = _pick_block_b(b, block_b)
     num_groups = b // block_b
     scale = 1.0 / (d ** 0.5)
@@ -369,17 +572,73 @@ def paged_decode_attention(
 # ---------------------------------------------------------------------------
 
 
+def _unpack_int4_tile(w: jnp.ndarray) -> jnp.ndarray:
+    """In-kernel nibble dequant, fused on the page stream: an int4 page
+    tile [Hkv, page//2, D] of packed pairs -> [Hkv, page, D] int8 values.
+    Sign extension is two arithmetic shifts; the interleave restores token
+    order (low nibble = even token, high = odd)."""
+    lo = jnp.right_shift(jnp.left_shift(w, 4), 4)
+    hi = jnp.right_shift(w, 4)
+    hkv, p2, d = w.shape
+    return jnp.stack([lo, hi], axis=2).reshape(hkv, p2 * 2, d)
+
+
+def _mixed_softmax_block(q_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref,
+                         acc_ref, buf, si, pos0, q_lo, *, page, scale,
+                         quantized, int4):
+    """One page of online-softmax accumulation — the SHARED compute body of
+    the dense and ragged mixed kernels, so byte-identity between the two
+    grids is structural, not coincidental."""
+    _, hkv, g, bq, d = q_ref.shape
+    q = q_ref[0].reshape(hkv, g * bq, d)
+    kt = kbuf[buf]
+    vt = vbuf[buf]
+    if int4:
+        kt = _unpack_int4_tile(kt)
+        vt = _unpack_int4_tile(vt)
+    k = kt.astype(q.dtype)                 # [Hkv, page, D]
+    v = vt.astype(q.dtype)
+    scores = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale   # [Hkv, G*BQ, page]
+    if quantized:
+        scores = scores * ksbuf[buf][:, None, :]
+    # Row r of the G*BQ axis is query index r % BQ (g-major layout).
+    row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    qpos = pos0 + q_lo + row % bq
+    kvpos = si * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+    scores = jnp.where(kvpos <= qpos, scores, _NEG_INF)
+
+    m_prev = m_ref[:]
+    l_prev = l_ref[:]
+    m_curr = jnp.max(scores, axis=2, keepdims=True)
+    m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
+    correction = jnp.exp(m_prev - m_next)
+    p = jnp.exp(scores - m_next[..., :1])
+    l_curr = jnp.sum(p, axis=2, keepdims=True)
+    l_next = l_prev * correction + jnp.broadcast_to(l_curr, l_prev.shape)
+    if quantized:
+        p = p * vsbuf[buf][:, None, :]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)           # [Hkv, G*BQ, D]
+    acc_ref[:] = acc_ref[:] * correction[..., :1] + pv
+    m_ref[:] = m_next
+    l_ref[:] = l_next
+
+
 def _paged_mixed_kernel(layer_ref, tables_ref, pos_start_ref, qlen_ref,
                         q_ref, kpool, vpool, *rest,
                         page: int, block_q: int, scale: float,
-                        quantized: bool):
-    """One SEQUENCE per grid row, ``block_q`` queries per q-block, pages on
-    the innermost axis.  The q_len=1 decode kernel generalized: query i of
-    sequence s sits at global position pos_start[s]+i and attends cache
-    positions [0, pos_start[s]+i] (causal within its own chunk — the rows
-    were written before this kernel runs, write-then-attend as everywhere).
-    Pages wholly past a q-block's causal end are skipped, so a decode lane
-    (q_len=1) costs the same page reads as the dedicated decode kernel."""
+                        quantized: bool, int4: bool):
+    """DENSE grid: one SEQUENCE per grid row, ``block_q`` queries per
+    q-block, pages on the innermost axis — (S, num_qb, max_pages) grid
+    steps regardless of how much of the batch is real.  Kept as the
+    byte-identity reference and ARKS_MIXED_GRID=dense fallback; the
+    ragged work-list kernel below is the default.  Query i of sequence s
+    sits at global position pos_start[s]+i and attends cache positions
+    [0, pos_start[s]+i] (write-then-attend as everywhere).  Pages wholly
+    past a q-block's causal end are masked off with pl.when."""
     if quantized:
         kspool, vspool, o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, \
             acc_ref, sem = rest
@@ -443,37 +702,9 @@ def _paged_mixed_kernel(layer_ref, tables_ref, pos_start_ref, qlen_ref,
     def _block():
         buf = si % 2
         wait_copies(buf)
-        _, hkv, g, bq, d = q_ref.shape
-        q = q_ref[0].reshape(hkv, g * bq, d)
-        k = kbuf[buf].astype(q.dtype)          # [Hkv, page, D]
-        v = vbuf[buf].astype(q.dtype)
-        scores = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale   # [Hkv, G*BQ, page]
-        if quantized:
-            scores = scores * ksbuf[buf][:, None, :]
-        # Row r of the G*BQ axis is query index r % BQ (g-major layout).
-        row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        qpos = pos0 + q_lo + row % bq
-        kvpos = si * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
-        scores = jnp.where(kvpos <= qpos, scores, _NEG_INF)
-
-        m_prev = m_ref[:]
-        l_prev = l_ref[:]
-        m_curr = jnp.max(scores, axis=2, keepdims=True)
-        m_next = jnp.maximum(m_prev, jnp.broadcast_to(m_curr, m_prev.shape))
-        correction = jnp.exp(m_prev - m_next)
-        p = jnp.exp(scores - m_next[..., :1])
-        l_curr = jnp.sum(p, axis=2, keepdims=True)
-        l_next = l_prev * correction + jnp.broadcast_to(l_curr, l_prev.shape)
-        if quantized:
-            p = p * vsbuf[buf][:, None, :]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)           # [Hkv, G*BQ, D]
-        acc_ref[:] = acc_ref[:] * correction[..., :1] + pv
-        m_ref[:] = m_next
-        l_ref[:] = l_next
+        _mixed_softmax_block(q_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref,
+                             acc_ref, buf, si, pos0, q_lo, page=page,
+                             scale=scale, quantized=quantized, int4=int4)
 
     @pl.when(si == num_pages - 1)
     def _finish():
@@ -482,85 +713,239 @@ def _paged_mixed_kernel(layer_ref, tables_ref, pos_start_ref, qlen_ref,
         o_ref[:] = out.reshape(1, hkv, g, bq, d).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def _paged_mixed_ragged_kernel(layer_ref, tables_ref, pos_start_ref,
+                               wl_seq_ref, wl_qb_ref, wl_pages_ref,
+                               q_ref, kpool, vpool, *rest,
+                               page: int, block_q: int, scale: float,
+                               quantized: bool, int4: bool, depth: int):
+    """RAGGED work-list grid: one grid step per (sequence, q_block) work
+    item, the page loop INSIDE the kernel bounded by that item's own
+    causal page count (``wl_pages``).  q_len=0 lanes and q-blocks past a
+    lane's q_len never become items, so grid length tracks real work —
+    a 3-active-of-64-slots batch costs 3 items' pages, not
+    64*num_qb*max_pages masked steps.  Items are compacted to the front
+    of the fixed-length list by :func:`build_mixed_work_list`; padding
+    items carry wl_pages=0 and alias the last real item's output block,
+    so their only cost is re-flushing an already-written block.
+
+    DMAs are ``depth``-way multi-buffered (depth=2 reduces exactly to the
+    dense kernel's double buffering; the accumulation order is identical
+    for any depth, so tuned depths preserve byte identity)."""
+    if quantized:
+        kspool, vspool, o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, \
+            acc_ref, sem = rest
+    else:
+        o_ref, kbuf, vbuf, m_ref, l_ref, acc_ref, sem = rest
+        kspool = vspool = ksbuf = vsbuf = None
+    item = pl.program_id(0)
+    lyr = layer_ref[0]
+    s_i = wl_seq_ref[item]
+    qb = wl_qb_ref[item]
+    npages = wl_pages_ref[item]
+    pos0 = pos_start_ref[s_i]
+    q_lo = qb * block_q
+
+    def start_copies(page_i, buf):
+        pg = tables_ref[s_i, page_i]
+        pltpu.make_async_copy(kpool.at[lyr, pg], kbuf.at[buf],
+                              sem.at[0, buf]).start()
+        pltpu.make_async_copy(vpool.at[lyr, pg], vbuf.at[buf],
+                              sem.at[1, buf]).start()
+        if quantized:
+            pltpu.make_async_copy(kspool.at[lyr, pg], ksbuf.at[buf],
+                                  sem.at[2, buf]).start()
+            pltpu.make_async_copy(vspool.at[lyr, pg], vsbuf.at[buf],
+                                  sem.at[3, buf]).start()
+
+    def wait_copies(buf):
+        pltpu.make_async_copy(kpool.at[lyr, 0], kbuf.at[buf],
+                              sem.at[0, buf]).wait()
+        pltpu.make_async_copy(vpool.at[lyr, 0], vbuf.at[buf],
+                              sem.at[1, buf]).wait()
+        if quantized:
+            pltpu.make_async_copy(kspool.at[lyr, 0], ksbuf.at[buf],
+                                  sem.at[2, buf]).wait()
+            pltpu.make_async_copy(vspool.at[lyr, 0], vsbuf.at[buf],
+                                  sem.at[3, buf]).wait()
+
+    # Padding item (npages == 0): compute nothing, write nothing — the
+    # output window still holds the previous (aliased) item's block and
+    # re-flushes it unchanged.
+    @pl.when(npages > 0)
+    def _run():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        for j in range(depth - 1):
+            @pl.when(j < npages)
+            def _warm(j=j):
+                start_copies(j, j)
+
+        def body(si, carry):
+            nxt = si + depth - 1
+
+            @pl.when(nxt < npages)
+            def _prefetch():
+                start_copies(nxt, nxt % depth)
+
+            buf = si % depth
+            wait_copies(buf)
+            _mixed_softmax_block(q_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref,
+                                 l_ref, acc_ref, buf, si, pos0, q_lo,
+                                 page=page, scale=scale,
+                                 quantized=quantized, int4=int4)
+            return carry
+
+        jax.lax.fori_loop(0, npages, body, 0)
+        _, hkv, g, bq, d = q_ref.shape
+        out = acc_ref[:] / (l_ref[..., :1] + 1e-9)
+        o_ref[:] = out.reshape(1, hkv, g, bq, d).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret", "grid",
+                                             "dma_depth"))
+def _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len, layer,
+                      k_scale, v_scale, *, block_q: int, dma_depth: int,
+                      grid: str, interpret: bool):
+    """Jitted mixed-attention launch with FULLY RESOLVED statics — the
+    public wrapper resolves the plan (env + autotune) per call so flipping
+    ARKS_MIXED_GRID / the tune table between calls can never hit a stale
+    jit cache entry keyed on unresolved defaults."""
+    s, hkv, g, qmax, d = q.shape
+    quantized = k_scale is not None
+    int4 = is_int4_pool(k_pool, k_scale)
+    page = pool_page_tokens(k_pool, k_scale)
+    kv_rows = k_pool.shape[3]            # page//2 byte rows for int4 pools
+    max_pages = tables.shape[1]
+    qpad = -(-qmax // block_q) * block_q
+    num_qb = qpad // block_q
+    qp = q if qpad == qmax else jnp.pad(
+        q, ((0, 0), (0, 0), (0, 0), (0, qpad - qmax), (0, 0)))
+    scale = 1.0 / (d ** 0.5)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    tables32 = tables.astype(jnp.int32)
+    pos32 = pos_start.astype(jnp.int32)
+    qlen32 = q_len.astype(jnp.int32)
+
+    def make_scratch(nbuf):
+        scratch = [
+            pltpu.VMEM((nbuf, hkv, kv_rows, d), k_pool.dtype),  # kbuf
+            pltpu.VMEM((nbuf, hkv, kv_rows, d), v_pool.dtype),  # vbuf
+        ]
+        n_sem = 2
+        if quantized:
+            scratch += [pltpu.VMEM((nbuf, hkv, page), jnp.float32),
+                        pltpu.VMEM((nbuf, hkv, page), jnp.float32)]
+            n_sem = 4
+        scratch += [
+            pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # m
+            pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((hkv, g * block_q, d), jnp.float32),    # acc
+            pltpu.SemaphoreType.DMA((n_sem, nbuf)),
+        ]
+        return scratch
+
+    pool_specs = [pl.BlockSpec(memory_space=pl.ANY),   # k pool (manual DMA)
+                  pl.BlockSpec(memory_space=pl.ANY)]   # v pool
+    scale_inputs = [k_scale, v_scale] if quantized else []
+    scale_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 2 if quantized else []
+
+    if grid == "dense":
+        def q_map(s_i, qb, si, *prefetch):
+            del si, prefetch
+            return (s_i, 0, 0, qb, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,  # layer, tables, pos_start, q_len
+            grid=(s, num_qb, max_pages),
+            in_specs=[pl.BlockSpec((1, hkv, g, block_q, d), q_map)]
+            + pool_specs + scale_specs,
+            out_specs=pl.BlockSpec((1, hkv, g, block_q, d), q_map),
+            scratch_shapes=make_scratch(2),
+        )
+        inputs = [layer_arr, tables32, pos32, qlen32,
+                  qp, k_pool, v_pool] + scale_inputs
+        kernel = functools.partial(_paged_mixed_kernel, page=page,
+                                   block_q=block_q, scale=scale,
+                                   quantized=quantized, int4=int4)
+        dims = ("parallel", "arbitrary", "arbitrary")
+    else:
+        wl_seq, wl_qb, wl_pages = build_mixed_work_list(
+            pos32, qlen32, page=page, block_q=block_q, num_qb=num_qb,
+            max_pages=max_pages)
+
+        def q_map(i, layer_p, tables_p, pos_p, seq_p, qb_p, pages_p):
+            del layer_p, tables_p, pos_p, pages_p
+            return (seq_p[i], 0, 0, qb_p[i], 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,  # layer, tables, pos_start, work list x3
+            grid=(s * num_qb,),
+            in_specs=[pl.BlockSpec((1, hkv, g, block_q, d), q_map)]
+            + pool_specs + scale_specs,
+            out_specs=pl.BlockSpec((1, hkv, g, block_q, d), q_map),
+            scratch_shapes=make_scratch(dma_depth),
+        )
+        inputs = [layer_arr, tables32, pos32, wl_seq, wl_qb, wl_pages,
+                  qp, k_pool, v_pool] + scale_inputs
+        kernel = functools.partial(_paged_mixed_ragged_kernel, page=page,
+                                   block_q=block_q, scale=scale,
+                                   quantized=quantized, int4=int4,
+                                   depth=dma_depth)
+        # Consecutive items may alias one output block (padding re-flush),
+        # so the item axis is "arbitrary", never "parallel".
+        dims = ("arbitrary",)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        compiler_params=_compiler_params(dimension_semantics=dims),
+        interpret=interpret,
+    )(*inputs)
+    if qpad != qmax:
+        out = out[..., :qmax, :]
+    # Rows past q_len[s] are undefined (dense: skipped blocks; ragged:
+    # never-visited items) — zero them so both grids return IDENTICAL
+    # bytes everywhere, not just on the rows callers keep.
+    valid = jnp.arange(qmax, dtype=jnp.int32)[None, :] < qlen32[:, None]
+    return jnp.where(valid[:, None, None, :, None], out,
+                     jnp.zeros_like(out))
+
+
 def paged_mixed_attention(
     q: jnp.ndarray,        # [S, Hkv, G, Q, D] — Q query tokens per sequence
-    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool
+    k_pool: jnp.ndarray,   # [L, N, Hkv, P, D] page pool ([.., P//2, D] int4)
     v_pool: jnp.ndarray,
     tables: jnp.ndarray,   # [S, MaxP] int32 block tables
     pos_start: jnp.ndarray,  # [S] int32 — global position of query 0
     q_len: jnp.ndarray,      # [S] int32 — valid queries (0 = inactive lane)
     layer,                   # int32
-    k_scale: jnp.ndarray | None = None,  # [L, N, Hkv, P] f32 (int8 pools)
+    k_scale: jnp.ndarray | None = None,  # [L, N, Hkv, P] f32 (int8/int4)
     v_scale: jnp.ndarray | None = None,
     block_q: int | None = None,
     interpret: bool = False,
+    grid: str | None = None,        # "ragged" | "dense" | None (env)
+    dma_depth: int | None = None,
 ) -> jnp.ndarray:
     """[S, Hkv, G, Q, D] ragged mixed attention: query i of sequence s
     attends its table pages over positions [0, pos_start[s]+i].  Rows past
-    q_len[s] are garbage the caller drops (the flat-batch scatter masks
-    them) — the ONE kernel serving decode lanes (q_len=1) and prefill
-    chunks (q_len>1) in a single dispatch."""
+    q_len[s] are zeroed — the ONE kernel serving decode lanes (q_len=1),
+    prefill chunks, and spec verify rows (q_len=K) in a single dispatch.
+    The plan (block_q via autotune, grid mode via ARKS_MIXED_GRID, DMA
+    depth) is resolved HERE, outside jit, then passed as statics."""
     s, hkv, g, qmax, d = q.shape
-    page = k_pool.shape[3]
-    max_pages = tables.shape[1]
     quantized = k_scale is not None
-    if block_q is None:
-        block_q = min(qmax, 32)
-    while qmax % block_q:
-        block_q -= 1
-    num_qb = qmax // block_q
-    scale = 1.0 / (d ** 0.5)
-    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
-
-    def q_map(s_i, qb, si, *prefetch):
-        del si, prefetch
-        return (s_i, 0, 0, qb, 0)
-
-    in_specs = [
-        pl.BlockSpec((1, hkv, g, block_q, d), q_map),
-        pl.BlockSpec(memory_space=pl.ANY),   # k pool (manual DMA)
-        pl.BlockSpec(memory_space=pl.ANY),   # v pool
-    ]
-    inputs = [layer_arr, tables.astype(jnp.int32),
-              pos_start.astype(jnp.int32), q_len.astype(jnp.int32),
-              q, k_pool, v_pool]
-    scratch = [
-        pltpu.VMEM((2, hkv, page, d), k_pool.dtype),  # kbuf
-        pltpu.VMEM((2, hkv, page, d), v_pool.dtype),  # vbuf
-    ]
-    n_sem = 2
-    if quantized:
-        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
-        inputs += [k_scale, v_scale]
-        scratch += [pltpu.VMEM((2, hkv, page), jnp.float32),
-                    pltpu.VMEM((2, hkv, page), jnp.float32)]
-        n_sem = 4
-    scratch += [
-        pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # m
-        pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # l
-        pltpu.VMEM((hkv, g * block_q, d), jnp.float32),    # acc
-        pltpu.SemaphoreType.DMA((n_sem, 2)),
-    ]
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,  # layer, tables, pos_start, q_len
-        grid=(s, num_qb, max_pages),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, hkv, g, block_q, d), q_map),
-        scratch_shapes=scratch,
-    )
-    kernel = functools.partial(_paged_mixed_kernel, page=page,
-                               block_q=block_q, scale=scale,
-                               quantized=quantized)
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=_compiler_params(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-        interpret=interpret,
-    )(*inputs)
+    int4 = is_int4_pool(k_pool, k_scale)
+    page = pool_page_tokens(k_pool, k_scale)
+    kvd = "int4" if int4 else ("int8" if quantized else str(k_pool.dtype))
+    plan = mixed_grid_plan(qmax, hkv=hkv, g=g, d=d, page=page, kv=kvd,
+                           block_q=block_q, grid=grid, dma_depth=dma_depth)
+    return _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len,
+                             layer, k_scale, v_scale,
+                             block_q=plan["block_q"],
+                             dma_depth=plan["dma_depth"],
+                             grid=plan["grid"], interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -665,7 +1050,7 @@ def _paged_update_quant_kernel(layer_ref, idx_ref, tables_ref,
                                kp_in, vp_in, kss_in, vss_in,
                                kp_out, vp_out, kss_out, vss_out,
                                kscr, vscr, ksscr, vsscr, sem,
-                               *, page: int):
+                               *, page: int, int4: bool):
     del kp_in, vp_in, kss_in, vss_in
     b, hkv, _, d = kn_ref.shape
     max_pos = tables_ref.shape[1] * page
@@ -683,7 +1068,14 @@ def _paged_update_quant_kernel(layer_ref, idx_ref, tables_ref,
         idx = idx_ref[i]
         pg = tables_ref[i, idx // page]
         off = idx % page
-        base = (off // ch) * ch
+        # int4 pools store nibble pairs: the token's BYTE row is off//2 and
+        # the read-modify-write below merges one nibble.  Rows in the same
+        # dispatch that share a byte (positions 2t and 2t+1 of a prefill
+        # chunk) are safe: the fori loop is sequential, so the second
+        # merge reads the first one's write.  All scale/position math
+        # stays in token units.
+        boff = off // 2 if int4 else off
+        base = (boff // ch) * ch
         sbase = (off // sch) * sch
         dst_k = kp_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(base, ch)]
         dst_v = vp_out.at[pl.ds(lyr, 1), pl.ds(pg, 1), :, pl.ds(base, ch)]
@@ -698,9 +1090,31 @@ def _paged_update_quant_kernel(layer_ref, idx_ref, tables_ref,
         for c in copies:
             c.wait()
         row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, hkv, ch, d), 3)
-        hit = row == (off - base)
-        kscr[:] = jnp.where(hit, kn_ref[pl.ds(i, 1)][None], kscr[:])
-        vscr[:] = jnp.where(hit, vn_ref[pl.ds(i, 1)][None], vscr[:])
+        hit = row == (boff - base)
+        if int4:
+            # Merge ONE nibble of the hit byte, int8-domain bitwise: low
+            # nibble = even token (keep 0xF0), high = odd (keep 0x0F; the
+            # int8 left shift wraps the value into the high nibble).
+            even = (off % 2) == 0
+            newk = kn_ref[pl.ds(i, 1)][None]
+            newv = vn_ref[pl.ds(i, 1)][None]
+            mk = jnp.where(
+                even,
+                jnp.bitwise_or(jnp.bitwise_and(kscr[:], jnp.int8(-16)),
+                               jnp.bitwise_and(newk, jnp.int8(15))),
+                jnp.bitwise_or(jnp.bitwise_and(kscr[:], jnp.int8(15)),
+                               jnp.left_shift(newk, 4)))
+            mv = jnp.where(
+                even,
+                jnp.bitwise_or(jnp.bitwise_and(vscr[:], jnp.int8(-16)),
+                               jnp.bitwise_and(newv, jnp.int8(15))),
+                jnp.bitwise_or(jnp.bitwise_and(vscr[:], jnp.int8(15)),
+                               jnp.left_shift(newv, 4)))
+            kscr[:] = jnp.where(hit, mk, kscr[:])
+            vscr[:] = jnp.where(hit, mv, vscr[:])
+        else:
+            kscr[:] = jnp.where(hit, kn_ref[pl.ds(i, 1)][None], kscr[:])
+            vscr[:] = jnp.where(hit, vn_ref[pl.ds(i, 1)][None], vscr[:])
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, hkv, sch), 3)
         shit = lane == (off - sbase)
         ksn = ksn_ref[pl.ds(i, 1)].reshape(1, 1, hkv, 1)
@@ -732,15 +1146,23 @@ def paged_kv_update_quant(
     layer,
     interpret: bool = False,
 ):
-    """int8 variant: quantize the new rows, write values + per-token scales
-    in place through the table."""
+    """int8/int4 variant: quantize the new rows, write values + per-token
+    scales in place through the table.  int4 pools (pool page rows !=
+    scale page) get the fused nibble merge in the kernel."""
     from arks_tpu.ops.pallas_attention import quantize_kv
 
-    _, n, hkv, page, d = k_pool.shape
+    _, n, hkv, rows, d = k_pool.shape
+    page = k_scale.shape[3]
+    int4 = rows != page
     if page % _SCALE_CHUNK != 0:
-        raise ValueError(f"int8 page {page} must be a multiple of {_SCALE_CHUNK}")
-    kq, ks = quantize_kv(k_new)
-    vq, vs = quantize_kv(v_new)
+        raise ValueError(
+            f"quantized page {page} must be a multiple of {_SCALE_CHUNK}")
+    if int4 and rows % _UPDATE_CHUNK_INT8 != 0:
+        raise ValueError(
+            f"int4 packed page rows {rows} must be a multiple of "
+            f"{_UPDATE_CHUNK_INT8}")
+    kq, ks = quantize_kv(k_new, qmax=7 if int4 else 127)
+    vq, vs = quantize_kv(v_new, qmax=7 if int4 else 127)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -756,7 +1178,8 @@ def paged_kv_update_quant(
             pltpu.SemaphoreType.DMA((4,)),
         ],
     )
-    kernel = functools.partial(_paged_update_quant_kernel, page=page)
+    kernel = functools.partial(_paged_update_quant_kernel, page=page,
+                               int4=int4)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

@@ -335,13 +335,16 @@ def kv_cache_update(
 _SCALE_CHUNK = 128  # f32 lane tile: scale RMW slices along S are 128-aligned
 
 
-def quantize_kv(x: jnp.ndarray, axis: int = -1) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Symmetric per-token int8: returns (q int8, scale f32) with the scale
-    axis removed. ``axis`` is the reduced (feature) axis."""
+def quantize_kv(x: jnp.ndarray, axis: int = -1,
+                qmax: int = 127) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-token quantization: returns (q int8, scale f32) with
+    the scale axis removed. ``axis`` is the reduced (feature) axis.
+    ``qmax`` is the integer range: 127 for int8 pools, 7 for int4 pools
+    (values in [-7, 7] so each fits a sign-extended nibble)."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
+    scale = jnp.maximum(amax / float(qmax), 1e-8)
     q = jnp.clip(jnp.round(x.astype(jnp.float32) / jnp.expand_dims(scale, axis)),
-                 -127, 127).astype(jnp.int8)
+                 -qmax, qmax).astype(jnp.int8)
     return q, scale
 
 

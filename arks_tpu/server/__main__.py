@@ -56,8 +56,10 @@ def main() -> None:
     p.add_argument("--steps-per-dispatch", type=int, default=4)
     p.add_argument("--dtype", default=None)
     p.add_argument("--kv-cache-dtype", default="auto",
-                   choices=("auto", "bf16", "int8"),
-                   help="int8 halves KV HBM traffic and doubles cache capacity")
+                   choices=("auto", "bf16", "int8", "int4"),
+                   help="int8 halves KV HBM traffic and doubles cache capacity; "
+                        "int4 packs token pairs per byte (paged layout only, "
+                        "dequant fused on the page stream)")
     p.add_argument("--weight-dtype", default="bf16",
                    choices=("bf16", "int8", "int4"),
                    help="weight-only quantization: int8 (w8a16, per-channel "

@@ -191,6 +191,91 @@ def pallas_parity_check(kv_quant: bool) -> float:
     return max(diff, pad_diff)
 
 
+def measure_kernel_microbench() -> dict:
+    """Mixed-kernel microbench rung: dense vs ragged grid x int8 vs int4
+    KV x default vs tuned block_q, on a SPARSE batch (3 active lanes of 8)
+    — the shape the ragged work-list grid exists for.  Runs in interpret
+    mode on CPU so the rung rides every bench round; interpret-mode
+    timings order the work (grid steps executed), they are not TPU
+    latencies — the grid_steps_* pair is the load-bearing number there.
+    Under ARKS_KERNEL_TUNE=sweep the winning block_q is persisted to the
+    autotune table, so a bench round doubles as the tuning pass."""
+    from arks_tpu.engine.paged import mixed_grid_steps
+    from arks_tpu.ops import autotune
+    from arks_tpu.ops import paged_attention as pa
+    from arks_tpu.ops.pallas_attention import quantize_kv
+
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
+    s, hkv, g, maxp = 8, 2, 2, 4
+    d = 128 if on_tpu else 32
+    page = 128 if on_tpu else 16
+    qmax = 8
+    repeats = 3 if on_tpu else 2
+    rng = np.random.default_rng(0)
+    kf = jnp.asarray(rng.normal(size=(1, s * maxp, hkv, page, d)),
+                     jnp.float32)
+    vf = jnp.asarray(rng.normal(size=kf.shape), jnp.float32)
+    k8, ks = quantize_kv(kf)
+    v8, vs = quantize_kv(vf)
+    k4q, k4s = quantize_kv(kf, qmax=7)
+    v4q, v4s = quantize_kv(vf, qmax=7)
+    pools = {
+        "int8": (k8, v8, ks, vs),
+        "int4": (pa.pack_int4(k4q, axis=3), pa.pack_int4(v4q, axis=3),
+                 k4s, v4s),
+    }
+    tables = jnp.arange(s * maxp, dtype=jnp.int32).reshape(s, maxp)
+    q = jnp.asarray(rng.normal(size=(s, hkv, g, qmax, d)), jnp.float32)
+    # 3 active lanes (one full chunk, one mid-page decode burst, one
+    # short), 5 idle — the padding the dense grid pays for.
+    pos = np.zeros(s, np.int32)
+    ql = np.zeros(s, np.int32)
+    pos[:3], ql[:3] = (0, page + 3, 5), (qmax, qmax, 3)
+    posj, qlj = jnp.asarray(pos), jnp.asarray(ql)
+
+    def timeit(fn):
+        fn()  # compile/warm outside the timed window
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            fn()
+        return round((time.perf_counter() - t0) / repeats * 1e3, 2)
+
+    out: dict = {}
+    for kv_name, (kp, vp, kss, vss) in pools.items():
+        for grid in ("ragged", "dense"):
+            def launch(block_q=None, dma_depth=None):
+                r = pa.paged_mixed_attention(
+                    q, kp, vp, tables, posj, qlj, 0, k_scale=kss,
+                    v_scale=vss, block_q=block_q, interpret=interpret,
+                    grid=grid, dma_depth=dma_depth)
+                np.asarray(r)  # host fetch = completion barrier
+            out[f"mixed_{grid}_{kv_name}_default_ms"] = timeit(launch)
+            # Tuned: best block_q over the candidate set; a sweep-mode run
+            # persists it under this shape's signature for serving reuse.
+            cands = [{"block_q": b, "dma_depth": 2} for b in (2, qmax)]
+            timed = {c["block_q"]: timeit(lambda c=c: launch(**c))
+                     for c in cands}
+            best_bq = min(timed, key=timed.get)
+            out[f"mixed_{grid}_{kv_name}_tuned_ms"] = timed[best_bq]
+            out[f"mixed_{grid}_{kv_name}_tuned_block_q"] = best_bq
+            if grid == "ragged" and autotune.mode() == "sweep":
+                autotune.record(
+                    "paged_mixed",
+                    autotune.mixed_signature(hkv=hkv, g=g, d=d, page=page,
+                                             qmax=qmax, kv=kv_name),
+                    {"block_q": best_bq, "dma_depth": 2})
+    # The structural number (hardware-independent): page-compute steps the
+    # ragged grid executes vs the dense grid's S*num_qb*max_pages padding.
+    plan = pa.mixed_grid_plan(qmax, hkv=hkv, g=g, d=d, page=page, kv="int8")
+    ideal, dense = mixed_grid_steps(pos, ql, page=page,
+                                    block_q=plan["block_q"],
+                                    num_qb=plan["num_qb"], max_pages=maxp)
+    out["grid_steps_ideal"] = ideal
+    out["grid_steps_dense"] = dense
+    return out
+
+
 def measure_mixed_ttft_under_load() -> float:
     """p50 TTFT (ms) of chunk-length prompts admitted while EVERY decode
     slot is busy — the decode+prefill contention number the mixed scheduler
@@ -423,6 +508,17 @@ def main() -> None:
                 parity_diff < (0.075 if kv_quant else 0.05)
         except Exception as e:
             result["pallas_parity_error"] = f"{type(e).__name__}: {e}"
+
+    # Kernel microbench rung: dense vs ragged mixed grid x int8/int4 KV x
+    # default/tuned blocks on a sparse batch.  Fault-isolated;
+    # ARKS_BENCH_KERNEL_MICRO=0 skips.
+    if os.environ.get("ARKS_BENCH_KERNEL_MICRO", "1") != "0":
+        try:
+            result["kernel_microbench"] = measure_kernel_microbench()
+        except Exception as e:
+            import traceback
+            traceback.print_exc()
+            result["kernel_microbench_error"] = f"{type(e).__name__}: {e}"
 
     # Mixed-step TTFT under load: the decode+prefill-contention latency the
     # unified mixed dispatch (ARKS_MIXED_STEP) exists to bound.  Fault-

@@ -9,6 +9,8 @@ so a future scheduler edit that silently reintroduces per-shape retraces
 of surfacing as TPU compile stalls in production.
 """
 
+import json
+
 from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
 from arks_tpu.engine.tokenizer import ByteTokenizer
 from arks_tpu.models import get_config
@@ -130,3 +132,120 @@ def test_spec_workload_compile_variant_budget(monkeypatch):
     assert variants.get("_decode_fn", 0) == 0, variants
     assert variants.get("_admit_fn", 0) == 0, variants
     assert "_spec_fn" not in variants, variants
+
+
+def test_ragged_kernel_family_budget_with_tuned_cache(monkeypatch, tmp_path):
+    """The ragged mixed kernel family under a CACHED autotune entry: the
+    tuned block_q must flow from the table into the resolved plan and the
+    jitted kernel launcher (_paged_mixed_call) must compile exactly ONE
+    variant for the whole mixed workload — a tuned entry swaps the statics'
+    VALUES, it must never add a compiled variant next to the default, and
+    the engine-level budget is unchanged from the dense-era census."""
+    from arks_tpu.ops import autotune, paged_attention
+    from arks_tpu.models import transformer as tf
+
+    cache = tmp_path / "kernel_tune.json"
+    monkeypatch.setenv("ARKS_KERNEL_TUNE", "cached")
+    monkeypatch.setenv("ARKS_KERNEL_TUNE_CACHE", str(cache))
+    monkeypatch.setenv("ARKS_ATTN_IMPL", "pallas")
+    monkeypatch.setenv("ARKS_MIXED_GRID", "ragged")
+    monkeypatch.setenv("ARKS_MIXED_STEP", "1")
+    autotune.invalidate_cache()
+
+    cfg = get_config("tiny")
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_cache_len=64,
+                        prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                        prefill_chunk=16, kv_layout="paged",
+                        prefix_cache_mb=0)
+    eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+    assert eng._mixed and eng._paged
+
+    # Seed the tune table for the engine's own mixed signature with a
+    # NON-default block_q (the heuristic would pick min(qmax, 32)).
+    sig = autotune.mixed_signature(
+        hkv=cfg.num_kv_heads, g=cfg.num_heads // cfg.num_kv_heads,
+        d=tf.cache_head_dim(cfg, eng._pad_head()), page=eng._page_size(),
+        qmax=eng._mixed_budget + 1, kv=str(eng._cache.k.dtype))
+    autotune.record("paged_mixed", sig, {"block_q": 8, "dma_depth": 2})
+    autotune.invalidate_cache()  # force the load path, not the write-through
+    assert json.loads(cache.read_text())  # the entry persisted
+
+    kernel_before = paged_attention._paged_mixed_call._cache_size()
+    reqs = [Request(f"rk{i}", [int(x) % cfg.vocab_size for x in p],
+                    SamplingParams(max_tokens=3, temperature=0.0,
+                                   ignore_eos=True))
+            for i, p in enumerate([[5, 6, 7], [3] * 12, [9] * 20])]
+    for r in reqs:
+        eng.add_request(r)
+    for _ in range(600):
+        eng.step(block_s=0.01)
+        if (eng.num_running == 0 and eng._queue.empty()
+                and not eng._prefilling):
+            break
+    for r in reqs:
+        assert _drain(r).finished
+
+    # The tuned entry reached the resolved plan (counters memoize it).
+    plan = eng._grid_plans[eng._mixed_budget + 1]
+    assert plan["block_q"] == 8 and plan["grid"] == "ragged", plan
+    # Inside the engine the launcher is INLINED into the jitted step
+    # programs — its own cache must not have grown (no stray eager launch
+    # escaped the step programs).
+    assert paged_attention._paged_mixed_call._cache_size() == kernel_before
+    # Engine-level census unchanged from the dense-grid era.
+    variants = eng.compiled_program_variants()
+    assert sum(variants.values()) <= MIXED_TOTAL_BUDGET, variants
+    assert variants.get("_mixed_fn", 0) == 1, variants
+
+
+def test_mixed_kernel_launcher_variant_census(monkeypatch, tmp_path):
+    """Kernel-family census at the launcher itself (direct calls, where
+    _paged_mixed_call owns its jit cache): repeated calls reuse one
+    variant; an autotune entry matching the heuristic's choice adds ZERO
+    variants (the table swaps static VALUES, it is not a second code
+    path); only a genuinely different tuned block_q compiles one more."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from arks_tpu.ops import autotune
+    from arks_tpu.ops import paged_attention as pa
+
+    cache = tmp_path / "kernel_tune.json"
+    monkeypatch.setenv("ARKS_KERNEL_TUNE", "cached")
+    monkeypatch.setenv("ARKS_KERNEL_TUNE_CACHE", str(cache))
+    monkeypatch.setenv("ARKS_MIXED_GRID", "ragged")
+    autotune.invalidate_cache()
+
+    l, s, hkv, g, maxp, page, d, qmax = 1, 2, 1, 1, 2, 8, 8, 4
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(s, hkv, g, qmax, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(l, s * maxp, hkv, page, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=kp.shape), jnp.float32)
+    tables = jnp.arange(s * maxp, dtype=jnp.int32).reshape(s, maxp)
+    pos = jnp.array([3, 0], jnp.int32)
+    qlen = jnp.array([2, 4], jnp.int32)
+
+    def launch():
+        out = pa.paged_mixed_attention(q, kp, vp, tables, pos, qlen, 0,
+                                       interpret=True)
+        return np.asarray(out)
+
+    before = pa._paged_mixed_call._cache_size()
+    launch()
+    assert pa._paged_mixed_call._cache_size() == before + 1
+    launch()  # same resolved plan -> cache hit
+    assert pa._paged_mixed_call._cache_size() == before + 1
+
+    sig = autotune.mixed_signature(hkv=hkv, g=g, d=d, page=page, qmax=qmax,
+                                   kv="float32")
+    # Entry matching the heuristic (block_q = min(qmax, 32) = qmax): the
+    # cached table must round-trip into the SAME compiled variant.
+    autotune.record("paged_mixed", sig, {"block_q": qmax, "dma_depth": 2})
+    autotune.invalidate_cache()
+    launch()
+    assert pa._paged_mixed_call._cache_size() == before + 1
+    # A genuinely different tuned block_q is one more variant, exactly.
+    autotune.record("paged_mixed", sig, {"block_q": 2, "dma_depth": 2})
+    autotune.invalidate_cache()
+    launch()
+    assert pa._paged_mixed_call._cache_size() == before + 2

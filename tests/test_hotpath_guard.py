@@ -65,6 +65,11 @@ HOT_PATH_FUNCTIONS = (
     "_preempt_replay",
     "_service_swapped",
     "_resume_swapped",
+    # Ragged-grid padding-waste counters: both mixed issuers call this per
+    # dispatch.  It reads the host-side numpy batch arrays the issuer
+    # already built — fetching device state here would reintroduce the
+    # per-step stall on every single mixed dispatch.
+    "_mixed_grid_counters",
 )
 
 # Sketch export surface: runs on SERVER threads, but the same contract
@@ -193,6 +198,91 @@ def test_no_blocking_fetches_in_stream_scatter_helpers():
         violations += _blocking_calls(name, funcs[name])
     assert not violations, (
         f"blocking device fetch in the weight-streaming path: {violations}")
+
+
+def _module_funcs(mod, names):
+    """FunctionDef nodes for module-level functions, asserting presence."""
+    src = inspect.getsource(mod)
+    tree = ast.parse(src)
+    funcs = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    missing = [f for f in names if f not in funcs]
+    assert not missing, f"guarded helpers renamed/removed: {missing}"
+    return [funcs[n] for n in names]
+
+
+# Work-list / grid-plan helpers that run per mixed dispatch (the ragged
+# grid's launch-parameter resolution), plus the autotune CACHE-LOAD path
+# that mixed_grid_plan consults.  All of them sit upstream of every mixed
+# issue — same zero-host-sync contract as the issuers themselves.
+# build_mixed_work_list is traceable jnp on purpose (the pipelined
+# dispatches derive q_len on device); mixed_grid_steps deliberately takes
+# already-host numpy without np.asarray.
+GRID_PLAN_HELPERS = {
+    "arks_tpu.ops.paged_attention": (
+        "mixed_grid_mode", "mixed_grid_plan", "build_mixed_work_list"),
+    "arks_tpu.engine.paged": ("mixed_grid_steps",),
+    "arks_tpu.ops.autotune": ("lookup", "_load_locked", "mixed_signature",
+                              "decode_signature"),
+}
+
+
+def test_no_blocking_fetches_in_grid_plan_helpers():
+    import importlib
+    violations = []
+    for mod_name, names in GRID_PLAN_HELPERS.items():
+        mod = importlib.import_module(mod_name)
+        for node in _module_funcs(mod, names):
+            violations += _blocking_calls(f"{mod_name}.{node.name}", node)
+    assert not violations, (
+        f"blocking device fetch in a grid-plan/autotune-load helper: "
+        f"{violations}")
+
+
+def test_no_sweep_reachable_from_step_loop():
+    """The autotune lookup/ensure split: the step loop (hot-path issuers
+    and the grid-plan helpers they call) may only ever take the PURE READ
+    side (autotune.lookup).  A sweep() or ensure() call — which compiles
+    and times candidate kernels — belongs exclusively in warm-up
+    (_warm_autotune, before the first dispatch)."""
+    import importlib
+
+    def sweep_calls(func_name, tree):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute):
+                # autotune.sweep / autotune.ensure / self._warm_autotune;
+                # other receivers' ensure (e.g. the weight pool's
+                # pool.ensure) are unrelated.
+                recv = ast.unparse(f.value)
+                if f.attr == "_warm_autotune" or (
+                        f.attr in ("sweep", "ensure")
+                        and recv.split(".")[-1] == "autotune"):
+                    hit = f"{recv}.{f.attr}"
+            elif isinstance(f, ast.Name) and f.id in ("sweep", "ensure",
+                                                      "_warm_autotune"):
+                hit = f.id
+            if hit:
+                out.append((func_name, hit, node.lineno))
+        return out
+
+    src = inspect.getsource(engine_mod)
+    module = ast.parse(src)
+    cls = next(n for n in module.body
+               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
+    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+    violations = []
+    for name in HOT_PATH_FUNCTIONS:
+        violations += sweep_calls(name, funcs[name])
+    for mod_name, names in GRID_PLAN_HELPERS.items():
+        mod = importlib.import_module(mod_name)
+        for node in _module_funcs(mod, names):
+            violations += sweep_calls(f"{mod_name}.{node.name}", node)
+    assert not violations, (
+        f"autotune sweep reachable from the step loop: {violations}")
 
 
 def test_resolve_tails_exist():

@@ -10,12 +10,16 @@ import pytest
 
 from arks_tpu.ops.attention import decode_attention_xla, _decode_attention_xla_quant
 from arks_tpu.ops.paged_attention import (
+    build_mixed_work_list,
+    mixed_grid_plan,
+    pack_int4,
     paged_decode_attention,
     paged_gather_kv,
     paged_kv_update,
     paged_kv_update_quant,
     paged_mixed_attention,
     paged_update_xla,
+    unpack_int4,
 )
 
 
@@ -266,6 +270,202 @@ def test_mixed_step_verify_rows_match_verify_step(quantized):
     # The written KV rows agree too (the next dispatch reads them).
     np.testing.assert_allclose(np.asarray(pool_b.k), np.asarray(pool_a.k),
                                atol=1e-5)
+
+
+def _setup_int4(l=2, b=4, hkv=2, g=3, max_pages=4, page=128, d=32, seed=0):
+    """int4 pool (packed token pairs) + the UNPACKED int8 twin for oracles."""
+    n = b * max_pages + 2
+    ks = jax.random.split(jax.random.PRNGKey(seed), 8)
+    k8 = jax.random.randint(ks[0], (l, n, hkv, page, d), -7, 8, jnp.int8)
+    v8 = jax.random.randint(ks[1], (l, n, hkv, page, d), -7, 8, jnp.int8)
+    kps = jax.random.uniform(ks[4], (l, n, hkv, page), jnp.float32, 0.01, 0.03)
+    vps = jax.random.uniform(ks[5], (l, n, hkv, page), jnp.float32, 0.01, 0.03)
+    kp = pack_int4(k8, axis=3)
+    vp = pack_int4(v8, axis=3)
+    q = jax.random.normal(ks[2], (b, hkv, g, d), jnp.float32)
+    perm = jax.random.permutation(ks[3], n)[: b * max_pages]
+    tables = perm.reshape(b, max_pages).astype(jnp.int32)
+    return q, (kp, vp), (k8, v8), kps, vps, tables
+
+
+def test_pack_unpack_int4_roundtrip():
+    vals = jax.random.randint(jax.random.PRNGKey(0), (2, 3, 8, 5), -7, 8,
+                              jnp.int8)
+    packed = pack_int4(vals, axis=2)
+    assert packed.shape == (2, 3, 4, 5)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed, axis=2)),
+                                  np.asarray(vals))
+
+
+@pytest.mark.parametrize("block_q", [2, 4, 8])
+def test_paged_mixed_attention_int4_matches_oracle(block_q):
+    """int4 cells of the oracle-parity matrix: the packed pool through the
+    mixed kernel equals (a) the XLA oracle on the unpacked pool and (b)
+    the mixed kernel fed the unpacked int8 pool BITWISE — dequant fused on
+    the page stream changes no math.  Includes a verify block crossing a
+    page boundary and an inactive lane."""
+    page = 128
+    q, (kp, vp), (k8, v8), kps, vps, tables = _setup_int4(page=page)
+    b, hkv, g, d = q.shape
+    qmax = 8
+    qm = jax.random.normal(jax.random.PRNGKey(3), (b, hkv, g, qmax, d),
+                           jnp.float32)
+    # Lane 1's rows cross the page boundary; lane 3 is inactive.
+    pos_start = jnp.asarray([5, page - 2, 0, 3], jnp.int32)
+    q_len = jnp.asarray([1, qmax, 3, 0], jnp.int32)
+    for layer in (0, 1):
+        out = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len,
+                                    layer, k_scale=kps, v_scale=vps,
+                                    block_q=block_q, interpret=True)
+        twin = paged_mixed_attention(qm, k8, v8, tables, pos_start, q_len,
+                                     layer, k_scale=kps, v_scale=vps,
+                                     block_q=block_q, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(twin))
+        ref = _mixed_ref(qm, k8, v8, kps, vps, tables, pos_start, q_len,
+                         layer)
+        for s in range(b):
+            for i in range(int(q_len[s])):
+                np.testing.assert_allclose(
+                    np.asarray(out[s, :, :, i], np.float32), ref[s, :, :, i],
+                    atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("kv", ["f32", "int8", "int4"])
+def test_ragged_and_dense_grids_byte_identical(kv):
+    """The ragged work-list grid and the dense (s, qb, pages) grid share
+    ONE compute body; their outputs must be bitwise identical for every
+    pool dtype — the invariant the engine's stream-identity gate rides."""
+    if kv == "int4":
+        q, (kp, vp), _, kps, vps, tables = _setup_int4()
+    else:
+        q, kp, vp, kps, vps, tables, _ = _setup(
+            quantized=(kv == "int8"), page=128 if kv == "int8" else 16)
+    b, hkv, g, d = q.shape
+    qmax = 8
+    qm = jax.random.normal(jax.random.PRNGKey(5), (b, hkv, g, qmax, d),
+                           jnp.float32)
+    page = kps.shape[3] if kps is not None else kp.shape[3]
+    pos_start = jnp.asarray([5, page - 2, 0, 3], jnp.int32)
+    q_len = jnp.asarray([1, qmax, 3, 0], jnp.int32)
+    kwargs = dict(k_scale=kps, v_scale=vps, block_q=4, interpret=True)
+    ragged = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                   grid="ragged", **kwargs)
+    dense = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                  grid="dense", **kwargs)
+    np.testing.assert_array_equal(np.asarray(ragged), np.asarray(dense))
+    # Depth is a pipelining knob, never a numerics knob.
+    deep = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                 grid="ragged", dma_depth=4,
+                                 k_scale=kps, v_scale=vps, block_q=4,
+                                 interpret=True)
+    np.testing.assert_array_equal(np.asarray(ragged), np.asarray(deep))
+
+
+def test_mixed_all_lanes_inactive_returns_zeros():
+    """q_len = 0 everywhere: the ragged work list is ALL padding (zero real
+    page steps) and the output is defined — all zeros."""
+    q, kp, vp, _, _, tables, _ = _setup(page=16)
+    b, hkv, g, d = q.shape
+    qm = jax.random.normal(jax.random.PRNGKey(1), (b, hkv, g, 4, d),
+                           jnp.float32)
+    zeros = jnp.zeros((b,), jnp.int32)
+    out = paged_mixed_attention(qm, kp, vp, tables, jnp.zeros_like(zeros),
+                                zeros, 0, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros_like(out))
+
+
+def test_mixed_single_item_work_list():
+    """One active lane, one q block: the smallest possible ragged grid
+    still matches the oracle (and the dense grid bitwise)."""
+    q, kp, vp, _, _, tables, _ = _setup(b=1, page=16)
+    _, hkv, g, d = q.shape
+    qm = jax.random.normal(jax.random.PRNGKey(2), (1, hkv, g, 4, d),
+                           jnp.float32)
+    pos_start = jnp.asarray([7], jnp.int32)
+    q_len = jnp.asarray([3], jnp.int32)
+    out = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                block_q=4, grid="ragged", interpret=True)
+    dense = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                  block_q=4, grid="dense", interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(dense))
+    ref = _mixed_ref(qm, kp, vp, None, None, tables, pos_start, q_len, 0)
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(out[0, :, :, i], np.float32),
+                                   ref[0, :, :, i], atol=2e-5, rtol=2e-5)
+
+
+def test_build_mixed_work_list_compaction():
+    """Real items are compacted to the grid front in (seq, qb) order with
+    per-item causal page counts; padding items alias the LAST real item's
+    output block (revisit semantics: no extra flush) with pages=0."""
+    pos = jnp.asarray([5, 128, 0, 3], jnp.int32)
+    qlen = jnp.asarray([1, 5, 3, 0], jnp.int32)
+    seq, qb, pages = build_mixed_work_list(
+        pos, qlen, page=128, block_q=2, num_qb=3, max_pages=3)
+    seq, qb, pages = map(np.asarray, (seq, qb, pages))
+    assert seq.shape == (12,)
+    # Real: (0,0) 1 page; (1,0/1/2) 2 pages each; (2,0/1) 1 page each.
+    np.testing.assert_array_equal(seq[:6], [0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(qb[:6], [0, 0, 1, 2, 0, 1])
+    np.testing.assert_array_equal(pages[:6], [1, 2, 2, 2, 1, 1])
+    # Padding aliases the last real item, zero pages.
+    np.testing.assert_array_equal(seq[6:], [2] * 6)
+    np.testing.assert_array_equal(qb[6:], [1] * 6)
+    np.testing.assert_array_equal(pages[6:], [0] * 6)
+
+
+def test_build_mixed_work_list_all_inactive():
+    seq, qb, pages = build_mixed_work_list(
+        jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
+        page=16, block_q=4, num_qb=2, max_pages=4)
+    np.testing.assert_array_equal(np.asarray(pages), np.zeros(6, np.int32))
+
+
+def test_mixed_grid_plan_pads_awkward_qmax():
+    """qmax=33 regression: the old fallback walked block_q down to the
+    largest divisor (11 — a terrible tile); the plan now keeps the tuned
+    block and pads the q axis instead."""
+    plan = mixed_grid_plan(33, hkv=2, g=3, d=32, page=16, kv="float32")
+    assert plan["block_q"] == 32
+    assert plan["qpad"] == 64 and plan["num_qb"] == 2
+    # And the padded grid still matches the oracle end to end.
+    q, kp, vp, _, _, tables, _ = _setup(b=2, page=16)
+    _, hkv, g, d = q.shape
+    qm = jax.random.normal(jax.random.PRNGKey(6), (2, hkv, g, 33, d),
+                           jnp.float32)
+    pos_start = jnp.asarray([0, 3], jnp.int32)
+    q_len = jnp.asarray([33, 1], jnp.int32)
+    out = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                interpret=True)
+    ref = _mixed_ref(qm, kp, vp, None, None, tables, pos_start, q_len, 0)
+    for s in range(2):
+        for i in range(int(q_len[s])):
+            np.testing.assert_allclose(
+                np.asarray(out[s, :, :, i], np.float32), ref[s, :, :, i],
+                atol=2e-5, rtol=2e-5)
+
+
+def test_paged_update_quant_int4_matches_oracle():
+    """int4 RMW update kernel vs the two-parity-pass XLA oracle: packed
+    values bitwise identical; scales allclose (the jitted wrapper compiles
+    amax/7 as a reciprocal multiply — 1-ULP vs the eager oracle)."""
+    _, (kp, vp), _, kps, vps, tables = _setup_int4(page=128)
+    b, hkv, d = 4, 2, 32
+    key = jax.random.PRNGKey(11)
+    kn = jax.random.normal(key, (b, hkv, d), jnp.float32)
+    vn = jax.random.normal(jax.random.fold_in(key, 1), (b, hkv, d),
+                           jnp.float32)
+    # Odd AND even token offsets in one batch: both nibble paths taken.
+    lengths = jnp.asarray([1, 2, 129, 256], jnp.int32)
+    got = paged_kv_update_quant(kp, vp, kps, vps, kn, vn, lengths, tables,
+                                1, interpret=True)
+    ref = paged_update_xla(kp, vp, kps, vps, kn, vn, lengths, tables, 1)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(ref[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(ref[1]))
+    np.testing.assert_allclose(np.asarray(got[2]), np.asarray(ref[2]),
+                               atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[3]), np.asarray(ref[3]),
+                               atol=1e-6, rtol=1e-5)
 
 
 def test_paged_mixed_attention_decode_lane_matches_decode_kernel():

@@ -138,6 +138,21 @@ def test_preempt_swap_streams_byte_identical(monkeypatch, depth):
     assert eng.metrics.requests_parked.get(reason="preempt") == 0
 
 
+def test_preempt_swap_int4_pool_byte_identical(monkeypatch):
+    """int4 KV pool through the preempt-swap path: the swap snapshot
+    gathers raw PACKED pool bytes (nibble pairs + scale stripes), so a
+    preempted-and-resumed victim's stream is byte-identical to the
+    preemption-off run — the int4 counterpart of the swap-mode gate."""
+    kw = dict(kv_cache_dtype="int4")
+    base, _ = _run_scenario(monkeypatch, 0, 64, preempt=False, **kw)
+    got, eng = _run_scenario(monkeypatch, 0, 64, preempt=True, **kw)
+    assert eng._cache.kv_bits == 4
+    assert eng.resolved_config["preempt"] == "swap"
+    assert eng.metrics.requests_preempted_total.total() >= 2
+    assert got == base, "int4 streams diverged across preempt on/off"
+    assert len(eng._swap) == 0 and eng._host.reserved == 0
+
+
 def test_preempt_replay_fallback_byte_identical(monkeypatch):
     """Replay mode (no host tier): preemption discards device state and
     re-enters the victim through token replay — streams still

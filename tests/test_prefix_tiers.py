@@ -159,6 +159,55 @@ def test_quantized_pool_spills_int8_blocks(monkeypatch):
            [(t, f.finish_reason) for t, f in outs[0]]
 
 
+def test_int4_pool_spill_restore_bit_exact(monkeypatch):
+    """kv-int4 pools spill RAW packed pages (token pairs per byte + f32
+    per-token scales — a quarter of the bf16 host bytes) and a restore
+    lands the EXACT bytes back in the pool: stream parity with the tier
+    on/off, plus a direct byte comparison of the restored device page
+    against the spilled host block."""
+    outs = {}
+    for host_mb in (0, 64):
+        cfg, eng = _mk_engine(monkeypatch, host_mb, kv_cache_dtype="int4")
+        assert eng._cache.kv_bits == 4
+        outs[host_mb] = [_run_one(eng, r) for r in _workload(cfg)]
+        if host_mb:
+            assert eng.metrics.prefix_restore_blocks_total.total() > 0
+            blk = next(iter(eng._host._blocks.values()))
+            assert blk["k"].dtype == np.int8
+            # Packed: half the token rows of the scale stripe.
+            assert blk["k"].shape[-2] * 2 == blk["k_scale"].shape[-1]
+    assert [(t, f.finish_reason) for t, f in outs[64]] == \
+           [(t, f.finish_reason) for t, f in outs[0]]
+
+    # Direct bit-exactness: spill a warm prompt's pages, restore them,
+    # and compare the device page bytes against the host block.
+    cfg, eng = _mk_engine(monkeypatch, 64, kv_cache_dtype="int4")
+    warm = [int(x) % cfg.vocab_size for x in range(3, 36)]
+    _run_one(eng, Request("w1", warm, SamplingParams(
+        max_tokens=3, temperature=0.0, ignore_eos=True)))
+    for i in range(5):
+        _run_one(eng, Request(f"c{i}", [(9 + i) % cfg.vocab_size] * 33,
+                              SamplingParams(max_tokens=3, temperature=0.0,
+                                             ignore_eos=True)))
+    from arks_tpu.engine.paged import chain_digests
+    digs = chain_digests(warm, CHUNK, 2)
+    assert all(eng._host.has(d) for d in digs), "spill never landed"
+    host_blks = [{k: np.array(v) for k, v in eng._host._blocks[d].items()}
+                 for d in digs]
+    _run_one(eng, Request("w2", warm, SamplingParams(
+        max_tokens=3, temperature=0.0, ignore_eos=True)))
+    pages = eng._alloc.match(digs)
+    assert len(pages) == 2
+    for pg, blk in zip(pages, host_blks):
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache.k[:, pg]), blk["k"])
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache.v[:, pg]), blk["v"])
+        np.testing.assert_array_equal(
+            np.asarray(eng._cache.k_scale[:, pg]), blk["k_scale"])
+    eng._alloc.decref(pages)
+
+
 def test_abort_while_parked_on_restore(monkeypatch):
     """An abort raised while the request is parked in awaiting_restore
     finishes it as "abort" and releases every page it held (refcount
