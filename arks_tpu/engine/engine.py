@@ -46,10 +46,14 @@ from arks_tpu.engine.tokenizer import Tokenizer
 from arks_tpu.engine.types import PrefilledState, Request, RequestOutput
 from arks_tpu.models.config import ModelConfig
 from arks_tpu.models import transformer as tf
+from arks_tpu.obs import logctx
+from arks_tpu.obs import profiler as prof_mod
+from arks_tpu.obs import trace as trace_mod
 from arks_tpu.utils import metrics as prom
 from arks_tpu import slo as slo_mod
 
 log = logging.getLogger("arks_tpu.engine")
+logctx.install(log)
 
 
 class ContextLengthExceededError(ValueError):
@@ -664,6 +668,7 @@ def _scoped(phase: str):
             hb = self._step_hb
             if hb is not None:
                 self._step_hb = (phase, hb[1])
+            self.trace.evt("", "phase." + phase, "B")
             try:
                 return fn(self, *args, **kwargs)
             except StepFault:
@@ -671,6 +676,8 @@ def _scoped(phase: str):
             except Exception as e:
                 raise StepFault(phase, faults_mod.classify(e),
                                 culprits=self._phase_culprits(phase)) from e
+            finally:
+                self.trace.evt("", "phase." + phase, "E")
         return wrapper
     return deco
 
@@ -806,6 +813,14 @@ class InferenceEngine:
         # may seize that victim's slot by swapping its full decode state
         # to host RAM.  Default OFF — priority stays pure queue ordering.
         self._slo = slo_mod.from_env()
+        # ---- End-to-end tracing + profiler windows (arks_tpu.obs) ------
+        # The tracer records span events from the scheduler seams into
+        # per-thread rings (ARKS_TRACE=0 disables; the step loop may only
+        # call trace.evt — tests/test_hotpath_guard.py enforces it) and
+        # doubles as the flight recorder the watchdog/fault dumps attach.
+        self.trace = trace_mod.Tracer()
+        self.profiler = prof_mod.ProfilerWindows()
+        self._pipe_seq = 0   # pipelined issue->resolve span pairing
         self._preempt_on = os.environ.get("ARKS_PREEMPT", "0") == "1"
         _pm = os.environ.get("ARKS_PREEMPT_MAX_INFLIGHT", "1")
         try:
@@ -2100,6 +2115,19 @@ class InferenceEngine:
             # across a switch at worst hints the active model; the
             # scheduler drops stale hints.
             self._model_prefetch.add(request.model)
+        if self.trace.enabled:
+            # Register the trace context (caller's thread — locking is
+            # fine here) and open the queue span.
+            self.trace.register(
+                request.request_id, ctx=request.trace,
+                tier=self._slo.tier_of(request.params.priority)
+                if self._slo else None)
+            self.trace.evt(request.request_id, "queue", "B")
+            with logctx.bound(request.request_id,
+                              request.trace.trace_id
+                              if request.trace is not None else None):
+                log.debug("request queued: %d prompt tokens, priority %d",
+                          len(request.prompt_ids), request.params.priority)
         self.metrics.num_requests_waiting.inc(1)
         with self._abort_lock:
             self._queued_rids.add(request.request_id)
@@ -2115,6 +2143,7 @@ class InferenceEngine:
 
     def start(self) -> None:
         self._running = True
+        self.trace.start()
         deadline = float(os.environ.get("ARKS_DISPATCH_DEADLINE_S", "0") or 0)
         if deadline > 0:
             # Wedged-dispatch escalation: a device call that never returns
@@ -2139,6 +2168,15 @@ class InferenceEngine:
             {s: st.request.request_id for s, st in self._slots.items()},
             {s: cs.request.request_id for s, cs in self._prefilling.items()},
             self._pending_n, len(self._pipe_inflight), self._queue.qsize())
+        # Flight recorder: the wedge dump ships its own timeline — the
+        # last N span events across every thread ring (this runs on the
+        # watchdog thread; the wedged step loop never pays for it).
+        tail = self.trace.tail()
+        if tail:
+            log.critical("flight recorder (last %d events): %s", len(tail),
+                         "; ".join(
+                             f"{e['t']:.3f} {e['rid'] or '<engine>'} "
+                             f"{e['name']}/{e['ph']}" for e in tail))
 
     def _set_state(self, state: str) -> None:
         self._state = state
@@ -2151,6 +2189,7 @@ class InferenceEngine:
 
     def stop(self) -> None:
         self._running = False
+        self.trace.stop()
         if self._watchdog is not None:
             self._watchdog.stop()
         if self._thread is not None:
@@ -2400,10 +2439,18 @@ class InferenceEngine:
             self._abort_swapped()
 
     def _run_loop(self) -> None:
+        prof = self.profiler
         while self._running:
-            self._step_hb = ("step", time.monotonic())
+            t0 = time.monotonic()
+            self._step_hb = ("step", t0)
             try:
-                progressed = self.step()
+                if prof.active:
+                    # Stamp the live span ids into the device timeline so
+                    # the profile correlates back to the trace store.
+                    with prof.annotate("arks_step", self.trace.live_ids()):
+                        progressed = self.step()
+                else:
+                    progressed = self.step()
                 self._consec_faults = 0
             except Exception as e:
                 # Fault-isolated recovery (engine.faults): quarantine the
@@ -2414,6 +2461,10 @@ class InferenceEngine:
                 progressed = self._recover_from_fault(e)
             finally:
                 self._step_hb = None
+            # Auto-arm hook: a step whose wall time jumps past
+            # ARKS_PROF_AUTO_ARM x the trailing median opens a profiler
+            # window by itself (closed after ARKS_PROF_WINDOW_S).
+            prof.on_step(time.monotonic() - t0)
             if not progressed:
                 time.sleep(0.001)
 
@@ -2459,8 +2510,20 @@ class InferenceEngine:
                   "consecutive=%d); recovering",
                   phase, kind, sorted(culprits) or "-", self._consec_faults,
                   exc_info=cause)
+        # Flight recorder: snapshot the ring tail ONCE and pin it onto
+        # every culprit's eventual trace; the fault dump ships its own
+        # timeline.  (Recovery is a slow path — assembly is allowed here.)
+        self.trace.evt("", "recover", "B", f"{phase}/{kind}")
+        flight_tail = self.trace.tail()
+        if flight_tail:
+            log.error("flight recorder (last %d events): %s",
+                      len(flight_tail), "; ".join(
+                          f"{e['t']:.3f} {e['rid'] or '<engine>'} "
+                          f"{e['name']}/{e['ph']}" for e in flight_tail))
         for rid in culprits:
             self._fault_counts[rid] = self._fault_counts.get(rid, 0) + 1
+            self.trace.evt(rid, "fault", "I", f"{phase}/{kind}")
+            self.trace.attach_tail(rid, flight_tail)
         if self._consec_faults > max(self._fault_retries + 1, 2):
             # Unattributed (or mis-attributed) fault storm: per-request
             # budgets cannot bound it — stop the crash loop.
@@ -2545,8 +2608,11 @@ class InferenceEngine:
                 # The culprit fails ALONE: finish_reason="error" maps to
                 # an OpenAI-style 500 at the HTTP layer.
                 self.metrics.requests_quarantined_total.inc(1)
-                log.warning("quarantining %s after %d faults (%s)", rid,
-                            self._fault_counts[rid], err)
+                with logctx.bound(rid):
+                    log.warning("quarantining %s after %d faults (%s)", rid,
+                                self._fault_counts[rid], err)
+                self.trace.attach_tail(rid, flight_tail)
+                self.trace.evt(rid, "quarantined", "I", err)
                 self._fail_survivor(sv, "error", err)
                 continue
             keep.append(sv)
@@ -2578,6 +2644,7 @@ class InferenceEngine:
                 else:
                     gate.restart(sv.generated)
                 self._replaying.add(rid)
+                self.trace.evt(rid, "replay", "I", len(sv.generated))
                 prio = req.params.priority - (1 << 20)
                 replay_n += 1
             else:
@@ -2598,6 +2665,10 @@ class InferenceEngine:
             (sv.request.request_id, sv.num_prompt, len(sv.generated))
             for sv in keep], phase=phase, kind=kind)
         self._reset_device_state()
+        self.trace.evt("", "recover", "E")
+        # Assemble NOW so quarantined timelines are retained even if the
+        # process dies before the collector's next pass.
+        self.trace.flush()
         if not replay_n:
             self._finish_recovery()
 
@@ -3367,6 +3438,9 @@ class InferenceEngine:
         # the batch's full prompt KV in HBM for the deferral window.
         if self._paged or self._prefix is None or m > 1:
             ks = vs = None
+        for req, ids, _ in items:
+            self.trace.evt(req.request_id, "queue", "E")
+            self.trace.evt(req.request_id, "prefill", "B", len(ids))
         return (items, slots_l, first_ids, lp_out, ks, vs)
 
     def _resolve_admit_batch(self, rec) -> None:
@@ -3550,6 +3624,7 @@ class InferenceEngine:
         if not self._host_tier_on():
             return
         victims = [(d, p) for d, p in victims if not self._host.has(d)]
+        self.trace.evt("", "spill", "I", len(victims))
         G = self._spill_group
         for i in range(0, len(victims), G):
             grp = victims[i: i + G]
@@ -3650,6 +3725,7 @@ class InferenceEngine:
             request=req, ids=ids, digests=digests, shared=shared,
             pages=pages, marker=marker, seed=seed, t0=time.monotonic()))
         self.metrics.num_requests_waiting.inc(1)
+        self.trace.evt(req.request_id, "park.restore", "B", len(blocks))
 
     def _dispatch_restore_group(self, blocks: list, pages: list[int],
                                 G: int):
@@ -3795,6 +3871,7 @@ class InferenceEngine:
             self.metrics.prefix_cache_usage_bytes.set(
                 self._alloc.retained_pages * self._page_bytes,
                 tier="device")
+            self.trace.evt(rid, "park.restore", "E")
             self._start_chunked(
                 rec.request, rec.ids,
                 prefix_len=(start + len(rec.pages)) * page,
@@ -4046,6 +4123,7 @@ class InferenceEngine:
                                                 jnp.asarray(slot, jnp.int32))
         self._swap_pending.append(_SwapState(rec=rec, staged=staged, row=row))
         self._preempt_last[rid] = time.monotonic()
+        self.trace.evt(rid, "park.preempt", "B", n_pages)
         self.metrics.requests_preempted_total.inc(
             1, tier=self._slo.tier_of(p.priority))
         self.metrics.num_requests_running.set(len(self._slots))
@@ -4086,6 +4164,7 @@ class InferenceEngine:
             self._sampling = self._clear_pen_fn(self._sampling,
                                                 jnp.asarray(slot, jnp.int32))
         self._preempt_last[rid] = time.monotonic()
+        self.trace.evt(rid, "park.preempt", "B", "replay")
         self.metrics.requests_preempted_total.inc(
             1, tier=self._slo.tier_of(p.priority))
         self.metrics.num_requests_running.set(len(self._slots))
@@ -4305,6 +4384,7 @@ class InferenceEngine:
         self.metrics.num_requests_running.set(len(self._slots))
         self.metrics.preempt_swap_seconds.observe(
             time.monotonic() - res.t0)
+        self.trace.evt(rec.request.request_id, "park.preempt", "E")
         log.info("resumed %s after preempt swap (slot %d, %d pages)",
                  rec.request.request_id, slot, len(res.pages))
 
@@ -4431,6 +4511,7 @@ class InferenceEngine:
             return
         self._awaiting_model.append((req, want, time.monotonic()))
         self.metrics.num_requests_waiting.inc(1)
+        self.trace.evt(req.request_id, "park.model", "B", want)
         self._switch_t0.setdefault(want, time.monotonic())
         self._update_parked()
 
@@ -4619,6 +4700,7 @@ class InferenceEngine:
                 self._queue_seq += 1
                 seq = self._queue_seq
             self._queue.put((req.params.priority, seq, req))
+            self.trace.evt(req.request_id, "park.model", "E")
         self._awaiting_model = keep
         self._switch_t0.pop(name, None)
         self._update_parked()
@@ -4871,6 +4953,7 @@ class InferenceEngine:
                 return got.error
             self._awaiting_guide.append((req, got))
             self.metrics.num_requests_waiting.inc(1)
+            self.trace.evt(req.request_id, "park.guide", "B")
             return "park"
         return "guide evicted repeatedly during admission"
 
@@ -4896,6 +4979,7 @@ class InferenceEngine:
             if not ticket.event.is_set():
                 still.append((req, ticket))
                 continue
+            self.trace.evt(req.request_id, "park.guide", "E")
             if ticket.error is not None:
                 self.metrics.num_requests_waiting.inc(-1)
                 req.outputs.put(RequestOutput(
@@ -5039,6 +5123,7 @@ class InferenceEngine:
             # suppression as a fault replay (the gate drops the delivered
             # prefix), but it is not a recovery — don't count it as one.
             self._resuming.discard(req.request_id)
+            self.trace.evt(req.request_id, "park.preempt", "E")
         st.generated.append(first)
         if first_lp is not None:
             st.logprobs.append(first_lp)
@@ -5064,6 +5149,16 @@ class InferenceEngine:
             self.metrics.time_to_first_token_seconds.observe(ttft)
             self.metrics.ttft_seconds.observe(
                 ttft, tier=self._slo.tier_of(p_.priority))
+        if self.trace.enabled:
+            self.trace.evt(req.request_id, "prefill", "E")
+            if not replaying and not resumed:
+                self.trace.evt(req.request_id, "first_token", "I", ttft)
+                tier = (self._slo.get(self._slo.tier_of(p_.priority))
+                        if self._slo else None)
+                if (tier is not None and tier.ttft_ms is not None
+                        and ttft * 1000.0 > tier.ttft_ms):
+                    self.trace.evt(req.request_id, "slo_violation", "I",
+                                   (ttft * 1000.0, tier.ttft_ms))
 
         if self._check_finished(slot):
             return
@@ -5197,6 +5292,8 @@ class InferenceEngine:
                                              key=jnp.asarray(
                                                  sampler_mod.np_prng_key(seed)),
                                              digests=digests)
+        self.trace.evt(req.request_id, "queue", "E")
+        self.trace.evt(req.request_id, "prefill", "B", len(ids))
         # Interleaved decode dispatches write garbage KV rows for every slot
         # at its length index; pointing this slot's length at the FINAL
         # prompt position keeps those writes beyond every masked read until
@@ -5221,6 +5318,7 @@ class InferenceEngine:
         c = self._chunk
         chunk = st.ids[st.pos: st.pos + c]
         valid = len(chunk)
+        self.trace.evt(rid, "chunk", "I", st.pos)
         padded = np.zeros((c,), np.int32)
         padded[:valid] = chunk
         try:
@@ -5645,6 +5743,8 @@ class InferenceEngine:
                 payload.update(spec_enable=self._pipe_cols_np[2].copy())
         self._emit("decode_pipe", **payload)
         t0 = time.monotonic()
+        self._pipe_seq += 1
+        self.trace.evt("", "pipe", "B", self._pipe_seq)
         if spec:
             out = self._pipe_call(want_lp, self.params, self._draft_params,
                                   self._cache, self._draft_cache, *state,
@@ -5712,6 +5812,7 @@ class InferenceEngine:
         now = time.monotonic()
         self.metrics.decode_resolve_wait_seconds_total.inc(
             now - t_wait, mode="pipelined")
+        self.trace.evt("", "pipe", "E", len(snapshot))
         # TPOT from resolve interarrival: in steady state one resolve
         # lands per dispatch, so the gap IS the per-dispatch device time —
         # this dispatch's own issue->resolve span covers the whole
@@ -6448,3 +6549,4 @@ class InferenceEngine:
         self.metrics.e2e_request_latency_seconds.observe(now - st.request.arrival_time)
         self.metrics.request_success_total.inc(reason=reason)
         self.metrics.num_requests_running.set(len(self._slots))
+        self.trace.evt(st.request.request_id, "finish", "I", reason)

@@ -104,6 +104,11 @@ class Request:
     # the engine's primary model.  Requests for a non-active model park in
     # the ``awaiting_model`` state until the scheduler switches to it.
     model: str | None = None
+    # End-to-end tracing: the W3C trace context for this request
+    # (arks_tpu.obs.trace.TraceCtx), carrying the gateway-minted trace id
+    # and any upstream (gateway/router) spans.  None = untraced or an
+    # engine-local request; the engine mints a local trace id on demand.
+    trace: object | None = None
 
 
 @dataclasses.dataclass

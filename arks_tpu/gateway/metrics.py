@@ -20,14 +20,15 @@ class GatewayMetrics:
             "Gateway-side processing time",
             buckets=[1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000])
         self.token_usage = r.counter(
-            "gateway_token_usage", "Token usage by type")
+            "gateway_token_usage_total", "Token usage by type")
         self.token_distribution = r.histogram(
             "gateway_token_distribution", "Per-request total tokens",
             buckets=[2 ** i for i in range(0, 17)])
         self.rate_limit_hits_total = r.counter(
             "gateway_rate_limit_hits_total", "Rate-limit rejections by rule")
         self.rate_limit_tokens = r.counter(
-            "gateway_rate_limit_tokens", "Tokens counted toward rate limits")
+            "gateway_rate_limit_tokens_total",
+            "Tokens counted toward rate limits")
         self.quota_usage = r.gauge("gateway_quota_usage", "Quota used")
         self.quota_limit = r.gauge("gateway_quota_limit", "Quota limit")
         self.errors_total = r.counter(

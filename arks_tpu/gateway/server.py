@@ -46,8 +46,16 @@ from arks_tpu.gateway.ratelimiter import (
 from arks_tpu.control.resources import (
     QUOTA_PROMPT, QUOTA_RESPONSE, QUOTA_TOTAL, RL_RPM, RL_TPM,
 )
+from arks_tpu.obs import logctx
+from arks_tpu.obs import trace as trace_mod
 
 log = logging.getLogger("arks_tpu.gateway")
+logctx.install(log)
+
+# End-to-end tracing: the gateway is the trace ROOT — it mints the W3C
+# trace id, completes its admit span, and forwards both downstream
+# (traceparent + x-arks-trace-spans); the engine's store assembles them.
+_TRACE_ON = os.environ.get("ARKS_TRACE", "1") != "0"
 
 DEFAULT_RPM = 100            # types.go:24-64
 DEFAULT_TPM_MULTIPLIER = 1000
@@ -479,11 +487,20 @@ class Gateway:
         qos = None
         status = 500
         tier = None
+        ctx = (trace_mod.TraceCtx.from_headers(handler.headers)
+               if _TRACE_ON else None)
         try:
-            qos, body, limits, tier = self._admit(handler)
-            # Admitted demand feeds the autoscaler's per-endpoint rate.
-            self.rate.record(qos.namespace, qos.endpoint)
-            status = self._proxy(handler, qos, body, limits, tier)
+            with logctx.bound(trace_id=ctx.trace_id if ctx else None):
+                qos, body, limits, tier = self._admit(handler)
+                if ctx is not None:
+                    ctx.upstream.append({
+                        "component": "gateway", "name": "gateway.admit",
+                        "start": t0, "end": time.monotonic(),
+                        "arg": qos.username})
+                # Admitted demand feeds the autoscaler's per-endpoint rate.
+                self.rate.record(qos.namespace, qos.endpoint)
+                status = self._proxy(handler, qos, body, limits, tier,
+                                     ctx=ctx)
         except _ApiError as e:
             status = e.code
             self.metrics.errors_total.inc(stage=e.stage or "other")
@@ -516,10 +533,18 @@ class Gateway:
             self.metrics.request_duration.observe(time.monotonic() - t0)
 
     def _proxy(self, handler, qos: TokenQos, body: dict,
-               limits: dict[str, int], tier: str | None = None) -> int:
+               limits: dict[str, int], tier: str | None = None,
+               ctx=None) -> int:
         payload = json.dumps(body).encode()
         stream = bool(body.get("stream", False))
         last_err: Exception | None = None
+        trace_headers = {}
+        if ctx is not None:
+            fwd = ctx.child()
+            trace_headers[trace_mod.TRACEPARENT_HEADER] = fwd.traceparent()
+            if fwd.upstream:
+                trace_headers[trace_mod.SPANS_HEADER] = \
+                    trace_mod.spans_header(fwd.upstream)
         for addr in self._pick_backends(qos.namespace, qos.endpoint):
             host, _, port = addr.partition(":")
             conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
@@ -531,6 +556,7 @@ class Gateway:
                     HDR_NAMESPACE: qos.namespace,
                     HDR_USER: qos.username,
                     **({HDR_TIER: tier} if tier is not None else {}),
+                    **trace_headers,
                 })
                 resp = conn.getresponse()
             except OSError as e:

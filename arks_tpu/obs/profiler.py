@@ -1,0 +1,105 @@
+"""On-demand ``jax.profiler`` windows.
+
+Armed two ways:
+
+- **HTTP**: ``POST /v1/profiler/start`` / ``POST /v1/profiler/stop`` on
+  the serving port — writes a profiler trace dir an operator can open in
+  TensorBoard / Perfetto.
+- **Auto-arm**: when a step's wall time jumps past
+  ``ARKS_PROF_AUTO_ARM`` × the trailing median step time (default 0 =
+  off), a window of ``ARKS_PROF_WINDOW_S`` seconds opens by itself — the
+  profile of the anomaly, captured while it is still happening.
+
+While a window is active the engine run loop wraps each step in a
+``jax.profiler.TraceAnnotation`` carrying the live request/trace ids, so
+device timelines correlate back to the span timelines in the TraceStore.
+All hooks are called from the run loop (not the guarded hot-path
+functions) and early-return to a couple of float compares when idle.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+
+
+class ProfilerWindows:
+    def __init__(self, base_dir: str | None = None) -> None:
+        self.base_dir = base_dir or os.environ.get(
+            "ARKS_PROF_DIR", "/tmp/arks-prof")
+        self.auto_mult = float(os.environ.get("ARKS_PROF_AUTO_ARM", "0") or 0)
+        self.window_s = float(os.environ.get("ARKS_PROF_WINDOW_S", "5"))
+        self.active = False
+        self.dir: str | None = None
+        self.auto_armed_total = 0
+        self._lock = threading.Lock()
+        self._auto_end: float | None = None
+        self._steps: collections.deque = collections.deque(maxlen=128)
+
+    def start(self, logdir: str | None = None) -> dict:
+        """Open a profiler window.  Returns {"ok", "dir"} or an error."""
+        with self._lock:
+            if self.active:
+                return {"ok": False, "error": "already_active",
+                        "dir": self.dir}
+            d = logdir or os.path.join(
+                self.base_dir, time.strftime("%Y%m%d-%H%M%S"))
+            try:
+                os.makedirs(d, exist_ok=True)
+                import jax
+                jax.profiler.start_trace(d)
+            except Exception as e:
+                return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            self.dir = d
+            self.active = True
+            return {"ok": True, "dir": d}
+
+    def stop(self) -> dict:
+        with self._lock:
+            if not self.active:
+                return {"ok": False, "error": "not_active"}
+            self.active = False
+            self._auto_end = None
+            d, self.dir = self.dir, None
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:
+                return {"ok": False, "error": f"{type(e).__name__}: {e}",
+                        "dir": d}
+            return {"ok": True, "dir": d}
+
+    def on_step(self, dur_s: float) -> None:
+        """Run-loop hook: feed one step's wall time.  Closes an expired
+        auto window; opens one when the step time spikes past
+        ``auto_mult`` × the trailing median."""
+        if self.active:
+            if self._auto_end is not None and time.monotonic() > self._auto_end:
+                self.stop()
+            return
+        if self.auto_mult <= 0:
+            return
+        steps = self._steps
+        steps.append(dur_s)
+        if len(steps) < 32:
+            return
+        ordered = sorted(steps)
+        med = ordered[len(ordered) // 2]
+        if med > 0 and dur_s > self.auto_mult * med:
+            r = self.start()
+            if r.get("ok"):
+                self._auto_end = time.monotonic() + self.window_s
+                self.auto_armed_total += 1
+
+    def annotate(self, name: str, ids: str = ""):
+        """A ``jax.profiler.TraceAnnotation`` stamping the live span ids
+        into the device timeline; a null context if jax is unavailable."""
+        try:
+            import jax
+            label = f"{name}[{ids}]" if ids else name
+            return jax.profiler.TraceAnnotation(label)
+        except Exception:
+            return contextlib.nullcontext()

@@ -50,8 +50,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from arks_tpu import prefix_sketch as sketch_mod
 from arks_tpu.gateway.metrics import RouterMetrics
+from arks_tpu.obs import logctx
+from arks_tpu.obs import trace as trace_mod
 
 log = logging.getLogger("arks_tpu.router")
+logctx.install(log)
+
+# Trace propagation rides the same switch the engine tracer uses; the
+# router keeps no span store of its own — its completed spans travel in
+# the x-arks-trace-spans header and assemble engine-side.
+_TRACE_ON = os.environ.get("ARKS_TRACE", "1") != "0"
 
 HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
 HDR_TIER = "x-arks-tier"   # SLO tier (arks_tpu.slo), forwarded verbatim
@@ -461,21 +469,33 @@ class Router:
         # Always drain the body first: an early error response with the body
         # unread desyncs HTTP/1.1 keep-alive connections.
         body = h.rfile.read(int(h.headers.get("Content-Length", 0)))
+        # Continue the gateway-propagated trace (or root one for direct
+        # clients); the pick span completes here and travels downstream in
+        # the spans header — the engine's store is the assembly point.
+        ctx = (trace_mod.TraceCtx.from_headers(h.headers)
+               if _TRACE_ON else None)
         try:
-            prefill, decode = self.discovery.backends()
-            if self.unified:
-                # Unified deployments list their backends under "decode"
-                # (or only set ARKS_DECODE_ADDRS); there is no prefill
-                # tier to pick.
-                prefill = []
-            self.backends_gauge.set(len(prefill), role="prefill")
-            self.backends_gauge.set(len(decode), role="decode")
-            if not decode or (not prefill and not self.unified):
-                status = 503
-                return h._error(503, "no ready prefill/decode backends")
-            p, candidates = self._pick(body, prefill, decode)
-            status = self._forward_failover(h, body, p, candidates[0],
-                                            candidates, started)
+            with logctx.bound(trace_id=ctx.trace_id if ctx else None):
+                prefill, decode = self.discovery.backends()
+                if self.unified:
+                    # Unified deployments list their backends under
+                    # "decode" (or only set ARKS_DECODE_ADDRS); there is
+                    # no prefill tier to pick.
+                    prefill = []
+                self.backends_gauge.set(len(prefill), role="prefill")
+                self.backends_gauge.set(len(decode), role="decode")
+                if not decode or (not prefill and not self.unified):
+                    status = 503
+                    return h._error(503, "no ready prefill/decode backends")
+                t0 = time.monotonic()
+                p, candidates = self._pick(body, prefill, decode)
+                if ctx is not None:
+                    ctx.upstream.append({
+                        "component": "router", "name": "router.pick",
+                        "start": t0, "end": time.monotonic(),
+                        "arg": candidates[0]})
+                status = self._forward_failover(h, body, p, candidates[0],
+                                                candidates, started, ctx=ctx)
         except (BrokenPipeError, ConnectionResetError):
             status = 499
         except Exception as e:
@@ -599,7 +619,7 @@ class Router:
 
     def _forward_failover(self, h, body: bytes, prefill_addr: str,
                           decode_addr: str, decode: list[str],
-                          started: list[bool]) -> int:
+                          started: list[bool], ctx=None) -> int:
         """Backend failover: the picked decode backend first, then every
         other ready one, retried for ONE bounded backoff round — a request
         moves to the next backend on a connection error or a 503
@@ -620,7 +640,7 @@ class Router:
                         self._inflight[cand] = self._inflight.get(cand, 0) + 1
                     try:
                         status, ra = self._forward(h, body, prefill_addr,
-                                                   cand, started)
+                                                   cand, started, ctx=ctx)
                     finally:
                         with self._load_lock:
                             self._inflight[cand] -= 1
@@ -659,7 +679,8 @@ class Router:
         return 503
 
     def _forward(self, h, body: bytes, prefill_addr: str, decode_addr: str,
-                 started: list[bool]) -> tuple[int | None, str | None]:
+                 started: list[bool], ctx=None
+                 ) -> tuple[int | None, str | None]:
         """Forward to one decode backend.  Returns (status, None) after
         relaying, or (None, retry_after) for a 503 swallowed BEFORE any
         byte reached the client (the failover input).  Raises OSError /
@@ -677,6 +698,15 @@ class Router:
         tier = h.headers.get(HDR_TIER)
         if tier:
             headers[HDR_TIER] = tier
+        if ctx is not None:
+            # Each attempt gets its own span id under the same trace id
+            # (a retry is a new hop); the accumulated upstream spans ride
+            # along for the engine-side assembly.
+            fwd = ctx.child()
+            headers[trace_mod.TRACEPARENT_HEADER] = fwd.traceparent()
+            if fwd.upstream:
+                headers[trace_mod.SPANS_HEADER] = trace_mod.spans_header(
+                    fwd.upstream)
         host, _, port = decode_addr.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
         try:

@@ -29,6 +29,9 @@ from arks_tpu import slo as slo_mod
 from arks_tpu.engine.engine import InferenceEngine
 from arks_tpu.engine.tokenizer import IncrementalDetokenizer
 from arks_tpu.engine.types import Request, SamplingParams
+from arks_tpu.obs import logctx
+from arks_tpu.obs import perfetto as perfetto_mod
+from arks_tpu.obs import trace as trace_mod
 
 # SLO tier header (gateway/router forward it; arks_tpu.gateway.server
 # validates it against the same ARKS_SLO_TIERS ladder).
@@ -267,6 +270,32 @@ class OpenAIServer:
                     self.wfile.write(text)
                 elif self.path in ("/healthz", "/health"):
                     self._json(200, {"status": "ok"})
+                elif self.path == "/v1/traces/export":
+                    # Chrome trace-event JSON of every retained trace —
+                    # open at ui.perfetto.dev / chrome://tracing.
+                    tracer = server.engine.trace
+                    tracer.flush()
+                    self._json(200, perfetto_mod.chrome_trace(
+                        tracer.store.all(), tracer.phase_spans()))
+                elif self.path == "/v1/traces":
+                    tracer = server.engine.trace
+                    tracer.flush()
+                    self._json(200, {"traces": [
+                        {"trace_id": t["trace_id"],
+                         "request_id": t["request_id"],
+                         "flags": t["flags"], "tier": t.get("tier"),
+                         "spans": len(t["spans"])}
+                        for t in tracer.store.all()]})
+                elif self.path.startswith("/v1/traces/"):
+                    # By trace id OR request id.
+                    tracer = server.engine.trace
+                    tracer.flush()
+                    tr = tracer.store.get(self.path[len("/v1/traces/"):])
+                    if tr is None:
+                        self._error(404, "trace not found (expired, "
+                                    "sampled out, or still in flight)")
+                    else:
+                        self._json(200, tr)
                 elif self.path == "/v1/cache/sketch":
                     # Prefix-digest sketch for cache-aware routing: a
                     # compact per-tier summary of the digest chains this
@@ -317,6 +346,14 @@ class OpenAIServer:
                     body = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError):
                     return self._error(400, "invalid JSON body")
+                if self.path == "/v1/profiler/start":
+                    # On-demand jax.profiler window (operator tooling —
+                    # exempt from the drain gate, like GET diagnostics).
+                    return self._json(
+                        200, server.engine.profiler.start(
+                            body.get("logdir") or None))
+                if self.path == "/v1/profiler/stop":
+                    return self._json(200, server.engine.profiler.stop())
                 # Admission check and active-count increment are ATOMIC:
                 # drain() waiting for _active == 0 is then guaranteed no
                 # handler slips in after its last look.
@@ -555,6 +592,14 @@ class OpenAIServer:
             note(body, batch[0])
 
         import dataclasses as _dc
+        # W3C trace context: continue the gateway/router-propagated trace
+        # (folding in their completed spans from the x-arks-trace-spans
+        # header) or mint a fresh root for direct-to-pod clients.  Only a
+        # single-choice request carries it — sibling choices would collide
+        # in the trace store under one trace id; they mint engine-local ids.
+        ctx = (trace_mod.TraceCtx.from_headers(h.headers)
+               if self.engine.trace.enabled else None)
+        single = len(batch) == 1 and n == 1
         reqs = []
         for prompt_ids in batch:
             for j in range(n):
@@ -563,8 +608,11 @@ class OpenAIServer:
                     p = _dc.replace(params, seed=params.seed + j)
                 req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
                               prompt_ids=list(prompt_ids), params=p,
-                              model=engine_model)
-                self.engine.add_request(req)
+                              model=engine_model,
+                              trace=ctx if single else None)
+                with logctx.bound(req.request_id,
+                                  ctx.trace_id if ctx is not None else None):
+                    self.engine.add_request(req)
                 reqs.append(req)
 
         if len(reqs) > 1:

@@ -15,10 +15,18 @@ import threading
 from bisect import bisect_left
 
 
+def _escape_label_value(v: str) -> str:
+    # Prometheus text exposition format: label values escape backslash,
+    # double-quote, and line-feed — in that order (backslash first, or
+    # the other escapes get double-escaped).
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -148,8 +156,17 @@ class Registry:
 
     def _register(self, m):
         with self._lock:
+            for existing in self._metrics:
+                if existing.name == m.name:
+                    raise ValueError(
+                        f"metric family {m.name!r} registered twice")
             self._metrics.append(m)
         return m
+
+    def families(self) -> list[_Metric]:
+        """Snapshot of registered metric families (for conformance tests)."""
+        with self._lock:
+            return list(self._metrics)
 
     def render(self) -> str:
         with self._lock:

@@ -221,10 +221,58 @@ def _series_sum(scraped: dict[str, float], name: str) -> float:
                if k == name or k.startswith(name + "{"))
 
 
+# TTFT leg -> trace span name.  "first_decode" is derived (first_token
+# instant minus prefill end) rather than a recorded span.
+_TTFT_LEGS = (("queue", "queue"), ("guide", "park.guide"),
+              ("restore", "park.restore"), ("model_wait", "park.model"),
+              ("prefill", "prefill"))
+
+
+def _ttft_decomposition(traces, since: float | None = None) -> dict:
+    """Per-phase TTFT split from assembled trace timelines: where the
+    time before the first token actually went.  Each leg is the summed
+    duration of that span family within a trace (a request can park more
+    than once); "first_decode" is the gap between the prefill's end and
+    the first-token instant — the first decode dispatch's issue+resolve.
+    Means are over the traces that HAVE the leg; ``n`` counts them."""
+    import numpy as np
+
+    legs: dict[str, list[float]] = {k: [] for k, _ in _TTFT_LEGS}
+    legs["first_decode"] = []
+    used = 0
+    for t in traces:
+        if since is not None and t["start"] < since:
+            continue
+        used += 1
+        closed: dict[str, float] = {}
+        first = prefill_end = None
+        for s in t["spans"]:
+            if s.get("component") not in (None, "engine"):
+                continue
+            if s["name"] == "first_token":
+                first = s["start"]
+            elif s.get("end") is not None:
+                closed[s["name"]] = closed.get(s["name"], 0.0) \
+                    + (s["end"] - s["start"])
+                if s["name"] == "prefill":
+                    prefill_end = max(prefill_end or 0.0, s["end"])
+        for key, span_name in _TTFT_LEGS:
+            if span_name in closed:
+                legs[key].append(closed[span_name])
+        if first is not None and prefill_end is not None:
+            legs["first_decode"].append(max(0.0, first - prefill_end))
+    out: dict = {"traces": used}
+    for key, vals in legs.items():
+        out[f"{key}_mean_ms"] = (
+            round(float(np.mean(vals)) * 1e3, 3) if vals else None)
+        out[f"{key}_n"] = len(vals)
+    return out
+
+
 def _run_moderate_phase(port: int, slots: int, seconds: float,
                         max_tokens: int, prompt_len: int, probe_len: int,
                         n_chips: int, names: tuple[str, ...],
-                        prefix_len: int = 0) -> dict:
+                        prefix_len: int = 0, engine=None) -> dict:
     """Second load phase at clients ~= slots/4: the north star's
     "p50 TTFT < 200ms under RPM load" is a moderate-load contract — the
     saturation phase answers a different question (TTFT at 100% slot
@@ -265,7 +313,18 @@ def _run_moderate_phase(port: int, slots: int, seconds: float,
     # TTFT probes from the ramp window are dropped for the same reason
     # the token window starts after it.
     mttfts = [v for ts, v in mclient["ttfts"] if ts >= ramp]
+    # Per-phase TTFT split from the server-side traces: the client
+    # subprocess only sees the total, the trace store knows which leg
+    # (queue / guide / restore / model_wait / prefill / first-decode)
+    # the time went to.  Window-scoped via the monotonic clock — bench
+    # and engine share a process.
+    decomp = None
+    if engine is not None and getattr(engine, "trace", None) is not None \
+            and engine.trace.enabled:
+        engine.trace.flush()
+        decomp = _ttft_decomposition(engine.trace.store.all(), since=tm0)
     return {
+        "serving_moderate_ttft_phases": decomp,
         "serving_moderate_clients": mclients,
         "serving_moderate_tok_s_chip": round(
             (m1.get("generation_tokens_total", 0.0)
@@ -502,7 +561,7 @@ def run_serving_bench(model: str | None = None) -> dict:
             try:
                 moderate = _run_moderate_phase(
                     server.port, slots, seconds, max_tokens, prompt_len,
-                    probe_len, n_chips, names, prefix_len)
+                    probe_len, n_chips, names, prefix_len, engine=engine)
             except Exception as e:
                 import traceback
                 traceback.print_exc()
@@ -706,11 +765,12 @@ def run_shared_prefix_bench() -> dict:
                 prompt = histories[ci] + [
                     rng.randrange(3, min(200, vocab))
                     for _ in range(chunk - 4)]
-                toks, ttft, ddev, dhost = _measure(
-                    f"sp-{ci}-{turn}", prompt)
+                rid = f"sp-{ci}-{turn}"
+                toks, ttft, ddev, dhost = _measure(rid, prompt)
                 depth = ("tier1" if dhost > 0
                          else "tier0" if ddev > 0 else "miss")
-                rows.append({"client": ci, "turn": turn, "depth": depth,
+                rows.append({"rid": rid, "client": ci, "turn": turn,
+                             "depth": depth,
                              "hit_dev": ddev, "hit_host": dhost,
                              "prompt_tokens": len(prompt),
                              "ttft_s": ttft})
@@ -722,12 +782,23 @@ def run_shared_prefix_bench() -> dict:
             plen = len(histories[i % clients]) if histories else 76
             prompt = [rng.randrange(3, min(200, vocab))
                       for _ in range(min(plen, 90))]
-            _, ttft, ddev, dhost = _measure(f"sp-cold-{i}", prompt)
+            rid = f"sp-cold-{i}"
+            _, ttft, ddev, dhost = _measure(rid, prompt)
             depth = ("tier1" if dhost > 0
                      else "tier0" if ddev > 0 else "miss")
-            rows.append({"client": -1, "turn": -1, "depth": depth,
+            rows.append({"rid": rid, "client": -1, "turn": -1,
+                         "depth": depth,
                          "hit_dev": ddev, "hit_host": dhost,
                          "prompt_tokens": len(prompt), "ttft_s": ttft})
+        # Per-phase TTFT split from the engine traces, keyed by hit-depth
+        # class: shows WHERE each class's TTFT goes — a tier-1 hit should
+        # trade prefill time for park.restore time, and the trade only
+        # pays if restore+queue comes in under the miss row's prefill.
+        traces_by_rid = {}
+        if eng.trace.enabled:
+            eng.trace.flush()
+            traces_by_rid = {t["request_id"]: t
+                             for t in eng.trace.store.all()}
     finally:
         eng.stop()
 
@@ -754,6 +825,10 @@ def run_shared_prefix_bench() -> dict:
         ts = _ttfts(depth)
         out[f"sp_ttft_{depth}_mean_ms"] = (
             round(float(np.mean(ts)) * 1e3, 2) if ts else None)
+        if traces_by_rid:
+            out[f"sp_ttft_phases_{depth}"] = _ttft_decomposition(
+                [traces_by_rid[r["rid"]] for r in rows
+                 if r["depth"] == depth and r["rid"] in traces_by_rid])
     return out
 
 

@@ -285,6 +285,79 @@ def test_no_sweep_reachable_from_step_loop():
         f"autotune sweep reachable from the step loop: {violations}")
 
 
+def test_trace_calls_on_hot_path_are_evt_only():
+    """The step loop may talk to the tracer through exactly one method:
+    ``self.trace.evt(...)`` — an append to a per-thread ring.  Any other
+    tracer attribute reached from a hot-path function (flush, register,
+    attach_tail, store access...) takes locks or allocates, i.e. it is
+    trace ASSEMBLY leaking onto the issue path."""
+    src = inspect.getsource(engine_mod)
+    module = ast.parse(src)
+    cls = next(n for n in module.body
+               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
+    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+    violations = []
+    for name in HOT_PATH_FUNCTIONS:
+        for node in ast.walk(funcs[name]):
+            if not isinstance(node, ast.Attribute):
+                continue
+            v = node.value
+            if (isinstance(v, ast.Attribute) and v.attr == "trace"
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"
+                    and node.attr not in ("evt", "enabled")):
+                violations.append((name, f"self.trace.{node.attr}",
+                                   node.lineno))
+    assert not violations, (
+        f"non-evt tracer access on the issue-side hot path: {violations}")
+
+
+def test_tracer_evt_is_lock_and_serialization_free():
+    """``Tracer.evt`` and the ``_Ring`` it appends to are the only tracing
+    code the step loop executes.  They must stay free of locks, context
+    managers, serialization, and sleeps — the single sanctioned exception
+    is the first-call-per-thread ring creation inside the AttributeError
+    handler (``self._new_ring()``, which takes the registration lock once
+    per thread lifetime, not per event)."""
+    from arks_tpu.obs import trace as trace_mod
+
+    src = inspect.getsource(trace_mod)
+    module = ast.parse(src)
+    classes = {n.name: n for n in module.body if isinstance(n, ast.ClassDef)}
+    tracer = classes["Tracer"]
+    ring = classes["_Ring"]
+    evt = next(n for n in tracer.body
+               if isinstance(n, ast.FunctionDef) and n.name == "evt")
+
+    def handler_nodes(tree):
+        inside = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler):
+                for sub in ast.walk(node):
+                    inside.add(id(sub))
+        return inside
+
+    violations = []
+    for scope_name, tree in (("Tracer.evt", evt), ("_Ring", ring)):
+        allowed = handler_nodes(tree)
+        for node in ast.walk(tree):
+            if id(node) in allowed:
+                continue
+            bad = None
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                bad = "with-block (lock?)"
+            elif isinstance(node, ast.Attribute) and node.attr in (
+                    "acquire", "Lock", "RLock", "sleep", "dumps", "loads",
+                    "flush", "join"):
+                bad = f".{node.attr}"
+            elif isinstance(node, ast.Name) and node.id in ("json", "pickle"):
+                bad = node.id
+            if bad:
+                violations.append((scope_name, bad, node.lineno))
+    assert not violations, (
+        f"lock/serialization on the event-record path: {violations}")
+
+
 def test_resolve_tails_exist():
     """The guard above is only meaningful while the sanctioned sync tails
     exist under their expected names."""

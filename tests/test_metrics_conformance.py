@@ -1,0 +1,108 @@
+"""Prometheus exposition conformance: label-value escaping and a
+registry-wide metric-name census (naming conventions + no duplicate
+families across the engine, gateway, router, and operator registries)."""
+
+import re
+
+import pytest
+
+from arks_tpu.utils import metrics as prom
+from arks_tpu.utils.metrics import _fmt_labels
+
+
+# ---------------------------------------------------------------- escaping
+
+def test_label_value_backslash_escaped():
+    assert _fmt_labels({"path": r"C:\tmp"}) == '{path="C:\\\\tmp"}'
+
+
+def test_label_value_quote_escaped():
+    assert _fmt_labels({"q": 'say "hi"'}) == '{q="say \\"hi\\""}'
+
+
+def test_label_value_newline_escaped():
+    assert _fmt_labels({"m": "a\nb"}) == '{m="a\\nb"}'
+
+
+def test_label_value_backslash_before_quote_order():
+    # \" in the raw value must become \\\" (escape the backslash first,
+    # then the quote) — not \\" which would terminate the value early.
+    assert _fmt_labels({"v": '\\"'}) == '{v="\\\\\\""}'
+
+
+def test_escaped_render_is_parseable():
+    """A scrape line with hostile label values must round-trip under the
+    Prometheus text-format grammar (no raw newline, balanced quotes)."""
+    reg = prom.Registry()
+    c = reg.counter("hostile_values_total", "escaping probe")
+    c.inc(user='a"b', path="c\\d", note="e\nf")
+    text = reg.render()
+    sample_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("hostile_values_total{")]
+    assert len(sample_lines) == 1
+    line = sample_lines[0]
+    assert "\n" not in line
+    # Every quote inside the label braces is either a delimiter or escaped.
+    body = line[line.index("{") + 1:line.rindex("}")]
+    # Unescape per exposition-format rules and check the originals survive.
+    m = dict(re.findall(r'(\w+)="((?:\\.|[^"\\])*)"', body))
+    unesc = {k: v.replace("\\n", "\n").replace('\\"', '"')
+                  .replace("\\\\", "\\") for k, v in m.items()}
+    assert unesc == {"user": 'a"b', "path": "c\\d", "note": "e\nf"}
+
+
+def test_histogram_le_labels_still_render():
+    reg = prom.Registry()
+    h = reg.histogram("probe_seconds", "h", buckets=[0.1, 1.0])
+    h.observe(0.05, op='x"y')
+    text = reg.render()
+    assert 'le="0.1"' in text and 'op="x\\"y"' in text
+
+
+# ------------------------------------------------------------- duplicates
+
+def test_duplicate_family_rejected():
+    reg = prom.Registry()
+    reg.counter("dup_total", "first")
+    with pytest.raises(ValueError):
+        reg.counter("dup_total", "second")
+    with pytest.raises(ValueError):
+        reg.gauge("dup_total", "different type, same family")
+
+
+# ----------------------------------------------------------------- census
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _all_registries():
+    from arks_tpu.engine.engine import EngineMetrics
+    from arks_tpu.gateway.metrics import GatewayMetrics, RouterMetrics
+    return {
+        "engine": EngineMetrics().registry,
+        "gateway": GatewayMetrics().registry,
+        "router": RouterMetrics().registry,
+    }
+
+
+def test_census_snake_case_and_counter_suffix():
+    for comp, reg in _all_registries().items():
+        for fam in reg.families():
+            assert _NAME_RE.match(fam.name), (comp, fam.name)
+            if fam.type == "counter":
+                assert fam.name.endswith("_total"), (
+                    f"{comp} counter {fam.name!r} must end in _total")
+            else:
+                assert not fam.name.endswith("_total"), (
+                    f"{comp} {fam.type} {fam.name!r} must not end in _total")
+
+
+def test_census_no_family_registered_twice_across_components():
+    seen: dict[str, str] = {}
+    for comp, reg in _all_registries().items():
+        for fam in reg.families():
+            prev = seen.get(fam.name)
+            assert prev is None, (
+                f"family {fam.name!r} registered by both {prev} and {comp}")
+            seen[fam.name] = comp
+    assert len(seen) > 40  # the census actually saw the real registries
