@@ -1,0 +1,132 @@
+"""arkslint — call-graph-aware static analysis for the arks-tpu engine.
+
+The engine's load-bearing invariants (zero-host-sync issue path, visible
+faults, registered configuration knobs, trace-pure jitted functions,
+metric naming) used to live in three hand-grown AST guard tests, each
+gated on a hand-maintained function allowlist that every PR had to
+remember to extend.  This package makes them machine-checked repo-wide:
+
+- ``hotpath``      hot-path purity propagated over the call graph from
+                   the scheduler roots — no hand-listed helper names.
+- ``exceptions``   broad-exception discipline for every module under
+                   ``arks_tpu/`` (engine keeps its stricter contract).
+- ``knobs``        every ``ARKS_*`` env read goes through the typed
+                   registry (``arks_tpu/utils/knobs.py``).
+- ``tracepurity``  no wall-clock / RNG / host-state reads inside
+                   functions handed to ``jax.jit`` / Pallas.
+- ``metrics``      static metric-family census (naming conventions, no
+                   duplicate families across components).
+
+Pure AST over the source tree: the analyzer imports neither JAX nor the
+modules it checks, so it runs anywhere in well under a second.  CLI:
+``python -m arks_tpu.analysis --all`` (or ``tools/arkslint``); reviewed
+suppressions live in ``tools/arkslint-baseline.json``.  See
+``docs/runbook.md`` ("Reading arkslint output").
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+
+__all__ = ["Finding", "SourceTree", "run_rules", "repo_root"]
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.
+
+    ``key()`` is deliberately line-independent (rule, path, qualname,
+    detail) so baseline suppressions survive unrelated edits to the same
+    file; ``check`` names the sub-check within a rule so thin test
+    wrappers can filter.
+    """
+
+    rule: str
+    check: str
+    path: str
+    line: int
+    qualname: str
+    message: str
+    detail: str = ""
+    severity: str = "error"          # "error" | "warn"
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.qualname,
+                self.detail or self.check)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        what = f" [{self.detail}]" if self.detail else ""
+        return (f"{loc}: {self.severity}[{self.rule}/{self.check}] "
+                f"{self.qualname}: {self.message}{what}")
+
+
+class SourceTree:
+    """The parsed source universe: repo-relative path -> AST.
+
+    Built from disk (``SourceTree.load``) for the real repo, or from an
+    in-memory ``{path: source}`` dict for rule fixture tests — rules see
+    no difference.
+    """
+
+    def __init__(self, files: dict[str, str]):
+        self.files = dict(files)
+        self._asts: dict[str, ast.Module] = {}
+
+    @classmethod
+    def load(cls, root: str | pathlib.Path,
+             package: str = "arks_tpu") -> "SourceTree":
+        root = pathlib.Path(root)
+        files: dict[str, str] = {}
+        for p in sorted((root / package).rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            files[p.relative_to(root).as_posix()] = p.read_text()
+        if not files:
+            raise FileNotFoundError(f"no {package}/**/*.py under {root}")
+        return cls(files)
+
+    def paths(self) -> list[str]:
+        return sorted(self.files)
+
+    def tree(self, path: str) -> ast.Module:
+        if path not in self._asts:
+            self._asts[path] = ast.parse(self.files[path], filename=path)
+        return self._asts[path]
+
+    def module_path(self, dotted: str) -> str | None:
+        """Resolve a dotted module name to a path in this tree
+        (``arks_tpu.ops.autotune`` -> ``arks_tpu/ops/autotune.py``)."""
+        base = dotted.replace(".", "/")
+        for cand in (base + ".py", base + "/__init__.py"):
+            if cand in self.files:
+                return cand
+        return None
+
+
+def repo_root() -> pathlib.Path:
+    """The repo root: the directory holding the ``arks_tpu`` package."""
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def run_rules(tree: SourceTree, rule_names=None) -> list[Finding]:
+    """Run the named rules (all by default) and return raw findings,
+    unsuppressed — baseline filtering is the caller's (CLI / test
+    wrapper) concern."""
+    from arks_tpu.analysis.rules import RULES
+    findings: list[Finding] = []
+    for name in (rule_names or sorted(RULES)):
+        try:
+            rule = RULES[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown rule {name!r} (have: {', '.join(sorted(RULES))})"
+            ) from None
+        findings.extend(rule(tree))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.check))
+    return findings

@@ -1,0 +1,141 @@
+"""arkslint CLI.
+
+    python -m arks_tpu.analysis --all                 # every rule
+    python -m arks_tpu.analysis --rules hotpath,knobs
+    python -m arks_tpu.analysis --all --json          # machine output
+    python -m arks_tpu.analysis --all --write-baseline  # seed suppressions
+    python -m arks_tpu.analysis --gen-knob-docs       # docs/configuration.md
+
+Exit codes: 0 clean (no unsuppressed errors, no stale suppressions),
+1 findings, 2 usage error.  Warnings never affect the exit code unless
+``--strict-warn``.  Pure AST — no JAX, no imports of the code under
+analysis — so it is safe (and fast) as a pre-commit hook; see
+``tools/arkslint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from arks_tpu.analysis import SourceTree, repo_root, run_rules
+from arks_tpu.analysis.baseline import (
+    DEFAULT_PATH, MAX_SUPPRESSIONS, Baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m arks_tpu.analysis",
+        description="arkslint: call-graph-aware static analysis over the "
+                    "arks_tpu tree")
+    ap.add_argument("--all", action="store_true",
+                    help="run every rule (default when --rules is absent)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset "
+                         "(hotpath,exceptions,knobs,tracepurity,metrics)")
+    ap.add_argument("--root", default=None,
+                    help="repo root holding arks_tpu/ (default: "
+                         "auto-detected from this install)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"suppression file (default {DEFAULT_PATH} under "
+                         "the root; 'none' disables)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current error findings as the baseline "
+                         "(review and fill in reasons before committing)")
+    ap.add_argument("--strict-warn", action="store_true",
+                    help="warnings also fail the run")
+    ap.add_argument("--gen-knob-docs", action="store_true",
+                    help="regenerate docs/configuration.md from the knob "
+                         "registry and exit")
+    args = ap.parse_args(argv)
+
+    root = pathlib.Path(args.root) if args.root else repo_root()
+
+    if args.gen_knob_docs:
+        from arks_tpu.utils import knobs
+        out = root / "docs" / "configuration.md"
+        out.write_text(knobs.render_markdown())
+        print(f"wrote {out}")
+        return 0
+
+    rule_names = None
+    if args.rules:
+        rule_names = [r.strip() for r in args.rules.split(",") if r.strip()]
+    t0 = time.monotonic()
+    try:
+        tree = SourceTree.load(root)
+        findings = run_rules(tree, rule_names)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = None if args.baseline == "none" else (
+        pathlib.Path(args.baseline) if args.baseline
+        else root / DEFAULT_PATH)
+
+    if args.write_baseline:
+        if baseline_path is None:
+            print("error: --write-baseline with --baseline none",
+                  file=sys.stderr)
+            return 2
+        bl = Baseline.from_findings(findings, str(baseline_path))
+        bl.save()
+        print(f"wrote {len(bl.entries)} suppressions to {baseline_path}")
+        return 0
+
+    baseline = Baseline.load(baseline_path) if baseline_path else \
+        Baseline([], None)
+    # a rule-subset run can only vouch for its own rules' entries —
+    # entries for unselected rules are out of scope, not stale
+    if rule_names is not None:
+        baseline.entries = [e for e in baseline.entries
+                            if e["rule"] in rule_names]
+    active, suppressed, stale = baseline.apply(findings)
+    errors = [f for f in active if f.severity == "error"]
+    warns = [f for f in active if f.severity == "warn"]
+    elapsed = time.monotonic() - t0
+
+    over_budget = len(baseline.entries) > MAX_SUPPRESSIONS
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in active],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_suppressions": stale,
+            "counts": {"errors": len(errors), "warnings": len(warns),
+                       "suppressed": len(suppressed),
+                       "stale": len(stale),
+                       "baseline_entries": len(baseline.entries)},
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for f in active:
+            print(f.render())
+        for e in stale:
+            print(f"{e['path']}: error[baseline/stale] {e['qualname']}: "
+                  f"suppression matches nothing — the justified code "
+                  f"moved or was fixed; delete the entry [{e['detail']}]")
+        if over_budget:
+            print(f"error[baseline/budget]: {len(baseline.entries)} "
+                  f"suppressions > cap of {MAX_SUPPRESSIONS} — fix code "
+                  "instead of suppressing")
+        print(f"arkslint: {len(errors)} error(s), {len(warns)} "
+              f"warning(s), {len(suppressed)} suppressed, "
+              f"{len(stale)} stale suppression(s) "
+              f"[{elapsed*1000:.0f} ms]")
+
+    failed = bool(errors) or bool(stale) or over_budget \
+        or (args.strict_warn and warns)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Reviewed-suppression baseline for arkslint.
+
+A suppression is a reviewed decision, not an escape hatch: every entry
+carries a one-line ``reason`` and matches findings by the same
+line-number-independent key findings use (rule, path, qualname, detail)
+— so it survives unrelated edits but goes STALE (an error, like the old
+guard tests' ``test_allowed_entries_still_exist``) the moment the code
+it justified moves or is fixed.  The file is capped at
+``MAX_SUPPRESSIONS`` entries; past that, fix the code instead.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from arks_tpu.analysis import Finding
+
+DEFAULT_PATH = "tools/arkslint-baseline.json"
+MAX_SUPPRESSIONS = 20
+
+
+class Baseline:
+    def __init__(self, entries: list[dict], path: str | None = None):
+        self.entries = entries
+        self.path = path
+        for e in entries:
+            missing = {"rule", "path", "qualname", "detail", "reason"} \
+                - set(e)
+            if missing:
+                raise ValueError(
+                    f"baseline entry {e!r} missing fields: {sorted(missing)}")
+
+    @classmethod
+    def load(cls, path: str | pathlib.Path) -> "Baseline":
+        p = pathlib.Path(path)
+        if not p.exists():
+            return cls([], str(path))
+        data = json.loads(p.read_text())
+        return cls(data.get("suppressions", []), str(path))
+
+    def _keys(self) -> dict[tuple, dict]:
+        return {(e["rule"], e["path"], e["qualname"], e["detail"]): e
+                for e in self.entries}
+
+    def apply(self, findings: list[Finding]):
+        """Split findings into (active, suppressed) and return the list
+        of stale entries that matched nothing."""
+        keys = self._keys()
+        active: list[Finding] = []
+        suppressed: list[Finding] = []
+        used: set[tuple] = set()
+        for f in findings:
+            k = f.key()
+            if k in keys:
+                suppressed.append(f)
+                used.add(k)
+            else:
+                active.append(f)
+        stale = [e for k, e in keys.items() if k not in used]
+        return active, suppressed, stale
+
+    def save(self) -> None:
+        assert self.path is not None
+        body = json.dumps({"version": 1, "suppressions": self.entries},
+                          indent=2, sort_keys=False)
+        pathlib.Path(self.path).write_text(body + "\n")
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding],
+                      path: str) -> "Baseline":
+        entries = []
+        seen: set[tuple] = set()
+        for f in findings:
+            if f.severity != "error" or f.key() in seen:
+                continue
+            seen.add(f.key())
+            entries.append({
+                "rule": f.rule, "path": f.path, "qualname": f.qualname,
+                "detail": f.detail or f.check,
+                "reason": "TODO: one-line justification (review before "
+                          "committing)",
+            })
+        return cls(entries, path)

@@ -1,0 +1,158 @@
+"""A call-graph builder tuned for this codebase's dispatch patterns.
+
+Nodes are functions, addressed ``path::Class.method`` or ``path::func``.
+Edges cover the ways the engine actually composes its hot path:
+
+- ``self.method(...)`` calls AND bare ``self.method`` references (the
+  scheduler passes methods as callbacks — ``on_evict=self._note_evicted``
+  must pull ``_note_evicted`` into the reachable set);
+- bare-name calls/references to functions of the same module;
+- ``alias.func(...)`` where ``alias`` is an imported ``arks_tpu`` module
+  (``from arks_tpu.ops import paged_attention as pa; pa.mixed_grid_plan``),
+  and names bound by ``from arks_tpu.x import f`` — so reachability flows
+  from ``_issue_mixed`` through ``ops.paged_attention.mixed_grid_plan``
+  into ``ops.autotune.lookup`` with zero configuration.
+
+Deliberately NOT handled (would need type inference): calls through
+instance attributes of *other* objects (``self.pool.load(...)``) — those
+cross a thread boundary in this engine anyway, which is exactly where
+the zero-host-sync contract changes hands.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from arks_tpu.analysis import SourceTree
+
+
+@dataclasses.dataclass
+class FuncNode:
+    qualname: str                 # "arks_tpu/engine/engine.py::C.m"
+    path: str
+    cls: str | None
+    name: str
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef
+
+
+def node_id(path: str, cls: str | None, name: str) -> str:
+    return f"{path}::{cls}.{name}" if cls else f"{path}::{name}"
+
+
+class CallGraph:
+    def __init__(self, tree: SourceTree):
+        self.tree = tree
+        self.nodes: dict[str, FuncNode] = {}
+        # per (path, cls) method tables and per-path module-level tables
+        self._methods: dict[tuple[str, str], dict[str, str]] = {}
+        self._mod_funcs: dict[str, dict[str, str]] = {}
+        # per-path import maps: alias -> module path; name -> func node id
+        self._mod_alias: dict[str, dict[str, str]] = {}
+        self._name_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        for path in tree.paths():
+            self._index_module(path)
+        self.edges: dict[str, set[str]] = {}
+        for nid in self.nodes:
+            self.edges[nid] = self._edges_of(nid)
+
+    # ---------------------------------------------------------- indexing
+
+    def _index_module(self, path: str) -> None:
+        mod = self.tree.tree(path)
+        self._mod_funcs[path] = {}
+        self._mod_alias[path] = {}
+        self._name_imports[path] = {}
+        for stmt in mod.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nid = node_id(path, None, stmt.name)
+                self.nodes[nid] = FuncNode(nid, path, None, stmt.name, stmt)
+                self._mod_funcs[path][stmt.name] = nid
+            elif isinstance(stmt, ast.ClassDef):
+                table: dict[str, str] = {}
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        nid = node_id(path, stmt.name, sub.name)
+                        self.nodes[nid] = FuncNode(nid, path, stmt.name,
+                                                   sub.name, sub)
+                        table[sub.name] = nid
+                self._methods[(path, stmt.name)] = table
+        # imports (module level only — local imports inside functions are
+        # also walked so `from arks_tpu.x import f` in a function resolves)
+        for stmt in ast.walk(mod):
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    target = self.tree.module_path(a.name)
+                    if target:
+                        alias = a.asname or a.name.split(".")[0]
+                        if a.asname or "." not in a.name:
+                            self._mod_alias[path][alias] = target
+            elif isinstance(stmt, ast.ImportFrom) and stmt.module:
+                for a in stmt.names:
+                    sub = self.tree.module_path(f"{stmt.module}.{a.name}")
+                    if sub:
+                        self._mod_alias[path][a.asname or a.name] = sub
+                        continue
+                    target = self.tree.module_path(stmt.module)
+                    if target:
+                        self._name_imports[path][a.asname or a.name] = (
+                            target, a.name)
+
+    # ------------------------------------------------------------- edges
+
+    def _edges_of(self, nid: str) -> set[str]:
+        fn = self.nodes[nid]
+        path = fn.path
+        out: set[str] = set()
+        methods = self._methods.get((path, fn.cls), {}) if fn.cls else {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Attribute):
+                v = node.value
+                # self.X — call or callback reference
+                if isinstance(v, ast.Name) and v.id == "self" \
+                        and node.attr in methods:
+                    out.add(methods[node.attr])
+                # alias.X — imported arks_tpu module
+                elif isinstance(v, ast.Name) \
+                        and v.id in self._mod_alias[path]:
+                    target = self._mod_alias[path][v.id]
+                    tfuncs = self._mod_funcs.get(target, {})
+                    if node.attr in tfuncs:
+                        out.add(tfuncs[node.attr])
+            elif isinstance(node, ast.Name):
+                if node.id in self._mod_funcs[path] \
+                        and node.id != fn.name:
+                    out.add(self._mod_funcs[path][node.id])
+                elif node.id in self._name_imports[path]:
+                    target, name = self._name_imports[path][node.id]
+                    tfuncs = self._mod_funcs.get(target, {})
+                    if name in tfuncs:
+                        out.add(tfuncs[name])
+        out.discard(nid)
+        return out
+
+    # ------------------------------------------------------ reachability
+
+    def find(self, path: str, cls: str | None, name: str) -> str | None:
+        nid = node_id(path, cls, name)
+        return nid if nid in self.nodes else None
+
+    def reachable(self, roots, stop=None) -> set[str]:
+        """Transitive closure from ``roots`` (node ids), never expanding
+        THROUGH a node for which ``stop(FuncNode)`` is true — boundary
+        nodes are excluded from the result entirely (they are sanctioned
+        surfaces with their own contract, e.g. ``_resolve_*`` sync
+        tails)."""
+        seen: set[str] = set()
+        stack = [r for r in roots if r in self.nodes]
+        while stack:
+            nid = stack.pop()
+            if nid in seen:
+                continue
+            fn = self.nodes[nid]
+            if stop is not None and stop(fn) and nid not in roots:
+                continue
+            seen.add(nid)
+            stack.extend(self.edges.get(nid, ()))
+        return seen

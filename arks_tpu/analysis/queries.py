@@ -1,0 +1,137 @@
+"""Shared AST queries: the vocabulary the rules (and the thin guard-test
+wrappers in ``tests/``) are built from.  Everything here is pure
+``ast`` — no imports of the code under analysis."""
+
+from __future__ import annotations
+
+import ast
+
+# Host-blocking device fetches: the calls that turn an async dispatch
+# into a synchronous host stall.
+BLOCKING_ATTRS = {"block_until_ready", "item"}
+
+
+def class_def(mod: ast.Module, name: str) -> ast.ClassDef | None:
+    for node in mod.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def func_defs(scope: ast.AST) -> dict[str, ast.AST]:
+    """Immediate function/async-function children of a module or class."""
+    return {n.name: n for n in scope.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def blocking_fetches(func_node: ast.AST):
+    """(kind, arg, lineno) for each blocking device fetch in the
+    function: np.asarray / *.device_get / .block_until_ready / .item —
+    skipping literal host containers, which are host data by
+    construction."""
+    out = []
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        hit = None
+        if (f.attr == "asarray" and isinstance(f.value, ast.Name)
+                and f.value.id == "np"):
+            hit = "np.asarray"
+        elif f.attr == "device_get":
+            hit = "device_get"
+        elif f.attr in BLOCKING_ATTRS:
+            hit = f.attr
+        if hit is None:
+            continue
+        if node.args and isinstance(node.args[0],
+                                    (ast.List, ast.ListComp, ast.Tuple,
+                                     ast.GeneratorExp, ast.Constant)):
+            continue
+        arg = ast.unparse(node.args[0]) if node.args else ""
+        out.append((hit, arg, node.lineno))
+    return out
+
+
+def is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def routes_fault(handler: ast.ExceptHandler, api_names: frozenset) -> bool:
+    """True if the handler re-raises or calls one of ``api_names``."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name in api_names:
+                return True
+    return False
+
+
+def logs_with_traceback(handler: ast.ExceptHandler) -> bool:
+    """True if the handler logs the exception observably: a
+    ``*.exception(...)`` call, or any call carrying an ``exc_info=``
+    keyword (``log.warning(..., exc_info=True)``)."""
+    for node in ast.walk(handler):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "exception":
+            return True
+        if any(kw.arg == "exc_info" for kw in node.keywords or []):
+            return True
+    return False
+
+
+def enclosing_function(mod: ast.Module, lineno: int) -> str:
+    """Qualname-ish (Class.method / func / <module>) of the innermost
+    function containing ``lineno``."""
+    best = "<module>"
+    best_line = 0
+
+    def visit(node, prefix):
+        nonlocal best, best_line
+        for child in ast.iter_child_nodes(node):
+            name = prefix
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                if (not isinstance(child, ast.ClassDef)
+                        and child.lineno <= lineno
+                        and child.lineno > best_line
+                        and lineno <= getattr(child, "end_lineno",
+                                              lineno)):
+                    best, best_line = name, child.lineno
+            visit(child, name)
+
+    visit(mod, "")
+    return best
+
+
+def module_imports(mod: ast.Module):
+    """Dotted module names imported anywhere in the module."""
+    out = []
+    for node in ast.walk(mod):
+        if isinstance(node, ast.Import):
+            out.extend((a.name, node.lineno) for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            out.append((node.module, node.lineno))
+    return out
+
+
+def string_constants(mod: ast.Module) -> set[str]:
+    return {n.value for n in ast.walk(mod)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}

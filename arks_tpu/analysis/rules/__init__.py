@@ -1,0 +1,17 @@
+"""Rule registry: name -> callable(SourceTree) -> list[Finding]."""
+
+from __future__ import annotations
+
+from arks_tpu.analysis.rules.exceptions import check as _exceptions
+from arks_tpu.analysis.rules.hotpath import check as _hotpath
+from arks_tpu.analysis.rules.knobs import check as _knobs
+from arks_tpu.analysis.rules.metrics import check as _metrics
+from arks_tpu.analysis.rules.tracepurity import check as _tracepurity
+
+RULES = {
+    "hotpath": _hotpath,
+    "exceptions": _exceptions,
+    "knobs": _knobs,
+    "tracepurity": _tracepurity,
+    "metrics": _metrics,
+}

@@ -1,0 +1,69 @@
+"""Rule ``exceptions`` — no silent swallows, repo-wide.
+
+The engine's fault-isolation contract (engine/faults.py) lives or dies
+on faults being VISIBLE; the same failure mode — an ``except Exception``
+that eats an error on a path tests rarely exercise — strands requests in
+the gateway, hides poisoned state in the router, and wedges reconcile
+loops in the control plane just as silently.  Every broad handler
+(``except Exception`` / bare ``except``) under ``arks_tpu/`` must:
+
+- re-raise (a ``raise`` anywhere in the handler), or
+- route through the fault API — ``faults.swallowed`` /
+  ``utils.swallow.swallowed`` / ``StepFault`` / ``classify`` /
+  ``_recover_from_fault`` / ``os._exit`` —, or
+- OUTSIDE ``arks_tpu/engine/``: log the exception with a traceback
+  (``log.exception(...)`` or any ``exc_info=`` logging call) — the
+  observable-swallow route supervision loops need, or
+- carry a reviewed suppression in the baseline file.
+
+``arks_tpu/engine/`` keeps the stricter legacy contract (no plain
+log-and-continue): a swallowed engine exception defeats quarantine
+accounting even when logged.  Narrow handlers are exempt — naming the
+exception class is already a reviewed decision.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from arks_tpu.analysis import Finding, SourceTree
+from arks_tpu.analysis import queries as q
+
+RULE = "exceptions"
+
+FAULT_API = frozenset({
+    "swallowed",            # faults.swallowed / utils.swallow.swallowed
+    "StepFault",            # re-raise as an attributed fault
+    "classify",             # building a StepFault's kind
+    "_recover_from_fault",  # the recovery entry point itself
+    "_exit",                # os._exit — the escalation ladder's last rung
+})
+
+STRICT_PREFIX = "arks_tpu/engine/"
+
+
+def check(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in tree.paths():
+        mod = tree.tree(path)
+        strict = path.startswith(STRICT_PREFIX)
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not q.is_broad_handler(node):
+                continue
+            if q.routes_fault(node, FAULT_API):
+                continue
+            if not strict and q.logs_with_traceback(node):
+                continue
+            fn = q.enclosing_function(mod, node.lineno)
+            routes = ("re-raise or route through the fault API "
+                      "(swallowed/StepFault)" if strict else
+                      "re-raise, call swallowed(), or log with "
+                      "exc_info/log.exception")
+            findings.append(Finding(
+                RULE, "broad-swallow", path, node.lineno, fn,
+                f"broad exception handler swallows silently — {routes}, "
+                "or justify a baseline entry",
+                detail=f"except in {fn}"))
+    return findings

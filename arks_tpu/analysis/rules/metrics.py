@@ -1,0 +1,91 @@
+"""Rule ``metrics`` — static metric-family census.
+
+Prometheus conventions, checked at the registration call sites
+(``registry.counter/gauge/histogram("name", ...)`` with a literal
+name) across every component — not just the three registries the old
+conformance test happened to instantiate:
+
+- ``name-convention``   snake_case family names; counters end in
+                        ``_total``; nothing else does;
+- ``duplicate-family``  the same family name registered in two different
+                        components (scrape-time collision when both land
+                        on one exposition endpoint);
+- ``dynamic-metric-name``  WARN: a non-literal family name — invisible
+                        to this census and to grep.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from arks_tpu.analysis import Finding, SourceTree
+
+RULE = "metrics"
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+KINDS = ("counter", "gauge", "histogram")
+
+
+def registrations(tree: SourceTree):
+    """(path, scope, kind, name|None, lineno) for each registration call
+    site; ``scope`` is the enclosing top-level class/function (the
+    component owning the family)."""
+    out = []
+    for path in tree.paths():
+        mod = tree.tree(path)
+
+        def visit(node, scope, path=path):
+            for child in ast.iter_child_nodes(node):
+                s = scope
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and not scope:
+                    s = child.name
+                if isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in KINDS:
+                    name = None
+                    if child.args and isinstance(child.args[0],
+                                                 ast.Constant) \
+                            and isinstance(child.args[0].value, str):
+                        name = child.args[0].value
+                    out.append((path, scope or "<module>",
+                                child.func.attr, name, child.lineno))
+                visit(child, s)
+
+        visit(mod, "")
+    return out
+
+
+def check(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: dict[str, tuple[str, str]] = {}
+    for path, scope, kind, name, lineno in registrations(tree):
+        if name is None:
+            findings.append(Finding(
+                RULE, "dynamic-metric-name", path, lineno, scope,
+                "metric family name computed at runtime — invisible to "
+                "the census", severity="warn"))
+            continue
+        if not NAME_RE.match(name):
+            findings.append(Finding(
+                RULE, "name-convention", path, lineno, scope,
+                "metric family name must be snake_case "
+                "([a-z][a-z0-9_]*)", detail=name))
+        if kind == "counter" and not name.endswith("_total"):
+            findings.append(Finding(
+                RULE, "name-convention", path, lineno, scope,
+                "counter family must end in _total", detail=name))
+        elif kind != "counter" and name.endswith("_total"):
+            findings.append(Finding(
+                RULE, "name-convention", path, lineno, scope,
+                f"{kind} family must not end in _total", detail=name))
+        prev = seen.get(name)
+        if prev is not None and prev != (path, scope):
+            findings.append(Finding(
+                RULE, "duplicate-family", path, lineno, scope,
+                f"family already registered by {prev[1]} ({prev[0]}) — "
+                "two components exporting one family collide at scrape "
+                "time", detail=name))
+        seen.setdefault(name, (path, scope))
+    return findings

@@ -1,0 +1,130 @@
+"""Rule ``tracepurity`` — no host state inside traced functions.
+
+A function handed to ``jax.jit`` / ``pjit`` / ``shard_map`` / a Pallas
+kernel executes its Python body only at TRACE time.  A ``time.time()``
+or ``np.random`` call inside one doesn't do what it looks like — it
+bakes a trace-time constant into the compiled program — and an
+``os.environ`` read there makes compilation depend on ambient process
+state, the compile-variant hazard the compile-budget test only catches
+after the fact.  This rule finds traced functions statically (decorator
+forms, ``jax.jit(f)`` call forms, ``pl.pallas_call(kernel)`` /
+``partial(kernel, ...)`` kernel references) and rejects:
+
+- wall-clock reads (``time.time/monotonic/perf_counter/time_ns``) and
+  sleeps;
+- host RNG (``np.random.*``, ``random.*`` — device randomness goes
+  through ``jax.random`` with threaded keys);
+- env/file reads (``os.environ`` / ``os.getenv`` / ``open()`` /
+  ``os.urandom``) — including knob reads: read the knob OUTSIDE and
+  close over the value.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from arks_tpu.analysis import Finding, SourceTree
+from arks_tpu.analysis import queries as q
+
+RULE = "tracepurity"
+
+TIME_ATTRS = {"time", "monotonic", "perf_counter", "time_ns", "sleep",
+              "monotonic_ns", "perf_counter_ns"}
+TRACE_ENTRY = {"jax.jit", "jit", "pjit", "jax.pjit", "pl.pallas_call",
+               "pallas_call", "shard_map", "jax.experimental.pjit"}
+
+
+def _decorator_traced(dec: ast.AST) -> bool:
+    s = ast.unparse(dec)
+    base = s.split("(")[0]
+    if base in TRACE_ENTRY or base.endswith(".pallas_call") \
+            or base.endswith(".pjit") or base == "jax.jit":
+        return True
+    # partial(jax.jit, ...) / functools.partial(jit, static_argnums=...)
+    return base.endswith("partial") and any(
+        t in s for t in ("jax.jit", "jit,", "jit)", "pallas_call"))
+
+
+def _call_targets(call: ast.Call) -> list[str]:
+    """Local function names referenced as the traced target of a
+    jit/pallas_call invocation: bare names, ``partial(name, ...)``, and
+    ``self.name`` / ``cls.name`` attribute references."""
+    out: list[str] = []
+    args = list(call.args)
+    for kw in call.keywords or []:
+        if kw.arg in ("fun", "f", "kernel"):
+            args.insert(0, kw.value)
+    if not args:
+        return out
+    a = args[0]
+    if isinstance(a, ast.Name):
+        out.append(a.id)
+    elif isinstance(a, ast.Attribute):
+        out.append(a.attr)
+    elif isinstance(a, ast.Call):
+        base = ast.unparse(a.func)
+        if base.endswith("partial") and a.args:
+            inner = a.args[0]
+            if isinstance(inner, ast.Name):
+                out.append(inner.id)
+            elif isinstance(inner, ast.Attribute):
+                out.append(inner.attr)
+    return out
+
+
+def traced_functions(mod: ast.Module) -> dict[str, ast.AST]:
+    """name -> FunctionDef for every function the module hands to a
+    trace entry point (any nesting level)."""
+    all_funcs: dict[str, ast.AST] = {}
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            all_funcs.setdefault(node.name, node)
+    traced: dict[str, ast.AST] = {}
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_traced(d) for d in node.decorator_list):
+                traced[node.name] = node
+        elif isinstance(node, ast.Call):
+            base = ast.unparse(node.func).split("(")[0]
+            if base in TRACE_ENTRY or base.endswith(".pallas_call") \
+                    or base.endswith(".pjit"):
+                for name in _call_targets(node):
+                    if name in all_funcs:
+                        traced[name] = all_funcs[name]
+    return traced
+
+
+def _impurities(fn: ast.AST):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute):
+            s = ast.unparse(node)
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id == "time" \
+                    and node.attr in TIME_ATTRS:
+                yield "wall-clock", s, node.lineno
+            elif s.startswith(("np.random", "numpy.random")):
+                yield "host-rng", s, node.lineno
+            elif isinstance(node.value, ast.Name) \
+                    and node.value.id == "random":
+                yield "host-rng", s, node.lineno
+            elif s in ("os.environ", "os.getenv", "os.urandom"):
+                yield "host-state", s, node.lineno
+        elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                       ast.Name):
+            if node.func.id == "open":
+                yield "host-state", "open()", node.lineno
+
+
+def check(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in tree.paths():
+        mod = tree.tree(path)
+        for name, fn in sorted(traced_functions(mod).items()):
+            for kind, what, lineno in _impurities(fn):
+                findings.append(Finding(
+                    RULE, kind, path, lineno, name,
+                    f"{what} inside a jit/Pallas-traced function — runs "
+                    "at trace time, not step time (compile-variant / "
+                    "nondeterminism hazard); hoist it out and close over "
+                    "the value", detail=what))
+    return findings

@@ -15,6 +15,8 @@ import os
 import sys
 import time
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.download")
 
 RETRIES = 3
@@ -36,7 +38,8 @@ def fetch(repo: str, dest: str, token: str | None) -> None:
             raise  # fatal: retrying can't help (reference download.py:58-66)
         except Exception as e:  # transient (network, 5xx)
             last = e
-            log.warning("download attempt %d/%d failed: %s", attempt, RETRIES, e)
+            log.warning("download attempt %d/%d failed: %s", attempt,
+                        RETRIES, e, exc_info=True)
             if attempt < RETRIES:
                 time.sleep(BACKOFF_S)
     raise RuntimeError(f"download failed after {RETRIES} attempts: {last}")
@@ -68,15 +71,15 @@ def main() -> int:
     try:
         fetch(repo, dest, token)
     except Exception as e:
-        log.error("model download failed: %s", e)
+        log.exception("model download failed: %s", e)
         return 1
-    if os.environ.get("ARKS_CONVERT_ORBAX") == "1":
+    if knobs.get_bool("ARKS_CONVERT_ORBAX"):
         try:
             convert_orbax(dest)
         except Exception as e:
             # Conversion is an optimization; raw safetensors still serve.
             log.warning("Orbax conversion failed (serving falls back to "
-                        "safetensors): %s", e)
+                        "safetensors): %s", e, exc_info=True)
     log.info("model %s ready at %s", repo, dest)
     return 0
 

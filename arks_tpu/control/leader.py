@@ -26,6 +26,7 @@ import time
 import uuid
 
 from arks_tpu.control.k8s_client import ApiError
+from arks_tpu.utils.swallow import swallowed
 
 log = logging.getLogger("arks_tpu.control.leader")
 
@@ -231,8 +232,10 @@ class LeaderElector:
                     holder = (lease or {}).get("spec", {}).get(
                         "holderIdentity")
                     held_elsewhere = bool(holder) and holder != self.identity
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Unreadable lease ≠ lost lease: the renewal-age check
+                    # below is the actual demotion trigger.
+                    swallowed("leader.lease-peek", e)
                 if held_elsewhere or (time.time() - self._last_renew_ok
                                       > self.lease_duration_s):
                     self._leading = False

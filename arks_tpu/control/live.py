@@ -37,6 +37,7 @@ import time
 from arks_tpu.control import resources as res
 from arks_tpu.control.store import Conflict, NotFound, Store
 from arks_tpu.control.workloads import pick_rolling_restart
+from arks_tpu.utils.swallow import swallowed
 
 log = logging.getLogger("arks_tpu.control.live")
 
@@ -824,7 +825,9 @@ def main() -> None:
         if ns is None:
             try:
                 ns = KubeApi.namespace_in_cluster()
-            except Exception:
+            except Exception as e:
+                # Outside a pod there is no serviceaccount namespace file.
+                swallowed("live.namespace-in-cluster", e)
                 ns = "default"
         elector = LeaderElector(api, namespace=ns)
     op = LiveOperator(api, models_root=args.models_root,

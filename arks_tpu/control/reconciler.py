@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import heapq
 import logging
+import queue
 import threading
 import time
 from typing import Callable, Iterable, Type
@@ -107,7 +108,7 @@ class Controller:
             while self._running:
                 try:
                     event, obj = q.get(timeout=0.2)
-                except Exception:
+                except queue.Empty:
                     continue
                 for key in mapper(obj):
                     self.queue.add(key)

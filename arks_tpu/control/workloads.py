@@ -32,6 +32,8 @@ import urllib.request
 from typing import Protocol
 
 from arks_tpu.control.resources import GangSet
+from arks_tpu.utils import knobs
+from arks_tpu.utils.swallow import swallowed
 
 log = logging.getLogger("arks_tpu.workloads")
 
@@ -340,7 +342,10 @@ class LocalProcessDriver:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/readiness", timeout=2) as r:
                 return r.status == 200
-        except Exception:
+        except Exception as e:
+            # A failed probe IS the signal (not-ready); expected while a
+            # member is still booting.
+            swallowed("workloads.readiness-probe", e)
             return False
 
     def _signal_stop(self, g: _Group) -> None:
@@ -409,7 +414,8 @@ def default_runtime_image(runtime: str) -> str:
     arksapplication_controller.go:907-939), extended with the native jax
     runtime's ARKS_RUNTIME_DEFAULT_JAX_IMAGE.  Spec.runtimeImage always
     wins; env beats the built-in default."""
-    env = os.environ.get(f"ARKS_RUNTIME_DEFAULT_{runtime.upper()}_IMAGE")
+    name = f"ARKS_RUNTIME_DEFAULT_{runtime.upper()}_IMAGE"
+    env = knobs.get_str(name) if knobs.is_registered(name) else None
     if env:
         return env
     return {
@@ -422,7 +428,7 @@ def default_runtime_image(runtime: str) -> str:
 def default_scripts_image() -> str:
     """Model-download worker image (ARKS_SCRIPTS_IMAGE escape hatch —
     arksmodel_controller.go:369-375)."""
-    return os.environ.get("ARKS_SCRIPTS_IMAGE", "arks-tpu/engine:latest")
+    return knobs.get_str("ARKS_SCRIPTS_IMAGE")
 
 
 def gpu_runtime_command(runtime: str, model_path: str, served_model_name: str,

@@ -49,6 +49,7 @@ from arks_tpu.models import transformer as tf
 from arks_tpu.obs import logctx
 from arks_tpu.obs import profiler as prof_mod
 from arks_tpu.obs import trace as trace_mod
+from arks_tpu.utils import knobs
 from arks_tpu.utils import metrics as prom
 from arks_tpu import slo as slo_mod
 
@@ -202,7 +203,7 @@ class EngineConfig:
         from arks_tpu.ops.attention import default_decode_impl
         if default_decode_impl() != "pallas":
             return 1
-        block_s = int(os.environ.get("ARKS_ATTN_BLOCK_S", "256"))
+        block_s = knobs.get_int("ARKS_ATTN_BLOCK_S")
         if self.max_cache_len >= block_s:
             return block_s
         return 128 if self.kv_quantized else 16
@@ -749,7 +750,7 @@ class InferenceEngine:
         # counts (the quarantine budget), and the serving/recovering/
         # wedged state machine /readiness reports.
         self._faults = faults_mod.FaultInjector()
-        self._fault_retries = int(os.environ.get("ARKS_FAULT_RETRIES", "1"))
+        self._fault_retries = knobs.get_int("ARKS_FAULT_RETRIES")
         if self._fault_retries < 0:
             raise ValueError(
                 f"ARKS_FAULT_RETRIES={self._fault_retries}: must be >= 0")
@@ -785,7 +786,7 @@ class InferenceEngine:
         # on CPU the "device" shares the host's cores, so the reorder only
         # delays new slots' first decode — sequential there.
         # ARKS_OVERLAP_DECODE=0/1 overrides.
-        _ov = os.environ.get("ARKS_OVERLAP_DECODE", "auto")
+        _ov = knobs.get_str("ARKS_OVERLAP_DECODE")
         self._overlap = (_ov == "1" or
                          (_ov != "0" and jax.default_backend() == "tpu"))
         # Multi-host: a DispatchLeader when this engine drives follower
@@ -795,12 +796,7 @@ class InferenceEngine:
         # ---- Pipelined decode depth (ARKS_PIPELINE_DEPTH) --------------
         # Parsed once per process (model-independent); the per-model pipe
         # state itself lives in _init_model_state.
-        _pd = os.environ.get("ARKS_PIPELINE_DEPTH", "2")
-        try:
-            pipe_depth = int(_pd)
-        except ValueError:
-            raise ValueError(
-                f"ARKS_PIPELINE_DEPTH={_pd!r}: expected an integer >= 0")
+        pipe_depth = knobs.get_int("ARKS_PIPELINE_DEPTH")
         if pipe_depth < 0:
             raise ValueError(
                 f"ARKS_PIPELINE_DEPTH={pipe_depth}: must be >= 0")
@@ -821,23 +817,13 @@ class InferenceEngine:
         self.trace = trace_mod.Tracer()
         self.profiler = prof_mod.ProfilerWindows()
         self._pipe_seq = 0   # pipelined issue->resolve span pairing
-        self._preempt_on = os.environ.get("ARKS_PREEMPT", "0") == "1"
-        _pm = os.environ.get("ARKS_PREEMPT_MAX_INFLIGHT", "1")
-        try:
-            preempt_max = int(_pm)
-        except ValueError:
-            raise ValueError(
-                f"ARKS_PREEMPT_MAX_INFLIGHT={_pm!r}: expected an integer >= 1")
+        self._preempt_on = knobs.get_bool("ARKS_PREEMPT")
+        preempt_max = knobs.get_int("ARKS_PREEMPT_MAX_INFLIGHT")
         if preempt_max < 1:
             raise ValueError(
                 f"ARKS_PREEMPT_MAX_INFLIGHT={preempt_max}: must be >= 1")
         self._preempt_max = preempt_max
-        _pc = os.environ.get("ARKS_PREEMPT_COOLDOWN_S", "2")
-        try:
-            preempt_cooldown = float(_pc)
-        except ValueError:
-            raise ValueError(
-                f"ARKS_PREEMPT_COOLDOWN_S={_pc!r}: expected a number >= 0")
+        preempt_cooldown = knobs.get_float("ARKS_PREEMPT_COOLDOWN_S")
         if preempt_cooldown < 0:
             raise ValueError(
                 f"ARKS_PREEMPT_COOLDOWN_S={preempt_cooldown}: must be >= 0")
@@ -854,12 +840,7 @@ class InferenceEngine:
         # A queued request's EFFECTIVE priority decays by one tier per
         # aging window, so sustained high-tier load cannot starve the
         # batch tier forever.  0 = off.
-        _qa = os.environ.get("ARKS_QUEUE_AGING_S", "0")
-        try:
-            queue_aging = float(_qa)
-        except ValueError:
-            raise ValueError(
-                f"ARKS_QUEUE_AGING_S={_qa!r}: expected a number >= 0")
+        queue_aging = knobs.get_float("ARKS_QUEUE_AGING_S")
         if queue_aging < 0:
             raise ValueError(
                 f"ARKS_QUEUE_AGING_S={queue_aging}: must be >= 0")
@@ -883,17 +864,8 @@ class InferenceEngine:
         self._model_prefetch: set[str] = set()
         self._model_ctxs: dict[str, dict] = {}      # saved per-model state
         self._switch_target: str | None = None
-        _sp = os.environ.get("ARKS_MODEL_SWITCH_POLICY", "drain")
-        if _sp not in ("drain", "timeslice"):
-            raise ValueError(
-                f"ARKS_MODEL_SWITCH_POLICY={_sp!r}: expected drain|timeslice")
-        self._switch_policy = _sp
-        _sq = os.environ.get("ARKS_MODEL_SWITCH_QUANTUM_S", "5")
-        try:
-            switch_quantum = float(_sq)
-        except ValueError:
-            raise ValueError(
-                f"ARKS_MODEL_SWITCH_QUANTUM_S={_sq!r}: expected a number > 0")
+        self._switch_policy = knobs.get_str("ARKS_MODEL_SWITCH_POLICY")
+        switch_quantum = knobs.get_float("ARKS_MODEL_SWITCH_QUANTUM_S")
         if switch_quantum <= 0:
             raise ValueError(
                 f"ARKS_MODEL_SWITCH_QUANTUM_S={switch_quantum}: must be > 0")
@@ -1168,12 +1140,7 @@ class InferenceEngine:
         self._spill_victims: list = []      # (digest, page) since last flush
         self._spills: "_deque" = _deque()   # in-flight D2H spill records
         self._awaiting_restore: list[_RestoreState] = []
-        _hmb = os.environ.get("ARKS_PREFIX_HOST_MB", "256")
-        try:
-            host_mb = int(_hmb)
-        except ValueError:
-            raise ValueError(
-                f"ARKS_PREFIX_HOST_MB={_hmb!r}: expected an integer >= 0")
+        host_mb = knobs.get_int("ARKS_PREFIX_HOST_MB")
         if host_mb < 0:
             raise ValueError(
                 f"ARKS_PREFIX_HOST_MB={host_mb}: must be >= 0")
@@ -1252,9 +1219,7 @@ class InferenceEngine:
         # supported; non-paged and no-chunk (pp) engines stay on the legacy
         # paths.  Speculative engines RIDE the mixed step (verify lanes are
         # q_len=draft_len rows of the same dispatch) and nothing else.
-        _mx = os.environ.get("ARKS_MIXED_STEP", "auto")
-        if _mx not in ("auto", "0", "1"):
-            raise ValueError(f"ARKS_MIXED_STEP={_mx!r}: expected auto|0|1")
+        _mx = knobs.get_str("ARKS_MIXED_STEP")
         mixed_capable = self._paged and bool(self._chunk)
         self._mixed = mixed_capable and _mx != "0"
         if _mx == "1" and not mixed_capable:
@@ -1275,8 +1240,8 @@ class InferenceEngine:
         # the issue path pays one dict hit per dispatch.
         self._grid_plans: dict[int, dict] = {}
         if self._mixed:
-            budget = int(os.environ.get("ARKS_MIXED_CHUNK_TOKENS",
-                                        str(self._chunk)))
+            budget = knobs.get_int("ARKS_MIXED_CHUNK_TOKENS",
+                                   fallback=self._chunk)
             if budget < 1:
                 raise ValueError(
                     f"ARKS_MIXED_CHUNK_TOKENS={budget}: must be >= 1")
@@ -2144,7 +2109,7 @@ class InferenceEngine:
     def start(self) -> None:
         self._running = True
         self.trace.start()
-        deadline = float(os.environ.get("ARKS_DISPATCH_DEADLINE_S", "0") or 0)
+        deadline = knobs.get_float("ARKS_DISPATCH_DEADLINE_S", fallback=0.0)
         if deadline > 0:
             # Wedged-dispatch escalation: a device call that never returns
             # (hung DMA, deadlocked collective) cannot be cancelled from
@@ -2258,7 +2223,7 @@ class InferenceEngine:
         fallback (exact math — zero K lanes add 0 to scores, padded V
         columns are sliced off; ops/attention prescales q).  Costs
         128/head_dim x KV HBM; ARKS_PAD_HEAD_DIM=0 opts out."""
-        if os.environ.get("ARKS_PAD_HEAD_DIM", "1") != "1":
+        if not knobs.get_bool("ARKS_PAD_HEAD_DIM"):
             return False
         from arks_tpu.ops.attention import default_decode_impl
         return (jax.default_backend() == "tpu"
@@ -3062,7 +3027,7 @@ class InferenceEngine:
         amortize more of the per-dispatch round-trip) without a code
         change.  Normalized descending; 1 is always present (the greedy
         fill's floor)."""
-        raw = os.environ.get("ARKS_ADMIT_BATCH_SIZES") or "8,4,2,1"
+        raw = knobs.raw("ARKS_ADMIT_BATCH_SIZES") or "8,4,2,1"
         try:
             sizes = {int(x) for x in raw.split(",") if x.strip()}
         except ValueError as e:

@@ -48,6 +48,8 @@ import os
 import threading
 import time
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.faults")
 
 # Engine state codes surfaced by the engine_state gauge (docs/monitoring.md).
@@ -117,7 +119,8 @@ class FaultInjector:
     def __init__(self, spec: str | None = None):
         self._specs: list[list] = []   # [phase, nth, kind, armed]
         self._counts: dict[str, int] = {}
-        spec = os.environ.get("ARKS_FAULT_INJECT", "") if spec is None else spec
+        spec = (knobs.get_str("ARKS_FAULT_INJECT", fallback="") or ""
+                ) if spec is None else spec
         if spec:
             for entry in spec.split(","):
                 self.arm(entry)
@@ -164,8 +167,7 @@ class FaultInjector:
                 log.warning("fault injection: phase=%s nth=%d kind=%s",
                             phase, n, kind)
                 if kind == "hang":
-                    time.sleep(float(os.environ.get("ARKS_FAULT_HANG_S",
-                                                    "3600")))
+                    time.sleep(knobs.get_float("ARKS_FAULT_HANG_S"))
                     return
                 if kind == "oom":
                     raise InjectedFault(

@@ -57,6 +57,8 @@ import time
 
 import numpy as np
 
+from arks_tpu.utils import knobs
+
 __all__ = ["GuideError", "GuideCompiler", "compile_regex_dfa",
            "json_mode_regex", "json_schema_regex"]
 
@@ -438,7 +440,7 @@ def json_mode_regex(depth: int | None = None) -> str:
     pushdown stack, here it is unrolled into the DFA).  Default depth via
     ARKS_JSON_DEPTH (3): state count grows ~2x per level."""
     if depth is None:
-        depth = int(os.environ.get("ARKS_JSON_DEPTH", "3"))
+        depth = knobs.get_int("ARKS_JSON_DEPTH")
 
     def value(d: int) -> str:
         alts = [_STR, _NUM, "true", "false", "null"]
@@ -491,7 +493,7 @@ def json_schema_regex(schema: dict, depth: int | None = None) -> str:
     loosening; numeric minimum/maximum are ignored (not regular).
     ``depth`` bounds untyped-value nesting and $ref recursion."""
     if depth is None:
-        depth = int(os.environ.get("ARKS_JSON_DEPTH", "3"))
+        depth = knobs.get_int("ARKS_JSON_DEPTH")
     defs = {}
     for key in ("$defs", "definitions"):
         defs.update(schema.get(key) or {})
@@ -777,12 +779,11 @@ class GuideCompiler:
                  max_rows: int | None = None,
                  max_classes: int | None = None,
                  metrics=None) -> None:
-        env = os.environ.get
         self.vocab_size = vocab_size
-        self.max_guides = max_guides or int(env("ARKS_GUIDE_MAX", "8"))
-        self.max_rows = max_rows or int(env("ARKS_GUIDE_ROWS", "4096"))
-        self.max_classes = max_classes or int(env("ARKS_GUIDE_CLASSES",
-                                                  "2048"))
+        self.max_guides = max_guides or knobs.get_int("ARKS_GUIDE_MAX")
+        self.max_rows = max_rows or knobs.get_int("ARKS_GUIDE_ROWS")
+        self.max_classes = max_classes or knobs.get_int(
+            "ARKS_GUIDE_CLASSES")
         self._tokenizer = tokenizer
         self._eos_ids = tuple(eos_ids)
         self._tok_table: tuple[np.ndarray, np.ndarray] | None = None
@@ -987,8 +988,7 @@ class GuideCompiler:
         with self._lock:
             if self._executor is None:
                 from concurrent.futures import ThreadPoolExecutor
-                n = max(1, int(os.environ.get(
-                    "ARKS_GUIDE_COMPILE_WORKERS", "2")))
+                n = max(1, knobs.get_int("ARKS_GUIDE_COMPILE_WORKERS"))
                 self._executor = ThreadPoolExecutor(
                     max_workers=n, thread_name_prefix="guide-compile")
             return self._executor

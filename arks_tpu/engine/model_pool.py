@@ -33,6 +33,8 @@ import os
 import threading
 import time
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.model_pool")
 
 
@@ -81,11 +83,7 @@ class ModelPool:
 
     def __init__(self, hbm_budget_mb: int | None = None):
         if hbm_budget_mb is None:
-            raw = os.environ.get("ARKS_MODEL_POOL_HBM_MB", "0")
-            try:
-                hbm_budget_mb = int(raw)
-            except ValueError:
-                raise ValueError(f"ARKS_MODEL_POOL_HBM_MB={raw!r} (want an integer)")
+            hbm_budget_mb = knobs.get_int("ARKS_MODEL_POOL_HBM_MB")
         if hbm_budget_mb < 0:
             raise ValueError(f"ARKS_MODEL_POOL_HBM_MB={hbm_budget_mb} (want >= 0)")
         self.budget_bytes = hbm_budget_mb * (1 << 20)  # 0 = unlimited

@@ -39,6 +39,8 @@ import struct
 import threading
 import time
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.multihost")
 
 DISPATCH_PORT_OFFSET = 1  # default dispatch port = coordinator port + 1
@@ -49,7 +51,7 @@ def dispatch_address(coordinator: str) -> tuple[str, int]:
     reserved one (the local gang driver does — derived ports can collide on
     a shared host), else coordinator port + 1 (fine where each process has
     its own network namespace, e.g. one pod per host)."""
-    explicit = os.environ.get("ARKS_DISPATCH_ADDRESS")
+    explicit = knobs.get_str("ARKS_DISPATCH_ADDRESS")
     if explicit:
         host, _, port = explicit.partition(":")
         return host, int(port)
@@ -58,7 +60,7 @@ def dispatch_address(coordinator: str) -> tuple[str, int]:
 
 
 def _secret() -> bytes:
-    return os.environ.get("ARKS_GANG_SECRET", "arks-gang").encode()
+    return knobs.get_str("ARKS_GANG_SECRET").encode()
 
 
 def _leader_ack(secret: bytes) -> bytes:
@@ -111,8 +113,7 @@ class DispatchLeader:
         self._lock = threading.Lock()
         self._hb_lock = threading.Lock()
         self._last_hb: list[float] = []
-        self._wedge_fatal_s = float(
-            os.environ.get("ARKS_GANG_WEDGE_FATAL_S", "120"))
+        self._wedge_fatal_s = knobs.get_float("ARKS_GANG_WEDGE_FATAL_S")
         secret = _secret()
         deadline = time.monotonic() + accept_timeout_s
         while len(self._conns) < num_followers:
@@ -278,7 +279,7 @@ class DispatchFollower:
         self._send_lock = threading.Lock()
         threading.Thread(
             target=self._hb_loop,
-            args=(float(os.environ.get("ARKS_GANG_HB_INTERVAL", "2")),),
+            args=(knobs.get_float("ARKS_GANG_HB_INTERVAL"),),
             name="dispatch-hb", daemon=True).start()
         try:
             self._run_inner(eng, jax, jnp)

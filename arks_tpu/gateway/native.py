@@ -22,6 +22,8 @@ import os
 import subprocess
 import threading
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.gateway.native")
 
 _lock = threading.Lock()
@@ -61,9 +63,9 @@ def _load() -> ctypes.CDLL | None:
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("ARKS_NATIVE", "1") == "0":
+        if not knobs.get_bool("ARKS_NATIVE"):
             return None
-        path = os.environ.get("ARKS_NATIVE_LIB") or _build()
+        path = knobs.get_str("ARKS_NATIVE_LIB") or _build()
         if not path:
             return None
         try:

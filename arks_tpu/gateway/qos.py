@@ -9,6 +9,7 @@ token index is maintained from a Token watch.
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
 
 from arks_tpu.control.resources import Endpoint, Quota, Token
@@ -42,7 +43,7 @@ class QosProvider:
         while self._running:
             try:
                 event, tok = self._queue.get(timeout=0.2)
-            except Exception:
+            except queue.Empty:
                 continue
             with self._lock:
                 secret = tok.spec.get("token", "")

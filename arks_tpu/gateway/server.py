@@ -48,6 +48,8 @@ from arks_tpu.control.resources import (
 )
 from arks_tpu.obs import logctx
 from arks_tpu.obs import trace as trace_mod
+from arks_tpu.utils import knobs
+from arks_tpu.utils.swallow import swallowed
 
 log = logging.getLogger("arks_tpu.gateway")
 logctx.install(log)
@@ -55,7 +57,7 @@ logctx.install(log)
 # End-to-end tracing: the gateway is the trace ROOT — it mints the W3C
 # trace id, completes its admit span, and forwards both downstream
 # (traceparent + x-arks-trace-spans); the engine's store assembles them.
-_TRACE_ON = os.environ.get("ARKS_TRACE", "1") != "0"
+_TRACE_ON = knobs.get_bool("ARKS_TRACE")
 
 DEFAULT_RPM = 100            # types.go:24-64
 DEFAULT_TPM_MULTIPLIER = 1000
@@ -221,8 +223,7 @@ class Gateway:
         # (scale-from-zero, weights still loading into a pool), QUEUE the
         # request — poll routing for up to this many seconds — instead of
         # an instant 503.  Past the window, 503 + Retry-After.
-        self.cold_start_wait_s = float(
-            os.environ.get("ARKS_GW_COLD_START_WAIT_S", "10"))
+        self.cold_start_wait_s = knobs.get_float("ARKS_GW_COLD_START_WAIT_S")
         # SLO-tier ladder (ARKS_SLO_TIERS).  Empty = tier headers rejected.
         self.slo = slo_mod.from_env()
         self._httpd: ThreadingHTTPServer | None = None
@@ -515,15 +516,16 @@ class Gateway:
             try:
                 handler._error(e.code, e.message, retry_after=ra,
                                headers=hdrs)
-            except Exception:
-                pass
+            except Exception as e2:
+                # Client hung up before the error response went out.
+                swallowed("gateway.error-response", e2)
         except Exception as e:
             log.exception("gateway failure")
             self.metrics.errors_total.inc(stage="internal")
             try:
                 handler._error(500, f"gateway error: {e}")
-            except Exception:
-                pass
+            except Exception as e2:
+                swallowed("gateway.error-response", e2)
         finally:
             labels = dict(status=str(status))
             if qos is not None:

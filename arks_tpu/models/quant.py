@@ -37,6 +37,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from arks_tpu.utils import knobs
+
 INT4_GROUP = 128
 
 
@@ -47,7 +49,7 @@ def _int4_group(group: int | None) -> int:
     the env knob avoids replumbing every load path for that case."""
     if group is not None:
         return group
-    return int(os.environ.get("ARKS_INT4_GROUP", str(INT4_GROUP)))
+    return knobs.get_int("ARKS_INT4_GROUP")
 
 # Weights quantized per-output-channel along reduction dim -2 ([.., K, N]).
 MATMUL_KEYS = frozenset({

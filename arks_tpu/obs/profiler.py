@@ -21,17 +21,23 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import logging
 import os
 import threading
 import time
 
+from arks_tpu.utils import knobs
+from arks_tpu.utils.swallow import swallowed
+
+log = logging.getLogger("arks_tpu.profiler")
+
 
 class ProfilerWindows:
     def __init__(self, base_dir: str | None = None) -> None:
-        self.base_dir = base_dir or os.environ.get(
-            "ARKS_PROF_DIR", "/tmp/arks-prof")
-        self.auto_mult = float(os.environ.get("ARKS_PROF_AUTO_ARM", "0") or 0)
-        self.window_s = float(os.environ.get("ARKS_PROF_WINDOW_S", "5"))
+        self.base_dir = base_dir or knobs.get_str("ARKS_PROF_DIR")
+        self.auto_mult = knobs.get_float("ARKS_PROF_AUTO_ARM",
+                                         fallback=0.0)
+        self.window_s = knobs.get_float("ARKS_PROF_WINDOW_S")
         self.active = False
         self.dir: str | None = None
         self.auto_armed_total = 0
@@ -52,6 +58,7 @@ class ProfilerWindows:
                 import jax
                 jax.profiler.start_trace(d)
             except Exception as e:
+                log.debug("profiler start failed", exc_info=True)
                 return {"ok": False, "error": f"{type(e).__name__}: {e}"}
             self.dir = d
             self.active = True
@@ -68,6 +75,7 @@ class ProfilerWindows:
                 import jax
                 jax.profiler.stop_trace()
             except Exception as e:
+                log.debug("profiler stop failed", exc_info=True)
                 return {"ok": False, "error": f"{type(e).__name__}: {e}",
                         "dir": d}
             return {"ok": True, "dir": d}
@@ -101,5 +109,7 @@ class ProfilerWindows:
             import jax
             label = f"{name}[{ids}]" if ids else name
             return jax.profiler.TraceAnnotation(label)
-        except Exception:
+        except Exception as e:
+            # No jax (pure-I/O process) → annotations are a no-op.
+            swallowed("profiler.annotate", e)
             return contextlib.nullcontext()

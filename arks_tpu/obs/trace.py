@@ -35,6 +35,9 @@ import random
 import threading
 import time
 
+from arks_tpu.utils import knobs
+from arks_tpu.utils.swallow import swallowed
+
 TRACEPARENT_HEADER = "traceparent"
 SPANS_HEADER = "x-arks-trace-spans"
 
@@ -152,13 +155,13 @@ class Tracer:
 
     def __init__(self, enabled: bool | None = None) -> None:
         if enabled is None:
-            enabled = os.environ.get("ARKS_TRACE", "1") != "0"
+            enabled = knobs.get_bool("ARKS_TRACE")
         self.enabled = enabled
-        self.ring_cap = int(os.environ.get("ARKS_TRACE_RING", "8192"))
-        self.sample = float(os.environ.get("ARKS_TRACE_SAMPLE", "1.0"))
-        self.tail_n = int(os.environ.get("ARKS_TRACE_TAIL", "256"))
-        self.flush_s = float(os.environ.get("ARKS_TRACE_FLUSH_S", "0.2"))
-        self.store = TraceStore(int(os.environ.get("ARKS_TRACE_MAX", "256")))
+        self.ring_cap = knobs.get_int("ARKS_TRACE_RING")
+        self.sample = knobs.get_float("ARKS_TRACE_SAMPLE")
+        self.tail_n = knobs.get_int("ARKS_TRACE_TAIL")
+        self.flush_s = knobs.get_float("ARKS_TRACE_FLUSH_S")
+        self.store = TraceStore(knobs.get_int("ARKS_TRACE_MAX"))
         self._tl = threading.local()
         self._rings: list[_Ring] = []
         self._lock = threading.Lock()          # ring creation + meta only
@@ -265,8 +268,10 @@ class Tracer:
         while not self._stopping.wait(self.flush_s):
             try:
                 self.flush()
-            except Exception:
-                pass
+            except Exception as e:
+                # Keep the flusher thread alive, but a failed flush means
+                # trace loss — surface it.
+                swallowed("trace.flush", e, warn=True)
 
     def flush(self) -> None:
         """Drain the rings and assemble every finished trace.  Safe from

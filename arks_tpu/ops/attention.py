@@ -30,6 +30,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.ops.attention")
 
 _NEG_INF = -1e30
@@ -48,9 +50,7 @@ def _pad_last(x, d_store: int):
 
 def default_decode_impl() -> str:
     """'pallas' on real TPU, 'xla' elsewhere; override via ARKS_ATTN_IMPL."""
-    impl = os.environ.get("ARKS_ATTN_IMPL", "auto")
-    if impl not in ("auto", "pallas", "xla"):
-        raise ValueError(f"ARKS_ATTN_IMPL={impl!r}: expected auto|pallas|xla")
+    impl = knobs.get_str("ARKS_ATTN_IMPL")
     if impl == "auto":
         return "pallas" if jax.default_backend() == "tpu" else "xla"
     return impl
@@ -709,8 +709,8 @@ def decode_update_and_attend(
         kv_cache_update, kv_cache_update_quant, ragged_decode_attention,
     )
     interpret = jax.default_backend() != "tpu"
-    block_s = int(os.environ.get("ARKS_ATTN_BLOCK_S", "256"))
-    block_b = int(os.environ.get("ARKS_ATTN_BLOCK_B", "16"))
+    block_s = knobs.get_int("ARKS_ATTN_BLOCK_S")
+    block_b = knobs.get_int("ARKS_ATTN_BLOCK_B")
 
     def local(qg, kn, vn, kc, vc, ks, vs, widx, lyr):
         if quantized:

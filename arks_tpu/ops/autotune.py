@@ -36,6 +36,8 @@ import logging
 import os
 import threading
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks.autotune")
 
 _MODES = ("off", "cached", "sweep")
@@ -49,7 +51,7 @@ _table_path: str | None = None
 
 
 def mode() -> str:
-    m = os.environ.get("ARKS_KERNEL_TUNE", "cached").lower()
+    m = (knobs.raw("ARKS_KERNEL_TUNE") or "cached").lower()
     if m not in _MODES:
         raise ValueError(
             f"ARKS_KERNEL_TUNE={m!r} (expected one of {_MODES})")
@@ -60,10 +62,10 @@ def cache_path() -> str:
     """JSON table location: ``ARKS_KERNEL_TUNE_CACHE`` wins; else the model
     dir (``ARKS_MODEL_DIR``) so the table ships next to the checkpoint it
     was tuned for; else a per-user cache dir."""
-    p = os.environ.get("ARKS_KERNEL_TUNE_CACHE")
+    p = knobs.get_str("ARKS_KERNEL_TUNE_CACHE")
     if p:
         return p
-    base = os.environ.get("ARKS_MODEL_DIR") or os.path.join(
+    base = knobs.get_str("ARKS_MODEL_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "arks_tpu")
     return os.path.join(base, "kernel_tune.json")
 
@@ -156,7 +158,8 @@ def sweep(kernel: str, signature: str, candidates: list[dict],
                 bench_fn(**cand)
             t = (time.perf_counter() - t0) / repeats
         except Exception as e:  # an infeasible candidate is not fatal
-            log.debug("autotune candidate %s failed: %s", cand, e)
+            log.debug("autotune candidate %s failed: %s", cand, e,
+                      exc_info=True)
             continue
         log.info("autotune %s %s %s: %.3f ms", kernel, signature, cand,
                  t * 1e3)

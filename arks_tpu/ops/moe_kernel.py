@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from arks_tpu.utils import knobs
+
 
 def _compiler_params(**kw):
     """Compat shim: pallas renamed TPUCompilerParams -> CompilerParams across
@@ -41,9 +43,7 @@ def _compiler_params(**kw):
 
 
 def moe_impl() -> str:
-    impl = os.environ.get("ARKS_MOE_KERNEL", "auto")
-    if impl not in ("auto", "pallas", "xla"):
-        raise ValueError(f"ARKS_MOE_KERNEL={impl!r}")
+    impl = knobs.get_str("ARKS_MOE_KERNEL")
     # auto currently resolves to the ragged_dot path; flips to the kernel
     # once measured faster on hardware.
     return "xla" if impl == "auto" else impl

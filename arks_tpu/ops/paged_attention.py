@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from arks_tpu.utils import knobs
+
 
 def _compiler_params(**kw):
     """Compat shim: pallas renamed TPUCompilerParams -> CompilerParams across
@@ -112,7 +114,7 @@ def mixed_grid_mode() -> str:
     """ARKS_MIXED_GRID: 'ragged' (work-list grid, default) | 'dense' (the
     legacy (S, num_qb, max_pages) grid, kept as the byte-identity
     reference and fallback)."""
-    m = os.environ.get("ARKS_MIXED_GRID", "ragged").lower()
+    m = (knobs.raw("ARKS_MIXED_GRID") or "ragged").lower()
     if m not in ("ragged", "dense"):
         raise ValueError(f"ARKS_MIXED_GRID={m!r} (expected ragged|dense)")
     return m

@@ -40,6 +40,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from arks_tpu.utils import knobs
+
 
 # ---------------------------------------------------------------------------
 # Chain digests — THE one hash-chaining implementation.  engine.paged
@@ -177,14 +179,6 @@ def _top_key(digest: bytes) -> str:
 # Engine side: build + export
 # ---------------------------------------------------------------------------
 
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "")
-    try:
-        return int(v) if v else default
-    except ValueError:
-        raise ValueError(f"{name}={v!r}: expected an integer")
-
-
 class SketchExporter:
     """Per-engine sketch builder.  Holds the boot/reset epoch, the
     text->token alignment ledger, and a build cache keyed by the tier
@@ -200,11 +194,11 @@ class SketchExporter:
 
     def __init__(self, page_tokens: int):
         self.page = page_tokens
-        self.text_chars = _env_int("ARKS_ROUTER_SKETCH_CHARS", 256)
-        self.m_bits = _env_int("ARKS_ROUTER_SKETCH_BITS", 16384)
-        self.k_hashes = _env_int("ARKS_ROUTER_SKETCH_HASHES", 4)
-        self.top_k = _env_int("ARKS_ROUTER_SKETCH_TOPK", 128)
-        self.max_links = _env_int("ARKS_ROUTER_SKETCH_LINKS", 4096)
+        self.text_chars = knobs.get_int("ARKS_ROUTER_SKETCH_CHARS")
+        self.m_bits = knobs.get_int("ARKS_ROUTER_SKETCH_BITS")
+        self.k_hashes = knobs.get_int("ARKS_ROUTER_SKETCH_HASHES")
+        self.top_k = knobs.get_int("ARKS_ROUTER_SKETCH_TOPK")
+        self.max_links = knobs.get_int("ARKS_ROUTER_SKETCH_LINKS")
         if min(self.text_chars, self.m_bits, self.k_hashes, self.top_k,
                self.max_links) <= 0:
             raise ValueError("ARKS_ROUTER_SKETCH_* knobs must be positive")

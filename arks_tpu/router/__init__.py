@@ -52,6 +52,8 @@ from arks_tpu import prefix_sketch as sketch_mod
 from arks_tpu.gateway.metrics import RouterMetrics
 from arks_tpu.obs import logctx
 from arks_tpu.obs import trace as trace_mod
+from arks_tpu.utils import knobs
+from arks_tpu.utils.swallow import swallowed
 
 log = logging.getLogger("arks_tpu.router")
 logctx.install(log)
@@ -59,7 +61,7 @@ logctx.install(log)
 # Trace propagation rides the same switch the engine tracer uses; the
 # router keeps no span store of its own — its completed spans travel in
 # the x-arks-trace-spans header and assemble engine-side.
-_TRACE_ON = os.environ.get("ARKS_TRACE", "1") != "0"
+_TRACE_ON = knobs.get_bool("ARKS_TRACE")
 
 HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
 HDR_TIER = "x-arks-tier"   # SLO tier (arks_tpu.slo), forwarded verbatim
@@ -93,8 +95,7 @@ class Discovery:
 
 
 def _env_addrs(name: str) -> list[str]:
-    v = os.environ.get(name, "")
-    return [a for a in v.split(",") if a]
+    return knobs.get_list(name)
 
 
 class KubeDiscovery:
@@ -371,8 +372,7 @@ class Router:
         # Unified mode: backends are plain OpenAI servers (no prefill/
         # decode split) — only the decode list is consulted, and requests
         # forward to the ordinary path with no prefill header.
-        self.unified = unified or os.environ.get(
-            "ARKS_ROUTER_UNIFIED", "") not in ("", "0", "false")
+        self.unified = unified or knobs.get_bool("ARKS_ROUTER_UNIFIED")
         self._rr = itertools.count()
         self._httpd: ThreadingHTTPServer | None = None
         self.metrics = RouterMetrics()
@@ -382,14 +382,12 @@ class Router:
         self.retries_total = self.metrics.retries_total
         # Sketch scoring (cache_aware only; ARKS_ROUTER_SKETCH=0 restores
         # the rendezvous-only behavior).
-        self.sketch_on = (policy == "cache_aware" and os.environ.get(
-            "ARKS_ROUTER_SKETCH", "1") not in ("0", "false"))
-        self._t0_weight = float(os.environ.get(
-            "ARKS_ROUTER_SKETCH_T0_WEIGHT", "1.0"))
-        self._max_blocks = int(os.environ.get(
-            "ARKS_ROUTER_SKETCH_MAX_BLOCKS", "64"))
-        poll_s = float(os.environ.get("ARKS_ROUTER_SKETCH_POLL_S", "2.0"))
-        stale_s = float(os.environ.get("ARKS_ROUTER_SKETCH_STALE_S", "10"))
+        self.sketch_on = (policy == "cache_aware"
+                          and knobs.get_bool("ARKS_ROUTER_SKETCH"))
+        self._t0_weight = knobs.get_float("ARKS_ROUTER_SKETCH_T0_WEIGHT")
+        self._max_blocks = knobs.get_int("ARKS_ROUTER_SKETCH_MAX_BLOCKS")
+        poll_s = knobs.get_float("ARKS_ROUTER_SKETCH_POLL_S")
+        stale_s = knobs.get_float("ARKS_ROUTER_SKETCH_STALE_S")
         self.sketches = _SketchPoller(self, poll_s, stale_s)
         # In-flight forwards per decode backend (least-loaded fallback).
         self._load_lock = threading.Lock()
@@ -508,8 +506,9 @@ class Router:
             else:
                 try:
                     h._error(500, f"router error: {e}")
-                except Exception:
-                    pass
+                except Exception as e2:
+                    # Client hung up before the error response went out.
+                    swallowed("router.error-response", e2)
         finally:
             self.requests_total.inc(status=str(status))
 
@@ -628,7 +627,7 @@ class Router:
         Retry-After the backends offered passes through so clients back
         off the amount the slowest replica asked for."""
         candidates = [decode_addr] + [b for b in decode if b != decode_addr]
-        backoff = float(os.environ.get("ARKS_ROUTER_RETRY_BACKOFF_S", "0.05"))
+        backoff = knobs.get_float("ARKS_ROUTER_RETRY_BACKOFF_S")
         retry_after: str | None = None
         last_err: Exception | None = None
         for attempt in range(2):

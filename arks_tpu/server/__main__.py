@@ -19,6 +19,8 @@ import argparse
 import logging
 import os
 
+from arks_tpu.utils import knobs
+
 log = logging.getLogger("arks_tpu.server")
 
 
@@ -98,7 +100,7 @@ def main() -> None:
                         "LRU-evicts idle unpinned models; the primary and "
                         "draft are pinned")
     p.add_argument("--drain-timeout", type=float,
-                   default=float(os.environ.get("ARKS_DRAIN_TIMEOUT", "20")),
+                   default=knobs.get_float("ARKS_DRAIN_TIMEOUT"),
                    help="SIGTERM grace: finish in-flight requests up to "
                         "this many seconds before exiting (rolling updates "
                         "become request-lossless when it covers the longest "
@@ -129,18 +131,18 @@ def main() -> None:
     # Fault-tolerance knobs travel by env (the engine and its watchdog
     # read them at start); explicit flags win over inherited env.
     if args.dispatch_deadline is not None:
-        os.environ["ARKS_DISPATCH_DEADLINE_S"] = str(args.dispatch_deadline)
+        knobs.push("ARKS_DISPATCH_DEADLINE_S", str(args.dispatch_deadline))
     if args.fault_retries is not None:
-        os.environ["ARKS_FAULT_RETRIES"] = str(args.fault_retries)
+        knobs.push("ARKS_FAULT_RETRIES", str(args.fault_retries))
 
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    coord = os.environ.get("ARKS_COORDINATOR_ADDRESS")
+    coord = knobs.get_str("ARKS_COORDINATOR_ADDRESS")
     if coord:
-        pid = int(os.environ.get("ARKS_PROCESS_ID", "0"))
-        nproc = int(os.environ.get("ARKS_NUM_PROCESSES", "1"))
+        pid = knobs.get_int("ARKS_PROCESS_ID")
+        nproc = knobs.get_int("ARKS_NUM_PROCESSES")
         log.info("multi-host init: coordinator=%s process=%d/%d", coord, pid, nproc)
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)
@@ -164,7 +166,7 @@ def main() -> None:
     # unset default is None, so forcing single-slice in a multi-slice pod
     # is expressible).
     if args.num_slices is None:
-        args.num_slices = int(os.environ.get("ARKS_NUM_SLICES", "1"))
+        args.num_slices = knobs.get_int("ARKS_NUM_SLICES")
     if (args.dp < 1 or args.cp < 1 or args.pp < 1 or args.num_slices < 1
             or (args.tp is not None and args.tp < 1)):
         raise SystemExit("parallel-size flags must be >= 1")
@@ -179,7 +181,7 @@ def main() -> None:
             f"requested tp={tp} x dp={args.dp} x cp={args.cp} "
             f"x pp={args.pp} needs {want} devices but only "
             f"{n_dev} are visible")
-    nproc = int(os.environ.get("ARKS_NUM_PROCESSES", "1"))
+    nproc = knobs.get_int("ARKS_NUM_PROCESSES")
     mesh = None
     if want > 1:
         from arks_tpu.parallel.mesh import make_mesh
@@ -289,7 +291,7 @@ def main() -> None:
         from arks_tpu.engine.multihost import (
             DispatchFollower, DispatchLeader, dispatch_address)
         dhost, dport = dispatch_address(coord)
-        pid = int(os.environ.get("ARKS_PROCESS_ID", "0"))
+        pid = knobs.get_int("ARKS_PROCESS_ID")
         if pid != 0:
             # The gang driver SIGTERMs every member at once; a follower
             # dying instantly would strand the leader's drain mid-

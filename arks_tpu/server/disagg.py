@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import uuid
 
 from arks_tpu.engine import kv_transfer
@@ -33,6 +34,8 @@ from arks_tpu.engine.types import PrefilledState, Request
 from arks_tpu.server.openai_server import (
     OpenAIServer, _sampling_from_body,
 )
+
+log = logging.getLogger("arks_tpu.disagg")
 
 PREFILL_PATH = "/v1/prefill"
 HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
@@ -139,6 +142,8 @@ class DecodeServer(OpenAIServer):
                                            "type": "invalid_request_error",
                                            "code": "context_length_exceeded"}})
         except Exception as e:
+            log.warning("prefill pull from %s failed", prefill_addr,
+                        exc_info=True)
             return h._error(502, f"prefill pull failed: {e}")
 
         try:

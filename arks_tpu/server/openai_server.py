@@ -19,6 +19,7 @@ engine thread; all device work stays on the engine thread.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -32,6 +33,10 @@ from arks_tpu.engine.types import Request, SamplingParams
 from arks_tpu.obs import logctx
 from arks_tpu.obs import perfetto as perfetto_mod
 from arks_tpu.obs import trace as trace_mod
+from arks_tpu.utils import knobs
+from arks_tpu.utils.swallow import swallowed
+
+log = logging.getLogger("arks_tpu.server")
 
 # SLO tier header (gateway/router forward it; arks_tpu.gateway.server
 # validates it against the same ARKS_SLO_TIERS ladder).
@@ -200,8 +205,7 @@ class OpenAIServer:
         disp = getattr(self.engine, "dispatcher", None)
         if disp is None or not hasattr(disp, "follower_health"):
             return None
-        h = disp.follower_health(float(os.environ.get("ARKS_GANG_STALE_S",
-                                                      "15")))
+        h = disp.follower_health(knobs.get_float("ARKS_GANG_STALE_S"))
         if h["stale"]:
             return (f"follower heartbeat stale: {h['stale']} "
                     f"(max age {h['max_heartbeat_age_s']}s)")
@@ -308,7 +312,7 @@ class OpenAIServer:
                     # traffic — workers participate in collectives but must
                     # stay out of Service endpoints (the K8s front Service
                     # selects the whole gang and relies on this gate).
-                    if os.environ.get("ARKS_PROCESS_ID", "0") not in ("", "0"):
+                    if knobs.raw("ARKS_PROCESS_ID") not in ("", "0"):
                         self._error(503, "worker process (leader serves)")
                     elif server.draining:
                         self._error(503, "draining")
@@ -373,10 +377,13 @@ class OpenAIServer:
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # engine/request failure → 500
+                    log.exception("request handler failure on %s",
+                                  self.path)
                     try:
                         self._error(500, f"internal error: {e}")
-                    except Exception:
-                        pass
+                    except Exception as e2:
+                        # Client hung up before the 500 went out.
+                        swallowed("server.error-response", e2)
                 finally:
                     with server._active_lock:
                         server._active -= 1
@@ -543,7 +550,7 @@ class OpenAIServer:
                 params = _dct.replace(params, priority=pri)
             tools_ctx = None
             if tools_on:
-                tools_ctx = os.environ.get("ARKS_TOOL_PARSER", "auto")
+                tools_ctx = knobs.get_str("ARKS_TOOL_PARSER")
                 forced = tools_mod.forced_call_guide(tools, tool_choice)
                 if forced is not None:
                     if params.guide is not None:

@@ -31,7 +31,8 @@ headers are rejected, and body priorities pass through untouched.
 from __future__ import annotations
 
 import dataclasses
-import os
+
+from arks_tpu.utils import knobs
 
 ENV_VAR = "ARKS_SLO_TIERS"
 DEFAULT_TIER = "default"
@@ -119,5 +120,5 @@ def parse_tiers(spec: str) -> SloTiers:
 def from_env() -> SloTiers:
     """The process-wide ladder from ``ARKS_SLO_TIERS`` (empty when
     unset)."""
-    spec = os.environ.get(ENV_VAR, "")
+    spec = knobs.get_str(ENV_VAR, fallback="") or ""
     return parse_tiers(spec) if spec.strip() else SloTiers()

@@ -1,0 +1,27 @@
+"""The sanctioned intentional-swallow marker for non-engine components.
+
+The arkslint ``exceptions`` rule requires every broad handler
+(``except Exception`` / bare ``except``) under ``arks_tpu/`` to re-raise,
+route through the fault API, or log the exception with a traceback.  The
+few handlers that *deliberately* discard an exception (capability
+probes, best-effort error responses after a failure already in flight)
+call this instead of silently passing — the same contract as
+``arks_tpu.engine.faults.swallowed`` but importable without the engine
+package (the router and gateway must stay JAX-free).
+"""
+
+from __future__ import annotations
+
+import logging
+
+_log = logging.getLogger("arks_tpu.swallowed")
+
+
+def swallowed(site: str, exc: BaseException | None = None, *,
+              warn: bool = False) -> None:
+    """Record an intentionally swallowed exception.  ``warn=True`` for
+    swallows that should be visible in default logs (supervision loops);
+    the default DEBUG level suits per-request best-effort paths that
+    would otherwise spam (client disconnects, probe failures)."""
+    _log.log(logging.WARNING if warn else logging.DEBUG,
+             "swallowed exception at %s: %s", site, exc, exc_info=exc)

@@ -1,368 +1,69 @@
-"""Static guard over the decode hot path.
+"""Static guard over the decode hot path — thin wrapper over arkslint.
 
-The zero-host-sync contract of the pipelined scheduler lives or dies on
-the ISSUE side of the issue/resolve split never blocking on device
-values: one stray ``np.asarray(device_array)`` in an ``_issue_*``
-function silently reintroduces the per-step host stall the pipeline
-exists to remove — and it would still pass every token-parity test,
-because blocking changes only the overlap, not the values.  This test
-walks the scheduler's issue-side functions via AST and fails on any new
-blocking fetch (np.asarray / jax.device_get / .block_until_ready /
-.item) outside the ``_resolve_*`` / ``_pipe_resolve_*`` tails, where
-host syncs belong.
+The invariants this file used to implement by hand (zero-host-sync issue
+path, autotune-sweep containment, evt-only tracing, jax-free sketch
+module) now live in ``arks_tpu/analysis/rules/hotpath.py``, which
+discovers the issue-side hot path by CALL GRAPH from the scheduler roots
+instead of the hand-curated ``HOT_PATH_FUNCTIONS`` tuple this file used
+to carry — a new helper cannot dodge the guard by not being listed.
+Reviewed exceptions (the old ``ALLOWED`` set) live in
+``tools/arkslint-baseline.json`` with one-line justifications.
+
+These wrappers keep ``pytest tests/`` and ``python -m arks_tpu.analysis``
+two doors into the same checker: each test filters the rule's findings
+by sub-check so a regression still fails the test whose name says what
+broke.  The call-graph discovery itself (including the guarantee that it
+covers everything the legacy tuple listed) is tested in
+``tests/test_analysis.py``.
 """
 
-import ast
-import inspect
+import functools
 
-from arks_tpu.engine import engine as engine_mod
-
-# The issue-side hot path: one dispatch goes OUT per call, nothing comes
-# back.  _resolve_* and _pipe_resolve_* are deliberately absent — they
-# are the sanctioned host-sync tails.
-HOT_PATH_FUNCTIONS = (
-    "step",
-    "_step_pipelined",
-    "_pipe_issue",
-    "_issue_decode",
-    "_issue_mixed",
-    # Speculative decoding rides the mixed dispatch: the spec-mixed issue
-    # path (and the chunk-lane builder both mixed issuers share) must not
-    # grow a blocking fetch either — draft proposals are scattered into
-    # the verify blocks ON DEVICE precisely so no host sync is needed.
-    "_issue_spec_mixed",
-    "_fill_chunk_lanes",
-    "_issue_admit_batch",
-    # Hierarchical prefix cache: spills and restores are ISSUE-side too —
-    # eviction must never block the engine thread, and a restore is just
-    # another async dispatch the pipelined decode overlaps.  Their host
-    # syncs live in _resolve_spills / _resolve_restores.
-    "_spill_flush",
-    "_issue_restore",
-    "_dispatch_restore_group",
-    # Multi-model serving: the switch issue path runs every step while
-    # another model's weights stream in the background — a blocking fetch
-    # here would stall the pipelined decode the overlap exists to protect.
-    # The load itself happens on a pool thread; the switch executes only
-    # at a fully drained boundary (nothing in flight to stall).
-    "_issue_model_load",
-    "_park_awaiting_model",
-    # Routing-sketch membership maintenance rides these engine-thread
-    # paths (the allocator's mirror updates inside register/evict): they
-    # must stay pure host bookkeeping — the sketch EXPORT happens on
-    # server threads from the mirror, never by fetching device state here.
-    "_note_evicted",
-    "_register_prompt_pages",
-    # Preemptive KV swap: the seize path runs INSIDE a loaded step — the
-    # victim's KV gathers and sampler-row snapshot go out as async
-    # dispatches (copy_to_host_async) and the resume scatter is the same
-    # async restore program as prefix restores.  A blocking fetch here
-    # would stall every survivor's decode for the length of a D2H drain.
-    # Host syncs live in _resolve_preempt_swaps / _finish_resume (via
-    # _resolve_restores).
-    "_maybe_preempt",
-    "_issue_preempt_swap",
-    "_preempt_replay",
-    "_service_swapped",
-    "_resume_swapped",
-    # Ragged-grid padding-waste counters: both mixed issuers call this per
-    # dispatch.  It reads the host-side numpy batch arrays the issuer
-    # already built — fetching device state here would reintroduce the
-    # per-step stall on every single mixed dispatch.
-    "_mixed_grid_counters",
-)
-
-# Sketch export surface: runs on SERVER threads, but the same contract
-# applies with more force — an export that fetched device data would
-# serialize against the dispatch stream from outside the engine thread.
-# Everything it reads (digest mirrors, host-tier maps, counters) is host
-# state by construction.
-SKETCH_EXPORT_FUNCTIONS = (
-    "cache_sketch",
-    "note_prompt_text",
-)
-
-# Sanctioned exceptions, keyed (function, unparsed argument).  Each entry
-# must stay justifiable as a NON-blocking read:
-#   - _fill_chunk_lanes / st.key: an 8-byte PRNG key materialized at
-#     _start_chunked, long before any in-flight dispatch could pin it.
-#   - _issue_admit_batch / slots_l: a host python list, not device data.
-ALLOWED = {
-    ("_fill_chunk_lanes", "st.key"),
-    ("_issue_admit_batch", "slots_l"),
-}
-
-BLOCKING_ATTRS = {"block_until_ready", "item"}
+from arks_tpu.analysis import SourceTree, repo_root, run_rules
+from arks_tpu.analysis.baseline import Baseline
 
 
-def _blocking_calls(func_name: str, tree: ast.AST):
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if not isinstance(f, ast.Attribute):
-            continue
-        hit = None
-        if (f.attr == "asarray" and isinstance(f.value, ast.Name)
-                and f.value.id == "np"):
-            hit = "np.asarray"
-        elif f.attr == "device_get":
-            hit = "device_get"
-        elif f.attr in BLOCKING_ATTRS:
-            hit = f.attr
-        if hit is None:
-            continue
-        arg = ast.unparse(node.args[0]) if node.args else ""
-        # Literal host containers are host data by construction.
-        if node.args and isinstance(node.args[0],
-                                    (ast.List, ast.ListComp, ast.Tuple,
-                                     ast.GeneratorExp, ast.Constant)):
-            continue
-        if (func_name, arg) in ALLOWED:
-            continue
-        out.append((func_name, hit, arg, node.lineno))
-    return out
+@functools.lru_cache(maxsize=1)
+def _active_findings():
+    """hotpath findings over the real tree, baseline applied (staleness
+    is asserted by test_analysis.py / the CLI, not per-wrapper)."""
+    root = repo_root()
+    findings = run_rules(SourceTree.load(root), ["hotpath"])
+    baseline = Baseline.load(root / "tools" / "arkslint-baseline.json")
+    active, _suppressed, _stale = baseline.apply(findings)
+    return [f for f in active if f.severity == "error"]
+
+
+def _errors(*checks):
+    return [f.render() for f in _active_findings() if f.check in checks]
 
 
 def test_no_blocking_fetches_on_the_issue_path():
-    src = inspect.getsource(engine_mod)
-    module = ast.parse(src)
-    cls = next(n for n in module.body
-               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
-    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
-    missing = [f for f in HOT_PATH_FUNCTIONS if f not in funcs]
-    assert not missing, f"hot-path functions renamed/removed: {missing}"
-
-    violations = []
-    for name in HOT_PATH_FUNCTIONS:
-        violations += _blocking_calls(name, funcs[name])
-    assert not violations, (
-        "blocking device fetch on the issue-side hot path (move it into a "
-        f"_resolve_* tail or justify it in ALLOWED): {violations}")
-
-
-def test_no_blocking_fetches_in_sketch_export():
-    """The sketch export path (GET /v1/cache/sketch -> engine.cache_sketch,
-    plus the server's note_prompt_text hook) must never grow a blocking
-    device fetch: it runs concurrently with the dispatch stream, with the
-    same non-blocking discipline as spills."""
-    src = inspect.getsource(engine_mod)
-    module = ast.parse(src)
-    cls = next(n for n in module.body
-               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
-    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
-    missing = [f for f in SKETCH_EXPORT_FUNCTIONS if f not in funcs]
-    assert not missing, f"sketch export functions renamed/removed: {missing}"
-    violations = []
-    for name in SKETCH_EXPORT_FUNCTIONS:
-        violations += _blocking_calls(name, funcs[name])
-    assert not violations, (
-        f"blocking device fetch in the sketch export path: {violations}")
-
-
-def test_sketch_module_stays_jax_free():
-    """The router imports arks_tpu.prefix_sketch directly — a jax (or
-    arks_tpu.engine) import there would drag the full runtime into the
-    pure-I/O router process."""
-    import arks_tpu.prefix_sketch as sketch_mod
-    src = inspect.getsource(sketch_mod)
-    module = ast.parse(src)
-    for node in ast.walk(module):
-        names = []
-        if isinstance(node, ast.Import):
-            names = [a.name for a in node.names]
-        elif isinstance(node, ast.ImportFrom):
-            names = [node.module or ""]
-        for n in names:
-            assert not n.startswith("jax"), f"jax import in prefix_sketch: {n}"
-            assert not n.startswith("arks_tpu.engine"), (
-                f"engine import in prefix_sketch: {n}")
-
-
-def test_no_blocking_fetches_in_stream_scatter_helpers():
-    """The weight-streaming scatter path (models.weights) issues its H2D
-    puts as ordinary async dispatches while the live engine keeps
-    decoding; a blocking fetch there would serialize the overlap the
-    streaming switch exists for."""
-    from arks_tpu.models import weights as weights_mod
-    src = inspect.getsource(weights_mod)
-    module = ast.parse(src)
-    funcs = {n.name: n for n in module.body
-             if isinstance(n, ast.FunctionDef)}
-    guarded = ("_shard_put_fns", "stream_params_to_device")
-    missing = [f for f in guarded if f not in funcs]
-    assert not missing, f"stream-scatter helpers renamed/removed: {missing}"
-    violations = []
-    for name in guarded:
-        violations += _blocking_calls(name, funcs[name])
-    assert not violations, (
-        f"blocking device fetch in the weight-streaming path: {violations}")
-
-
-def _module_funcs(mod, names):
-    """FunctionDef nodes for module-level functions, asserting presence."""
-    src = inspect.getsource(mod)
-    tree = ast.parse(src)
-    funcs = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
-    missing = [f for f in names if f not in funcs]
-    assert not missing, f"guarded helpers renamed/removed: {missing}"
-    return [funcs[n] for n in names]
-
-
-# Work-list / grid-plan helpers that run per mixed dispatch (the ragged
-# grid's launch-parameter resolution), plus the autotune CACHE-LOAD path
-# that mixed_grid_plan consults.  All of them sit upstream of every mixed
-# issue — same zero-host-sync contract as the issuers themselves.
-# build_mixed_work_list is traceable jnp on purpose (the pipelined
-# dispatches derive q_len on device); mixed_grid_steps deliberately takes
-# already-host numpy without np.asarray.
-GRID_PLAN_HELPERS = {
-    "arks_tpu.ops.paged_attention": (
-        "mixed_grid_mode", "mixed_grid_plan", "build_mixed_work_list"),
-    "arks_tpu.engine.paged": ("mixed_grid_steps",),
-    "arks_tpu.ops.autotune": ("lookup", "_load_locked", "mixed_signature",
-                              "decode_signature"),
-}
-
-
-def test_no_blocking_fetches_in_grid_plan_helpers():
-    import importlib
-    violations = []
-    for mod_name, names in GRID_PLAN_HELPERS.items():
-        mod = importlib.import_module(mod_name)
-        for node in _module_funcs(mod, names):
-            violations += _blocking_calls(f"{mod_name}.{node.name}", node)
-    assert not violations, (
-        f"blocking device fetch in a grid-plan/autotune-load helper: "
-        f"{violations}")
+    assert not _errors("blocking-fetch"), _errors("blocking-fetch")
 
 
 def test_no_sweep_reachable_from_step_loop():
-    """The autotune lookup/ensure split: the step loop (hot-path issuers
-    and the grid-plan helpers they call) may only ever take the PURE READ
-    side (autotune.lookup).  A sweep() or ensure() call — which compiles
-    and times candidate kernels — belongs exclusively in warm-up
-    (_warm_autotune, before the first dispatch)."""
-    import importlib
+    assert not _errors("autotune-sweep"), _errors("autotune-sweep")
 
-    def sweep_calls(func_name, tree):
-        out = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            hit = None
-            if isinstance(f, ast.Attribute):
-                # autotune.sweep / autotune.ensure / self._warm_autotune;
-                # other receivers' ensure (e.g. the weight pool's
-                # pool.ensure) are unrelated.
-                recv = ast.unparse(f.value)
-                if f.attr == "_warm_autotune" or (
-                        f.attr in ("sweep", "ensure")
-                        and recv.split(".")[-1] == "autotune"):
-                    hit = f"{recv}.{f.attr}"
-            elif isinstance(f, ast.Name) and f.id in ("sweep", "ensure",
-                                                      "_warm_autotune"):
-                hit = f.id
-            if hit:
-                out.append((func_name, hit, node.lineno))
-        return out
 
-    src = inspect.getsource(engine_mod)
-    module = ast.parse(src)
-    cls = next(n for n in module.body
-               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
-    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
-    violations = []
-    for name in HOT_PATH_FUNCTIONS:
-        violations += sweep_calls(name, funcs[name])
-    for mod_name, names in GRID_PLAN_HELPERS.items():
-        mod = importlib.import_module(mod_name)
-        for node in _module_funcs(mod, names):
-            violations += sweep_calls(f"{mod_name}.{node.name}", node)
-    assert not violations, (
-        f"autotune sweep reachable from the step loop: {violations}")
+def test_no_serialization_on_the_issue_path():
+    assert not _errors("serialization", "lock-acquire"), (
+        _errors("serialization", "lock-acquire"))
 
 
 def test_trace_calls_on_hot_path_are_evt_only():
-    """The step loop may talk to the tracer through exactly one method:
-    ``self.trace.evt(...)`` — an append to a per-thread ring.  Any other
-    tracer attribute reached from a hot-path function (flush, register,
-    attach_tail, store access...) takes locks or allocates, i.e. it is
-    trace ASSEMBLY leaking onto the issue path."""
-    src = inspect.getsource(engine_mod)
-    module = ast.parse(src)
-    cls = next(n for n in module.body
-               if isinstance(n, ast.ClassDef) and n.name == "InferenceEngine")
-    funcs = {n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
-    violations = []
-    for name in HOT_PATH_FUNCTIONS:
-        for node in ast.walk(funcs[name]):
-            if not isinstance(node, ast.Attribute):
-                continue
-            v = node.value
-            if (isinstance(v, ast.Attribute) and v.attr == "trace"
-                    and isinstance(v.value, ast.Name)
-                    and v.value.id == "self"
-                    and node.attr not in ("evt", "enabled")):
-                violations.append((name, f"self.trace.{node.attr}",
-                                   node.lineno))
-    assert not violations, (
-        f"non-evt tracer access on the issue-side hot path: {violations}")
+    assert not _errors("trace-access"), _errors("trace-access")
 
 
 def test_tracer_evt_is_lock_and_serialization_free():
-    """``Tracer.evt`` and the ``_Ring`` it appends to are the only tracing
-    code the step loop executes.  They must stay free of locks, context
-    managers, serialization, and sleeps — the single sanctioned exception
-    is the first-call-per-thread ring creation inside the AttributeError
-    handler (``self._new_ring()``, which takes the registration lock once
-    per thread lifetime, not per event)."""
-    from arks_tpu.obs import trace as trace_mod
+    assert not _errors("trace-evt-impl"), _errors("trace-evt-impl")
 
-    src = inspect.getsource(trace_mod)
-    module = ast.parse(src)
-    classes = {n.name: n for n in module.body if isinstance(n, ast.ClassDef)}
-    tracer = classes["Tracer"]
-    ring = classes["_Ring"]
-    evt = next(n for n in tracer.body
-               if isinstance(n, ast.FunctionDef) and n.name == "evt")
 
-    def handler_nodes(tree):
-        inside = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ExceptHandler):
-                for sub in ast.walk(node):
-                    inside.add(id(sub))
-        return inside
-
-    violations = []
-    for scope_name, tree in (("Tracer.evt", evt), ("_Ring", ring)):
-        allowed = handler_nodes(tree)
-        for node in ast.walk(tree):
-            if id(node) in allowed:
-                continue
-            bad = None
-            if isinstance(node, (ast.With, ast.AsyncWith)):
-                bad = "with-block (lock?)"
-            elif isinstance(node, ast.Attribute) and node.attr in (
-                    "acquire", "Lock", "RLock", "sleep", "dumps", "loads",
-                    "flush", "join"):
-                bad = f".{node.attr}"
-            elif isinstance(node, ast.Name) and node.id in ("json", "pickle"):
-                bad = node.id
-            if bad:
-                violations.append((scope_name, bad, node.lineno))
-    assert not violations, (
-        f"lock/serialization on the event-record path: {violations}")
+def test_sketch_module_stays_jax_free():
+    assert not _errors("sketch-import"), _errors("sketch-import")
 
 
 def test_resolve_tails_exist():
-    """The guard above is only meaningful while the sanctioned sync tails
-    exist under their expected names."""
-    for name in ("_resolve_decode", "_resolve_mixed", "_resolve_spec_mixed",
-                 "_pipe_resolve_one", "_resolve_admit_batch",
-                 "_resolve_spills", "_resolve_restores",
-                 "_resolve_preempt_swaps", "_finish_resume"):
-        assert callable(getattr(engine_mod.InferenceEngine, name)), name
+    """Roots and sanctioned host-sync tails still exist under their
+    expected names — the guard is only meaningful while they do."""
+    assert not _errors("contract"), _errors("contract")
