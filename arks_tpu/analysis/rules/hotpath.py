@@ -70,6 +70,14 @@ ROOTS = (
     (ENGINE, ENGINE_CLASS, "cache_sketch"),
     (ENGINE, ENGINE_CLASS, "note_prompt_text"),
     ("arks_tpu/models/weights.py", None, "stream_params_to_device"),
+    # Tenant-fair admission: the WDRR pick/put/aging path runs inside the
+    # scheduler's admission slice every step — same no-serialization /
+    # no-sleep / no-blocking-fetch contract as the step roots.  (Appended
+    # AFTER the legacy entries: step_reachable slices ROOTS[:2].)
+    ("arks_tpu/engine/fairqueue.py", "FairQueue", "get_nowait"),
+    ("arks_tpu/engine/fairqueue.py", "FairQueue", "put"),
+    ("arks_tpu/engine/fairqueue.py", "FairQueue", "head_prio"),
+    ("arks_tpu/engine/fairqueue.py", "FairQueue", "age_tick"),
 )
 
 BOUNDARY_RE = re.compile(

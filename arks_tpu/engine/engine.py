@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import heapq
 import logging
 import os
 import queue
@@ -37,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from arks_tpu.engine import fairqueue
 from arks_tpu.engine import faults as faults_mod
 from arks_tpu.engine import sampler as sampler_mod
 from arks_tpu.engine.faults import StepFault
@@ -52,6 +52,7 @@ from arks_tpu.obs import trace as trace_mod
 from arks_tpu.utils import knobs
 from arks_tpu.utils import metrics as prom
 from arks_tpu import slo as slo_mod
+from arks_tpu import tenancy
 
 log = logging.getLogger("arks_tpu.engine")
 logctx.install(log)
@@ -655,6 +656,20 @@ class EngineMetrics:
             "resume issue -> slot live again)",
             buckets=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1, 2.5])
+        # ---- Tenant-fair admission + overload ladder (engine.fairqueue)
+        # The tenant label rides through TenantLabels (first-K tenants
+        # keep their id, the rest share "other") so hostile key churn
+        # cannot mint unbounded series — tests/test_metrics_conformance
+        # enforces the bound.
+        self.requests_shed_total = r.counter(
+            "requests_shed_total",
+            "Requests rejected by the overload ladder, by reason "
+            "(queue_full|tenant_cap|deadline), tier, and bounded tenant "
+            "label")
+        self.admission_queue_depth = r.gauge(
+            "admission_queue_depth",
+            "Admission-queue depth across all tiers and tenants (compare "
+            "against ARKS_QUEUE_MAX for the saturation fraction)")
 
 
 def _scoped(phase: str):
@@ -730,13 +745,28 @@ class InferenceEngine:
         # single-model engine of that model would hold.
         from collections import deque
 
-        # Admission queue: priority-ordered (lower value first), FIFO
-        # within a priority via a monotonic tiebreak — Request objects are
-        # never compared.
-        self._queue: "queue.PriorityQueue[tuple[int, int, Request]]" = \
-            queue.PriorityQueue()
+        # Admission queue: tier-ordered (lower value first), weighted
+        # deficit round-robin across tenants within a tier, FIFO within a
+        # (tier, tenant) via a monotonic tiebreak — Request objects are
+        # never compared.  Bounded (ARKS_QUEUE_MAX / ARKS_QUEUE_TENANT_MAX)
+        # on the external add_request path only; with a single tenant the
+        # pick order is exactly the old PriorityQueue order.
+        self._queue = fairqueue.FairQueue()
         self._queue_seq = 0
         self._queued_rids: set[str] = set()
+        # Deadline-aware shedding (ARKS_SHED_DEADLINE): a popped request
+        # whose queue wait already exceeds factor x its tier's ttft_ms
+        # budget is rejected at _preadmit instead of wasting prefill on a
+        # stream its client has given up on.  0 = off.  Replay, swap-
+        # resume, and disagg-prefilled requests are exempt.
+        shed_factor = knobs.get_float("ARKS_SHED_DEADLINE")
+        if shed_factor < 0:
+            raise ValueError(
+                f"ARKS_SHED_DEADLINE={shed_factor}: must be >= 0")
+        self._shed_deadline_factor = shed_factor
+        # Bounded tenant metric labels (ARKS_TENANT_LABEL_MAX): tenant ids
+        # are unbounded user input; label cardinality must not be.
+        self._tenant_labels = tenancy.TenantLabels()
         self._aborted: set[str] = set()
         self._abort_lock = threading.Lock()
         # Detached prefill (disaggregated mode) runs on server threads, not
@@ -2098,7 +2128,24 @@ class InferenceEngine:
             self._queued_rids.add(request.request_id)
             self._queue_seq += 1
             seq = self._queue_seq
-        self._queue.put((request.params.priority, seq, request))
+        try:
+            # Bounded put: external admissions hit the overload ladder's
+            # first rung HERE, on the caller's (server) thread — the
+            # QueueFullError carries a drain-rate-derived Retry-After the
+            # HTTP layer maps to 429 (tenant cap) / 503 (total cap).
+            self._queue.put((request.params.priority, seq, request),
+                            bounded=True)
+        except fairqueue.QueueFullError as e:
+            with self._abort_lock:
+                self._queued_rids.discard(request.request_id)
+            self.metrics.num_requests_waiting.inc(-1)
+            self.metrics.requests_shed_total.inc(
+                1,
+                reason="queue_full" if e.scope == "queue" else "tenant_cap",
+                tier=self._slo.tier_of(request.params.priority),
+                tenant=self._tenant_labels.label(request.tenant))
+            raise
+        self.metrics.admission_queue_depth.set(self._queue.qsize())
 
     def abort(self, request_id: str) -> None:
         """Free the request's slot at the next scheduler boundary (client
@@ -3064,6 +3111,23 @@ class InferenceEngine:
                     _, _, req = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                try:
+                    # Chaos hook at the WDRR pick point: the popped
+                    # request is the sole culprit (its retry budget
+                    # burns; over budget it quarantines alone) AND a
+                    # survivor (nothing was emitted — recovery plain-
+                    # requeues it through the fair queue again).
+                    self._faults.fire("admit_fair")
+                except Exception as e:
+                    self.metrics.num_requests_waiting.inc(-1)
+                    with self._abort_lock:
+                        self._queued_rids.discard(req.request_id)
+                    raise StepFault(
+                        "admit_fair", faults_mod.classify(e),
+                        culprits=[req.request_id],
+                        survivors=[_Survivor(
+                            request=req, seed=self._resolve_seed(req),
+                            num_prompt=len(req.prompt_ids))]) from e
                 admitted = True
                 pre = self._preadmit(req)
                 if pre is not None:
@@ -3169,6 +3233,7 @@ class InferenceEngine:
         and the chunked/prefix paths are handled HERE (individually);
         one-shot prompts return (req, ids, padded) for batch grouping."""
         self.metrics.num_requests_waiting.inc(-1)
+        self.metrics.admission_queue_depth.set(self._queue.qsize())
         with self._abort_lock:
             self._queued_rids.discard(req.request_id)
             if req.request_id in self._aborted:
@@ -3178,6 +3243,29 @@ class InferenceEngine:
                     request_id=req.request_id, token_ids=[], finished=True,
                     finish_reason="abort"))
                 return
+        if self._shed_due(req):
+            # Deadline-aware shedding: the queue wait already burned the
+            # tier's whole TTFT budget (x ARKS_SHED_DEADLINE) — prefill
+            # would be wasted on a stream the client has written off.
+            # Reject with a machine-readable code; the server maps it to
+            # 503 + Retry-After.  Exempt: replayers/swap-resumes (already
+            # decoding before their fault/preemption — shedding them
+            # breaks the byte-identity contract) and disagg-prefilled
+            # requests (the expensive half is already paid for).
+            waited = time.monotonic() - req.arrival_time
+            tier = self._slo.tier_of(req.params.priority)
+            self._unpin_guide(req)
+            self.metrics.requests_shed_total.inc(
+                1, reason="deadline", tier=tier,
+                tenant=self._tenant_labels.label(req.tenant))
+            self.trace.evt(req.request_id, "shed", "I", round(waited, 3))
+            req.outputs.put(RequestOutput(
+                request_id=req.request_id, token_ids=[], finished=True,
+                finish_reason="error",
+                error=(f"shed_deadline: queued {waited:.2f}s, tier "
+                       f"{tier} ttft budget already unmeetable"),
+                num_prompt_tokens=len(req.prompt_ids)))
+            return
         if isinstance(req.outputs, _ReplayGate):
             # Fault-recovery re-admission: a per-request injectable point
             # ("replay" phase) so the chaos suite can kill one survivor's
@@ -3910,41 +3998,54 @@ class InferenceEngine:
 
     def _queue_head_prio(self):
         """Effective priority of the admission-queue head (None when
-        empty).  Reads the underlying heap under the queue's own mutex —
-        heap[0] IS the minimum, so this is O(1)."""
-        with self._queue.mutex:
-            if not self._queue.queue:
-                return None
-            return self._queue.queue[0][0]
+        empty) — delegated to the FairQueue, which knows its own lanes
+        (urgent heap first, then the best non-empty tier)."""
+        return self._queue.head_prio()
+
+    def _shed_due(self, req: Request) -> bool:
+        """Should this just-popped request be deadline-shed?  True only
+        when shedding is on, the request's tier declares a ttft_ms
+        target, the wait already exceeds factor x that budget, and the
+        request is not exempt (replay / swap-resume / disagg-prefilled)."""
+        if not self._shed_deadline_factor or not self._slo:
+            return False
+        if (isinstance(req.outputs, _ReplayGate)
+                or req.request_id in self._resuming
+                or req.prefilled is not None):
+            return False
+        tier = self._slo.get(self._slo.tier_of(req.params.priority))
+        if tier is None or not tier.ttft_ms:
+            return False
+        budget_s = tier.ttft_ms / 1000.0 * self._shed_deadline_factor
+        return (time.monotonic() - req.arrival_time) > budget_s
+
+    def saturation(self) -> dict:
+        """Admission-queue overload signal (depth, caps, waiting tenants,
+        drain rate, 0-1 saturation fraction) — exported via /readiness
+        and the x-arks-saturation header on shed responses."""
+        return self._queue.saturation()
+
+    def queue_retry_after(self) -> int:
+        """Drain-rate-derived backoff (seconds) for shed responses."""
+        return self._queue.retry_after()
 
     def _queue_age_tick(self) -> None:
-        """Priority-queue aging (ARKS_QUEUE_AGING_S): rewrite queued
-        entries' effective priority to ``base - elapsed/aging_s`` (floored
+        """Priority-queue aging (ARKS_QUEUE_AGING_S): re-derive queued
+        entries' effective tier as ``base - elapsed/aging_s`` (floored
         at 0) so a starved batch request climbs one tier per window and
-        eventually admits under sustained latency-tier load.  Replay
-        re-queues (priority - 2**20) are skipped — they already outrank
-        everything.  Throttled to a fraction of the window so the heapify
-        cost stays off the per-step path."""
+        eventually admits under sustained latency-tier load.  The aging
+        itself is per-(tier, tenant) inside the FairQueue (promotions
+        keep each tenant's FIFO order); replay re-queues (priority -
+        2**20) ride the urgent lane and never age.  Throttled to a
+        fraction of the window so the rebucketing cost stays off the
+        per-step path."""
         if not self._queue_aging_s:
             return
         now = time.monotonic()
         if now - self._queue_age_last < min(1.0, self._queue_aging_s / 4):
             return
         self._queue_age_last = now
-        with self._queue.mutex:
-            heap = self._queue.queue
-            changed = False
-            for i, (prio, seq, req) in enumerate(heap):
-                if prio < 0:
-                    continue
-                base = req.params.priority
-                eff = max(0, base - int((now - req.arrival_time)
-                                        / self._queue_aging_s))
-                if eff != prio:
-                    heap[i] = (eff, seq, req)
-                    changed = True
-            if changed:
-                heapq.heapify(heap)
+        self._queue.age_tick(now, self._queue_aging_s)
 
     def _preempt_inflight(self) -> int:
         """Victims preempted and not yet back in a slot, across both

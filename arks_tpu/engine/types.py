@@ -104,6 +104,12 @@ class Request:
     # the engine's primary model.  Requests for a non-active model park in
     # the ``awaiting_model`` state until the scheduler switches to it.
     model: str | None = None
+    # Tenant identity (arks_tpu.tenancy): "namespace/username" minted by
+    # the gateway (x-arks-tenant) and mapped here by the OpenAI server.
+    # Drives the engine's weighted-fair admission and per-tenant queue
+    # caps.  None = untenanted (direct-to-pod clients) — all such
+    # requests share one fair-queue lane, the pre-tenancy behavior.
+    tenant: str | None = None
     # End-to-end tracing: the W3C trace context for this request
     # (arks_tpu.obs.trace.TraceCtx), carrying the gateway-minted trace id
     # and any upstream (gateway/router) spans.  None = untraced or an

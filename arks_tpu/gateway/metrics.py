@@ -33,6 +33,20 @@ class GatewayMetrics:
         self.quota_limit = r.gauge("gateway_quota_limit", "Quota limit")
         self.errors_total = r.counter(
             "gateway_errors_total", "Gateway errors by stage")
+        self.shed_total = r.counter(
+            "gateway_shed_total",
+            "Requests shed at the edge by bounded tenant label and reason "
+            "(inflight_overshare = gateway at ARKS_GW_SHED_INFLIGHT and the "
+            "tenant at/over its weighted fair share)")
+        self.client_disconnects_total = r.counter(
+            "gateway_client_disconnects_total",
+            "Streaming responses whose client hung up before the stream "
+            "finished (the gateway drains the backend to meter usage)")
+        self.usage_unmetered_total = r.counter(
+            "gateway_usage_unmetered_total",
+            "Disconnected streams abandoned before the usage frame arrived "
+            "(ARKS_GW_DISCONNECT_DRAIN_S exceeded) — billed-but-unmetered "
+            "tokens; should be ~0")
 
 
 class RouterMetrics:

@@ -36,12 +36,13 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from arks_tpu import slo as slo_mod
+from arks_tpu import tenancy
 from arks_tpu.control.store import Store
 from arks_tpu.gateway.metrics import GatewayMetrics
 from arks_tpu.gateway.qos import QosProvider, TokenQos
 from arks_tpu.gateway.quota import QuotaService, QuotaStatusSyncer
 from arks_tpu.gateway.ratelimiter import (
-    RateLimiter, REQUEST_RULES, TOKEN_RULES,
+    RateLimiter, REQUEST_RULES, RULES, TOKEN_RULES,
 )
 from arks_tpu.control.resources import (
     QUOTA_PROMPT, QUOTA_RESPONSE, QUOTA_TOTAL, RL_RPM, RL_TPM,
@@ -76,6 +77,14 @@ EJECT_SECONDS = 30.0
 MAX_BODY_BYTES = 4 * 1024 * 1024
 PROCESS_TIMEOUT_S = 5.0
 
+# Memory bounds for per-key state that grows with CLIENT-chosen inputs
+# (namespace/endpoint pairs, backend addresses).  Both trackers are
+# LRU-evicted at these caps: hostile key/address churn costs the oldest
+# entry its history (a fresh window / fresh failure count — benign),
+# never unbounded gateway memory.
+RATE_TRACKER_MAX_KEYS = 4096
+EJECTOR_MAX_ADDRS = 1024
+
 HDR_MODEL = "x-arks-model"
 HDR_NAMESPACE = "x-arks-namespace"
 HDR_USER = "x-arks-username"
@@ -88,12 +97,17 @@ HDR_TIER = "x-arks-tier"
 
 class _ApiError(Exception):
     def __init__(self, code: int, message: str, stage: str = "",
-                 retry_after: int | None = None):
+                 retry_after: int | None = None,
+                 tenant: str | None = None):
         super().__init__(message)
         self.code, self.message, self.stage = code, message, stage
         # Emitted as a Retry-After header on the error response (cold-start
         # backpressure: retry, don't fail the request class).
         self.retry_after = retry_after
+        # Backpressure errors raised while the token is already resolved
+        # carry the tenant so the 429/503 can say WHO should slow down
+        # even when the handler never got past admission.
+        self.tenant = tenant
 
 
 class PyUsageScanner:
@@ -152,14 +166,23 @@ class RequestRateTracker:
     current window — cheap, lock-bounded, and smooth enough for the
     autoscaler (arks_tpu.control.autoscaler) to damp on."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_keys: int = RATE_TRACKER_MAX_KEYS) -> None:
         self._lock = threading.Lock()
+        self._max_keys = max_keys
+        # Insertion order doubles as LRU order (record() moves its key to
+        # the end): dict ordering makes next(iter(...)) the LRU victim.
         self._counts: dict[tuple[str, str], dict[int, int]] = {}
 
     def record(self, namespace: str, endpoint: str) -> None:
         m = int(time.time() // 60)
+        key = (namespace, endpoint)
         with self._lock:
-            w = self._counts.setdefault((namespace, endpoint), {})
+            w = self._counts.pop(key, None)
+            if w is None:
+                w = {}
+                while len(self._counts) >= self._max_keys:
+                    del self._counts[next(iter(self._counts))]
+            self._counts[key] = w
             w[m] = w.get(m, 0) + 1
             for k in [k for k in w if k < m - 1]:
                 del w[k]
@@ -174,10 +197,13 @@ class RequestRateTracker:
 
 
 class _Ejector:
-    """Passive outlier detection per backend address."""
+    """Passive outlier detection per backend address.  State is bounded
+    (EJECTOR_MAX_ADDRS, LRU): addresses come from the control store's
+    routes, which endpoint churn can grow without limit."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_addrs: int = EJECTOR_MAX_ADDRS) -> None:
         self._lock = threading.Lock()
+        self._max_addrs = max_addrs
         self._bad: dict[str, int] = {}
         self._ejected_until: dict[str, float] = {}
 
@@ -186,11 +212,20 @@ class _Ejector:
             self._bad.pop(addr, None)
 
     def fail(self, addr: str) -> None:
+        now = time.monotonic()
         with self._lock:
-            n = self._bad.get(addr, 0) + 1
+            # Expired ejections are dead weight — reap them before the
+            # LRU bound so eviction only ever hits live state.
+            for a in [a for a, t in self._ejected_until.items() if t <= now]:
+                del self._ejected_until[a]
+            n = self._bad.pop(addr, 0) + 1
+            while len(self._bad) >= self._max_addrs:
+                del self._bad[next(iter(self._bad))]
             self._bad[addr] = n
             if n >= EJECT_AFTER_CONSECUTIVE_5XX:
-                self._ejected_until[addr] = time.monotonic() + EJECT_SECONDS
+                while len(self._ejected_until) >= self._max_addrs:
+                    del self._ejected_until[next(iter(self._ejected_until))]
+                self._ejected_until[addr] = now + EJECT_SECONDS
                 self._bad[addr] = 0
 
     def available(self, addrs: list[str]) -> list[str]:
@@ -226,6 +261,19 @@ class Gateway:
         self.cold_start_wait_s = knobs.get_float("ARKS_GW_COLD_START_WAIT_S")
         # SLO-tier ladder (ARKS_SLO_TIERS).  Empty = tier headers rejected.
         self.slo = slo_mod.from_env()
+        # Edge shedding (ARKS_GW_SHED_INFLIGHT, 0 = off): once gateway
+        # in-flight requests reach the cap, the tenant MOST over its
+        # weighted fair share is rejected 429 here — before its flood
+        # even reaches the engine queue.  Weights match the engine's
+        # WDRR (ARKS_FAIR_WEIGHTS), so edge and engine agree on "share".
+        self.shed_inflight_max = knobs.get_int("ARKS_GW_SHED_INFLIGHT")
+        self.fair_weights = tenancy.weights_from_env()
+        self.tenant_labels = tenancy.TenantLabels()
+        self._inflight: dict[str, int] = {}
+        self._inflight_lock = threading.Lock()
+        # How long to keep draining (and metering) a backend stream after
+        # the CLIENT hung up — usage must still be billed exactly once.
+        self.disconnect_drain_s = knobs.get_float("ARKS_GW_DISCONNECT_DRAIN_S")
         self._httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------------
@@ -410,13 +458,27 @@ class Gateway:
             if res.over:
                 self.metrics.rate_limit_hits_total.inc(
                     rule=res.rule, namespace=qos.namespace, user=qos.username)
+                # Windows are wall-clock-aligned, so the exact moment this
+                # rule resets is known: Retry-After = time to window edge.
+                # Every 429 carries the header — clients and the router
+                # back off with precision instead of guess-retrying.
+                period = RULES[res.rule][0]
                 raise _ApiError(429, f"rate limit exceeded: {res.rule} "
-                                f"({res.current}/{res.limit})", "ratelimit")
+                                f"({res.current}/{res.limit})", "ratelimit",
+                                retry_after=max(
+                                    1, int(period - (time.time() % period))),
+                                tenant=tenancy.tenant_id(
+                                    qos.namespace, qos.username))
         if qos.quota_name:
             q_limits = self.qos.get_quota_limits(qos.namespace, qos.quota_name)
             over, typ = self.quota.check(qos.namespace, qos.quota_name, q_limits)
             if over:
-                raise _ApiError(429, f"quota exceeded: {typ}", "quota")
+                # Quota recovers on the syncer's status cadence, not a
+                # rate window — a minute is the honest retry horizon.
+                raise _ApiError(429, f"quota exceeded: {typ}", "quota",
+                                retry_after=60,
+                                tenant=tenancy.tenant_id(
+                                    qos.namespace, qos.username))
             for typ, limit in q_limits.items():
                 self.metrics.quota_limit.set(
                     limit, namespace=qos.namespace, quota=qos.quota_name, type=typ)
@@ -490,6 +552,7 @@ class Gateway:
         tier = None
         ctx = (trace_mod.TraceCtx.from_headers(handler.headers)
                if _TRACE_ON else None)
+        tenant = None
         try:
             with logctx.bound(trace_id=ctx.trace_id if ctx else None):
                 qos, body, limits, tier = self._admit(handler)
@@ -500,22 +563,32 @@ class Gateway:
                         "arg": qos.username})
                 # Admitted demand feeds the autoscaler's per-endpoint rate.
                 self.rate.record(qos.namespace, qos.endpoint)
-                status = self._proxy(handler, qos, body, limits, tier,
-                                     ctx=ctx)
+                tenant = tenancy.tenant_id(qos.namespace, qos.username)
+                self._edge_admit(tenant)
+                try:
+                    status = self._proxy(handler, qos, body, limits, tier,
+                                         tenant=tenant, ctx=ctx)
+                finally:
+                    self._edge_done(tenant)
         except _ApiError as e:
             status = e.code
             self.metrics.errors_total.inc(stage=e.stage or "other")
             ra = getattr(e, "retry_after", None)
-            hdrs = None
-            if e.code == 503 and tier is not None:
-                # Tier-capacity backpressure: tell the client WHICH tier
-                # is saturated and when to come back (satellite contract).
-                hdrs = {HDR_TIER: tier}
+            hdrs = {}
+            if e.code in (429, 503):
+                # Backpressure responses carry the full picture: WHO to
+                # slow down (tenant), WHICH tier is saturated, and WHEN to
+                # come back (Retry-After — every 429/503 has one).
+                if tier is not None:
+                    hdrs[HDR_TIER] = tier
+                tnt = tenant or getattr(e, "tenant", None)
+                if tnt is not None:
+                    hdrs[tenancy.HDR_TENANT] = tnt
                 if ra is None:
                     ra = 1
             try:
                 handler._error(e.code, e.message, retry_after=ra,
-                               headers=hdrs)
+                               headers=hdrs or None)
             except Exception as e2:
                 # Client hung up before the error response went out.
                 swallowed("gateway.error-response", e2)
@@ -534,9 +607,45 @@ class Gateway:
             self.metrics.requests_total.inc(**labels)
             self.metrics.request_duration.observe(time.monotonic() - t0)
 
+    def _edge_admit(self, tenant: str) -> None:
+        """Pre-emptive edge shed: with the gateway at its in-flight cap
+        (ARKS_GW_SHED_INFLIGHT), reject the tenant MOST over its weighted
+        fair share — the flood pays, steady tenants keep flowing.  429 +
+        Retry-After 1: this clears as soon as any in-flight completes."""
+        if self.shed_inflight_max <= 0:
+            with self._inflight_lock:
+                self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+            return
+        w = tenancy.weight_of(self.fair_weights, tenant)
+        with self._inflight_lock:
+            total = sum(self._inflight.values())
+            if total >= self.shed_inflight_max:
+                mine = (self._inflight.get(tenant, 0) + 1) / w
+                worst = max(
+                    (n / tenancy.weight_of(self.fair_weights, t)
+                     for t, n in self._inflight.items()), default=0.0)
+                if mine >= worst:
+                    self.metrics.shed_total.inc(
+                        tenant=self.tenant_labels.label(tenant),
+                        reason="inflight_overshare")
+                    raise _ApiError(
+                        429, f"gateway saturated ({total} in-flight >= "
+                        f"{self.shed_inflight_max}) and tenant {tenant!r} "
+                        "is at or above its fair share", "shed",
+                        retry_after=1)
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+
+    def _edge_done(self, tenant: str) -> None:
+        with self._inflight_lock:
+            n = self._inflight.get(tenant, 0) - 1
+            if n <= 0:
+                self._inflight.pop(tenant, None)
+            else:
+                self._inflight[tenant] = n
+
     def _proxy(self, handler, qos: TokenQos, body: dict,
                limits: dict[str, int], tier: str | None = None,
-               ctx=None) -> int:
+               tenant: str | None = None, ctx=None) -> int:
         payload = json.dumps(body).encode()
         stream = bool(body.get("stream", False))
         last_err: Exception | None = None
@@ -557,6 +666,11 @@ class Gateway:
                     HDR_MODEL: qos.endpoint,
                     HDR_NAMESPACE: qos.namespace,
                     HDR_USER: qos.username,
+                    # Tenant identity: minted HERE (namespace/username is
+                    # what the token resolved to — clients cannot spoof
+                    # it), consumed by the engine's weighted-fair queue.
+                    **({tenancy.HDR_TENANT: tenant}
+                       if tenant is not None else {}),
                     **({HDR_TIER: tier} if tier is not None else {}),
                     **trace_headers,
                 })
@@ -611,10 +725,12 @@ class Gateway:
         if ra:
             handler.send_header("Retry-After", ra)
         # Tier-capacity 503s echo the tier so per-tier clients back off
-        # independently.
-        bt = resp.headers.get(HDR_TIER)
-        if bt:
-            handler.send_header(HDR_TIER, bt)
+        # independently; tenant-fair sheds echo the tenant and the
+        # backend's queue-saturation signal the same way.
+        for h in (HDR_TIER, tenancy.HDR_TENANT, tenancy.HDR_SATURATION):
+            v = resp.headers.get(h)
+            if v:
+                handler.send_header(h, v)
         handler.end_headers()
         handler.wfile.write(data)
 
@@ -631,18 +747,49 @@ class Gateway:
 
         scanner = make_usage_scanner()
         t_proc = 0.0
+        client_dead = False
+        drain_deadline = None
+        drained = True
         while True:
             chunk = resp.read1(65536)
             if not chunk:
                 break
-            handler.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
-            handler.wfile.flush()
+            if not client_dead:
+                try:
+                    handler.wfile.write(
+                        f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                    handler.wfile.flush()
+                except OSError:
+                    # Client hung up mid-stream.  The backend has already
+                    # generated (and will bill) these tokens, so KEEP
+                    # READING — the usage frame at the end of the stream
+                    # is the only exact record.  Bounded: past the drain
+                    # window we give up rather than babysit a slow
+                    # backend for a client that's gone.
+                    client_dead = True
+                    drain_deadline = (time.monotonic()
+                                      + self.disconnect_drain_s)
             tp = time.monotonic()
             scanner.feed(chunk)
             t_proc += time.monotonic() - tp
+            if drain_deadline is not None and time.monotonic() > drain_deadline:
+                drained = False
+                break
+        # Exactly-once metering: account() runs once per stream, with
+        # whatever the scanner captured — a disconnect neither
+        # double-counts (no retry path re-accounts) nor leaks tokens
+        # (the drain usually reaches the usage frame).
         account(scanner.usage())
-        handler.wfile.write(b"0\r\n\r\n")
-        handler.wfile.flush()
+        if client_dead:
+            self.metrics.client_disconnects_total.inc()
+            if not drained or scanner.usage() is None:
+                # Gave up before the usage frame: tokens the backend
+                # billed that the gateway could not meter.  Alert on this.
+                self.metrics.usage_unmetered_total.inc()
+            handler.close_connection = True
+        else:
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
         self.metrics.response_process_duration.observe(t_proc * 1000)
 
     # ------------------------------------------------------------------

@@ -49,6 +49,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from arks_tpu import prefix_sketch as sketch_mod
+from arks_tpu import tenancy
 from arks_tpu.gateway.metrics import RouterMetrics
 from arks_tpu.obs import logctx
 from arks_tpu.obs import trace as trace_mod
@@ -693,10 +694,15 @@ class Router:
                        HDR_PREFILL_ADDR: prefill_addr}
         # SLO tier rides through to the decode backend (arks_tpu.slo):
         # the OpenAI server maps it onto the engine priority scale, where
-        # preemptive swap / queue aging act on it.
+        # preemptive swap / queue aging act on it.  The gateway-minted
+        # tenant identity rides along the same way — the engine's
+        # weighted-fair admission keys on it.
         tier = h.headers.get(HDR_TIER)
         if tier:
             headers[HDR_TIER] = tier
+        tenant = h.headers.get(tenancy.HDR_TENANT)
+        if tenant:
+            headers[tenancy.HDR_TENANT] = tenant
         if ctx is not None:
             # Each attempt gets its own span id under the same trace id
             # (a retry is a new hop); the accumulated upstream spans ride
@@ -718,6 +724,17 @@ class Router:
             h.send_response(resp.status)
             ctype = resp.headers.get("Content-Type", "application/json")
             h.send_header("Content-Type", ctype)
+            # Backpressure metadata must survive the relay: the backend's
+            # Retry-After (queue_full / shed_deadline / pool-exhausted
+            # 429s and 503s), the saturated tier, the shed tenant, and
+            # the queue-saturation signal all reach the gateway/client
+            # unchanged — stripping them here would turn precise backoff
+            # into blind retry storms.
+            for bh in ("Retry-After", HDR_TIER, tenancy.HDR_TENANT,
+                       tenancy.HDR_SATURATION):
+                bv = resp.headers.get(bh)
+                if bv:
+                    h.send_header(bh, bv)
             clen = resp.headers.get("Content-Length")
             if clen is not None:
                 h.send_header("Content-Length", clen)

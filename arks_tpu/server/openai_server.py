@@ -27,6 +27,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from arks_tpu import slo as slo_mod
+from arks_tpu import tenancy
+from arks_tpu.engine import fairqueue
 from arks_tpu.engine.engine import InferenceEngine
 from arks_tpu.engine.tokenizer import IncrementalDetokenizer
 from arks_tpu.engine.types import Request, SamplingParams
@@ -338,9 +340,14 @@ class OpenAIServer:
                             # Sketch age/version metadata rides readiness
                             # so operators (and the router's monitoring)
                             # can spot a wedged/stale sketch export
-                            # without scraping the sketch itself.
+                            # without scraping the sketch itself.  The
+                            # admission block is the saturation signal:
+                            # edges read queue depth/drain here to back
+                            # off BEFORE the bounded queue starts 503ing.
                             self._json(200, {"status": "ready",
-                                             "sketch": server._sketch_meta()})
+                                             "sketch": server._sketch_meta(),
+                                             "admission":
+                                                 server.engine.saturation()})
                 else:
                     self._error(404, f"no route {self.path}")
 
@@ -607,6 +614,10 @@ class OpenAIServer:
         ctx = (trace_mod.TraceCtx.from_headers(h.headers)
                if self.engine.trace.enabled else None)
         single = len(batch) == 1 and n == 1
+        # Tenant identity: minted by the gateway (x-arks-tenant), forwarded
+        # verbatim by the router.  Direct-to-pod clients carry none — their
+        # requests share the fair queue's single untenanted lane.
+        tenant = (h.headers.get(tenancy.HDR_TENANT) or "").strip() or None
         reqs = []
         for prompt_ids in batch:
             for j in range(n):
@@ -615,11 +626,23 @@ class OpenAIServer:
                     p = _dc.replace(params, seed=params.seed + j)
                 req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
                               prompt_ids=list(prompt_ids), params=p,
-                              model=engine_model,
+                              model=engine_model, tenant=tenant,
                               trace=ctx if single else None)
-                with logctx.bound(req.request_id,
-                                  ctx.trace_id if ctx is not None else None):
-                    self.engine.add_request(req)
+                try:
+                    with logctx.bound(req.request_id,
+                                      ctx.trace_id if ctx is not None else None):
+                        self.engine.add_request(req)
+                except fairqueue.QueueFullError as e:
+                    # Overload ladder: the bounded admission queue refused
+                    # this request.  Roll back the siblings already queued
+                    # (a batch admits atomically or not at all) and map the
+                    # scope: the GLOBAL bound means this backend is
+                    # saturated (503 — router should fail over), while a
+                    # per-TENANT bound is the caller's own backlog (429 —
+                    # slow down; other tenants are fine).
+                    for prev in reqs:
+                        self.engine.abort(prev.request_id)
+                    return self._queue_full_error(h, e)
                 reqs.append(req)
 
         if len(reqs) > 1:
@@ -628,6 +651,31 @@ class OpenAIServer:
         else:
             self._respond(h, reqs[0], chat, model, body, stop_strings,
                           echo=echo, tools_ctx=tools_ctx)
+
+    def _queue_full_error(self, h, e: "fairqueue.QueueFullError") -> None:
+        """Map a bounded-queue rejection to HTTP, with the backoff hints
+        the edge needs: Retry-After derived from the queue's observed
+        drain rate and the saturation signal so the gateway can shed
+        pre-emptively instead of retry-hammering a full backend."""
+        sat = self.engine.saturation()
+        headers = {"Retry-After": str(e.retry_after),
+                   tenancy.HDR_SATURATION: f"{sat['saturation']:.2f}"}
+        if e.tenant:
+            headers[tenancy.HDR_TENANT] = e.tenant
+        if e.scope == "tenant":
+            h._json(429, {"error": {
+                "message": (f"tenant queue is full ({e.depth}/{e.limit} "
+                            "queued requests for this tenant)"),
+                "type": "rate_limit_error",
+                "code": "tenant_queue_full",
+            }}, headers=headers)
+        else:
+            h._json(503, {"error": {
+                "message": (f"admission queue is full ({e.depth}/{e.limit} "
+                            "queued requests)"),
+                "type": "server_error",
+                "code": "queue_full",
+            }}, headers=headers)
 
     def _context_length_error(self, h, got: int, limit: int) -> None:
         h._json(400, {"error": {
@@ -653,6 +701,20 @@ class OpenAIServer:
                 "type": "server_error",
                 "code": "engine_fault",
             }})
+        if fin.error and fin.error.startswith("shed_deadline"):
+            # Deadline-aware shed: the request waited so long in the
+            # admission queue that its tier's TTFT budget is already
+            # unmeetable — burning prefill on it would only delay work
+            # that can still meet its SLO.  503 + drain-derived
+            # Retry-After, same capacity semantics as queue_full.
+            sat = self.engine.saturation()
+            return h._json(503, {"error": {
+                "message": f"request shed before prefill ({fin.error})",
+                "type": "server_error",
+                "code": "shed_deadline",
+            }}, headers={
+                "Retry-After": str(self.engine.queue_retry_after()),
+                tenancy.HDR_SATURATION: f"{sat['saturation']:.2f}"})
         if fin.error and fin.error.startswith("model_pool_exhausted"):
             # Capacity, not client error: the pool can't fit the model
             # right now (pinned/in-use residents).  503 + Retry-After so

@@ -96,6 +96,33 @@ _k("ARKS_PREEMPT_COOLDOWN_S", "float", "2",
 _k("ARKS_QUEUE_AGING_S", "float", "0",
    "Queue-aging half-life for tier promotion of starved requests; 0 "
    "disables aging.", "engine")
+_k("ARKS_FAIR", "bool", "1",
+   "Tenant-fair admission: weighted deficit round-robin across tenants "
+   "within each SLO tier. 0 reverts to the flat priority heap (the "
+   "bench control arm).", "engine")
+_k("ARKS_FAIR_QUANTUM_TOKENS", "int", "512",
+   "Token credit (prompt + max_tokens cost units) each tenant earns per "
+   "fair-queue round-robin visit.", "engine")
+_k("ARKS_FAIR_WEIGHTS", "str", None,
+   "Per-tenant fair-share weights as `namespace/user:weight,...`; "
+   "unlisted tenants weigh 1. Shared by the engine's WDRR admission "
+   "and the gateway's edge shedding.", "engine")
+_k("ARKS_QUEUE_MAX", "int", "0",
+   "Admission-queue depth cap across all tiers/tenants; a put past it "
+   "is shed with 503 + drain-rate Retry-After. 0 = unbounded.",
+   "engine")
+_k("ARKS_QUEUE_TENANT_MAX", "int", "0",
+   "Per-tenant admission-queue depth cap; a put past it is shed with "
+   "429 + Retry-After while other tenants keep admitting. 0 = "
+   "unbounded.", "engine")
+_k("ARKS_SHED_DEADLINE", "float", "0",
+   "Deadline-aware shedding factor: a popped request whose queue wait "
+   "exceeds factor x its tier's ttft_ms budget is rejected before "
+   "prefill (shed_deadline -> 503 + Retry-After). 0 = off.", "engine")
+_k("ARKS_TENANT_LABEL_MAX", "int", "32",
+   "Metric-label cardinality bound for tenant labels: the first N "
+   "distinct tenants keep their id, later ones share the `other` "
+   "bucket.", "engine")
 _k("ARKS_SLO_TIERS", "str", None,
    "The SLO tier ladder, best tier first (see arks_tpu/slo.py for the "
    "spec grammar). Unset = no tiers.", "engine")
@@ -192,6 +219,15 @@ _k("ARKS_NATIVE_LIB", "str", None,
 _k("ARKS_GW_COLD_START_WAIT_S", "float", "10",
    "How long gateway admission holds a request for a cold-starting "
    "model before 503ing.", "gateway")
+_k("ARKS_GW_SHED_INFLIGHT", "int", "0",
+   "Gateway edge-shedding trigger: once this many proxied requests are "
+   "in flight, new arrivals from the most-over-share tenant "
+   "(in-flight/weight, per ARKS_FAIR_WEIGHTS) get 429 + Retry-After at "
+   "the edge. 0 = off.", "gateway")
+_k("ARKS_GW_DISCONNECT_DRAIN_S", "float", "10",
+   "After a streaming client disconnects mid-relay, keep draining the "
+   "backend response (feeding the usage scanner) for up to this long "
+   "so the stream's tokens are still metered exactly.", "gateway")
 
 # --------------------------------------------------------------- router
 _k("ARKS_PREFILL_ADDRS", "list", None,

@@ -212,6 +212,70 @@ def test_admit_fault_requeues_requests(monkeypatch):
     assert sum(eng.metrics.requests_recovered_total._values.values()) >= 1
 
 
+def _tenant_workload(cfg):
+    """Two tenants' worth of seeded streams for the fair-admission
+    chaos scenarios — enough depth that the WDRR pick point fires with
+    requests still waiting behind it."""
+    reqs = []
+    for i in range(3):
+        reqs.append(Request(f"a{i}", [5, 6, 7], SamplingParams(
+            max_tokens=8, temperature=0.9, top_p=0.9, seed=41 + i,
+            ignore_eos=True), tenant="ns/a"))
+        reqs.append(Request(f"b{i}", [9] * 5, SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True),
+            tenant="ns/b"))
+    return reqs
+
+
+def _run_tenants(monkeypatch, inject=None, retries=None):
+    monkeypatch.setenv("ARKS_FAIR", "1")
+    cfg, eng = _mk_engine(monkeypatch, 0, "0", inject=inject,
+                          retries=retries)
+    reqs = _tenant_workload(cfg)
+    for r in reqs:
+        eng.add_request(r)
+    _drive(eng)
+    return [_collect(r) for r in reqs], eng
+
+
+def test_admit_fair_fault_requeues_through_the_fair_queue(monkeypatch):
+    """A fault at the WDRR pick point ("admit_fair" phase): the popped
+    request re-queues through the fair queue (nothing was emitted yet)
+    and EVERY stream — both tenants — comes out byte-identical to the
+    fault-free run."""
+    base, _ = _run_tenants(monkeypatch)
+    got, eng = _run_tenants(monkeypatch, inject="admit_fair:2:runtime")
+    assert got == base, \
+        "streams diverged after the admit_fair fault"
+    assert sum(eng.metrics.engine_faults_total._values.values()) == 1
+    assert eng.metrics.engine_faults_total.get(
+        phase="admit_fair", kind="injected") == 1
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+def test_admit_fair_repeated_fault_quarantines_only_the_culprit(
+        monkeypatch):
+    """Zero retry budget: the admit_fair fault fails its ONE popped
+    request (the sole culprit), every other stream — same tenant and
+    the other tenant alike — finishes byte-identical to the fault-free
+    run, and the fair queue keeps serving."""
+    base, _ = _run_tenants(monkeypatch)
+    got, eng = _run_tenants(monkeypatch, inject="admit_fair:2:runtime",
+                            retries=0)
+    reasons = [f.finish_reason for _, f in got]
+    assert reasons.count("error") == 1, reasons
+    errs = [f for _, f in got if f.finish_reason == "error"]
+    assert errs[0].error.startswith("engine_fault")
+    base_by_rid = {f.request_id: (ids, f.finish_reason) for ids, f in base}
+    for ids, f in got:
+        if f.finish_reason != "error":
+            assert (ids, f.finish_reason) == base_by_rid[f.request_id], \
+                "survivor stream diverged from the fault-free run"
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 1
+    assert eng.state == "serving"
+
+
 def test_chunk_fault_on_long_prompt_is_isolated(monkeypatch):
     """A chunked-prefill dispatch fault is attributed to its ONE request:
     within budget it recovers; the co-resident decoding stream is
@@ -345,7 +409,8 @@ def test_randomized_chaos_sweep(monkeypatch, mixed, kw):
     base_by_rid = {fin.request_id: (ids, fin.finish_reason)
                    for ids, fin in base}
     rng = random.Random(1234)
-    phases = ["decode", "resolve", "admit", "chunk", "replay", "pages"]
+    phases = ["decode", "resolve", "admit", "admit_fair", "chunk",
+              "replay", "pages"]
     for round_i in range(6):
         spec = ",".join(
             f"{rng.choice(phases)}:{rng.randint(1, 6)}:runtime"

@@ -360,6 +360,44 @@ def test_tier_header_forwarded_to_backend(world):
     assert backend.requests[-1]["headers"]["x-arks-tier"] == "latency"
 
 
+def test_rpm_429_carries_retry_after_to_window_edge(world):
+    """Every rate-limit 429 carries Retry-After derived from the
+    wall-clock window edge (satellite contract: precise backoff, not
+    guess-retry) plus the tenant identity header."""
+    gw, _, _ = world
+    for _ in range(4):
+        _post(gw, {"model": "m1"}).read()
+    try:
+        _post(gw, {"model": "m1"})
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        assert e.code == 429
+        ra = e.headers.get("Retry-After")
+        assert ra is not None and 1 <= int(ra) <= 60
+        assert e.headers.get("x-arks-tenant") == "team-a/alice"
+
+
+def test_quota_429_carries_retry_after(world):
+    """Quota-exhaustion 429s carry Retry-After too (the syncer's status
+    cadence horizon) — BOTH 429 classes are retryable-with-a-clock."""
+    gw, store, _ = world
+    t = store.get(res.Token, "alice", "team-a")
+    t.spec["qos"][0]["rateLimits"] = [{"type": "rpm", "value": 100}]
+    store.update(t)
+    time.sleep(0.3)
+    for _ in range(5):
+        _post(gw, {"model": "m1"}).read()
+    try:
+        _post(gw, {"model": "m1"})
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        assert e.code == 429
+        assert "quota" in json.load(e)["error"]["message"]
+        assert e.headers.get("Retry-After") is not None
+        assert int(e.headers["Retry-After"]) >= 1
+        assert e.headers.get("x-arks-tenant") == "team-a/alice"
+
+
 def test_tier_capacity_503_carries_retry_after_and_tier(world):
     """A tier-carrying request that hits capacity (no ready backends)
     gets 503 + Retry-After + x-arks-tier, so per-tier clients back off
@@ -379,3 +417,228 @@ def test_tier_capacity_503_carries_retry_after_and_tier(world):
         assert e.code == 503
         assert e.headers.get("Retry-After") is not None
         assert e.headers.get("x-arks-tier") == "latency"
+
+
+# ---------------------------------------------------------------------------
+# Tenant-fair admission: identity mint, edge shed, bounded tracker state
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_header_minted_toward_backend(world):
+    """The gateway mints x-arks-tenant from the token's resolved
+    namespace/username — clients cannot spoof tenant identity by
+    sending the header themselves."""
+    gw, _, backend = world
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/chat/completions",
+        data=json.dumps({"model": "m1", "messages": []}).encode(),
+        headers={"Content-Type": "application/json",
+                 "Authorization": "Bearer sk-alice",
+                 "x-arks-tenant": "spoofed/identity"})
+    urllib.request.urlopen(req, timeout=30).read()
+    assert backend.requests[-1]["headers"]["x-arks-tenant"] == "team-a/alice"
+
+
+def test_edge_shed_rejects_most_over_share_tenant(world):
+    """At the in-flight cap the MOST over-share tenant is shed with
+    429 + Retry-After + tenant header; an under-share tenant still
+    flows (pre-emptive edge protection, not a blanket 429)."""
+    gw, _, _ = world
+    gw.shed_inflight_max = 5
+    # A phantom tenant holds most of the in-flight budget.
+    with gw._inflight_lock:
+        gw._inflight["team-b/flood"] = 5
+    try:
+        # alice: prospective share (0+1)/1 = 1 < flood's 5 -> admitted.
+        with _post(gw, {"model": "m1", "messages": []}) as r:
+            assert r.status == 200
+        # Now alice IS the most over-share prospective tenant.
+        with gw._inflight_lock:
+            gw._inflight.clear()
+            gw._inflight["team-a/alice"] = 5
+        try:
+            _post(gw, {"model": "m1"})
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert e.headers.get("Retry-After") == "1"
+            assert e.headers.get("x-arks-tenant") == "team-a/alice"
+            assert "fair share" in json.load(e)["error"]["message"]
+        assert gw.metrics.shed_total.get(
+            tenant="team-a/alice", reason="inflight_overshare") == 1
+    finally:
+        gw.shed_inflight_max = 0
+        with gw._inflight_lock:
+            gw._inflight.clear()
+
+
+def test_rate_tracker_lru_bound():
+    from arks_tpu.gateway.server import RequestRateTracker
+    tr = RequestRateTracker(max_keys=3)
+    for i in range(3):
+        tr.record("ns", f"ep{i}")
+    # Touch ep0 so it becomes most-recently-used, then overflow.
+    tr.record("ns", "ep0")
+    tr.record("ns", "ep3")
+    assert len(tr._counts) == 3
+    assert tr.rpm("ns", "ep1") == 0.0     # LRU victim: evicted
+    assert tr.rpm("ns", "ep0") >= 2.0     # survived via the touch
+    assert tr.rpm("ns", "ep3") >= 1.0
+
+
+def test_ejector_lru_bound():
+    from arks_tpu.gateway.server import _Ejector
+    ej = _Ejector(max_addrs=4)
+    for i in range(1000):
+        ej.fail(f"10.0.0.{i}:80")
+    assert len(ej._bad) <= 4
+    assert len(ej._ejected_until) <= 4
+
+
+# ---------------------------------------------------------------------------
+# SSE metering: exact accounting across mid-stream client disconnect
+# ---------------------------------------------------------------------------
+
+
+class _SlowStreamBackend:
+    """Streams SSE frames with a pause before the usage frame so a test
+    client can hang up mid-stream.  ``usage_delay_s`` paces the frames;
+    with ``send_usage=False`` the stream trickles fillers and never
+    delivers usage (the unmetered-giveup case)."""
+
+    def __init__(self, usage_delay_s=0.3, send_usage=True):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                usage = {"prompt_tokens": PROMPT_TOKENS,
+                         "completion_tokens": COMPLETION_TOKENS,
+                         "total_tokens": PROMPT_TOKENS + COMPLETION_TOKENS}
+                first = (b"data: " + json.dumps(
+                    {"id": "x", "choices": [{"delta": {"content": "hi"}}]}
+                ).encode() + b"\n\n")
+                if stub.send_usage:
+                    rest = (b"data: " + json.dumps(
+                        {"id": "x", "choices": [], "usage": usage}
+                    ).encode() + b"\n\n" + b"data: [DONE]\n\n")
+                else:
+                    filler = (b"data: " + json.dumps(
+                        {"id": "x", "choices": [{"delta": {"content": "z"}}]}
+                    ).encode() + b"\n\n")
+                    rest = filler * 6 + b"data: [DONE]\n\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Content-Length",
+                                 str(len(first) + len(rest)))
+                self.end_headers()
+                self.wfile.write(first)
+                self.wfile.flush()
+                if stub.send_usage:
+                    time.sleep(stub.usage_delay_s)
+                    self.wfile.write(rest)
+                else:
+                    step = len(rest) // 6
+                    for i in range(0, len(rest), step):
+                        time.sleep(stub.usage_delay_s)
+                        try:
+                            self.wfile.write(rest[i:i + step])
+                            self.wfile.flush()
+                        except OSError:
+                            return
+
+        self.usage_delay_s, self.send_usage = usage_delay_s, send_usage
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def addr(self):
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def _disconnect_mid_stream(gw, slow):
+    """Open a streaming request, read up to the first frame, then RST
+    the connection (SO_LINGER 0) so the gateway's next relay write
+    fails immediately."""
+    import socket as _socket
+    import struct as _struct
+
+    body = json.dumps({"model": "m1", "stream": True,
+                       "stream_options": {"include_usage": True}}).encode()
+    s = _socket.create_connection(("127.0.0.1", gw.port), timeout=10)
+    try:
+        s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
+                  b"Host: x\r\nAuthorization: Bearer sk-alice\r\n"
+                  b"Content-Type: application/json\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        got = b""
+        while b"delta" not in got:
+            got += s.recv(4096)
+    finally:
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                     _struct.pack("ii", 1, 0))
+        s.close()
+
+
+def test_disconnect_mid_stream_still_meters_exactly_once(world):
+    """Client hangs up after the first SSE frame; the backend only
+    emits usage later.  The gateway drains to the usage frame and
+    accounts it EXACTLY once — no unmetered leak, no double-count."""
+    gw, store, _ = world
+    slow = _SlowStreamBackend(usage_delay_s=0.3)
+    try:
+        ep = store.get(res.Endpoint, "m1", "team-a")
+        ep.status["routes"] = [
+            {"backend": {"addresses": [slow.addr]}, "weight": 1}]
+        store.update_status(ep)
+        _disconnect_mid_stream(gw, slow)
+        deadline = time.monotonic() + 5
+        while (gw.metrics.client_disconnects_total.total() < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert gw.metrics.client_disconnects_total.total() == 1
+        assert gw.metrics.usage_unmetered_total.total() == 0
+        # Exactly once: the full usage object, not zero, not doubled.
+        deadline = time.monotonic() + 5
+        while (gw.quota.get_usage("team-a", "alice-quota").get("total", 0) < 12
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert gw.quota.get_usage("team-a", "alice-quota")["total"] == 12
+    finally:
+        slow.stop()
+
+
+def test_disconnect_drain_window_bounds_the_babysit(world):
+    """Client gone AND the backend never sends usage: the gateway gives
+    up at ARKS_GW_DISCONNECT_DRAIN_S and records the unmetered leak
+    instead of hanging on a dead stream — and nothing is billed."""
+    gw, store, _ = world
+    slow = _SlowStreamBackend(usage_delay_s=0.25, send_usage=False)
+    gw.disconnect_drain_s = 0.3
+    try:
+        ep = store.get(res.Endpoint, "m1", "team-a")
+        ep.status["routes"] = [
+            {"backend": {"addresses": [slow.addr]}, "weight": 1}]
+        store.update_status(ep)
+        t0 = time.monotonic()
+        _disconnect_mid_stream(gw, slow)
+        deadline = time.monotonic() + 5
+        while (gw.metrics.usage_unmetered_total.total() < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert gw.metrics.usage_unmetered_total.total() == 1
+        assert time.monotonic() - t0 < 4, "drain window did not bound"
+        assert gw.quota.get_usage("team-a", "alice-quota").get("total", 0) == 0
+    finally:
+        gw.disconnect_drain_s = 10.0
+        slow.stop()

@@ -97,6 +97,24 @@ def test_census_no_family_registered_twice_across_components():
         _metric_errors("duplicate-family"))
 
 
+def test_tenant_label_cardinality_bounded():
+    """Per-tenant metric families must not explode under hostile tenant
+    churn: a thousand distinct tenants through the TenantLabels bound
+    land on at most cap distinct labels plus the shared "other" bucket,
+    and nothing is lost — the counter total still sees every event."""
+    from arks_tpu import tenancy
+    reg = prom.Registry()
+    shed = reg.counter("cardinality_probe_total", "bounded-label probe")
+    labels = tenancy.TenantLabels(cap=32)
+    for i in range(1000):
+        shed.inc(tenant=labels.label(f"churn/user{i}"))
+    seen = {dict(k)["tenant"] for k in shed._values}
+    assert len(seen) <= 32 + 1
+    assert tenancy.OTHER_LABEL in seen
+    assert shed.get(tenant=tenancy.OTHER_LABEL) == 1000 - 32
+    assert shed.total() == 1000
+
+
 def test_census_matches_live_registries():
     """The static census must actually see the real registries: every
     family the live engine/gateway/router registries expose appears in

@@ -157,6 +157,60 @@ def test_all_backends_503_passes_retry_after_through(monkeypatch):
         b.stop()
 
 
+def test_tenant_shed_429_relays_headers_unchanged(monkeypatch):
+    """A backend 429 (tenant_queue_full / shed) is NOT a failover event —
+    it is the caller's own backlog.  The router relays the response with
+    Retry-After, x-arks-tenant, and x-arks-saturation intact, and
+    forwards the gateway-minted tenant header toward the backend."""
+    from arks_tpu import tenancy
+
+    seen_headers = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            seen_headers.update(
+                {k.lower(): v for k, v in self.headers.items()})
+            data = (b'{"error":{"message":"tenant queue full",'
+                    b'"code":"tenant_queue_full"}}')
+            self.send_response(429)
+            self.send_header("Retry-After", "3")
+            self.send_header(tenancy.HDR_TENANT, "team-a/alice")
+            self.send_header(tenancy.HDR_SATURATION, "0.87")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    addr = f"127.0.0.1:{httpd.server_port}"
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    router = _mk_router(monkeypatch, [addr])
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/v1/completions",
+            data=json.dumps({"model": "tiny", "prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json",
+                     tenancy.HDR_TENANT: "team-a/alice"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") == "3"
+        assert ei.value.headers.get(tenancy.HDR_TENANT) == "team-a/alice"
+        assert ei.value.headers.get(tenancy.HDR_SATURATION) == "0.87"
+        assert json.load(ei.value)["error"]["code"] == "tenant_queue_full"
+        # Request-side: the minted identity reached the backend unchanged.
+        assert seen_headers.get(tenancy.HDR_TENANT) == "team-a/alice"
+    finally:
+        router.stop()
+        httpd.shutdown()
+
+
 def test_no_backends_still_503s(monkeypatch):
     monkeypatch.setenv("ARKS_PREFILL_ADDRS", "")
     monkeypatch.setenv("ARKS_DECODE_ADDRS", "")

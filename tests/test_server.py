@@ -801,3 +801,113 @@ def test_tier_header_maps_to_priority(server):
             assert "bogus" in json.load(e)["error"]["message"]
     finally:
         server.slo = old
+
+
+def test_tenant_header_maps_to_request(server):
+    """x-arks-tenant (gateway-minted, router-forwarded) lands on
+    Request.tenant — the engine's fair-queue key."""
+    seen = []
+    orig = server.engine.add_request
+
+    def spy(req):
+        seen.append(req.tenant)
+        return orig(req)
+
+    server.engine.add_request = spy
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps({"model": "tiny-serve", "prompt": "hi",
+                             "max_tokens": 2, "ignore_eos": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-arks-tenant": "acme/alice"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+        # No header -> None (untenanted single lane).
+        with _post(server, "/v1/completions",
+                   {"model": "tiny-serve", "prompt": "hi",
+                    "max_tokens": 2, "ignore_eos": True}) as r:
+            assert r.status == 200
+    finally:
+        server.engine.add_request = orig
+    assert seen == ["acme/alice", None], seen
+
+
+def test_queue_full_maps_to_http(server):
+    """Bounded-queue rejections map by scope: the global cap is a
+    saturated backend (503 queue_full), a per-tenant cap is the caller's
+    own backlog (429 tenant_queue_full) — both with Retry-After and the
+    saturation header."""
+    from arks_tpu.engine import fairqueue
+    orig = server.engine.add_request
+
+    def reject_tenant(req):
+        raise fairqueue.QueueFullError("tenant", "acme/alice", 8, 8, 3)
+
+    def reject_queue(req):
+        raise fairqueue.QueueFullError("queue", "acme/alice", 64, 64, 7)
+
+    try:
+        server.engine.add_request = reject_tenant
+        try:
+            _post(server, "/v1/completions",
+                  {"model": "tiny-serve", "prompt": "hi", "max_tokens": 2})
+            raise AssertionError("expected HTTP 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert e.headers["Retry-After"] == "3"
+            assert e.headers["x-arks-tenant"] == "acme/alice"
+            assert e.headers["x-arks-saturation"] is not None
+            assert json.load(e)["error"]["code"] == "tenant_queue_full"
+        server.engine.add_request = reject_queue
+        try:
+            _post(server, "/v1/completions",
+                  {"model": "tiny-serve", "prompt": "hi", "max_tokens": 2})
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers["Retry-After"] == "7"
+            assert json.load(e)["error"]["code"] == "queue_full"
+    finally:
+        server.engine.add_request = orig
+
+
+def test_shed_deadline_maps_to_503_with_retry_after(server):
+    """A deadline-shed engine output (queued past the tier's TTFT
+    budget) is capacity, not client error: 503 + drain-derived
+    Retry-After, code shed_deadline."""
+    from arks_tpu.engine.types import RequestOutput
+    orig = server.engine.add_request
+
+    def shed(req):
+        req.outputs.put(RequestOutput(
+            request_id=req.request_id, token_ids=[], finished=True,
+            finish_reason="error",
+            error="shed_deadline: queued 9.00s, tier 1 ttft budget "
+                  "already unmeetable", num_prompt_tokens=2))
+
+    server.engine.add_request = shed
+    try:
+        try:
+            _post(server, "/v1/completions",
+                  {"model": "tiny-serve", "prompt": "hi", "max_tokens": 2})
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert int(e.headers["Retry-After"]) >= 1
+            assert json.load(e)["error"]["code"] == "shed_deadline"
+    finally:
+        server.engine.add_request = orig
+
+
+def test_readiness_exports_admission_saturation(server):
+    """/readiness carries the queue-saturation block so edges can back
+    off BEFORE the bounded queue starts rejecting."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/readiness", timeout=30) as r:
+        data = json.load(r)
+    adm = data["admission"]
+    for key in ("queue_depth", "queue_max", "tenants_waiting",
+                "drain_per_s", "saturation", "fair"):
+        assert key in adm, adm
+    assert adm["queue_depth"] >= 0
