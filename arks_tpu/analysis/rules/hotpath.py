@@ -15,7 +15,11 @@ contract):
 - ``_resolve_*`` / ``_pipe_resolve_*`` / ``_finish_resume`` — the host-
   sync tails where blocking fetches BELONG;
 - ``_warm_autotune`` — the pre-first-dispatch warm-up, the one place
-  allowed to call ``autotune.ensure/sweep``.
+  allowed to call ``autotune.ensure/sweep``;
+- ``_disk_write_loop`` / ``_fetch_loop`` — the tier-2 spill writer and
+  prefix-fetch worker THREADS (reached via their Thread-target
+  registration): file and peer-HTTP IO is their whole job, so the
+  issue-side purity contract stops at the thread hand-off queue.
 
 (``_switch_to`` is deliberately NOT a boundary even though its stall is
 sanctioned — it runs only after ``_drained_for_switch()`` — because its
@@ -78,10 +82,21 @@ ROOTS = (
     ("arks_tpu/engine/fairqueue.py", "FairQueue", "put"),
     ("arks_tpu/engine/fairqueue.py", "FairQueue", "head_prio"),
     ("arks_tpu/engine/fairqueue.py", "FairQueue", "age_tick"),
+    # Fleet prefix KV (tier 2): the spill hand-off and fetch park run in
+    # the scheduler's step slice (file IO lives on the writer/fetch
+    # threads — only the queue hand-off is issue-side); block_for_export
+    # serves peer GETs from server threads under the same non-blocking
+    # contract as cache_sketch; the disk tier's admission probe is a
+    # pure in-memory index walk.
+    (ENGINE, ENGINE_CLASS, "_drain_disk_spills"),
+    (ENGINE, ENGINE_CLASS, "_issue_fetch"),
+    (ENGINE, ENGINE_CLASS, "block_for_export"),
+    ("arks_tpu/engine/prefix_cache.py", "DiskPrefixTier", "match_digests"),
 )
 
 BOUNDARY_RE = re.compile(
-    r"^(_resolve_|_pipe_resolve_)|^(_finish_resume|_warm_autotune)$")
+    r"^(_resolve_|_pipe_resolve_)"
+    r"|^(_finish_resume|_warm_autotune|_disk_write_loop|_fetch_loop)$")
 
 # The sanctioned host-sync tails the boundary regex exists FOR: if these
 # disappear wholesale the guard is checking a fiction.
@@ -89,6 +104,7 @@ EXPECTED_TAILS = (
     "_resolve_decode", "_resolve_mixed", "_resolve_spec_mixed",
     "_pipe_resolve_one", "_resolve_admit_batch", "_resolve_spills",
     "_resolve_restores", "_resolve_preempt_swaps", "_finish_resume",
+    "_resolve_fetches", "_disk_write_loop", "_fetch_loop",
 )
 
 SERIAL_CALLS = {"json.dumps", "json.loads", "pickle.dumps",

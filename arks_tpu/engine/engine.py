@@ -293,6 +293,30 @@ class _RestoreState:
 
 
 @dataclasses.dataclass
+class _FetchState:
+    """A tier-2 / peer prefix fetch in flight: the request parks here
+    while a worker thread stages the missing blocks from the local disk
+    tier (DiskPrefixTier.get) and/or a peer replica (GET
+    /v1/cache/blocks/{digest}) INTO THE HOST TIER.  No device pages are
+    held across the park — _resolve_fetches re-runs the admission match
+    from scratch, so the unparked request rides the existing tier-1
+    restore path (or plain chunked prefill if the fetch came up empty).
+    The worker writes only `done`/`fetched_*`; the engine thread owns
+    membership in _awaiting_fetch."""
+
+    request: Request
+    ids: list[int]
+    digests: list          # full prompt digest chain (computed at match)
+    start: int             # first uncovered digest index at park time
+    peer: str | None       # hinted peer base address ("host:port")
+    seed: int
+    t0: float
+    done: bool = False
+    fetched_disk: int = 0  # blocks staged from the local disk tier
+    fetched_peer: int = 0  # blocks staged from the peer
+
+
+@dataclasses.dataclass
 class _SwapRecord:
     """A preempted request's host-side slot snapshot (ARKS_PREEMPT):
     everything `_finish_resume` needs to rebuild the victim's `_Slot` and
@@ -502,6 +526,27 @@ class EngineMetrics:
             "Host-tier restore latency (scatter issue -> request unparked)",
             buckets=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1, 2.5])
+        # Tier 2 (DiskPrefixTier) + fleet peer fetch: the families that
+        # make disk-budget churn, a poisoned disk tier (corrupt reads),
+        # and a peer fetch that lost to re-prefill visible on a dashboard.
+        self.prefix_disk_evictions_total = r.counter(
+            "prefix_disk_evictions_total",
+            "KV page blocks LRU-evicted from the tier-2 disk store past "
+            "its byte budget")
+        self.prefix_disk_corrupt_total = r.counter(
+            "prefix_disk_corrupt_total",
+            "Tier-2 block files rejected on read (corrupt, truncated, or "
+            "stale-epoch) and deleted")
+        self.prefix_peer_fetch_blocks_total = r.counter(
+            "prefix_peer_fetch_blocks_total",
+            "Prefix KV blocks fetched into the host tier, by source "
+            "(disk = local tier 2, peer = remote replica)")
+        self.prefix_peer_fetch_seconds = r.histogram(
+            "prefix_peer_fetch_seconds",
+            "Disk/peer prefix fetch latency (park -> blocks staged in "
+            "the host tier)",
+            buckets=[0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                     2.5, 5, 10])
         self.guided_requests_total = r.counter(
             "guided_requests_total",
             "Admitted guided-decoding requests by guide kind")
@@ -1186,6 +1231,63 @@ class InferenceEngine:
         self._spill_group = min(8, max(self._max_pages, 1))
         self._restore_group = min(8, max(self._max_pages, 1))
 
+        # ---- Tier-2 disk block store + fleet peer fetch ----------------
+        # Tier 2 = DiskPrefixTier: a byte-budgeted local-disk store fed
+        # ASYNCHRONOUSLY from tier-1 LRU evictions (host.on_evict queues
+        # the victim block; a writer thread does the file IO — the step
+        # loop only drains the queue).  Same chain-digest keys, same
+        # pool-native blocks, so warm prefixes survive an engine restart.
+        # Peer fetch makes the tiers fleet-wide: an admission miss whose
+        # prefix a peer replica advertises (router X-Arks-Peer-Hint, or
+        # the ARKS_PEER_ADDRS probe list) parks in _awaiting_fetch while
+        # a worker pulls the raw AKV1 blocks into the host tier — the
+        # unpark then rides the ordinary tier-1 restore path.
+        self._disk = None
+        self._disk_spill_pending: "_deque" = _deque()   # (digest, block)
+        self._awaiting_fetch: list[_FetchState] = []
+        self._disk_write_queue = None
+        self._disk_writer = None
+        self._fetch_queue = None
+        self._kv_epoch = self._kv_layout_epoch()
+        self._disk_stats_lock = threading.Lock()
+        self._disk_evict_seen = 0
+        self._disk_corrupt_seen = 0
+        disk_mb = knobs.get_int("ARKS_PREFIX_DISK_MB")
+        if disk_mb < 0:
+            raise ValueError(
+                f"ARKS_PREFIX_DISK_MB={disk_mb}: must be >= 0")
+        self._peer_timeout = knobs.get_float("ARKS_PEER_FETCH_TIMEOUT_S")
+        if self._peer_timeout <= 0:
+            raise ValueError(
+                f"ARKS_PEER_FETCH_TIMEOUT_S={self._peer_timeout}: "
+                "must be > 0")
+        self._peer_addrs = [a.strip() for a in knobs.get_list(
+            "ARKS_PEER_ADDRS") if a.strip()]
+        self._peer_fetch = (knobs.get_bool("ARKS_PEER_FETCH")
+                            and self._host is not None
+                            and self.dispatcher is None)
+        if disk_mb and self._host is not None and self.dispatcher is None:
+            import tempfile
+
+            from arks_tpu.engine.prefix_cache import DiskPrefixTier
+            ddir = knobs.get_str("ARKS_PREFIX_DISK_DIR") or os.path.join(
+                tempfile.gettempdir(), "arks-prefix-disk")
+            self._disk = DiskPrefixTier(
+                self._page_size(), disk_mb * 2**20, ddir,
+                self._kv_layout_epoch())
+            self._host.on_evict = self._note_host_evicted
+            # Bounded: a spill storm drops blocks (best-effort warmth)
+            # instead of growing an unbounded host-RAM backlog.
+            self._disk_write_queue = queue.Queue(maxsize=256)
+            self._disk_writer = threading.Thread(
+                target=self._disk_write_loop, name="disk-spill",
+                daemon=True)
+            self._disk_writer.start()
+        if self._disk is not None or self._peer_fetch:
+            self._fetch_queue = queue.Queue()
+            threading.Thread(target=self._fetch_loop,
+                             name="prefix-fetch", daemon=True).start()
+
         # ---- Preemptive KV swap state (ARKS_PREEMPT) -------------------
         # Victim decode state (KV page blocks + sampler row) parks in a
         # keyed SwapStore sharing the host tier's byte budget; swap-mode
@@ -1491,7 +1593,13 @@ class InferenceEngine:
             functools.partial(prefill_detached_prog, want_lp=False))
         self._prefill_detached_lp_fn = jax.jit(
             functools.partial(prefill_detached_prog, want_lp=True))
-        self._insert_fn = jax.jit(tf.insert, donate_argnums=(0,))
+        # Lambda wrapper (here and for the other module-level tf.* jits
+        # below): jit's trace cache is keyed on the underlying callable,
+        # so a bare jax.jit(tf.insert) would share one process-wide cache
+        # across engines and leak other engines' shape variants into
+        # compiled_program_variants().
+        self._insert_fn = jax.jit(lambda *a: tf.insert(*a),
+                                  donate_argnums=(0,))
 
         # Fused BATCHED admission: M queued prompts prefill + sample +
         # insert + set_slot in ONE dispatch.  Under churn admissions were
@@ -1553,14 +1661,15 @@ class InferenceEngine:
 
         self._chunk_fn = jax.jit(chunk_step, donate_argnums=(1,))
         if self._paged:
-            self._insert_pages_fn = jax.jit(tf.insert_pages,
-                                            donate_argnums=(0,))
+            self._insert_pages_fn = jax.jit(
+                lambda *a: tf.insert_pages(*a), donate_argnums=(0,))
             # Host-tier spill/restore: gather evicted pages into a D2H
             # staging block; scatter host blocks back into fresh pool
             # pages.  The restore returns a marker READ FROM the written
             # pool, so marker.is_ready() == "the scatter landed" (a
             # passed-through input would alias and read ready instantly).
-            self._spill_gather_fn = jax.jit(tf.gather_pool_pages)
+            self._spill_gather_fn = jax.jit(
+                lambda *a, **kw: tf.gather_pool_pages(*a, **kw))
 
             def restore_scatter(cache, kb, vb, ksb, vsb, pages, n_valid):
                 cache = tf.scatter_pool_pages(cache, kb, vb, pages, n_valid,
@@ -1614,10 +1723,17 @@ class InferenceEngine:
         # Donated slot-state writes: eager .at[].set() would copy the whole
         # [num_slots, vocab] penalty-counts buffer on EVERY admission
         # (~117MB at 192 slots x 152k vocab); donation updates in place.
-        self._set_slot_fn = jax.jit(sampler_mod.set_slot,
-                                    donate_argnums=(0,))
-        self._clear_pen_fn = jax.jit(sampler_mod.clear_slot_penalties,
-                                     donate_argnums=(0,))
+        # Per-engine lambda wrappers: jax.jit's trace cache is keyed on the
+        # underlying callable, so jitting the module-level functions
+        # directly would share one process-wide cache across engines and
+        # make compiled_program_variants() report shapes traced by OTHER
+        # engines (order-dependent compile-budget counts under pytest).
+        self._set_slot_fn = jax.jit(
+            lambda *a, **kw: sampler_mod.set_slot(*a, **kw),
+            donate_argnums=(0,))
+        self._clear_pen_fn = jax.jit(
+            lambda *a, **kw: sampler_mod.clear_slot_penalties(*a, **kw),
+            donate_argnums=(0,))
 
         # Free/pending slots park their lengths at this write-drop value;
         # the fused loop derives the active mask from it so PRNG keys and
@@ -2215,6 +2331,27 @@ class InferenceEngine:
                 log.warning(
                     "engine thread did not exit within 120s; it aborts "
                     "deferred admissions itself on exit")
+        # Graceful-stop persistence: publish the warm prefixes still
+        # resident on-device / in tier 1 into the disk store BEFORE the
+        # writer gets its exit sentinel, so a relaunch on the same
+        # ARKS_PREFIX_DISK_DIR re-serves them without re-prefilling.
+        if self._disk is not None:
+            try:
+                self._flush_warm_to_disk()
+            except Exception as e:  # best-effort: warmth, not shutdown
+                faults_mod.swallowed("disk_tier.flush", e)
+        # Disk-spill writer / prefix-fetch workers: daemon threads, but
+        # hand them their exit sentinel so a clean stop doesn't leave
+        # them blocked on an empty queue.
+        for wq in (self._disk_write_queue, self._fetch_queue):
+            if wq is not None:
+                try:
+                    wq.put_nowait(None)
+                except queue.Full:
+                    pass
+        if self._disk_writer is not None:
+            # Queued spill writes land before the process exits.
+            self._disk_writer.join(timeout=30.0)
         # Deferred admissions are drained by _run()'s finally on the
         # engine thread itself; a never-started engine has none.
 
@@ -2253,6 +2390,7 @@ class InferenceEngine:
                 and not self._prefilling and not self._pending_admits
                 and not self._awaiting_guide
                 and not self._awaiting_restore
+                and not self._awaiting_fetch
                 and not self._awaiting_model
                 and not self._swap_pending and not self._swapped)
 
@@ -2447,6 +2585,7 @@ class InferenceEngine:
             self._abort_pending_admits()
             self._abort_awaiting_guide()
             self._abort_awaiting_restores()
+            self._abort_awaiting_fetches()
             self._abort_awaiting_model()
             self._abort_swapped()
 
@@ -2577,6 +2716,17 @@ class InferenceEngine:
                     request=rst.request, seed=rst.seed,
                     num_prompt=len(rst.ids)))
         self._awaiting_restore = []
+        for fs in self._awaiting_fetch:
+            # Fetch-parked requests emitted nothing and hold no pages:
+            # plain re-queue.  The host tier survives the reset, so any
+            # blocks the worker already staged still pay off on the
+            # re-run's admission; a worker still mid-fetch harmlessly
+            # finishes against the surviving tiers.
+            self.metrics.num_requests_waiting.inc(-1)
+            survivors.append(_Survivor(
+                request=fs.request, seed=fs.seed,
+                num_prompt=len(fs.ids)))
+        self._awaiting_fetch = []
         # Preempted victims (spill in flight or parked in host RAM):
         # token-replay instead of trusting a snapshot that may share the
         # fault's poisoned stream.  Their SwapStore bytes come back.
@@ -2688,8 +2838,16 @@ class InferenceEngine:
         """Blast-radius attribution for a phase-scoped fault: the requests
         the failing operation was doing work for.  Guide-table uploads
         serve no specific request — nobody's retry budget burns for one."""
-        if phase == "guide":
+        if phase in ("guide", "disk_spill"):
+            # Guide-table uploads and tier-2 spill drains serve no
+            # specific request — nobody's retry budget burns for one.
             return ()
+        if phase == "peer_fetch":
+            # Fetch faults are raised with the explicit fetching request
+            # at every fire site; an unattributed one can only be the
+            # park bookkeeping — blame the parked fetches, not the
+            # decoding slots.
+            return [st.request.request_id for st in self._awaiting_fetch]
         if phase == "model_switch":
             # The switch serves the requests parked for the target model;
             # nobody else was in flight (switches run fully drained).
@@ -2791,6 +2949,7 @@ class InferenceEngine:
         self._prefilling.clear()
         self._abort_pending_admits()
         self._abort_awaiting_restores()
+        self._abort_awaiting_fetches()
         self._abort_awaiting_model()
         # Preempted victims fail too, and their SwapStore entries go with
         # them — swapped-out KV may carry the poison back on resume.
@@ -2803,6 +2962,12 @@ class InferenceEngine:
             # the poisoned KV back on the next restore.
             self._host.clear()
             self.metrics.prefix_cache_usage_bytes.set(0, tier="host")
+        if self._disk is not None:
+            # The disk tier goes with it — AND its files, or the poison
+            # would resurrect on the next boot's directory scan.
+            self._disk_spill_pending.clear()
+            self._disk.clear()
+            self.metrics.prefix_cache_usage_bytes.set(0, tier="disk")
         self._fault_counts.clear()
         self._consec_faults = 0
         self._reset_device_state()
@@ -2950,8 +3115,19 @@ class InferenceEngine:
             self.metrics.scheduler_seconds_total.inc(tr - t0,
                                                      phase="restore")
             t0 = tr
+        if self._awaiting_fetch:
+            # Disk/peer fetch parks whose worker finished re-enter the
+            # admission match; in-flight ones stay parked (the worker
+            # thread owns them — the step loop never blocks on IO).
+            worked = self._resolve_fetches() or worked
+            tq = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(tq - t0,
+                                                     phase="fetch")
+            t0 = tq
         if self._spills:
             worked = self._resolve_spills() or worked
+        if self._disk_spill_pending:
+            worked = self._drain_disk_spills() or worked
         if self._swap_pending or self._swapped or self._preempt_on:
             # Preemptive KV swap: harvest landed victim spills into the
             # SwapStore, serve aborts / schedule resumes for swapped-out
@@ -3039,6 +3215,8 @@ class InferenceEngine:
             self.metrics.scheduler_seconds_total.inc(
                 time.monotonic() - t4, phase="admit")
         if not worked and (self._awaiting_restore or self._spills
+                           or self._awaiting_fetch
+                           or self._disk_spill_pending
                            or self._swap_pending or self._swapped
                            or self._awaiting_model or self._model_loads):
             # Parked restores / in-flight spills / pending model loads
@@ -3354,6 +3532,17 @@ class InferenceEngine:
                 self.metrics.prefix_cache_hit_tokens_total.inc(
                     hlen, tier="host")
             self.metrics.prefix_cache_hit_rate.set(self._alloc.hit_rate)
+            covered = len(shared) + len(host_blocks)
+            if covered < nfull and self._fetch_candidate(req, digests,
+                                                         covered):
+                # Tier 2 / fleet: the uncovered span exists on local
+                # disk or (per the router's hint) on a peer replica —
+                # park for an async fetch into the host tier instead of
+                # re-prefilling it.  Shared device refs are RELEASED
+                # across the park (the resolve re-matches from scratch),
+                # so no page bookkeeping outlives this frame.
+                self._alloc.decref(shared)
+                return self._issue_fetch(req, ids, digests, covered)
             if host_blocks:
                 return self._issue_restore(req, ids, digests, shared,
                                            host_blocks)
@@ -3622,13 +3811,20 @@ class InferenceEngine:
         host = self._host
         if host is not None:
             host_list, hver = host.snapshot()
+        disk_list: list = []
+        dkver = -1
+        disk = self._disk
+        if disk is not None:
+            disk_list, dkver = disk.snapshot()
         # id(alloc) keys the build cache across resets/model switches,
         # where a FRESH allocator restarts its version counter.
         hits = self.metrics.prefix_cache_hit_tokens_total
         return sk.build(
             device, (id(alloc), dver), host_list, hver,
+            disk=disk_list, disk_key=dkver,
             hit_tokens={"device": hits.get(tier="device"),
-                        "host": hits.get(tier="host")},
+                        "host": hits.get(tier="host"),
+                        "disk": hits.get(tier="disk")},
             query_tokens=self.metrics.prefix_cache_query_tokens_total.total(),
             extra={"model": self.cfg.name})
 
@@ -3944,6 +4140,375 @@ class InferenceEngine:
                 finished=True, finish_reason="abort",
                 num_prompt_tokens=len(rec.ids)))
         self._awaiting_restore = []
+
+    # ------------------------------------------------------------------
+    # Tier-2 disk block store + fleet peer fetch
+    # ------------------------------------------------------------------
+
+    def _kv_layout_epoch(self) -> str:
+        """Pool layout signature digest.  Chain digests are content-only
+        (token ids) — NOT keyed by model or pool geometry — so every
+        tier-2 block file and every peer-fetched wire block carries this
+        stamp, and a reader on any other layout rejects the bytes
+        instead of reinterpreting them."""
+        import hashlib
+        sig = "|".join(str(x) for x in (
+            self.cfg.name, self._page_size(), self.cfg.num_layers,
+            self.cfg.num_kv_heads, self._page_bytes,
+            self.ecfg.kv_quantized, self.ecfg.kv_bits,
+            self.ecfg.resolve_kv_cache_dtype()))
+        return hashlib.sha1(sig.encode()).hexdigest()[:16]
+
+    @property
+    def kv_epoch(self) -> str:
+        """The layout epoch peers validate fetched blocks against (the
+        server's block-export path packs with this)."""
+        return self._kv_epoch
+
+    def _note_host_evicted(self, digest: bytes, block: dict) -> None:
+        """HostPrefixTier.on_evict hook: queue a tier-1 evictee for the
+        async disk spill.  Called outside the tier lock, from whichever
+        thread triggered the eviction (engine spill harvest, disagg
+        publish) — bookkeeping only; the step loop drains the queue and
+        a writer thread does the file IO.  Bounded: a spill storm drops
+        blocks (cache warmth is best-effort) rather than growing an
+        unbounded backlog of host RAM the LRU just decided to free."""
+        if self._disk is None or len(self._disk_spill_pending) >= 1024:
+            return
+        self._disk_spill_pending.append((digest, block))
+
+    def _drain_disk_spills(self) -> bool:
+        """Hand queued tier-1 evictees to the disk writer thread (engine
+        thread; no file IO here).  Phase "disk_spill" raises with NO
+        culprits — a spill serves no request, so a fault replays every
+        in-flight stream and burns nobody's retry budget."""
+        if self._disk is None or not self._disk_spill_pending:
+            return False
+        try:
+            self._faults.fire("disk_spill")
+        except Exception as e:
+            if isinstance(e, StepFault):
+                raise
+            raise StepFault("disk_spill", faults_mod.classify(e)) from e
+        n = 0
+        while self._disk_spill_pending:
+            digest, blk = self._disk_spill_pending.popleft()
+            if self._disk.has(digest):
+                continue
+            try:
+                self._disk_write_queue.put_nowait((digest, blk))
+            except queue.Full:
+                # Best-effort: losing a spill costs one future
+                # re-prefill; blocking the step loop would cost every
+                # in-flight stream.
+                self._disk_spill_pending.clear()
+                break
+            n += 1
+        if n:
+            self.trace.evt("", "disk_spill", "I", n)
+        return n > 0
+
+    def _disk_write_loop(self) -> None:
+        """Writer thread: persist queued blocks (tmp+rename inside the
+        tier) and mirror the tier's gauges.  Failures are swallowed —
+        the disk tier is warmth, never correctness."""
+        q = self._disk_write_queue
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            digest, blk = item
+            try:
+                self._disk.put(digest, blk)
+            except Exception as e:
+                faults_mod.swallowed("disk_spill.write", e)
+            self._mirror_disk_metrics()
+
+    def _mirror_disk_metrics(self) -> None:
+        """Mirror the disk tier's internal counters into EngineMetrics
+        (called from the writer/fetch threads after tier mutations)."""
+        d = self._disk
+        if d is None:
+            return
+        m = self.metrics
+        m.prefix_cache_usage_bytes.set(d.bytes_used, tier="disk")
+        with self._disk_stats_lock:
+            ev, co = d.evicted_blocks, d.corrupt_blocks
+            if ev > self._disk_evict_seen:
+                m.prefix_disk_evictions_total.inc(ev - self._disk_evict_seen)
+                self._disk_evict_seen = ev
+            if co > self._disk_corrupt_seen:
+                m.prefix_disk_corrupt_total.inc(co - self._disk_corrupt_seen)
+                self._disk_corrupt_seen = co
+
+    def _flush_warm_to_disk(self) -> None:
+        """Graceful-stop persistence (stop(), engine thread already
+        joined): gather every prefix block still resident in the device
+        index with the spill path's own grouped gather, and copy every
+        tier-1 block, into the disk store — synchronously; blocking D2H
+        is fine once the step loop is gone.  Best-effort throughout: a
+        failed gather or write costs restart warmth, never the
+        shutdown."""
+        disk, host, alloc = self._disk, self._host, self._alloc
+        gather = getattr(self, "_spill_gather_fn", None)
+        if alloc is not None and gather is not None and \
+                self._cache is not None:
+            with alloc._mirror_lock:
+                resident = list(alloc._index.items())  # digest -> page
+            victims = [(d, p) for d, p in resident if not disk.has(d)]
+            G = self._spill_group
+            for i in range(0, len(victims), G):
+                grp = victims[i: i + G]
+                pages = [p for _, p in grp] + [grp[0][1]] * (G - len(grp))
+                try:
+                    out = gather(self._cache,
+                                 jnp.asarray(pages, jnp.int32))
+                    k, v, ks, vs = [None if a is None else np.asarray(a)
+                                    for a in out]
+                except Exception as e:
+                    faults_mod.swallowed("disk_tier.flush", e)
+                    continue
+                for j, (d, _) in enumerate(grp):
+                    blk = {"k": np.ascontiguousarray(k[:, j]),
+                           "v": np.ascontiguousarray(v[:, j])}
+                    if ks is not None:
+                        blk["k_scale"] = np.ascontiguousarray(ks[:, j])
+                        blk["v_scale"] = np.ascontiguousarray(vs[:, j])
+                    disk.put(d, blk)
+        if host is not None:
+            digests, _ver = host.snapshot()
+            for d in digests:
+                if disk.has(d):
+                    continue
+                blk = host.peek(d)
+                if blk is not None:
+                    disk.put(d, blk)
+        self._mirror_disk_metrics()
+
+    def _fetch_candidate(self, req: Request, digests: list,
+                         covered: int) -> bool:
+        """Can tier 2 or a peer extend this admission's coverage?  Pure
+        host probes (the disk check is an in-memory index hit): True
+        parks the request in _awaiting_fetch instead of re-prefilling
+        the uncovered span."""
+        if self._fetch_queue is None or not self._host_tier_on():
+            return False
+        if self._disk is not None and \
+                self._disk.match_digests(digests, covered):
+            return True
+        return self._peer_fetch and bool(req.peer_hint or self._peer_addrs)
+
+    def _issue_fetch(self, req: Request, ids: list[int], digests: list,
+                     start: int) -> None:
+        """Park an admission miss whose uncovered digests the disk tier
+        (or a hinted peer) can supply.  No device pages are held across
+        the park — the resolve re-runs the match from scratch — so abort
+        and recovery need no page bookkeeping for this state."""
+        seed = self._resolve_seed(req)
+        st = _FetchState(request=req, ids=ids, digests=digests,
+                         start=start, peer=(req.peer_hint or None),
+                         seed=seed, t0=time.monotonic())
+        self._awaiting_fetch.append(st)
+        self._fetch_queue.put(st)
+        self.metrics.num_requests_waiting.inc(1)
+        self.trace.evt(req.request_id, "park.fetch", "B",
+                       len(digests) - start)
+
+    def _fetch_loop(self) -> None:
+        """Fetch worker thread: stage parked requests' missing blocks
+        into the host tier.  Every failure mode degrades to `done` with
+        whatever run was staged — the resolve then restores the partial
+        run and chunk-prefills the rest (mid-fetch peer death costs
+        latency, never correctness)."""
+        q = self._fetch_queue
+        while True:
+            st = q.get()
+            if st is None:
+                return
+            try:
+                self._fetch_one(st)
+            except Exception as e:
+                faults_mod.swallowed("prefix_fetch", e)
+            st.done = True
+
+    def _fetch_one(self, st: _FetchState) -> None:
+        """Stage st's uncovered digest run: local disk first (cheaper),
+        then the hinted peer, then the static ARKS_PEER_ADDRS list.
+        Consecutive-only — a gap stops the run, because a restore needs
+        a contiguous prefix."""
+        peers = [a for a in ([st.peer] if st.peer else [])
+                 + self._peer_addrs if a]
+        for d in st.digests[st.start:]:
+            if self._host.has(d):
+                continue
+            blk = self._disk.get(d) if self._disk is not None else None
+            src = "disk"
+            if blk is None and peers:
+                blk = self._fetch_from_peers(peers, d)
+                src = "peer"
+            if blk is None:
+                break
+            if not self._host.put(d, blk) and not self._host.has(d):
+                break   # host budget cannot hold the staged run
+            if src == "disk":
+                st.fetched_disk += 1
+            else:
+                st.fetched_peer += 1
+        self._mirror_disk_metrics()
+
+    def _fetch_from_peers(self, peers: list[str], digest: bytes):
+        """One block from the first peer that has it, validated against
+        the local layout epoch (a peer on another pool layout 404s or is
+        rejected — never reinterpreted)."""
+        from arks_tpu.engine import kv_transfer
+        for addr in peers:
+            buf = self._peer_block_get(addr, digest)
+            if buf is None:
+                continue
+            try:
+                blk = kv_transfer.unpack_block(buf, digest, self._kv_epoch)
+            except ValueError as e:
+                faults_mod.swallowed("peer_fetch.unpack", e)
+                continue
+            return {k: np.ascontiguousarray(v) for k, v in blk.items()}
+        return None
+
+    def _peer_block_get(self, addr: str, digest: bytes) -> bytes | None:
+        """GET /v1/cache/blocks/{digest} from one peer; None on any
+        failure (timeout, refused, 404, mid-body death) — the caller
+        falls back to the next peer or to re-prefill."""
+        import http.client
+        addr = addr.split("//", 1)[-1].rstrip("/")
+        host, _, port = addr.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(
+                host or addr, int(port) if port else 80,
+                timeout=self._peer_timeout)
+            try:
+                conn.request("GET", f"/v1/cache/blocks/{digest.hex()}")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return resp.read()
+            finally:
+                conn.close()
+        except Exception as e:
+            faults_mod.swallowed("peer_fetch.http", e)
+            return None
+
+    def _fetch_ready_any(self) -> bool:
+        return bool(self._free) and any(st.done
+                                        for st in self._awaiting_fetch)
+
+    def _resolve_fetches(self) -> bool:
+        """Unpark fetch-parked requests whose worker finished: re-run
+        the admission match (the staged blocks now sit in the host tier)
+        and continue through the ordinary tier-1 restore / chunked-tail
+        path.  A resolve fault culprits the fetching request ALONE
+        (phase "peer_fetch"); aborts raised while parked just fail the
+        request — no pages were held across the park."""
+        did = False
+        pending = self._awaiting_fetch
+        i = 0
+        while i < len(pending):
+            st = pending[i]
+            rid = st.request.request_id
+            with self._abort_lock:
+                was_aborted = rid in self._aborted
+                if was_aborted:
+                    self._aborted.discard(rid)
+            if was_aborted:
+                pending.pop(i)
+                did = True
+                self.metrics.num_requests_waiting.inc(-1)
+                self._unpin_guide(st.request)
+                st.request.outputs.put(RequestOutput(
+                    request_id=rid, token_ids=[], finished=True,
+                    finish_reason="abort", num_prompt_tokens=len(st.ids)))
+                continue
+            if not st.done or not self._free:
+                i += 1
+                continue
+            pending.pop(i)  # before the fault fire, so recovery cannot
+            did = True      # double-count the record as a survivor
+            self.metrics.num_requests_waiting.inc(-1)
+            try:
+                self._faults.fire("peer_fetch")
+            except Exception as e:
+                if isinstance(e, StepFault):
+                    raise
+                raise StepFault(
+                    "peer_fetch", faults_mod.classify(e), culprits=[rid],
+                    survivors=[_Survivor(request=st.request, seed=st.seed,
+                                         num_prompt=len(st.ids))]) from e
+            page = self._page_size()
+            if st.fetched_disk:
+                self.metrics.prefix_peer_fetch_blocks_total.inc(
+                    st.fetched_disk, source="disk")
+                self.metrics.prefix_cache_hit_tokens_total.inc(
+                    st.fetched_disk * page, tier="disk")
+            if st.fetched_peer:
+                self.metrics.prefix_peer_fetch_blocks_total.inc(
+                    st.fetched_peer, source="peer")
+                self.metrics.prefix_cache_hit_tokens_total.inc(
+                    st.fetched_peer * page, tier="peer")
+            if st.fetched_disk or st.fetched_peer:
+                self.metrics.prefix_peer_fetch_seconds.observe(
+                    time.monotonic() - st.t0)
+            self.trace.evt(rid, "park.fetch", "E",
+                           st.fetched_disk + st.fetched_peer)
+            self._admit_after_fetch(st)
+        return did
+
+    def _admit_after_fetch(self, st: _FetchState) -> None:
+        """Route an unparked fetch through the standard admission match:
+        device run (may have changed while parked), then host tier (now
+        holding the staged blocks), then the chunked tail.  An empty
+        fetch degrades to plain chunked prefill — the no-worse-than-
+        re-prefill guarantee."""
+        req, ids, digests = st.request, st.ids, st.digests
+        page = self._page_size()
+        shared = self._alloc.match(digests)
+        plen = len(shared) * page
+        host_blocks: list = []
+        if self._host_tier_on() and len(shared) < len(digests):
+            host_blocks = self._host.match_blocks(digests, len(shared))
+        if host_blocks:
+            return self._issue_restore(req, ids, digests, shared,
+                                       host_blocks)
+        if plen:
+            return self._start_chunked(req, ids, prefix_len=plen,
+                                       prefix_pages=shared,
+                                       digests=digests)
+        self._alloc.decref(shared)
+        self._start_chunked(req, ids)
+
+    def block_for_export(self, digest: bytes) -> dict | None:
+        """One prefix block for a peer's GET /v1/cache/blocks/{digest}.
+        Server threads.  Host tier first (peek — a remote reader must
+        not distort this replica's own recency order), then disk; None
+        maps to 404 at the HTTP layer."""
+        host = self._host
+        if host is not None:
+            blk = host.peek(digest)
+            if blk is not None:
+                return blk
+        disk = self._disk
+        if disk is not None:
+            return disk.get(digest)
+        return None
+
+    def _abort_awaiting_fetches(self) -> None:
+        """Fail every fetch-parked request (engine exit / blanket
+        abort): no scheduler remains to unpark them."""
+        for st in self._awaiting_fetch:
+            self.metrics.num_requests_waiting.inc(-1)
+            self._unpin_guide(st.request)
+            st.request.outputs.put(RequestOutput(
+                request_id=st.request.request_id, token_ids=[],
+                finished=True, finish_reason="abort",
+                num_prompt_tokens=len(st.ids)))
+        self._awaiting_fetch = []
 
     # ------------------------------------------------------------------
     # SLO-tiered preemptive KV swap (ARKS_PREEMPT)
@@ -5601,6 +6166,11 @@ class InferenceEngine:
             # slot with authoritative mirrors.  Restores still in flight
             # keep pipelining at full depth — that is the point of
             # issuing them as ordinary stream dispatches.
+            return False
+        if self._fetch_ready_any():
+            # A disk/peer fetch finished staging: drain so the unpark
+            # re-enters admission with authoritative mirrors.  In-flight
+            # fetches are worker-thread work — full depth continues.
             return False
         if self._free and not self._queue.empty():
             # Admission is possible RIGHT NOW; with no free slot the queue

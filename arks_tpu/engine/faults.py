@@ -22,7 +22,10 @@ vocabulary for it:
   (recovery re-admission), ``pages`` (page-table growth/alloc),
   ``guide`` (guide-table upload), ``spec`` (speculative dispatch),
   ``preempt`` (preemptive-swap spill issue/harvest and victim resume —
-  culprit is the preempted/resuming request only).
+  culprit is the preempted/resuming request only), ``disk_spill``
+  (tier-2 disk spill issue — serves no request, so nobody's retry
+  budget burns), ``peer_fetch`` (disk/peer prefix-block fetch resolve —
+  culprit is the fetching request only).
   Kinds: ``runtime``, ``value``, ``oom`` (RESOURCE_EXHAUSTED-shaped
   RuntimeError), ``hang`` (sleeps ``ARKS_FAULT_HANG_S``, default 3600 —
   the watchdog-escalation fixture).

@@ -42,6 +42,41 @@ def pack(meta: dict[str, Any], tensors: list[np.ndarray]) -> bytes:
     return b"".join(parts)
 
 
+# Canonical field order for a pool-native prefix page block.  Disk files
+# (DiskPrefixTier) and the peer-fetch wire (GET /v1/cache/blocks/{digest})
+# both serialize blocks through pack_block/unpack_block so every tier and
+# every replica agrees on one byte layout — which is what keeps a
+# spill → disk → peer-fetch → restore round trip bit-exact by construction.
+BLOCK_FIELDS = ("k", "v", "k_scale", "v_scale")
+
+
+def pack_block(digest: bytes, epoch: str, block: dict[str, np.ndarray]) -> bytes:
+    """One prefix page block as an AKV1 message.  ``epoch`` is the pool
+    layout signature digest: a reader on a different layout (other model,
+    page size, or kv dtype) must reject the block, not reinterpret it."""
+    fields = [f for f in BLOCK_FIELDS if block.get(f) is not None]
+    return pack({"digest": digest.hex(), "epoch": epoch, "fields": fields},
+                [block[f] for f in fields])
+
+
+def unpack_block(buf: bytes, digest: bytes,
+                 epoch: str) -> dict[str, np.ndarray]:
+    """Validate and decode one pack_block message.  Raises ValueError on
+    any mismatch — digest (content), epoch (pool layout), or field set —
+    so a stale or cross-layout block can never be served as a hit."""
+    meta, tensors = unpack(buf)
+    if meta.get("digest") != digest.hex():
+        raise ValueError(f"block digest mismatch: {meta.get('digest')!r}")
+    if meta.get("epoch") != epoch:
+        raise ValueError(f"block epoch mismatch: {meta.get('epoch')!r} "
+                         f"!= {epoch!r}")
+    fields = meta.get("fields") or []
+    if len(fields) != len(tensors) or any(f not in BLOCK_FIELDS
+                                          for f in fields):
+        raise ValueError(f"bad block fields: {fields!r}")
+    return dict(zip(fields, tensors))
+
+
 def unpack(buf: bytes) -> tuple[dict[str, Any], list[np.ndarray]]:
     if buf[:4] != MAGIC:
         raise ValueError("bad KV transfer magic")

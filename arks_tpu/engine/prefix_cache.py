@@ -171,6 +171,12 @@ class HostPrefixTier:
             raise ValueError("page_tokens must be positive")
         self.page = page_tokens
         self.capacity = capacity_bytes
+        # Eviction sink: called as on_evict(digest, block) for every block
+        # LRU-evicted past the byte budget — the engine points this at the
+        # tier-2 disk spill queue so a block falling out of host RAM gets
+        # a chance to survive on disk.  Invoked AFTER the tier lock is
+        # released (the callback may take other locks / touch queues).
+        self.on_evict = None
         # Bytes carved out of ``capacity`` by non-prefix tenants (the
         # preempt SwapStore).  The LRU eviction loop honors
         # ``capacity - reserved``: prefix blocks evict around reserved
@@ -209,17 +215,32 @@ class HostPrefixTier:
             self._bytes += self._block_bytes(block)
             self.spilled_blocks += 1
             self.version += 1
-            self._evict_to_budget()
-            return digest in self._blocks
+            evicted = self._evict_to_budget()
+            stored = digest in self._blocks
+        self._notify_evicted(evicted)
+        return stored
 
-    def _evict_to_budget(self) -> None:
+    def _evict_to_budget(self) -> list[tuple[bytes, dict]]:
         """LRU-evict prefix blocks past the effective byte budget
-        (``capacity - reserved``).  Caller holds the lock."""
+        (``capacity - reserved``).  Caller holds the lock; the evicted
+        (digest, block) pairs are returned so the caller can hand them to
+        ``on_evict`` once the lock is dropped."""
         budget = max(self.capacity - self.reserved, 0)
+        evicted: list[tuple[bytes, dict]] = []
         while self._bytes > budget and self._blocks:
-            _, old = self._blocks.popitem(last=False)
+            d, old = self._blocks.popitem(last=False)
             self._bytes -= self._block_bytes(old)
             self.version += 1
+            evicted.append((d, old))
+        return evicted
+
+    def _notify_evicted(self, evicted: list[tuple[bytes, dict]]) -> None:
+        """Fan evictees out to ``on_evict`` outside the tier lock."""
+        cb = self.on_evict
+        if cb is None:
+            return
+        for d, blk in evicted:
+            cb(d, blk)
 
     def match_blocks(self, digests: list[bytes], start: int) -> list[dict]:
         """The longest run of consecutively-cached blocks for
@@ -236,6 +257,13 @@ class HostPrefixTier:
                 self._blocks.move_to_end(d)
                 out.append(blk)
         return out
+
+    def peek(self, digest: bytes) -> dict | None:
+        """The stored block WITHOUT an LRU touch — the peer block-serving
+        path reads through here, and a remote replica's fetch must not
+        distort this replica's own recency ordering."""
+        with self._lock:
+            return self._blocks.get(digest)
 
     def clear(self) -> None:
         """Drop every block (fault recovery's blanket deep clean — spilled
@@ -259,6 +287,263 @@ class HostPrefixTier:
     def num_blocks(self) -> int:
         with self._lock:
             return len(self._blocks)
+
+
+class DiskPrefixTier:
+    """Tier-2 local-disk block store behind the host tier.
+
+    Same chain-digest keys, same pool-native page blocks (int8/int4 +
+    scales) as ``HostPrefixTier`` — serialized one-file-per-block in the
+    kv_transfer AKV1 format, so a spill → restore round trip stays
+    bit-exact by construction and the same bytes can be served verbatim
+    to a fetching peer.  The point of the tier is durability: warm
+    prefixes survive an engine restart because the store re-indexes the
+    directory on boot.
+
+    Layout safety: chain digests are content-only (token ids), NOT keyed
+    by model or pool geometry, so a directory written under one pool
+    layout must never be served under another.  Every file's AKV1 meta
+    carries the pool layout signature digest (``epoch``), and a
+    ``manifest.json`` stamps the directory; a mismatched manifest on boot
+    wipes the directory, and a mismatched per-file epoch on read is
+    rejected (defense in depth — a crashed writer from a previous layout
+    may have left files behind the manifest's back).
+
+    Crash safety: writes go tmp + fsync + rename (a torn write leaves a
+    ``.tmp`` orphan, never a half-block under a valid name); corrupt or
+    truncated files are swallowed on read, deleted, and counted in
+    ``corrupt_blocks`` rather than poisoning a restore.
+
+    Threading: the in-memory index (digest → file size, LRU order) is
+    lock-guarded and cheap — ``match_digests``/``has`` are safe from the
+    engine thread.  File IO (``get``/``put``) is meant for the spill
+    writer / fetch worker / server threads, never the step loop.
+    """
+
+    SUFFIX = ".akv"
+    MANIFEST = "manifest.json"
+    FORMAT = 1
+
+    def __init__(self, page_tokens: int, capacity_bytes: int,
+                 directory: str, epoch: str) -> None:
+        if page_tokens <= 0:
+            raise ValueError("page_tokens must be positive")
+        import os
+        self.page = page_tokens
+        self.capacity = capacity_bytes
+        self.epoch = epoch
+        self.dir = directory
+        self._lock = threading.Lock()
+        # digest -> file size in bytes, LRU order (oldest first).
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self._bytes = 0
+        self.version = 0
+        # Stats (mirrored into EngineMetrics by the engine).
+        self.spilled_blocks = 0
+        self.restored_blocks = 0
+        self.evicted_blocks = 0
+        self.corrupt_blocks = 0
+        os.makedirs(self.dir, exist_ok=True)
+        self._boot_scan()
+
+    # -- paths ---------------------------------------------------------
+
+    def _path(self, digest: bytes) -> str:
+        import os
+        return os.path.join(self.dir, digest.hex() + self.SUFFIX)
+
+    # -- boot ----------------------------------------------------------
+
+    def _boot_scan(self) -> None:
+        """Adopt (or wipe) whatever a previous process left behind.  A
+        manifest from a different pool layout means every block in the
+        directory was written for other bytes-per-page geometry: delete
+        them all rather than serving one as a hit."""
+        import json
+        import os
+        mpath = os.path.join(self.dir, self.MANIFEST)
+        stale = False
+        try:
+            with open(mpath, "r", encoding="utf-8") as f:
+                m = json.load(f)
+            stale = (m.get("epoch") != self.epoch
+                     or m.get("format") != self.FORMAT)
+        except FileNotFoundError:
+            stale = False   # fresh directory: nothing to distrust
+        except Exception as e:
+            from arks_tpu.engine import faults as faults_mod
+            faults_mod.swallowed("disk_tier.manifest", e)
+            stale = True
+        for name in sorted(os.listdir(self.dir)):
+            path = os.path.join(self.dir, name)
+            if name.endswith(".tmp"):
+                # Torn write from a crashed spill: never adopted.
+                self._unlink(path)
+                continue
+            if not name.endswith(self.SUFFIX):
+                continue
+            if stale:
+                self._unlink(path)
+                continue
+            try:
+                digest = bytes.fromhex(name[:-len(self.SUFFIX)])
+                size = os.path.getsize(path)
+            except (ValueError, OSError) as e:
+                from arks_tpu.engine import faults as faults_mod
+                faults_mod.swallowed("disk_tier.scan", e)
+                self._unlink(path)
+                continue
+            self._index[digest] = size
+            self._bytes += size
+        tmp = mpath + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"epoch": self.epoch, "format": self.FORMAT}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, mpath)
+        with self._lock:
+            self._evict_to_budget()
+
+    @staticmethod
+    def _unlink(path: str) -> None:
+        import os
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    # -- membership (engine-thread safe: index only, no file IO) -------
+
+    def has(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._index
+
+    def match_digests(self, digests: list[bytes], start: int) -> list[bytes]:
+        """The longest run of consecutively-indexed digests from
+        ``digests[start:]`` — a pure in-memory probe (admission runs on
+        the engine thread; the file reads happen later, off-thread).
+        LRU-touches the hits so a hot prefix outlives churn."""
+        out: list[bytes] = []
+        with self._lock:
+            for d in digests[start:]:
+                if d not in self._index:
+                    break
+                self._index.move_to_end(d)
+                out.append(d)
+        return out
+
+    def snapshot(self) -> tuple[list[bytes], int]:
+        """Resident digests + membership version (tier-2 sketch input)."""
+        with self._lock:
+            return list(self._index), self.version
+
+    # -- file IO (worker / server threads) -----------------------------
+
+    def put(self, digest: bytes, block: dict) -> bool:
+        """Persist one block (tmp + fsync + rename).  Returns True when
+        newly stored.  IO failure is best-effort: swallowed, indexed as
+        absent."""
+        import os
+
+        from arks_tpu.engine import faults as faults_mod
+        from arks_tpu.engine import kv_transfer
+        with self._lock:
+            if digest in self._index:
+                self._index.move_to_end(digest)
+                return False
+        buf = kv_transfer.pack_block(digest, self.epoch, block)
+        path = self._path(digest)
+        tmp = path + f".{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(buf)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            faults_mod.swallowed("disk_tier.put", e)
+            self._unlink(tmp)
+            return False
+        with self._lock:
+            if digest in self._index:   # raced another writer: fine
+                return False
+            self._index[digest] = len(buf)
+            self._bytes += len(buf)
+            self.spilled_blocks += 1
+            self.version += 1
+            evicted = self._evict_to_budget()
+        for d in evicted:
+            self._unlink(self._path(d))
+        return True
+
+    def get(self, digest: bytes) -> dict | None:
+        """Read + validate one block.  A corrupt, truncated, or
+        cross-epoch file is deleted and counted — the caller sees a miss,
+        not an exception (the restore path re-prefills instead)."""
+        from arks_tpu.engine import faults as faults_mod
+        from arks_tpu.engine import kv_transfer
+        path = self._path(digest)
+        try:
+            with open(path, "rb") as f:
+                buf = f.read()
+            blk = kv_transfer.unpack_block(buf, digest, self.epoch)
+        except FileNotFoundError:
+            self._drop(digest)
+            return None
+        except Exception as e:
+            faults_mod.swallowed("disk_tier.get", e)
+            self._unlink(path)
+            with self._lock:
+                self.corrupt_blocks += 1
+            self._drop(digest)
+            return None
+        # Copy out of the frombuffer views so the mmap'd/read buffer is
+        # released and callers own mutable, contiguous arrays.
+        blk = {k: np.ascontiguousarray(v) for k, v in blk.items()}
+        with self._lock:
+            if digest in self._index:
+                self._index.move_to_end(digest)
+            self.restored_blocks += 1
+        return blk
+
+    def _drop(self, digest: bytes) -> None:
+        with self._lock:
+            size = self._index.pop(digest, None)
+            if size is not None:
+                self._bytes -= size
+                self.version += 1
+
+    def _evict_to_budget(self) -> list[bytes]:
+        """LRU-evict past the byte budget.  Caller holds the lock; the
+        evicted digests are returned for out-of-lock file deletion."""
+        evicted: list[bytes] = []
+        while self._bytes > self.capacity and self._index:
+            d, size = self._index.popitem(last=False)
+            self._bytes -= size
+            self.version += 1
+            self.evicted_blocks += 1
+            evicted.append(d)
+        return evicted
+
+    def clear(self) -> None:
+        """Drop every block, index AND files (blanket-abort deep clean —
+        a poisoned disk tier must not resurrect on the next boot)."""
+        with self._lock:
+            digests = list(self._index)
+            self._index.clear()
+            self._bytes = 0
+            self.version += 1
+        for d in digests:
+            self._unlink(self._path(d))
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @property
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._index)
 
 
 class SwapStore:
@@ -313,7 +598,8 @@ class SwapStore:
             if t.reserved + need > t.capacity:
                 return False
             t.reserved += need
-            t._evict_to_budget()
+            evicted = t._evict_to_budget()
+        t._notify_evicted(evicted)
         self._entries[rid] = (entry, need)
         return True
 

@@ -115,6 +115,13 @@ class Request:
     # and any upstream (gateway/router) spans.  None = untraced or an
     # engine-local request; the engine mints a local trace id on demand.
     trace: object | None = None
+    # Fleet prefix cache: peer base address ("host:port") the router
+    # believes holds this prompt's warm prefix blocks (X-Arks-Peer-Hint).
+    # On an admission miss with ARKS_PEER_FETCH, the engine fetches the
+    # blocks from this peer over GET /v1/cache/blocks/{digest} instead
+    # of re-prefilling.  None = no hint; ARKS_PEER_ADDRS is the static
+    # fallback probe list.
+    peer_hint: str | None = None
 
 
 @dataclasses.dataclass

@@ -288,21 +288,30 @@ class SketchExporter:
         }
 
     def build(self, device: list[bytes], device_key, host: list[bytes],
-              host_key, hit_tokens: dict | None = None,
+              host_key, disk: list[bytes] | None = None, disk_key=-1,
+              hit_tokens: dict | None = None,
               query_tokens: float = 0, extra: dict | None = None) -> dict:
         """The export payload for the given tier membership snapshots
-        (oldest-first digest lists + an opaque version key per tier).
-        Cached until a membership version, the link ledger, or the epoch
-        changes; ``hit_tokens``/``query_tokens`` ride every response
-        uncached (they are cheap counters, and the actual-hit side of the
-        router's expected-vs-actual accounting must not lag)."""
+        (oldest-first digest lists + an opaque version key per tier;
+        ``disk`` is the optional tier-2 membership — peers use it to
+        advertise restart-surviving blocks they can serve over
+        /v1/cache/blocks).  Cached until a membership version, the link
+        ledger, or the epoch changes; ``hit_tokens``/``query_tokens``
+        ride every response uncached (they are cheap counters, and the
+        actual-hit side of the router's expected-vs-actual accounting
+        must not lag)."""
         with self._lock:
-            key = (self._resets, device_key, host_key, self._links_version)
+            key = (self._resets, device_key, host_key, disk_key,
+                   self._links_version)
             if self._cache is not None and self._cache[0] == key:
                 payload = self._cache[1]
             else:
                 links = list(self._links.items())
                 self._builds += 1
+                tiers = {"device": self._tier_payload(device, links),
+                         "host": self._tier_payload(host, links)}
+                if disk:
+                    tiers["disk"] = self._tier_payload(disk, links)
                 payload = {
                     "enabled": True,
                     "epoch": self.epoch,
@@ -310,8 +319,7 @@ class SketchExporter:
                     "built_unix": time.time(),
                     "page_tokens": self.page,
                     "text_chars": self.text_chars,
-                    "tiers": {"device": self._tier_payload(device, links),
-                              "host": self._tier_payload(host, links)},
+                    "tiers": tiers,
                 }
                 self._cache = (key, payload)
         out = dict(payload)
@@ -354,7 +362,9 @@ class BackendSketch:
         self.query_tokens = float(payload.get("query_tokens", 0) or 0)
         tiers = payload.get("tiers") or {}
         self._views = {}
-        for tier in ("device", "host"):
+        for tier in ("device", "host", "disk"):
+            # "disk" is absent from pre-tier-2 backends' payloads; the
+            # empty view then simply never extends a chain's coverage.
             t = tiers.get(tier) or {}
             self._views[(tier, "token")] = _TierView(t, text=False)
             self._views[(tier, "text")] = _TierView(t, text=True)
@@ -364,14 +374,16 @@ class BackendSketch:
         return cls(payload)
 
     def score_chain(self, digests: list[bytes],
-                    domain: str = "token") -> tuple[int, int]:
+                    domain: str = "token") -> tuple[int, int, int]:
         """Expected hit depth for one request chain: the initial
-        consecutive run resident in tier 0 (device), then the consecutive
-        continuation resident in tier 1 (host).  Returns
-        (device_blocks, host_blocks) — deterministic for a given sketch
-        and chain."""
+        consecutive run resident in tier 0 (device), then the
+        consecutive continuation resident in tier 1 (host), then the
+        continuation resident in tier 2 (disk).  Returns
+        (device_blocks, host_blocks, disk_blocks) — deterministic for a
+        given sketch and chain."""
         dev_view = self._views[("device", domain)]
         host_view = self._views[("host", domain)]
+        disk_view = self._views[("disk", domain)]
         dev = 0
         n = len(digests)
         while dev < n and dev_view.contains(digests[dev]):
@@ -379,4 +391,8 @@ class BackendSketch:
         host = 0
         while dev + host < n and host_view.contains(digests[dev + host]):
             host += 1
-        return dev, host
+        disk = 0
+        while (dev + host + disk < n
+               and disk_view.contains(digests[dev + host + disk])):
+            disk += 1
+        return dev, host, disk

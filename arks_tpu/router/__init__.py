@@ -66,6 +66,12 @@ _TRACE_ON = knobs.get_bool("ARKS_TRACE")
 
 HDR_PREFILL_ADDR = "X-Arks-Prefill-Addr"
 HDR_TIER = "x-arks-tier"   # SLO tier (arks_tpu.slo), forwarded verbatim
+# Fleet prefix cache: the decode backend the router's sketches say holds
+# the request's warm prefix DEEPEST.  Forwarded whenever it differs from
+# the backend actually chosen (load/ties/failover can route elsewhere) —
+# the engine's peer fetch (ARKS_PEER_FETCH) then pulls the blocks from
+# this peer instead of re-prefilling.
+HDR_PEER_HINT = "X-Arks-Peer-Hint"
 
 
 class Discovery:
@@ -386,6 +392,7 @@ class Router:
         self.sketch_on = (policy == "cache_aware"
                           and knobs.get_bool("ARKS_ROUTER_SKETCH"))
         self._t0_weight = knobs.get_float("ARKS_ROUTER_SKETCH_T0_WEIGHT")
+        self._disk_weight = knobs.get_float("ARKS_ROUTER_SKETCH_DISK_WEIGHT")
         self._max_blocks = knobs.get_int("ARKS_ROUTER_SKETCH_MAX_BLOCKS")
         poll_s = knobs.get_float("ARKS_ROUTER_SKETCH_POLL_S")
         stale_s = knobs.get_float("ARKS_ROUTER_SKETCH_STALE_S")
@@ -487,14 +494,17 @@ class Router:
                     status = 503
                     return h._error(503, "no ready prefill/decode backends")
                 t0 = time.monotonic()
-                p, candidates = self._pick(body, prefill, decode)
+                hint_out: list = []
+                p, candidates = self._pick(body, prefill, decode,
+                                           hint_out=hint_out)
                 if ctx is not None:
                     ctx.upstream.append({
                         "component": "router", "name": "router.pick",
                         "start": t0, "end": time.monotonic(),
                         "arg": candidates[0]})
-                status = self._forward_failover(h, body, p, candidates[0],
-                                                candidates, started, ctx=ctx)
+                status = self._forward_failover(
+                    h, body, p, candidates[0], candidates, started,
+                    ctx=ctx, peer_hint=(hint_out[0] if hint_out else None))
         except (BrokenPipeError, ConnectionResetError):
             status = 499
         except Exception as e:
@@ -514,12 +524,16 @@ class Router:
             self.requests_total.inc(status=str(status))
 
     def _pick(self, body: bytes, prefill: list[str],
-              decode: list[str]) -> tuple[str, tuple[str, ...]]:
+              decode: list[str], hint_out: list | None = None
+              ) -> tuple[str, tuple[str, ...]]:
         """(prefill addr, decode candidates in preference order).  The
         failover path walks the decode tuple in exactly this order, so
         sketch scoring shapes the retry sequence too — while the failover
         semantics themselves (when to move on, backoff, Retry-After) stay
-        untouched.  Unified mode returns "" for prefill."""
+        untouched.  Unified mode returns "" for prefill.  ``hint_out``
+        (when given) receives the peer-hint backend: the one whose
+        sketch covers the request deepest, for the X-Arks-Peer-Hint
+        header when routing lands elsewhere."""
         if self.policy == "cache_aware":
             try:
                 obj = json.loads(body)
@@ -528,7 +542,8 @@ class Router:
             key = _prefix_key_obj(obj)
             if key is not None:
                 p = _rendezvous(key, prefill) if prefill else ""
-                return p, tuple(self._order_decode(obj, key, decode))
+                return p, tuple(self._order_decode(obj, key, decode,
+                                                   hint_out))
             if self.sketch_on:
                 self.metrics.route_decisions_total.inc(reason="no_key")
         n = next(self._rr)
@@ -536,7 +551,8 @@ class Router:
         i = n % len(decode)
         return p, tuple(decode[i:] + decode[:i])
 
-    def _order_decode(self, obj, key: bytes, decode: list[str]) -> list[str]:
+    def _order_decode(self, obj, key: bytes, decode: list[str],
+                      hint_out: list | None = None) -> list[str]:
         """Decode candidates by expected prefix hit depth, deepest first.
 
         Scoring walks the request's digest chain against each backend's
@@ -544,10 +560,16 @@ class Router:
         exact keys — else the text domain fed by the server's alignment
         ledger) and weights tier-0 blocks by 1 + ARKS_ROUTER_SKETCH_T0_
         WEIGHT over tier-1 blocks (a device hit is free; a host hit costs
-        one H2D restore).  Fallback ladder: no fresh sketch anywhere ->
-        rendezvous (reason stale_sketch); tied scores, including the
-        all-zero case -> least in-flight, then rendezvous among the still
-        tied (tie_fallback); a unique deepest hit wins (sketch_hit)."""
+        one H2D restore); tier-2 (disk) blocks weigh ARKS_ROUTER_SKETCH_
+        DISK_WEIGHT — a disk hit costs a file read plus the restore, but
+        still beats re-prefill.  Fallback ladder: no fresh sketch
+        anywhere -> rendezvous (reason stale_sketch); tied scores,
+        including the all-zero case -> least in-flight, then rendezvous
+        among the still tied (tie_fallback); a unique deepest hit wins
+        (sketch_hit).  ``hint_out`` receives the deepest-covering
+        backend regardless of who wins routing — ties and load can send
+        the request elsewhere, and the peer hint is how the warm blocks
+        still get used (engine-side ARKS_PEER_FETCH)."""
         def rz(b: str) -> bytes:
             return hashlib.sha1(key + b"\x00" + b.encode()).digest()
 
@@ -589,23 +611,31 @@ class Router:
             m.route_decisions_total.inc(reason="stale_sketch")
             return sorted(decode, key=rz, reverse=True)
         w = self._t0_weight
+        dw = self._disk_weight
 
         def val(b: str) -> float:
-            dev, host = scores.get(b, (0, 0))
-            return dev * (1.0 + w) + host
+            dev, host, disk = scores.get(b, (0, 0, 0))
+            return dev * (1.0 + w) + host + disk * dw
 
+        if hint_out is not None and scores:
+            deepest = max(scores, key=lambda b: (sum(scores[b]), rz(b)))
+            if sum(scores[deepest]) > 0:
+                hint_out.append(deepest)
         best = max(val(b) for b in decode)
         tied = [b for b in decode if val(b) == best]
         if best > 0 and len(tied) == 1:
             chosen = tied[0]
             m.route_decisions_total.inc(reason="sketch_hit")
-            dev, host = scores[chosen]
+            dev, host, disk = scores[chosen]
             if dev:
                 m.expected_hit_blocks_total.inc(dev, backend=chosen,
                                                 tier="device")
             if host:
                 m.expected_hit_blocks_total.inc(host, backend=chosen,
                                                 tier="host")
+            if disk:
+                m.expected_hit_blocks_total.inc(disk, backend=chosen,
+                                                tier="disk")
         else:
             with self._load_lock:
                 load = {b: self._inflight.get(b, 0) for b in tied}
@@ -619,7 +649,8 @@ class Router:
 
     def _forward_failover(self, h, body: bytes, prefill_addr: str,
                           decode_addr: str, decode: list[str],
-                          started: list[bool], ctx=None) -> int:
+                          started: list[bool], ctx=None,
+                          peer_hint: str | None = None) -> int:
         """Backend failover: the picked decode backend first, then every
         other ready one, retried for ONE bounded backoff round — a request
         moves to the next backend on a connection error or a 503
@@ -640,7 +671,8 @@ class Router:
                         self._inflight[cand] = self._inflight.get(cand, 0) + 1
                     try:
                         status, ra = self._forward(h, body, prefill_addr,
-                                                   cand, started, ctx=ctx)
+                                                   cand, started, ctx=ctx,
+                                                   peer_hint=peer_hint)
                     finally:
                         with self._load_lock:
                             self._inflight[cand] -= 1
@@ -679,7 +711,7 @@ class Router:
         return 503
 
     def _forward(self, h, body: bytes, prefill_addr: str, decode_addr: str,
-                 started: list[bool], ctx=None
+                 started: list[bool], ctx=None, peer_hint: str | None = None
                  ) -> tuple[int | None, str | None]:
         """Forward to one decode backend.  Returns (status, None) after
         relaying, or (None, retry_after) for a 503 swallowed BEFORE any
@@ -700,6 +732,10 @@ class Router:
         tier = h.headers.get(HDR_TIER)
         if tier:
             headers[HDR_TIER] = tier
+        if peer_hint and peer_hint != decode_addr:
+            # Only when routing landed AWAY from the deepest-covering
+            # replica: fetching from yourself is a no-op.
+            headers[HDR_PEER_HINT] = peer_hint
         tenant = h.headers.get(tenancy.HDR_TENANT)
         if tenant:
             headers[tenancy.HDR_TENANT] = tenant

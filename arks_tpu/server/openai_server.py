@@ -302,6 +302,21 @@ class OpenAIServer:
                                     "sampled out, or still in flight)")
                     else:
                         self._json(200, tr)
+                elif self.path.startswith("/v1/cache/blocks/"):
+                    # Fleet prefix cache: serve one raw AKV1 block to a
+                    # fetching peer (host tier peeked, then disk).  404 =
+                    # not resident; the peer falls back to re-prefill.
+                    buf = server._block_payload(
+                        self.path[len("/v1/cache/blocks/"):])
+                    if buf is None:
+                        self._error(404, "block not resident")
+                    else:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length", str(len(buf)))
+                        self.end_headers()
+                        self.wfile.write(buf)
                 elif self.path == "/v1/cache/sketch":
                     # Prefix-digest sketch for cache-aware routing: a
                     # compact per-tier summary of the digest chains this
@@ -458,6 +473,22 @@ class OpenAIServer:
     def _sketch_payload(self) -> dict:
         fn = getattr(self.engine, "cache_sketch", None)
         return fn() if callable(fn) else {"enabled": False}
+
+    def _block_payload(self, hexdigest: str) -> bytes | None:
+        """One prefix block, packed for the peer-fetch wire (GET
+        /v1/cache/blocks/{digest}).  The engine's export path does the
+        tier lookups; the AKV1 packing (json header) happens HERE, on
+        the server thread, outside the engine hot path."""
+        try:
+            digest = bytes.fromhex(hexdigest)
+        except ValueError:
+            return None
+        fn = getattr(self.engine, "block_for_export", None)
+        blk = fn(digest) if callable(fn) else None
+        if blk is None:
+            return None
+        from arks_tpu.engine import kv_transfer
+        return kv_transfer.pack_block(digest, self.engine.kv_epoch, blk)
 
     def _sketch_meta(self) -> dict:
         """Age/version metadata for /readiness (not the full sketch)."""
@@ -618,6 +649,10 @@ class OpenAIServer:
         # verbatim by the router.  Direct-to-pod clients carry none — their
         # requests share the fair queue's single untenanted lane.
         tenant = (h.headers.get(tenancy.HDR_TENANT) or "").strip() or None
+        # Fleet prefix cache: the router's deepest-covering-replica hint
+        # (X-Arks-Peer-Hint) — the engine's peer fetch pulls warm blocks
+        # from there on an admission miss (ARKS_PEER_FETCH).
+        peer_hint = (h.headers.get("x-arks-peer-hint") or "").strip() or None
         reqs = []
         for prompt_ids in batch:
             for j in range(n):
@@ -627,7 +662,8 @@ class OpenAIServer:
                 req = Request(request_id=f"req-{uuid.uuid4().hex[:16]}",
                               prompt_ids=list(prompt_ids), params=p,
                               model=engine_model, tenant=tenant,
-                              trace=ctx if single else None)
+                              trace=ctx if single else None,
+                              peer_hint=peer_hint)
                 try:
                     with logctx.bound(req.request_id,
                                       ctx.trace_id if ctx is not None else None):

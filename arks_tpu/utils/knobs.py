@@ -85,6 +85,25 @@ _k("ARKS_PAD_HEAD_DIM", "bool", "1",
 _k("ARKS_PREFIX_HOST_MB", "int", "256",
    "Host-RAM byte budget (MiB) of the tier-1 prefix KV cache; 0 "
    "disables the host tier.", "engine")
+_k("ARKS_PREFIX_DISK_MB", "int", "0",
+   "Local-disk byte budget (MiB) of the tier-2 prefix KV block store, "
+   "fed from tier-1 LRU evictions; 0 disables the disk tier.", "engine")
+_k("ARKS_PREFIX_DISK_DIR", "str", None,
+   "Directory for the tier-2 prefix block store; epoch-stamped so warm "
+   "prefixes survive engine restarts on the same pool layout.  Unset "
+   "with ARKS_PREFIX_DISK_MB>0 uses <tmpdir>/arks-prefix-disk.",
+   "engine")
+_k("ARKS_PEER_FETCH", "bool", "0",
+   "Fetch missing prefix KV blocks from peer replicas (router "
+   "X-Arks-Peer-Hint or ARKS_PEER_ADDRS) over GET /v1/cache/blocks/"
+   "{digest} instead of re-prefilling.", "engine")
+_k("ARKS_PEER_FETCH_TIMEOUT_S", "float", "5",
+   "Per-request HTTP timeout for one peer block fetch; a timeout falls "
+   "back to chunked re-prefill of the uncovered tail.", "engine")
+_k("ARKS_PEER_ADDRS", "list", None,
+   "Static comma-separated peer base addresses (host:port) probed for "
+   "prefix blocks when no router peer hint accompanies the request.",
+   "engine")
 _k("ARKS_PREEMPT", "bool", "0",
    "Enable preemptive KV swap: latency-tier arrivals seize running "
    "low-tier slots by spilling their decode state to host RAM.",
@@ -253,6 +272,10 @@ _k("ARKS_ROUTER_SKETCH_STALE_S", "float", "10",
 _k("ARKS_ROUTER_SKETCH_T0_WEIGHT", "float", "1.0",
    "Extra score weight of a tier-0 (device) block over a host-tier "
    "block.", "router")
+_k("ARKS_ROUTER_SKETCH_DISK_WEIGHT", "float", "0.5",
+   "Score weight of a tier-2 (disk) block relative to a host-tier "
+   "block; disk hits restore slower than RAM but still beat "
+   "re-prefill.", "router")
 _k("ARKS_ROUTER_SKETCH_MAX_BLOCKS", "int", "64",
    "Max prompt prefix blocks hashed per routing decision.", "router")
 _k("ARKS_ROUTER_SKETCH_CHARS", "int", "256",

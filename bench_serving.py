@@ -832,6 +832,280 @@ def run_shared_prefix_bench() -> dict:
     return out
 
 
+def _sp_clients_workload(cfg, chunk, clients, extra):
+    """Deterministic per-client prompts sharing a system prefix: the
+    request sequence every persistence/peer rung replays verbatim."""
+    import random
+    rng = random.Random(42)
+    lo, hi = 3, min(200, cfg.vocab_size)
+    system = [rng.randrange(lo, hi) for _ in range(2 * chunk)]
+    return [(f"c{ci}", system + [rng.randrange(lo, hi)
+                                 for _ in range(extra)])
+            for ci in range(clients)]
+
+
+def _sp_engine_measure(eng, rid, prompt, peer_hint=None):
+    """One request through a started engine; returns
+    (token_ids, ttft_s, per-tier hit/query/chunk deltas)."""
+    from arks_tpu.engine import Request, SamplingParams
+    m = eng.metrics
+    b = {"query": m.prefix_cache_query_tokens_total.total(),
+         "chunk": m.mixed_chunk_tokens_total.total(),
+         **{t: m.prefix_cache_hit_tokens_total.get(tier=t)
+            for t in ("device", "host", "disk", "peer")}}
+    req = Request(rid, prompt,
+                  SamplingParams(max_tokens=4, temperature=0.0,
+                                 ignore_eos=True), peer_hint=peer_hint)
+    eng.add_request(req)
+    toks, ttft = [], None
+    while True:
+        out = req.outputs.get(timeout=300)
+        if out.ttft_s is not None and ttft is None:
+            ttft = out.ttft_s
+        toks.extend(out.token_ids)
+        if out.finished:
+            assert out.finish_reason == "length", (rid, out)
+            break
+    d = {"query": m.prefix_cache_query_tokens_total.total() - b["query"],
+         "chunk": m.mixed_chunk_tokens_total.total() - b["chunk"],
+         **{t: m.prefix_cache_hit_tokens_total.get(tier=t) - b[t]
+            for t in ("device", "host", "disk", "peer")}}
+    return toks, ttft, d
+
+
+def run_shared_prefix_restart_bench() -> dict:
+    """``--workload shared-prefix --restart``: the tier-2 persistence
+    rung.  An engine with a disk tier warms per-client shared-prefix
+    prompts, stops (the graceful stop flushes warm blocks to
+    ARKS_PREFIX_DISK_DIR), and a SECOND engine boots on the same
+    directory and replays the identical prompts.
+
+    The acceptance surface: the relaunched engine re-prefills ZERO
+    warm-prefix full-page tokens — every full page comes back through
+    the disk fetch + tier-1 restore path (only the sub-page tail is
+    chunk-prefilled), the generated streams are byte-identical across
+    the restart, and the warm TTFT is reported against the relaunched
+    engine's own cold-miss TTFT (the re-prefill it avoided)."""
+    import tempfile
+
+    import numpy as np
+
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+
+    model = os.environ.get("ARKS_BENCH_SP_MODEL", "tiny")
+    clients = int(os.environ.get("ARKS_BENCH_SP_CLIENTS", "4"))
+    chunk = 16
+    cfg = get_config(model)
+    ddir = tempfile.mkdtemp(prefix="arks-bench-restart-")
+    saved = {k: os.environ.get(k) for k in
+             ("ARKS_PREFIX_HOST_MB", "ARKS_PREFIX_DISK_MB",
+              "ARKS_PREFIX_DISK_DIR")}
+    os.environ["ARKS_PREFIX_HOST_MB"] = "64"
+    os.environ["ARKS_PREFIX_DISK_MB"] = "64"
+    os.environ["ARKS_PREFIX_DISK_DIR"] = ddir
+
+    def _mk():
+        ecfg = EngineConfig(model=model, num_slots=2, max_cache_len=128,
+                            prefill_buckets=(16, 32), steps_per_dispatch=4,
+                            prefill_chunk=chunk, kv_layout="paged",
+                            prefix_cache_mb=0)
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+        eng.start()
+        return eng
+
+    # 76-token prompts: 4 full pages (restorable) + a 12-token tail.
+    work = _sp_clients_workload(cfg, chunk, clients, extra=44 - chunk)
+    try:
+        eng = _mk()
+        cold_rows, base_toks = [], {}
+        try:
+            for rid, prompt in work:
+                toks, ttft, d = _sp_engine_measure(eng, rid, prompt)
+                base_toks[rid] = toks
+                cold_rows.append({"rid": rid, "ttft_s": ttft, **d})
+        finally:
+            eng.stop()  # graceful: flushes warm blocks into the store
+
+        eng2 = _mk()
+        warm_rows = []
+        try:
+            assert eng2._disk is not None and eng2._disk.num_blocks > 0, \
+                "restart bench: the disk store came up empty"
+            for rid, prompt in work:
+                toks, ttft, d = _sp_engine_measure(eng2, rid, prompt)
+                assert toks == base_toks[rid], \
+                    f"stream diverged across the restart: {rid}"
+                nfull = (len(prompt) - 1) // chunk
+                reprefill = (d["query"] - d["device"] - d["host"]
+                             - d["disk"] - d["peer"])
+                assert reprefill == len(prompt) - nfull * chunk, (
+                    "warm full-page tokens were re-prefilled after the "
+                    f"restart: {rid} {d}")
+                warm_rows.append({"rid": rid, "ttft_s": ttft,
+                                  "reprefill": reprefill, **d})
+            # Cold miss on the RELAUNCHED engine: the apples-to-apples
+            # re-prefill TTFT the disk restore avoided.
+            import random
+            rng = random.Random(9)
+            miss_rows = []
+            for i in range(max(clients // 2, 2)):
+                prompt = [rng.randrange(3, min(200, cfg.vocab_size))
+                          for _ in range(len(work[0][1]))]
+                _, ttft, d = _sp_engine_measure(eng2, f"miss-{i}", prompt)
+                miss_rows.append({"ttft_s": ttft, **d})
+        finally:
+            eng2.stop()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _mean_ms(rows, skip_first=False):
+        ts = [r["ttft_s"] for r in rows if r["ttft_s"] is not None]
+        if skip_first and len(ts) > 1:
+            ts = ts[1:]  # first warm row pays the restore-scatter compile
+        return round(float(np.mean(ts)) * 1e3, 2) if ts else None
+
+    return {
+        "workload": "shared-prefix-restart",
+        "spr2_model": model, "spr2_clients": clients,
+        "spr2_prompt_tokens": len(work[0][1]),
+        "spr2_identical_streams": True,
+        "spr2_disk_hit_tokens": sum(r["disk"] for r in warm_rows),
+        "spr2_warm_reprefill_tokens": sum(r["reprefill"]
+                                          for r in warm_rows),
+        "spr2_cold_chunk_tokens": sum(r["chunk"] for r in cold_rows),
+        "spr2_warm_chunk_tokens": sum(r["chunk"] for r in warm_rows),
+        "spr2_ttft_cold_mean_ms": _mean_ms(cold_rows),
+        "spr2_ttft_warm_mean_ms": _mean_ms(warm_rows, skip_first=True),
+        "spr2_ttft_miss_mean_ms": _mean_ms(miss_rows),
+    }
+
+
+def run_shared_prefix_peer_restore_bench() -> dict:
+    """``--workload shared-prefix --peer-restore``: the fleet-wide
+    restore rung.  Replica A warms the shared-prefix prompts and (after
+    churn spills them into its host tier) serves raw blocks from its
+    OpenAI server's ``/v1/cache/blocks/{digest}``; replica B admits the
+    identical prompts with a peer hint and restores A's blocks instead
+    of re-prefilling; a hint-less control replica C re-prefills.
+
+    Asserts B's streams are byte-identical to A's and C's, and that B
+    chunk-prefills STRICTLY fewer tokens than C — the paper's
+    fetch-beats-prefill premise, reported as TTFT + fetched-block
+    numbers per side."""
+    import numpy as np
+
+    from arks_tpu.engine import EngineConfig, InferenceEngine
+    from arks_tpu.engine.paged import chain_digests
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.server import OpenAIServer
+
+    model = os.environ.get("ARKS_BENCH_SP_MODEL", "tiny")
+    clients = int(os.environ.get("ARKS_BENCH_SP_CLIENTS", "4"))
+    chunk = 16
+    cfg = get_config(model)
+    saved = {k: os.environ.get(k) for k in
+             ("ARKS_PREFIX_HOST_MB", "ARKS_PREFIX_DISK_MB",
+              "ARKS_PEER_FETCH")}
+    os.environ["ARKS_PREFIX_HOST_MB"] = "64"
+    os.environ.pop("ARKS_PREFIX_DISK_MB", None)
+
+    def _mk(peer_fetch):
+        os.environ["ARKS_PEER_FETCH"] = "1" if peer_fetch else "0"
+        ecfg = EngineConfig(model=model, num_slots=2, max_cache_len=128,
+                            prefill_buckets=(16, 32), steps_per_dispatch=4,
+                            prefill_chunk=chunk, kv_layout="paged",
+                            prefix_cache_mb=0)
+        eng = InferenceEngine(cfg, ecfg, ByteTokenizer())
+        eng.start()
+        return eng
+
+    work = _sp_clients_workload(cfg, chunk, clients, extra=44 - chunk)
+    digests = {rid: chain_digests(prompt, chunk,
+                                  (len(prompt) - 1) // chunk)
+               for rid, prompt in work}
+    a = srv = b = c = None
+    try:
+        # --- replica A: warm, churn into the host tier, serve blocks.
+        a = _mk(peer_fetch=False)
+        base_toks = {}
+        for rid, prompt in work:
+            toks, _, _ = _sp_engine_measure(a, rid, prompt)
+            base_toks[rid] = toks
+        i = 0
+        while (not all(a._host.has(d) for ds in digests.values()
+                       for d in ds) and i < 40):
+            _sp_engine_measure(a, f"churn-{i}", [(9 + i) % cfg.vocab_size] * 33)
+            i += 1
+        assert all(a._host.has(d) for ds in digests.values() for d in ds), \
+            "churn never spilled the warm prompts into A's host tier"
+        srv = OpenAIServer(a, served_model_name=model + "-bench",
+                           host="127.0.0.1", port=0)
+        srv.start(background=True)
+        hint = f"127.0.0.1:{srv.port}"
+
+        # --- control replica C: no hint, re-prefills everything.
+        c = _mk(peer_fetch=False)
+        ctrl_rows = []
+        for rid, prompt in work:
+            toks, ttft, d = _sp_engine_measure(c, rid, prompt)
+            assert toks == base_toks[rid], f"control diverged: {rid}"
+            ctrl_rows.append({"ttft_s": ttft, **d})
+
+        # --- replica B: peer hint, fetches A's blocks instead.
+        b = _mk(peer_fetch=True)
+        peer_rows = []
+        for rid, prompt in work:
+            toks, ttft, d = _sp_engine_measure(b, rid, prompt,
+                                               peer_hint=hint)
+            assert toks == base_toks[rid], f"peer-restored diverged: {rid}"
+            peer_rows.append({"ttft_s": ttft, **d})
+        fetched = int(b.metrics.prefix_peer_fetch_blocks_total.get(
+            source="peer"))
+        assert fetched > 0, "the peer-restore rung never fetched a block"
+        b_chunk = sum(r["chunk"] for r in peer_rows)
+        c_chunk = sum(r["chunk"] for r in ctrl_rows)
+        assert b_chunk < c_chunk, (
+            "peer restore must chunk-prefill strictly fewer tokens than "
+            f"the no-fetch control: {b_chunk} vs {c_chunk}")
+        fs = b.metrics.prefix_peer_fetch_seconds._data.get(())
+        fetch_mean_ms = (round(fs[1] / fs[2] * 1e3, 2)
+                         if fs and fs[2] else None)
+    finally:
+        for x in (srv, b, c, a):
+            if x is not None:
+                x.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _mean_ms(rows):
+        ts = [r["ttft_s"] for r in rows if r["ttft_s"] is not None]
+        return round(float(np.mean(ts)) * 1e3, 2) if ts else None
+
+    return {
+        "workload": "shared-prefix-peer-restore",
+        "spp_model": model, "spp_clients": clients,
+        "spp_prompt_tokens": len(work[0][1]),
+        "spp_identical_streams": True,
+        "spp_peer_fetched_blocks": fetched,
+        "spp_peer_hit_tokens": sum(r["peer"] for r in peer_rows),
+        "spp_peer_chunk_tokens": b_chunk,
+        "spp_control_chunk_tokens": c_chunk,
+        "spp_peer_fetch_mean_ms": fetch_mean_ms,
+        "spp_ttft_peer_mean_ms": _mean_ms(peer_rows),
+        "spp_ttft_control_mean_ms": _mean_ms(ctrl_rows),
+    }
+
+
 def run_slo_tiers_bench() -> dict:
     """``--workload slo-tiers``: the preemptive-KV-swap acceptance bench
     (CPU mechanics).  A mixed load — long batch-tier decodes occupying
@@ -1659,8 +1933,24 @@ def main() -> None:
                     help="shared-prefix only: N>1 runs the multi-backend "
                          "routing comparison (N engines behind a real "
                          "Router; sketch vs rendezvous vs random)")
+    ap.add_argument("--restart", action="store_true",
+                    help="shared-prefix only: the tier-2 persistence rung "
+                         "(stop + relaunch on the same disk store; zero "
+                         "re-prefilled warm full-page tokens)")
+    ap.add_argument("--peer-restore", action="store_true",
+                    help="shared-prefix only: the fleet-wide restore rung "
+                         "(replica B fetches replica A's blocks instead "
+                         "of re-prefilling)")
     args, _ = ap.parse_known_args()
     if args.workload == "shared-prefix":
+        if args.restart:
+            print(json.dumps({"metric": "shared_prefix_restart",
+                              **run_shared_prefix_restart_bench()}))
+            return
+        if args.peer_restore:
+            print(json.dumps({"metric": "shared_prefix_peer_restore",
+                              **run_shared_prefix_peer_restore_bench()}))
+            return
         if args.backends > 1:
             print(json.dumps({"metric": "shared_prefix_router",
                               **run_shared_prefix_router_bench(

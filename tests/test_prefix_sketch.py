@@ -82,8 +82,8 @@ def test_build_is_cached_until_membership_changes():
     # Evicted members vanish from the summary.
     p4 = ex.build(dev[1:], ("a", 3), host, 1)
     bs = ps.BackendSketch.from_payload(p4)
-    assert bs.score_chain([dev[0]], "token") == (0, 0)
-    assert bs.score_chain([dev[1]], "token") == (1, 0)
+    assert bs.score_chain([dev[0]], "token") == (0, 0, 0)
+    assert bs.score_chain([dev[1]], "token") == (1, 0, 0)
 
 
 def test_hit_counters_ride_every_response_uncached():
@@ -112,11 +112,11 @@ def test_scoring_is_deterministic_and_tier_split():
     payload = ex.build(chain[:3], ("a", 1), chain[3:5], 1)
     bs = ps.BackendSketch.from_payload(payload)
     for _ in range(3):
-        assert bs.score_chain(chain, "token") == (3, 2)
+        assert bs.score_chain(chain, "token") == (3, 2, 0)
     # A hole in the device run stops tier-0 counting there; the host walk
     # continues from the miss point only if resident.
     holey = [chain[0], _rand_digests(rng, 1)[0]] + chain[1:]
-    dev, host = bs.score_chain(holey, "token")
+    dev, host, _disk = bs.score_chain(holey, "token")
     assert dev == 1 and host == 0
 
 
@@ -134,7 +134,7 @@ def test_text_alignment_rounds_token_depth_up():
     # sketch must demand token depth 2 resident before advertising it.
     payload = ex.build(toks[:1], ("a", 1), [], 1)
     bs = ps.BackendSketch.from_payload(payload)
-    assert bs.score_chain(tds, "text") == (0, 0)
+    assert bs.score_chain(tds, "text") == (0, 0, 0)
     payload = ex.build(toks[:2], ("a", 2), [], 1)
     bs = ps.BackendSketch.from_payload(payload)
     assert bs.score_chain(tds, "text")[0] == 1
@@ -216,7 +216,7 @@ def test_engine_exports_resident_chain(sketch_server):
     assert payload["enabled"] and payload["page_tokens"] == PAGE
     bs = ps.BackendSketch.from_payload(payload)
     digs = ps.chain_digests(warm, PAGE, 2)
-    dev, host = bs.score_chain(digs, "token")
+    dev, host, _disk = bs.score_chain(digs, "token")
     assert dev + host == 2, "the warm prompt's pages are resident somewhere"
     # Version metadata is stable while membership is.
     again = _get(srv.port, "/v1/cache/sketch")
@@ -251,5 +251,5 @@ def test_server_links_text_prompts(sketch_server):
     chars = payload["text_chars"]
     tds = list(ps.iter_text_digests(text, chars))
     assert tds, "test text shorter than a text block"
-    dev, host = bs.score_chain(tds, "text")
+    dev, host, _disk = bs.score_chain(tds, "text")
     assert dev + host >= 1, "text-domain membership never surfaced"

@@ -275,7 +275,7 @@ def test_poller_drops_restarted_backend_epoch(monkeypatch):
         r.sketches.poll_once()
         bs = r.sketches.get(be.addr)
         assert bs.epoch == "boot2.0"
-        assert bs.score_chain(CHAIN, "token") == (0, 0)
+        assert bs.score_chain(CHAIN, "token") == (0, 0, 0)
         assert r.metrics.sketch_epoch_drops_total.get(backend=be.addr) == 1
         # An unreachable poll keeps the last copy (staleness retires it).
         be.stop()
