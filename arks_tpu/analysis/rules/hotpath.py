@@ -19,7 +19,12 @@ contract):
 - ``_disk_write_loop`` / ``_fetch_loop`` — the tier-2 spill writer and
   prefix-fetch worker THREADS (reached via their Thread-target
   registration): file and peer-HTTP IO is their whole job, so the
-  issue-side purity contract stops at the thread hand-off queue.
+  issue-side purity contract stops at the thread hand-off queue;
+- ``_residency_step`` — the windowed-residency forward: engagement
+  spills, span-chained attends, and the sampler tail resolve
+  synchronously by contract (a windowed slot's context does not fit the
+  device, so its step IS a host-sync round trip).  Its prefetch issue
+  helpers re-enter the checked set as explicit ROOTS instead.
 
 (``_switch_to`` is deliberately NOT a boundary even though its stall is
 sanctioned — it runs only after ``_drained_for_switch()`` — because its
@@ -92,11 +97,27 @@ ROOTS = (
     (ENGINE, ENGINE_CLASS, "_issue_fetch"),
     (ENGINE, ENGINE_CLASS, "block_for_export"),
     ("arks_tpu/engine/prefix_cache.py", "DiskPrefixTier", "match_digests"),
+    # Windowed residency (contexts larger than the device pool): the
+    # prefetch ISSUE helpers — staging-half H2D scatter and span-table
+    # assembly — run between attend dispatches inside the residency
+    # forward; if they ever block on the device, the span-(i+1) prefetch
+    # stops overlapping the attend of span i that hides it.  The forward
+    # itself resolves logits synchronously by contract, so
+    # _residency_step is a sanctioned sync tail (BOUNDARY_RE below),
+    # like the _resolve_* family.
+    ("arks_tpu/engine/residency.py", "ResidencyManager", "_ensure_staged"),
+    ("arks_tpu/engine/residency.py", "ResidencyManager", "_span_tables"),
+    # Depth-0 sampler fusion: the fused step's issue half dispatches the
+    # whole token step (forward + sample) in one call and must stay free
+    # of blocking fetches — the host sync belongs to its
+    # _pipe_resolve_one tail alone.
+    (ENGINE, ENGINE_CLASS, "_step_fused"),
 )
 
 BOUNDARY_RE = re.compile(
     r"^(_resolve_|_pipe_resolve_)"
-    r"|^(_finish_resume|_warm_autotune|_disk_write_loop|_fetch_loop)$")
+    r"|^(_finish_resume|_warm_autotune|_disk_write_loop|_fetch_loop"
+    r"|_residency_step)$")
 
 # The sanctioned host-sync tails the boundary regex exists FOR: if these
 # disappear wholesale the guard is checking a fiction.
@@ -105,6 +126,7 @@ EXPECTED_TAILS = (
     "_pipe_resolve_one", "_resolve_admit_batch", "_resolve_spills",
     "_resolve_restores", "_resolve_preempt_swaps", "_finish_resume",
     "_resolve_fetches", "_disk_write_loop", "_fetch_loop",
+    "_residency_step",
 )
 
 SERIAL_CALLS = {"json.dumps", "json.loads", "pickle.dumps",

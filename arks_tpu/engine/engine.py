@@ -613,6 +613,34 @@ class EngineMetrics:
             "mixed_grid_steps_ideal_total",
             "Per-sequence causal minimum page-compute steps for the same "
             "mixed dispatches")
+        # KV bytes-moved pair (engine/paged.mixed_kv_bytes): bytes_total
+        # mirrors the ragged kernel's actual DMA schedule (every q-block
+        # re-streams its causal page prefix at the PLAN's block_q — the
+        # GQA head-grouped autotune entries earn their keep by raising
+        # block_q, which this counter shows directly); ideal_total counts
+        # each distinct causal page once per dispatch.  The ratio is the
+        # KV streaming waste factor (docs/monitoring.md alert).
+        self.mixed_kv_bytes_total = r.counter(
+            "mixed_kv_bytes_total",
+            "KV bytes streamed from HBM by mixed dispatches (plan mirror)")
+        self.mixed_kv_bytes_ideal_total = r.counter(
+            "mixed_kv_bytes_ideal_total",
+            "KV bytes a perfect once-per-page schedule would stream for "
+            "the same mixed dispatches")
+        # Windowed-residency decode (ARKS_RESIDENCY_WINDOW_PAGES): spans
+        # attended and cold pages prefetched for contexts larger than the
+        # device page pool.
+        self.residency_spans_total = r.counter(
+            "residency_spans_total",
+            "Windowed-residency attention spans attended")
+        self.residency_prefetch_pages_total = r.counter(
+            "residency_prefetch_pages_total",
+            "Cold KV pages restored into staging by residency prefetch")
+        self.sampler_fused_dispatch_total = r.counter(
+            "sampler_fused_dispatch_total",
+            "Steady-state decode dispatches issued through the fused "
+            "attention+sampler program (ARKS_SAMPLER_FUSE) with zero "
+            "host-side prep arrays")
         # Scheduler phase breakdown (seconds of engine-thread wall time):
         # where a serving cycle actually goes — the counters bench_serving
         # scrapes to attribute throughput loss (admit vs chunk vs decode).
@@ -876,6 +904,10 @@ class InferenceEngine:
             raise ValueError(
                 f"ARKS_PIPELINE_DEPTH={pipe_depth}: must be >= 0")
         self._pipe_depth = pipe_depth
+        # Depth-0 sampler fusion (ARKS_SAMPLER_FUSE): steady-state decode
+        # issues the fused attention+sampler pipe program with immediate
+        # resolve instead of the classic host-prepped mixed batch.
+        self._sampler_fuse = knobs.get_str("ARKS_SAMPLER_FUSE") != "0"
 
         # ---- SLO tiers + preemptive KV swap (ARKS_PREEMPT) -------------
         # Tier ladder (metric labels + admission semantics; arks_tpu.slo)
@@ -1137,6 +1169,8 @@ class InferenceEngine:
 
         # ---- KV layout: paged pool or slot-contiguous cache ------------
         self._paged = self._resolve_kv_layout()
+        self._residency_window = 0
+        self._residency = None
         self._alloc = None
         self._tables = None
         self._slot_pages: dict[int, list[int]] = {}
@@ -1163,7 +1197,26 @@ class InferenceEngine:
                 # The byte budget is tuned for 7B-class pools; cap by
                 # proportion so tiny test models don't allocate huge pools.
                 extra = min(extra, engine_cfg.num_slots * max_pages * 4)
-            num_pages = engine_cfg.num_slots * max_pages + extra
+            # Windowed residency (ARKS_RESIDENCY_WINDOW_PAGES): bound the
+            # RESIDENT per-slot page budget below the logical table width
+            # — slots whose context outgrows the window engage the
+            # span-streaming decode path (engine/residency.py) instead of
+            # holding their whole KV on device.  The logical tables keep
+            # the full max_cache_len width; only the pool shrinks.
+            window = knobs.get_int("ARKS_RESIDENCY_WINDOW_PAGES")
+            if window < 0:
+                raise ValueError(
+                    f"ARKS_RESIDENCY_WINDOW_PAGES={window}: must be >= 0")
+            per_slot = max_pages
+            if window and window < max_pages:
+                if window < 4:
+                    raise ValueError(
+                        f"ARKS_RESIDENCY_WINDOW_PAGES={window}: the window "
+                        "must cover 2 hot-tail pages + 2 staging halves "
+                        "(>= 4)")
+                per_slot = window
+                self._residency_window = window
+            num_pages = engine_cfg.num_slots * per_slot + extra
             self._page_bytes = page_bytes
             self._cache = tf.init_paged_cache(
                 cfg, num_pages, page, self._cache_dtype(dtype),
@@ -1379,6 +1432,35 @@ class InferenceEngine:
                     f"ARKS_MIXED_CHUNK_TOKENS={budget}: must be >= 1")
             self._mixed_budget = min(budget, engine_cfg.max_cache_len)
 
+        # ---- Windowed residency (ARKS_RESIDENCY_WINDOW_PAGES) ----------
+        # Created only once the mixed scheduler is resolved: the manager's
+        # jitted helpers replicate the mixed program's batch shapes, and
+        # the span chain needs the Pallas ragged kernel (the XLA oracle
+        # attend cannot carry online-softmax state across page spans).
+        if self._residency_window:
+            if not self._mixed:
+                raise ValueError(
+                    "ARKS_RESIDENCY_WINDOW_PAGES requires the mixed "
+                    "scheduler (paged KV + chunked prefill, "
+                    "ARKS_MIXED_STEP!=0)")
+            if self._draft_cfg is not None:
+                raise ValueError(
+                    "ARKS_RESIDENCY_WINDOW_PAGES is incompatible with "
+                    "speculative decoding (spec verify blocks never ride "
+                    "the span-streaming path)")
+            from arks_tpu.ops.attention import default_decode_impl
+            if default_decode_impl() != "pallas":
+                raise ValueError(
+                    "ARKS_RESIDENCY_WINDOW_PAGES requires "
+                    "ARKS_ATTN_IMPL=pallas — the span chain carries "
+                    "online-softmax state through the ragged kernel; the "
+                    "XLA oracle attend is one-shot")
+            from arks_tpu.engine.residency import ResidencyManager
+            self._residency = ResidencyManager(self, self._residency_window)
+            log.info("windowed residency: %d-page window (2x%d staging), "
+                     "%d-page logical tables", self._residency_window,
+                     self._residency.chunk, self._max_pages)
+
         # ---- Pipelined decode (ARKS_PIPELINE_DEPTH) --------------------
         # Steady-state decoding free of blocking host syncs: the decode
         # state (last token / lengths / liveness) lives ON DEVICE and each
@@ -1511,16 +1593,27 @@ class InferenceEngine:
         layer = jnp.asarray(0, jnp.int32)
         interpret = jax.default_backend() != "tpu"
 
-        def bench(block_q: int, dma_depth: int) -> None:
+        def bench(block_q: int, dma_depth: int,
+                  head_group: int = hkv) -> None:
             out = paged_mixed_attention(
                 q, self._cache.k, self._cache.v, tables, pos_j, ql_j,
                 layer, self._cache.k_scale, self._cache.v_scale,
-                block_q=block_q, interpret=interpret, dma_depth=dma_depth)
+                block_q=block_q, interpret=interpret, dma_depth=dma_depth,
+                head_group=head_group)
             np.asarray(out)  # block until the kernel actually ran
 
-        cands = [{"block_q": bq, "dma_depth": dd}
-                 for bq in sorted({min(b, qmax) for b in (8, 16, 32)})
-                 for dd in (2, 4)]
+        # GQA head grouping shrinks per-item VMEM by hkv/head_group, so
+        # grouped candidates may afford proportionally larger q blocks —
+        # the block_q growth is where the bytes-moved win comes from.
+        hgs = sorted({h for h in (1, 2, hkv) if hkv % h == 0})
+        cands = [{"block_q": min(bq * (hkv // hg), qmax), "dma_depth": dd,
+                  "head_group": hg}
+                 for bq in (8, 16, 32)
+                 for dd in (2, 4)
+                 for hg in hgs]
+        # De-dup candidates that clamp to the same statics.
+        cands = [dict(t) for t in
+                 sorted({tuple(sorted(c.items())) for c in cands})]
         autotune.sweep("paged_mixed", sig, cands, bench)
 
     # ------------------------------------------------------------------
@@ -2454,6 +2547,10 @@ class InferenceEngine:
         page = self._page_size()
         rows = rows_per_slot * (ahead + 1)
         for slot in self._slots:
+            if self._residency is not None and slot in self._residency.slots:
+                # Engaged slots own staging + hot-tail pages only; the
+                # residency manager grows their tail itself.
+                continue
             need = pages_needed(int(self._lengths[slot]), rows, page,
                                 self._max_pages)
             row = self._slot_pages[slot]
@@ -2862,6 +2959,13 @@ class InferenceEngine:
                     + list(self._swapped)
                     + [r.request.request_id for r in self._awaiting_restore
                        if isinstance(r, _ResumeState)])
+        if phase == "residency":
+            # The span-streaming step only does work for ENGAGED slots —
+            # co-resident classic-path slots never touch its dispatches.
+            if self._residency is not None:
+                return [self._slots[s].request.request_id
+                        for s in self._residency.slots if s in self._slots]
+            return ()
         rids = [st.request.request_id for st in self._slots.values()]
         if phase == "mixed":
             rids += [cs.request.request_id
@@ -3013,6 +3117,12 @@ class InferenceEngine:
                 self._alloc.on_evict = self._note_evicted
             self._tables[:] = 0
             self._slot_pages.clear()
+            if self._residency is not None:
+                # Windowed slots' host stores reference the pre-reset
+                # stream; their requests token-replay from the top, so the
+                # windowed state drops wholesale (the staging/tail pages
+                # died with the rebuilt allocator).
+                self._residency.slots.clear()
         else:
             self._cache = tf.init_cache(self.cfg, self.ecfg.num_slots,
                                         self.ecfg.max_cache_len,
@@ -3106,6 +3216,24 @@ class InferenceEngine:
             td = time.monotonic()
             self.metrics.scheduler_seconds_total.inc(td - t0, phase="decode")
             t0 = td
+        if self._fuse_ready():
+            # Depth-0 sampler fusion: steady-state pure decode rides the
+            # fused attention+sampler program with an immediate resolve —
+            # one device program per step, no host-side sampler prep.
+            self._step_fused()
+            self.metrics.scheduler_seconds_total.inc(
+                time.monotonic() - t0, phase="mixed")
+            return True
+        if self._residency_active():
+            # Windowed-residency slots: span-by-span decode on the host
+            # loop (cold pages stream through staging while resident
+            # spans attend).  Runs before the classic mixed dispatch so
+            # windowed slots never enter its lanes.
+            worked = self._residency_step() or worked
+            tw = time.monotonic()
+            self.metrics.scheduler_seconds_total.inc(tw - t0,
+                                                     phase="residency")
+            t0 = tw
         if self._awaiting_restore:
             # Host-tier restores whose scatter landed unpark into the
             # chunked-tail path (needs authoritative mirrors — the
@@ -4647,6 +4775,11 @@ class InferenceEngine:
             rid = st.request.request_id
             if rid in self._replaying or rid in self._resuming:
                 continue
+            if self._residency is not None and slot in self._residency.slots:
+                # An engaged slot's KV is split across host store +
+                # staging + tail — the swap harvest has no single page
+                # list to gather.  Windowed slots finish in place.
+                continue
             if now - self._preempt_last.get(rid, -1e9) < self._preempt_cooldown_s:
                 continue
             cands.append((-prio, len(st.generated),
@@ -5850,6 +5983,20 @@ class InferenceEngine:
             raise ContextLengthExceededError(
                 f"prompt has {len(ids)} tokens but the maximum context "
                 f"length is {self.max_prompt_len}")
+        if self._residency_window:
+            # Windowed residency engages on DECODE growth only: the
+            # prompt itself must fit the resident budget (prefill chunks
+            # attend through gather_pages, which needs every causal page
+            # on device).  A window that cannot hold the prompt would
+            # fail deep inside the allocator instead.
+            limit = self._residency_window * self._page_size()
+            if len(ids) > limit:
+                raise ContextLengthExceededError(
+                    f"prompt has {len(ids)} tokens but "
+                    f"ARKS_RESIDENCY_WINDOW_PAGES={self._residency_window} "
+                    f"bounds resident prompts to {limit} tokens (windowed "
+                    "residency streams DECODE-grown context; prompts must "
+                    "fit the window)")
         if len(ids) > self._one_shot_limit():
             return ids, None  # chunked path
         return ids, self._pad_to_bucket(ids)
@@ -6156,7 +6303,35 @@ class InferenceEngine:
         slow compile must not degrade live decoding to the sequential
         path — step() re-queues the request the moment its guide
         publishes, which the admission check below then catches."""
-        if not self._pipe_depth or not self._slots:
+        if not self._pipe_depth:
+            return False
+        return self._steady_ready()
+
+    def _fuse_ready(self) -> bool:
+        """Depth-0 sampler fusion (ARKS_SAMPLER_FUSE): a steady-state
+        pure-decode iteration issues the fused attention+sampler pipe
+        program (count_tokens -> mixed_step -> sample -> liveness, one
+        device program, ZERO host-side prep arrays) and resolves it
+        immediately, instead of packing the classic ~20-array mixed
+        batch.  Shares the pipelined path's readiness gates exactly —
+        anything host-side (prefill chunks, transient first-token
+        override columns, admissions, aborts, oversized stop sets)
+        falls back to the classic _issue_mixed/_resolve_mixed pair, as
+        do speculative engines (their spec-mixed dispatch carries
+        per-slot verify blocks the fused columns don't)."""
+        if self._pipe_depth or not self._sampler_fuse or not self._mixed:
+            return False
+        if self._draft_cfg is not None:
+            return False
+        return self._steady_ready()
+
+    def _steady_ready(self) -> bool:
+        """Shared steady-state gate of the pipelined and fused paths."""
+        if not self._slots:
+            return False
+        if self._residency_active():
+            # Windowed-residency slots decode span-by-span on the host
+            # loop — neither steady-state device program covers them.
             return False
         if self._prefilling or self._pending_admits:
             return False
@@ -6203,6 +6378,72 @@ class InferenceEngine:
             return False
         return True
 
+    # ------------------------------------------------------------------
+    # Windowed residency (ARKS_RESIDENCY_WINDOW_PAGES)
+    # ------------------------------------------------------------------
+
+    def _residency_active(self) -> bool:
+        """True when a slot decodes (or is about to decode) through the
+        windowed-residency path.  The margin term drains the pipelined
+        path a few tokens BEFORE a slot's page need crosses the window,
+        so pipelined grow calls can never allocate past the resident
+        budget while dispatches are still in flight."""
+        r = self._residency
+        if r is None:
+            return False
+        if r.slots:
+            return True
+        if not self._slots:
+            return False
+        from arks_tpu.engine.paged import pages_needed
+        page = self._page_size()
+        margin = 1 + max(self._pipe_depth, 1)
+        return any(
+            pages_needed(int(self._lengths[s]), margin, page,
+                         self._max_pages) > r.window
+            for s in self._slots)
+
+    @_scoped("residency")
+    def _residency_step(self) -> bool:
+        """Advance every engaged slot one token: the manager runs the
+        span-streaming forward (cold pages rotate through staging while
+        resident spans attend), the engine runs the mixed program's
+        sampler tail on the returned logits and fans the token out
+        through the shared per-slot resolve path."""
+        r = self._residency
+        r.engage_pending()
+        if not r.slots:
+            return False
+        self._faults.fire("residency")
+        worked = False
+        for slot in list(r.slots):
+            st = self._slots.get(slot)
+            if st is None:
+                r.release(slot)
+                continue
+            t0 = time.monotonic()
+            want_lp = st.request.params.logprobs is not None
+            logits = r.forward(slot)
+            feed_tokens = np.zeros((self.ecfg.num_slots,), np.int32)
+            feed_active = np.zeros((self.ecfg.num_slots,), bool)
+            feed_tokens[slot] = self._last_token[slot]
+            feed_active[slot] = True
+            args = (self._sampling, logits, jnp.asarray(feed_tokens),
+                    jnp.asarray(feed_active),
+                    jnp.asarray(np.array(self._lengths)), self._guide_dev)
+            if want_lp:
+                ids, clp, vals, lids, self._sampling = r.sample_lp_fn(*args)
+                lp_rows = ([np.asarray(clp)[slot]], [np.asarray(vals)[slot]],
+                           [np.asarray(lids)[slot]])
+            else:
+                ids, self._sampling = r.sample_fn(*args)
+                lp_rows = None
+            tok = int(np.asarray(ids)[slot])
+            self._fanout_decode_tokens(slot, [tok], lp_rows,
+                                       max(time.monotonic() - t0, 1e-6))
+            worked = True
+        return worked
+
     def _pipe_signature(self):
         """Specimen arguments for AOT-lowering the pipe programs: the
         exact avals+shardings a fresh `_pipe_issue` produces.  Built on
@@ -6240,8 +6481,13 @@ class InferenceEngine:
 
     def _pipe_kick_warmup(self) -> None:
         """Start the one-shot background compile of both pipe-program
-        variants (with/without logprobs).  Idempotent; engine-thread."""
-        if self._pipe_warm_state is not None or not self._pipe_depth:
+        variants (with/without logprobs).  Idempotent; engine-thread.
+        Depth-0 engines warm them too when sampler fusion is on — the
+        fused path dispatches the same programs."""
+        fuse = (self._sampler_fuse and self._mixed
+                and self._draft_cfg is None)
+        if self._pipe_warm_state is not None or not (self._pipe_depth
+                                                     or fuse):
             return
         self._pipe_warm_state = "compiling"
         sig = self._pipe_signature()
@@ -6307,6 +6553,25 @@ class InferenceEngine:
         if self._spills:
             # Harvest landed spill gathers (steady-state evictions come
             # from _pipe_issue's page growth); ready-only, never blocks.
+            self._resolve_spills()
+
+    @_scoped("mixed")
+    def _step_fused(self) -> None:
+        """One depth-0 fused iteration (ARKS_SAMPLER_FUSE): issue the
+        attention+sampler pipe program FRESH from the host mirrors and
+        resolve it immediately.  The host stays authoritative — the
+        threaded device state is dropped after every resolve, so the
+        fused path is the classic sequential loop with the host-side
+        sampler prep folded into the dispatch, not a hidden pipeline."""
+        self._pipe_issue()
+        if self._pipe_inflight:
+            self.metrics.sampler_fused_dispatch_total.inc()
+            self._pipe_resolve_one()
+        self._pipe_state = None
+        self._pipe_cols = None
+        self._pipe_cols_np = None
+        self._pipe_last_resolve = None
+        if self._spills:
             self._resolve_spills()
 
     @staticmethod
@@ -6803,7 +7068,7 @@ class InferenceEngine:
                 d=tf.cache_head_dim(self.cfg, self._pad_head()),
                 page=self._page_size(), kv=kv)
             self._grid_plans[qmax] = plan
-        from arks_tpu.engine.paged import mixed_grid_steps
+        from arks_tpu.engine.paged import mixed_grid_steps, mixed_kv_bytes
         ideal, dense = mixed_grid_steps(
             pos_start, q_len, page=self._page_size(),
             block_q=plan["block_q"], num_qb=plan["num_qb"],
@@ -6811,6 +7076,24 @@ class InferenceEngine:
         actual = ideal if plan["grid"] == "ragged" else dense
         self.metrics.mixed_grid_steps_total.inc(actual)
         self.metrics.mixed_grid_steps_ideal_total.inc(ideal)
+        b_actual, b_ideal = mixed_kv_bytes(
+            pos_start, q_len, page=self._page_size(),
+            block_q=plan["block_q"], num_qb=plan["num_qb"],
+            max_pages=self._max_pages, hkv=self.cfg.num_kv_heads,
+            page_head_bytes=self._page_head_bytes())
+        self.metrics.mixed_kv_bytes_total.inc(b_actual)
+        self.metrics.mixed_kv_bytes_ideal_total.inc(b_ideal)
+
+    def _page_head_bytes(self) -> int:
+        """Bytes one (page, KV head) block moves over the mixed kernel's
+        page stream: K + V rows (int4 pools store packed nibble rows, so
+        the row count already reflects the halving) plus the f32 scale
+        rows for quantized pools."""
+        k = self._cache.k
+        per = 2 * k.shape[3] * k.shape[4] * k.dtype.itemsize
+        if self._cache.k_scale is not None:
+            per += 2 * self._cache.k_scale.shape[3] * 4
+        return per
 
     @_scoped("mixed")
     def _issue_mixed(self):
@@ -6829,10 +7112,18 @@ class InferenceEngine:
         self._grow_slot_pages(1)
         self._faults.fire("decode")
         num_slots = self.ecfg.num_slots
+        dec_slots = list(self._slots.keys())
+        if self._residency is not None:
+            # Engaged slots decode through _residency_step — their lanes
+            # must never enter the classic dispatch (its attend expects
+            # the whole causal prefix resident).
+            dec_slots = [s for s in dec_slots
+                         if s not in self._residency.slots]
+            if not dec_slots and not self._prefilling:
+                return None
         a = self._mixed_batch_arrays(num_slots + self._mixed_budget)
 
         t = 0
-        dec_slots = list(self._slots.keys())
         for slot in dec_slots:
             a["tokens"][t] = self._last_token[slot]
             a["token_slot"][t] = slot
@@ -7145,6 +7436,10 @@ class InferenceEngine:
         live on for future hits."""
         if not self._paged:
             return
+        if self._residency is not None:
+            # Engaged slots: slot_pages already lists staging + hot tail
+            # (the decref below returns them); the host store just drops.
+            self._residency.release(slot)
         pages = self._slot_pages.pop(slot, [])
         if pages:
             self._alloc.decref(pages)

@@ -25,7 +25,9 @@ vocabulary for it:
   culprit is the preempted/resuming request only), ``disk_spill``
   (tier-2 disk spill issue — serves no request, so nobody's retry
   budget burns), ``peer_fetch`` (disk/peer prefix-block fetch resolve —
-  culprit is the fetching request only).
+  culprit is the fetching request only), ``residency`` (windowed-
+  residency span step: engage/spill/prefetch/forward — culprits are
+  the window-engaged requests only).
   Kinds: ``runtime``, ``value``, ``oom`` (RESOURCE_EXHAUSTED-shaped
   RuntimeError), ``hang`` (sleeps ``ARKS_FAULT_HANG_S``, default 3600 —
   the watchdog-escalation fixture).

@@ -1,0 +1,431 @@
+"""Windowed-residency decode: contexts larger than the device page pool.
+
+A slot whose logical context outgrows ``ARKS_RESIDENCY_WINDOW_PAGES``
+*engages*: all but its two newest KV pages spill to a host-RAM store
+(pool-native bytes, the same gather/scatter pair the prefix host tier
+uses), and from then on the slot decodes span-by-span on a host loop —
+the engine's resident budget per slot is the window, while the slot's
+LOGICAL block table keeps its full ``max_cache_len`` width.
+
+Per decode token, per layer:
+
+- the new token's q/k/v come from the SAME ``_block_qkv`` the mixed
+  program runs, and its KV row lands on the resident hot-tail page via
+  the same ``paged_kv_update(_quant)`` kernel;
+- attention walks the causal page prefix in SPANS: cold spans stream
+  through a rotating two-half staging area (scatter the next span's
+  host blocks H2D while the current span attends — the prefetch
+  overlap), the final span reads the resident tail in place;
+- the ragged mixed kernel chains its online-softmax (m, l, acc) state
+  across spans (``carry_state``/``emit_state``), which reproduces the
+  single-call result BITWISE — so an engaged slot's token stream is
+  byte-identical to the same request on a pool big enough to never
+  engage.
+
+Residency requires the Pallas ragged path (``ARKS_ATTN_IMPL=pallas``):
+the XLA oracle attend is a one-shot softmax and cannot carry state
+across spans.  Layer sequencing is fundamental — layer l+1's q/k/v
+need layer l's full attention output — so the span loop nests inside a
+host layer loop; per-layer params come from ``jax.tree.map(x[l])``,
+which slices the SAME stacked arrays ``lax.scan`` feeds the fused
+program (bitwise-identical weights).
+
+Engine-thread only, like the rest of the scheduler state.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from arks_tpu.engine import sampler as sampler_mod
+from arks_tpu.models import transformer as tf
+
+log = logging.getLogger("arks_tpu.residency")
+
+__all__ = ["ResidencyManager"]
+
+
+class _WindowedSlot:
+    """Host bookkeeping for one engaged slot.
+
+    ``store`` maps logical page index -> pool-native block tuple
+    (k, v, k_scale, v_scale; scales None when unquantized), each array
+    ``[L, 1, Hkv, P(, D)]`` — raw pool bytes, so a staging scatter
+    reproduces the original device pages bit-exactly.  ``cold`` pages
+    [0, cold) live ONLY in the store; ``tail`` holds the two resident
+    hot pages (device ids, logical order) the decode writes into;
+    ``staging`` holds the two half-buffers (chunk device pages each)
+    cold spans rotate through, and ``staged`` remembers which span a
+    half currently holds so unchanged spans skip the re-scatter."""
+
+    __slots__ = ("cold", "tail", "staging", "staged", "store")
+
+    def __init__(self) -> None:
+        self.cold = 0
+        self.tail: list[int] = []
+        self.staging: list[list[int]] = [[], []]
+        self.staged: list[tuple | None] = [None, None]
+        self.store: dict[int, tuple] = {}
+
+
+class ResidencyManager:
+    """Span-by-span decode for slots whose context exceeds the window.
+
+    Holds the per-slot windowed state plus the jitted per-layer helper
+    programs.  Every helper replicates the corresponding piece of the
+    engine's mixed program on the SAME batch shapes (flat token width
+    ``num_slots + mixed_budget``, per-lane qmax ``mixed_budget + 1``),
+    so an engaged slot's logits row is computed by the same ops on the
+    same values as an un-windowed engine's — only the attention call is
+    substituted, and the span chain is bitwise-equal to the single
+    call."""
+
+    def __init__(self, eng, window: int) -> None:
+        if window < 4:
+            raise ValueError(
+                f"ARKS_RESIDENCY_WINDOW_PAGES={window}: the window must "
+                "cover 2 hot-tail pages + 2 staging halves (>= 4)")
+        self.eng = eng
+        self.window = int(window)
+        # Staging half width: two halves + two tail pages fit the window.
+        self.chunk = max(1, (self.window - 2) // 2)
+        self.slots: dict[int, _WindowedSlot] = {}
+        self._interpret = jax.default_backend() != "tpu"
+
+        cfg = eng.cfg
+        mesh = eng.mesh
+        num_slots = eng.ecfg.num_slots
+        quantized = eng._cache.quantized
+        t_flat = num_slots + eng._mixed_budget
+        qmax = eng._mixed_budget + 1
+        b_lanes = num_slots
+        self._t_flat = t_flat
+        self._qmax = qmax
+        interpret = self._interpret
+
+        def _embed(params, tokens):
+            return tf.embed_lookup(params["embed"], tokens[None],
+                                   params["layers"]["attn_norm"].dtype)
+
+        def _head(lp, h, rope_pos, kc, vc, ksc, vsc, tables_tok, write_idx,
+                  seq_q_start, layer):
+            # Mirrors mixed_step's _block_qkv + the pallas branch of
+            # paged_mixed_update_and_attend up to (but not including)
+            # the attend: write the new KV rows through the table and
+            # return the per-lane query blocks the span calls consume.
+            from arks_tpu.ops.attention import _pad_last
+            from arks_tpu.ops.paged_attention import (paged_kv_update,
+                                                      paged_kv_update_quant)
+            q, k, v = tf._block_qkv(h, lp, cfg, rope_pos)
+            q, kn, vn = q[0], k[0], v[0]
+            d = kc.shape[-1]
+            d_model = q.shape[-1]
+            if d != d_model:
+                q = _pad_last(q, d) * ((d / d_model) ** 0.5)
+                kn = _pad_last(kn, d)
+                vn = _pad_last(vn, d)
+            if quantized:
+                kc, vc, ksc, vsc = paged_kv_update_quant(
+                    kc, vc, ksc, vsc, kn, vn, write_idx, tables_tok, layer,
+                    interpret=interpret)
+            else:
+                kc, vc = paged_kv_update(kc, vc, kn, vn, write_idx,
+                                         tables_tok, layer,
+                                         interpret=interpret)
+            hkv = cfg.num_kv_heads
+            g = cfg.num_heads // hkv
+            qg = q.reshape(t_flat, hkv, g, d)
+            span = seq_q_start[:, None] + jnp.arange(qmax, dtype=jnp.int32)
+            gather_idx = jnp.minimum(span, t_flat - 1)
+            qs = jnp.take(qg, gather_idx.reshape(-1), axis=0).reshape(
+                b_lanes, qmax, hkv, g, d)
+            qs = jnp.transpose(qs, (0, 2, 3, 1, 4))
+            return qs, kc, vc, ksc, vsc
+
+        def _tail(h, out_seq, lp, seq_q_start, seq_q_len):
+            # The scatter-back + block tail of the mixed layer body.
+            hkv = cfg.num_kv_heads
+            g = cfg.num_heads // hkv
+            d = out_seq.shape[-1]
+            rows = jnp.transpose(out_seq, (0, 3, 1, 2, 4)).reshape(
+                b_lanes * qmax, hkv, g, d)
+            span = seq_q_start[:, None] + jnp.arange(qmax, dtype=jnp.int32)
+            q_valid = (jnp.arange(qmax, dtype=jnp.int32)[None]
+                       < seq_q_len[:, None])
+            scatter_idx = jnp.where(q_valid, span, t_flat)
+            out = jnp.zeros((t_flat, hkv, g, d), out_seq.dtype).at[
+                scatter_idx.reshape(-1)].set(rows)
+            attn = out.reshape(t_flat, cfg.num_heads, d)[..., :cfg.head_dim]
+            attn = attn.reshape(1, t_flat, cfg.q_dim)
+            attn = tf._constrain(attn, mesh, None, None, tf.AXIS_MODEL)
+            return tf._block_tail(h, attn, lp, cfg, mesh, None)
+
+        def _logits(params, h, sample_src):
+            h_sel = jnp.take(h[0], sample_src.astype(jnp.int32), axis=0)
+            return tf._unembed(h_sel, params, cfg, mesh, None)
+
+        def _sample(sampling, logits, feed_tokens, feed_active, lengths,
+                    gtables, want_lp: bool):
+            # The mixed program's sampler tail for a plain decode lane
+            # (no transient override columns — a jnp.where with an
+            # all-False mask is the identity, so skipping the columns is
+            # bitwise-equal to the fused program's path).
+            sampling = sampler_mod.count_tokens(sampling, feed_tokens,
+                                                feed_active)
+            ids, eff2 = sampler_mod.sample(logits, sampling, feed_active,
+                                           lengths, guide_tables=gtables)
+            sampling = sampling._replace(
+                key=jnp.where(feed_active[:, None], eff2.key, sampling.key),
+                guide_row=jnp.where(feed_active, eff2.guide_row,
+                                    sampling.guide_row))
+            if want_lp:
+                clp, vals, lids = sampler_mod.top_logprobs(logits, ids)
+                return ids, clp, vals, lids, sampling
+            return ids, sampling
+
+        self._embed_fn = jax.jit(_embed)
+        self._head_fn = jax.jit(_head)
+        self._tail_fn = jax.jit(_tail)
+        self._logits_fn = jax.jit(_logits)
+        self.sample_fn = jax.jit(functools.partial(_sample, want_lp=False))
+        self.sample_lp_fn = jax.jit(functools.partial(_sample, want_lp=True))
+
+    # -- engagement ----------------------------------------------------
+
+    def engage_pending(self) -> None:
+        """Engage every decoding slot whose NEXT write would outgrow the
+        window.  Deterministic — driven by the host length mirror, never
+        by allocator pressure — so a given request engages at the same
+        token on every run."""
+        from arks_tpu.engine.paged import pages_needed
+        eng = self.eng
+        page = eng._page_size()
+        for slot in list(eng._slots):
+            if slot in self.slots:
+                continue
+            need = pages_needed(int(eng._lengths[slot]), 1, page,
+                                eng._max_pages)
+            if need > self.window:
+                self.engage(slot)
+
+    def engage(self, slot: int) -> None:
+        """Spill the slot's cold page prefix to the host store, keep the
+        two newest pages resident, and carve the staging halves out of
+        the freed budget.  Shared prefix pages spill by COPY — the
+        slot's reference drops but the allocator's index retains them
+        for other slots' hits."""
+        eng = self.eng
+        ws = _WindowedSlot()
+        row = list(eng._slot_pages[slot])
+        cold = max(len(row) - 2, 0)
+        for lo in range(0, cold, self.chunk):
+            grp = row[lo: min(lo + self.chunk, cold)]
+            kb, vb, ksb, vsb = eng._spill_gather_fn(
+                eng._cache, jnp.asarray(grp, jnp.int32))
+            kb, vb = np.asarray(kb), np.asarray(vb)
+            ksb = None if ksb is None else np.asarray(ksb)
+            vsb = None if vsb is None else np.asarray(vsb)
+            for j in range(len(grp)):
+                ws.store[lo + j] = (
+                    kb[:, j: j + 1], vb[:, j: j + 1],
+                    None if ksb is None else ksb[:, j: j + 1],
+                    None if vsb is None else vsb[:, j: j + 1])
+            eng._alloc.decref(grp)
+        ws.cold = cold
+        ws.tail = row[cold:]
+        half_ids = eng._alloc.alloc(2 * self.chunk)
+        eng._spill_flush()
+        ws.staging = [half_ids[: self.chunk], half_ids[self.chunk:]]
+        eng._slot_pages[slot] = list(half_ids) + list(ws.tail)
+        self.slots[slot] = ws
+        eng.trace.evt("", "residency.engage", "I", slot)
+        log.info("residency: slot %d engaged (%d cold pages spilled, "
+                 "window=%d, staging=2x%d)", slot, cold, self.window,
+                 self.chunk)
+
+    def release(self, slot: int) -> None:
+        """Drop the windowed state (device pages are returned by the
+        engine's normal _release_slot_pages — slot_pages already lists
+        staging + tail)."""
+        self.slots.pop(slot, None)
+
+    # -- per-token forward ---------------------------------------------
+
+    def _rotate_tail(self, slot: int, ws: _WindowedSlot,
+                     p_total: int) -> None:
+        """Grow the hot tail to cover logical page ``p_total - 1``:
+        spill the oldest tail page (it is full — two newer pages exist)
+        and allocate a fresh device page for the new logical tail."""
+        eng = self.eng
+        while ws.cold + len(ws.tail) < p_total:
+            victim = ws.tail.pop(0)
+            kb, vb, ksb, vsb = eng._spill_gather_fn(
+                eng._cache, jnp.asarray([victim], jnp.int32))
+            ws.store[ws.cold] = (
+                np.asarray(kb), np.asarray(vb),
+                None if ksb is None else np.asarray(ksb),
+                None if vsb is None else np.asarray(vsb))
+            eng._alloc.decref([victim])
+            ws.cold += 1
+            # Span boundaries shifted: every staged half is stale.
+            ws.staged = [None, None]
+            new = eng._alloc.alloc(1)[0]
+            eng._spill_flush()
+            ws.tail.append(new)
+            eng._tables[slot, ws.cold + len(ws.tail) - 1] = new
+            eng._slot_pages[slot] = (ws.staging[0] + ws.staging[1]
+                                     + list(ws.tail))
+
+    def _ensure_staged(self, ws: _WindowedSlot, i: int, lo: int, hi: int,
+                       kc, vc, ksc, vsc):
+        """Scatter cold span [lo, hi) into staging half ``i % 2`` unless
+        the half already holds it.  Issued async (the device stream
+        orders it before any attend issued after) — calling this for
+        span i+1 right before attending span i is the prefetch
+        overlap."""
+        half = i % 2
+        if ws.staged[half] == (lo, hi):
+            return kc, vc, ksc, vsc
+        eng = self.eng
+        eng.trace.evt("", "residency.prefetch", "B", (lo, hi))
+        n = hi - lo
+        pad = self.chunk - n
+        blocks = [ws.store[j] for j in range(lo, hi)]
+        kb = np.concatenate([b[0] for b in blocks] + [blocks[-1][0]] * pad,
+                            axis=1)
+        vb = np.concatenate([b[1] for b in blocks] + [blocks[-1][1]] * pad,
+                            axis=1)
+        ksb = vsb = None
+        if blocks[0][2] is not None:
+            ksb = np.concatenate(
+                [b[2] for b in blocks] + [blocks[-1][2]] * pad, axis=1)
+            vsb = np.concatenate(
+                [b[3] for b in blocks] + [blocks[-1][3]] * pad, axis=1)
+        pages = np.array(ws.staging[half], np.int32)
+        cache = tf.PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+        cache, _ = eng._restore_fn(cache, jax.device_put(kb),
+                                   jax.device_put(vb),
+                                   None if ksb is None
+                                   else jax.device_put(ksb),
+                                   None if vsb is None
+                                   else jax.device_put(vsb),
+                                   jnp.asarray(pages),
+                                   jnp.asarray(n, jnp.int32))
+        ws.staged[half] = (lo, hi)
+        eng.metrics.residency_prefetch_pages_total.inc(n)
+        eng.trace.evt("", "residency.prefetch", "E", (lo, hi))
+        return cache.k, cache.v, cache.k_scale, cache.v_scale
+
+    def _span_tables(self, slot: int, ws: _WindowedSlot, lo: int, hi: int,
+                     half: int | None) -> jnp.ndarray:
+        """Temp block tables for one span: the slot's row maps logical
+        pages [lo, hi) to the staging half (cold spans) or the resident
+        tail (half None).  Only [page_lo, page_hi) entries are ever
+        read — the rest stay zero."""
+        eng = self.eng
+        tbl = np.zeros_like(eng._tables)
+        if half is None:
+            tbl[slot, lo:hi] = ws.tail[: hi - lo]
+        else:
+            tbl[slot, lo:hi] = ws.staging[half][: hi - lo]
+        return jnp.asarray(tbl)
+
+    def forward(self, slot: int) -> jnp.ndarray:
+        """One decode token for an engaged slot: the mixed program's
+        layer stack on the engine's standard flat batch shape, with the
+        attend replaced by the span chain.  Returns the ``[B, V]``
+        logits (only the slot's row is meaningful); the engine runs the
+        sampler tail and fans the token out."""
+        from arks_tpu.ops.paged_attention import paged_mixed_attention
+        eng = self.eng
+        ws = self.slots[slot]
+        cfg = eng.cfg
+        page = eng._page_size()
+        num_slots = eng.ecfg.num_slots
+        L = int(eng._lengths[slot])
+        p_total = L // page + 1
+        self._rotate_tail(slot, ws, p_total)
+
+        t_flat = self._t_flat
+        sentinel = eng._park_sentinel()
+        tokens = np.zeros((t_flat,), np.int32)
+        token_slot = np.full((t_flat,), -1, np.int32)
+        token_pos = np.full((t_flat,), sentinel, np.int32)
+        tokens[0] = eng._last_token[slot]
+        token_slot[0] = slot
+        token_pos[0] = L
+        sample_src = np.zeros((num_slots,), np.int32)
+        seq_q_start = np.zeros((num_slots,), np.int32)
+        seq_q_len = np.zeros((num_slots,), np.int32)
+        seq_pos_start = np.zeros((num_slots,), np.int32)
+        seq_q_len[slot] = 1
+        seq_pos_start[slot] = L
+
+        cover = eng._max_pages * page
+        token_slot_d = jnp.asarray(token_slot)
+        tables_tok = jnp.take(jnp.asarray(eng._tables),
+                              jnp.maximum(token_slot_d, 0), axis=0)
+        write_idx = jnp.where(token_slot_d < 0, cover,
+                              jnp.asarray(token_pos))
+        rope_pos = jnp.minimum(jnp.asarray(token_pos), cover - 1)[None]
+        pos0 = jnp.asarray(seq_pos_start)
+        qlen = jnp.asarray(seq_q_len)
+        qstart = jnp.asarray(seq_q_start)
+
+        spans = [(lo, min(lo + self.chunk, ws.cold))
+                 for lo in range(0, ws.cold, self.chunk)]
+        h = self._embed_fn(eng.params, jnp.asarray(tokens))
+        cache = eng._cache
+        kc, vc, ksc, vsc = cache.k, cache.v, cache.k_scale, cache.v_scale
+        layers = eng.params["layers"]
+        for l in range(cfg.num_layers):
+            lp = jax.tree.map(lambda x: x[l], layers)
+            lyr = jnp.asarray(l, jnp.int32)
+            qs, kc, vc, ksc, vsc = self._head_fn(
+                lp, h, rope_pos, kc, vc, ksc, vsc, tables_tok, write_idx,
+                qstart, lyr)
+            carry = None
+            for i, (lo, hi) in enumerate(spans):
+                kc, vc, ksc, vsc = self._ensure_staged(ws, i, lo, hi,
+                                                       kc, vc, ksc, vsc)
+                if i + 1 < len(spans):
+                    # Prefetch the NEXT cold span into the other half
+                    # before this span's attend — the H2D scatter
+                    # overlaps the attend on the device stream.
+                    kc, vc, ksc, vsc = self._ensure_staged(
+                        ws, i + 1, *spans[i + 1], kc, vc, ksc, vsc)
+                plo = np.zeros((num_slots,), np.int32)
+                phi = np.zeros((num_slots,), np.int32)
+                plo[slot], phi[slot] = lo, hi
+                eng.trace.evt("", "residency.attend", "B", (lo, hi))
+                carry = paged_mixed_attention(
+                    qs, kc, vc, self._span_tables(slot, ws, lo, hi, i % 2),
+                    pos0, qlen, lyr, k_scale=ksc, v_scale=vsc,
+                    interpret=self._interpret, page_lo=jnp.asarray(plo),
+                    page_hi=jnp.asarray(phi), carry_state=carry,
+                    emit_state=True)
+                eng.trace.evt("", "residency.attend", "E")
+                eng.metrics.residency_spans_total.inc(1)
+            plo = np.zeros((num_slots,), np.int32)
+            phi = np.zeros((num_slots,), np.int32)
+            plo[slot], phi[slot] = ws.cold, p_total
+            eng.trace.evt("", "residency.attend", "B",
+                          (ws.cold, p_total))
+            out = paged_mixed_attention(
+                qs, kc, vc, self._span_tables(slot, ws, ws.cold, p_total,
+                                              None),
+                pos0, qlen, lyr, k_scale=ksc, v_scale=vsc,
+                interpret=self._interpret, page_lo=jnp.asarray(plo),
+                page_hi=jnp.asarray(phi), carry_state=carry,
+                emit_state=False)
+            eng.trace.evt("", "residency.attend", "E")
+            eng.metrics.residency_spans_total.inc(1)
+            h = self._tail_fn(h, out, lp, qstart, qlen)
+        eng._cache = tf.PagedKVCache(k=kc, v=vc, k_scale=ksc, v_scale=vsc)
+        return self._logits_fn(eng.params, h, jnp.asarray(sample_src))

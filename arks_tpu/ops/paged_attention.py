@@ -123,7 +123,8 @@ def mixed_grid_mode() -> str:
 def mixed_grid_plan(qmax: int, *, hkv: int, g: int, d: int, page: int,
                     kv: str, block_q: int | None = None,
                     grid: str | None = None,
-                    dma_depth: int | None = None) -> dict:
+                    dma_depth: int | None = None,
+                    head_group: int | None = None) -> dict:
     """Resolve the mixed kernel's static launch parameters — ONE place, so
     the kernel wrapper, the engine's grid-step counters, and bench.py can
     never disagree on what actually launches.
@@ -133,14 +134,28 @@ def mixed_grid_plan(qmax: int, *, hkv: int, g: int, d: int, page: int,
     back to the min(qmax, 32) heuristic.  Non-divisible qmax is handled by
     PADDING the q axis to the block (qpad), not by shrinking block_q to a
     divisor — the old ``while qmax % block_q: block_q -= 1`` fallback
-    degraded to tiny odd blocks (qmax=33 -> block_q=11)."""
+    degraded to tiny odd blocks (qmax=33 -> block_q=11).
+
+    head_group is the number of KV heads each work item streams (a
+    divisor of hkv; hkv = no grouping, the default).  Grouping shrinks a
+    single item's KV and accumulator VMEM footprint by hkv/head_group,
+    which is what lets a tuned entry raise block_q — fewer q-blocks means
+    each causal page prefix is re-streamed fewer times, which is where
+    the GQA bytes-moved win actually comes from.  Only the ragged grid
+    understands grouping; invalid divisors fall back to hkv rather than
+    raising so stale tune tables can never break a launch."""
     from arks_tpu.ops import autotune
 
     qmax = max(int(qmax), 1)
     tuned: dict = {}
-    if block_q is None or dma_depth is None:
+    if block_q is None or dma_depth is None or head_group is None:
         tuned = autotune.lookup("paged_mixed", autotune.mixed_signature(
             hkv=hkv, g=g, d=d, page=page, qmax=qmax, kv=kv)) or {}
+    if head_group is None:
+        head_group = int(tuned.get("head_group", 0)) or hkv
+    head_group = int(head_group)
+    if head_group <= 0 or hkv % head_group:
+        head_group = hkv
     if block_q is None:
         block_q = int(tuned.get("block_q", 0)) or min(qmax, 32)
     block_q = max(1, min(int(block_q), qmax))
@@ -151,30 +166,47 @@ def mixed_grid_plan(qmax: int, *, hkv: int, g: int, d: int, page: int,
         grid = mixed_grid_mode()
     qpad = -(-qmax // block_q) * block_q
     return dict(block_q=block_q, qpad=qpad, num_qb=qpad // block_q,
-                dma_depth=dma_depth, grid=grid)
+                dma_depth=dma_depth, grid=grid, head_group=head_group)
 
 
 def build_mixed_work_list(pos_start: jnp.ndarray, q_len: jnp.ndarray, *,
                           page: int, block_q: int, num_qb: int,
-                          max_pages: int):
+                          max_pages: int, head_groups: int = 1,
+                          page_lo: jnp.ndarray | None = None,
+                          page_hi: jnp.ndarray | None = None):
     """Scalar-prefetch work list for the ragged mixed grid: one item per
-    REAL (sequence, q_block), compacted to the front of a fixed-length
-    [S*num_qb] list (Pallas grids are static; the page axis is what
-    actually scales with work).  Returns (seq, qb, pages), each int32
-    [S*num_qb]:
+    REAL (sequence, head_group, q_block), compacted to the front of a
+    fixed-length [S*head_groups*num_qb] list (Pallas grids are static; the
+    page axis is what actually scales with work).  Returns
+    (seq, hg, qb, plo, pages), each int32 [S*head_groups*num_qb]:
 
     - real items: pages = ceil(causal kv end / page) clamped to the table
       width — that sequence's OWN page count, not the pool-wide max;
+      plo is the first page the item streams (0 unless span-bounded);
     - padding items (q_len=0 lanes, blocks past a lane's q_len): pages = 0
-      and (seq, qb) aliased to the LAST real item, so their grid step
+      and (seq, hg, qb) aliased to the LAST real item, so their grid step
       re-flushes an already-written output block and computes nothing.
+
+    head_groups replicates every (seq, q_block) item per KV head group so
+    each grid step streams only its hkv/head_groups slice of the pool's
+    head axis.  Item order is seq-major, then head group, then q_block —
+    with head_groups=1 the (seq, qb, pages) columns are bit-for-bit the
+    PR 11 layout (pinned by test_build_mixed_work_list_compaction).
+
+    page_lo / page_hi ([S] int32, optional) bound each sequence's page
+    span to [page_lo[s], min(pages, page_hi[s])) — the windowed-residency
+    hook: a caller attending only the resident window clamps the span
+    here and carries the online-softmax state across spans.
 
     Built from fixed-shape jnp ops only: the device-state pipelined
     dispatches derive q_len on device (zero-host-sync), so the list must
     be traceable — no host round trip."""
     s = q_len.shape[0]
-    seq = jnp.repeat(jnp.arange(s, dtype=jnp.int32), num_qb)
-    qb = jnp.tile(jnp.arange(num_qb, dtype=jnp.int32), s)
+    n = s * head_groups * num_qb
+    seq = jnp.repeat(jnp.arange(s, dtype=jnp.int32), head_groups * num_qb)
+    hg = jnp.tile(jnp.repeat(jnp.arange(head_groups, dtype=jnp.int32),
+                             num_qb), s)
+    qb = jnp.tile(jnp.arange(num_qb, dtype=jnp.int32), s * head_groups)
     qlen_i = q_len.astype(jnp.int32)[seq]
     q_lo = qb * block_q
     active = q_lo < qlen_i
@@ -184,16 +216,27 @@ def build_mixed_work_list(pos_start: jnp.ndarray, q_len: jnp.ndarray, *,
                                                        qlen_i),
         0)
     pages = jnp.minimum(-(-kv_end // page), max_pages)
+    if page_hi is not None:
+        pages = jnp.minimum(pages, page_hi.astype(jnp.int32)[seq])
+    if page_lo is not None:
+        plo = jnp.where(active,
+                        jnp.minimum(page_lo.astype(jnp.int32)[seq], pages),
+                        0)
+    else:
+        plo = jnp.zeros_like(pages)
     order = jnp.argsort(jnp.logical_not(active).astype(jnp.int32),
                         stable=True)
-    seq, qb, pages = seq[order], qb[order], pages[order]
+    seq, hg, qb, plo, pages = (seq[order], hg[order], qb[order],
+                               plo[order], pages[order])
     n_real = jnp.sum(active.astype(jnp.int32))
     last = jnp.maximum(n_real - 1, 0)
-    pad = jnp.arange(s * num_qb, dtype=jnp.int32) >= n_real
+    pad = jnp.arange(n, dtype=jnp.int32) >= n_real
     seq = jnp.where(pad, seq[last], seq)
+    hg = jnp.where(pad, hg[last], hg)
     qb = jnp.where(pad, qb[last], qb)
+    plo = jnp.where(pad, 0, plo)
     pages = jnp.where(pad, 0, pages)
-    return seq, qb, pages
+    return seq, hg, qb, plo, pages
 
 
 # ---------------------------------------------------------------------------
@@ -716,74 +759,126 @@ def _paged_mixed_kernel(layer_ref, tables_ref, pos_start_ref, qlen_ref,
 
 
 def _paged_mixed_ragged_kernel(layer_ref, tables_ref, pos_start_ref,
-                               wl_seq_ref, wl_qb_ref, wl_pages_ref,
+                               wl_seq_ref, wl_hg_ref, wl_qb_ref,
+                               wl_plo_ref, wl_pages_ref,
                                q_ref, kpool, vpool, *rest,
                                page: int, block_q: int, scale: float,
-                               quantized: bool, int4: bool, depth: int):
-    """RAGGED work-list grid: one grid step per (sequence, q_block) work
-    item, the page loop INSIDE the kernel bounded by that item's own
-    causal page count (``wl_pages``).  q_len=0 lanes and q-blocks past a
-    lane's q_len never become items, so grid length tracks real work —
-    a 3-active-of-64-slots batch costs 3 items' pages, not
-    64*num_qb*max_pages masked steps.  Items are compacted to the front
-    of the fixed-length list by :func:`build_mixed_work_list`; padding
-    items carry wl_pages=0 and alias the last real item's output block,
-    so their only cost is re-flushing an already-written block.
+                               quantized: bool, int4: bool, depth: int,
+                               head_group: int, carry: bool,
+                               emit_state: bool):
+    """RAGGED work-list grid: one grid step per (sequence, head_group,
+    q_block) work item, the page loop INSIDE the kernel bounded by that
+    item's own causal page span [wl_plo, wl_pages).  q_len=0 lanes and
+    q-blocks past a lane's q_len never become items, so grid length
+    tracks real work — a 3-active-of-64-slots batch costs 3 items'
+    pages, not 64*num_qb*max_pages masked steps.  Items are compacted to
+    the front of the fixed-length list by :func:`build_mixed_work_list`;
+    padding items carry wl_pages=0 and alias the last real item's output
+    block, so their only cost is re-flushing an already-written block.
+
+    GQA head grouping: each item DMAs only its ``head_group``-head slice
+    of the pool's head axis (wl_hg picks which), so per-item KV and
+    accumulator VMEM shrink by hkv/head_group — the headroom a tuned
+    entry spends on a larger block_q, which is what actually cuts the
+    re-streamed causal-prefix bytes.  head_group == hkv with one group
+    reduces exactly to the ungrouped kernel.
+
+    Carried state: with ``carry`` the online-softmax state (m, l, acc)
+    initializes from BlockSpec'd f32 inputs instead of (-inf, 0, 0); with
+    ``emit_state`` the RAW state is written out instead of the
+    normalized output.  Chaining spans through f32 state is bitwise
+    exact — the per-page update sequence is identical and the final
+    acc/(l+eps) division happens exactly once, on the last span.
 
     DMAs are ``depth``-way multi-buffered (depth=2 reduces exactly to the
     dense kernel's double buffering; the accumulation order is identical
     for any depth, so tuned depths preserve byte identity)."""
+    rest = list(rest)
     if quantized:
-        kspool, vspool, o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, \
-            acc_ref, sem = rest
+        kspool, vspool = rest[:2]
+        rest = rest[2:]
     else:
-        o_ref, kbuf, vbuf, m_ref, l_ref, acc_ref, sem = rest
-        kspool = vspool = ksbuf = vsbuf = None
+        kspool = vspool = None
+    if carry:
+        mi_ref, li_ref, ai_ref = rest[:3]
+        rest = rest[3:]
+    else:
+        mi_ref = li_ref = ai_ref = None
+    if emit_state:
+        mo_ref, lo_ref, ao_ref = rest[:3]
+        o_ref = None
+        rest = rest[3:]
+    else:
+        o_ref = rest[0]
+        mo_ref = lo_ref = ao_ref = None
+        rest = rest[1:]
+    if quantized:
+        kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, acc_ref, sem = rest
+    else:
+        kbuf, vbuf, m_ref, l_ref, acc_ref, sem = rest
+        ksbuf = vsbuf = None
     item = pl.program_id(0)
     lyr = layer_ref[0]
     s_i = wl_seq_ref[item]
+    hg_i = wl_hg_ref[item]
     qb = wl_qb_ref[item]
+    plo = wl_plo_ref[item]
     npages = wl_pages_ref[item]
     pos0 = pos_start_ref[s_i]
     q_lo = qb * block_q
+    h0 = hg_i * head_group
 
     def start_copies(page_i, buf):
         pg = tables_ref[s_i, page_i]
-        pltpu.make_async_copy(kpool.at[lyr, pg], kbuf.at[buf],
-                              sem.at[0, buf]).start()
-        pltpu.make_async_copy(vpool.at[lyr, pg], vbuf.at[buf],
-                              sem.at[1, buf]).start()
+        pltpu.make_async_copy(kpool.at[lyr, pg, pl.ds(h0, head_group)],
+                              kbuf.at[buf], sem.at[0, buf]).start()
+        pltpu.make_async_copy(vpool.at[lyr, pg, pl.ds(h0, head_group)],
+                              vbuf.at[buf], sem.at[1, buf]).start()
         if quantized:
-            pltpu.make_async_copy(kspool.at[lyr, pg], ksbuf.at[buf],
-                                  sem.at[2, buf]).start()
-            pltpu.make_async_copy(vspool.at[lyr, pg], vsbuf.at[buf],
-                                  sem.at[3, buf]).start()
+            pltpu.make_async_copy(kspool.at[lyr, pg,
+                                            pl.ds(h0, head_group)],
+                                  ksbuf.at[buf], sem.at[2, buf]).start()
+            pltpu.make_async_copy(vspool.at[lyr, pg,
+                                            pl.ds(h0, head_group)],
+                                  vsbuf.at[buf], sem.at[3, buf]).start()
 
     def wait_copies(buf):
-        pltpu.make_async_copy(kpool.at[lyr, 0], kbuf.at[buf],
-                              sem.at[0, buf]).wait()
-        pltpu.make_async_copy(vpool.at[lyr, 0], vbuf.at[buf],
-                              sem.at[1, buf]).wait()
+        pltpu.make_async_copy(kpool.at[lyr, 0, pl.ds(0, head_group)],
+                              kbuf.at[buf], sem.at[0, buf]).wait()
+        pltpu.make_async_copy(vpool.at[lyr, 0, pl.ds(0, head_group)],
+                              vbuf.at[buf], sem.at[1, buf]).wait()
         if quantized:
-            pltpu.make_async_copy(kspool.at[lyr, 0], ksbuf.at[buf],
-                                  sem.at[2, buf]).wait()
-            pltpu.make_async_copy(vspool.at[lyr, 0], vsbuf.at[buf],
-                                  sem.at[3, buf]).wait()
+            pltpu.make_async_copy(kspool.at[lyr, 0,
+                                            pl.ds(0, head_group)],
+                                  ksbuf.at[buf], sem.at[2, buf]).wait()
+            pltpu.make_async_copy(vspool.at[lyr, 0,
+                                            pl.ds(0, head_group)],
+                                  vsbuf.at[buf], sem.at[3, buf]).wait()
 
-    # Padding item (npages == 0): compute nothing, write nothing — the
-    # output window still holds the previous (aliased) item's block and
-    # re-flushes it unchanged.
-    @pl.when(npages > 0)
+    # Padding item (npages == 0 <= plo): compute nothing, write nothing —
+    # the output window still holds the previous (aliased) item's block
+    # and re-flushes it unchanged.  A carry call must still run REAL
+    # items whose span is empty (all their pages fell in earlier spans:
+    # plo == npages > 0) — the carried state still has to be passed
+    # through / normalized into the output.
+    run_gate = (npages > 0) if carry else (npages > plo)
+
+    @pl.when(run_gate)
     def _run():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        if carry:
+            m_ref[:] = mi_ref[0].reshape(m_ref.shape)
+            l_ref[:] = li_ref[0].reshape(l_ref.shape)
+            acc_ref[:] = ai_ref[0].reshape(acc_ref.shape)
+        else:
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
         for j in range(depth - 1):
-            @pl.when(j < npages)
+            @pl.when(plo + j < npages)
             def _warm(j=j):
-                start_copies(j, j)
+                start_copies(plo + j, (plo + j) % depth)
 
-        def body(si, carry):
+        def body(si, loop_c):
             nxt = si + depth - 1
 
             @pl.when(nxt < npages)
@@ -796,19 +891,27 @@ def _paged_mixed_ragged_kernel(layer_ref, tables_ref, pos_start_ref,
                                  l_ref, acc_ref, buf, si, pos0, q_lo,
                                  page=page, scale=scale,
                                  quantized=quantized, int4=int4)
-            return carry
+            return loop_c
 
-        jax.lax.fori_loop(0, npages, body, 0)
-        _, hkv, g, bq, d = q_ref.shape
-        out = acc_ref[:] / (l_ref[..., :1] + 1e-9)
-        o_ref[:] = out.reshape(1, hkv, g, bq, d).astype(o_ref.dtype)
+        jax.lax.fori_loop(plo, npages, body, 0)
+        _, hg, g, bq, d = q_ref.shape
+        if emit_state:
+            mo_ref[:] = m_ref[:].reshape(1, hg, g, bq, 128)
+            lo_ref[:] = l_ref[:].reshape(1, hg, g, bq, 128)
+            ao_ref[:] = acc_ref[:].reshape(1, hg, g, bq, d)
+        else:
+            out = acc_ref[:] / (l_ref[..., :1] + 1e-9)
+            o_ref[:] = out.reshape(1, hg, g, bq, d).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_q", "interpret", "grid",
-                                             "dma_depth"))
+                                             "dma_depth", "head_group",
+                                             "emit_state"))
 def _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len, layer,
-                      k_scale, v_scale, *, block_q: int, dma_depth: int,
-                      grid: str, interpret: bool):
+                      k_scale, v_scale, page_lo=None, page_hi=None,
+                      carry_state=None, *, block_q: int, dma_depth: int,
+                      grid: str, interpret: bool, head_group: int,
+                      emit_state: bool):
     """Jitted mixed-attention launch with FULLY RESOLVED statics — the
     public wrapper resolves the plan (env + autotune) per call so flipping
     ARKS_MIXED_GRID / the tune table between calls can never hit a stale
@@ -819,6 +922,14 @@ def _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len, layer,
     page = pool_page_tokens(k_pool, k_scale)
     kv_rows = k_pool.shape[3]            # page//2 byte rows for int4 pools
     max_pages = tables.shape[1]
+    carry = carry_state is not None
+    if grid == "dense" and (head_group != hkv or carry or emit_state
+                            or page_lo is not None or page_hi is not None):
+        raise ValueError(
+            "head grouping / span bounds / carried state need the ragged "
+            "work-list grid (ARKS_MIXED_GRID=ragged); the dense grid is "
+            "the legacy byte-identity reference only")
+    n_hg = hkv // head_group
     qpad = -(-qmax // block_q) * block_q
     num_qb = qpad // block_q
     qp = q if qpad == qmax else jnp.pad(
@@ -831,18 +942,18 @@ def _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len, layer,
 
     def make_scratch(nbuf):
         scratch = [
-            pltpu.VMEM((nbuf, hkv, kv_rows, d), k_pool.dtype),  # kbuf
-            pltpu.VMEM((nbuf, hkv, kv_rows, d), v_pool.dtype),  # vbuf
+            pltpu.VMEM((nbuf, head_group, kv_rows, d), k_pool.dtype),
+            pltpu.VMEM((nbuf, head_group, kv_rows, d), v_pool.dtype),
         ]
         n_sem = 2
         if quantized:
-            scratch += [pltpu.VMEM((nbuf, hkv, page), jnp.float32),
-                        pltpu.VMEM((nbuf, hkv, page), jnp.float32)]
+            scratch += [pltpu.VMEM((nbuf, head_group, page), jnp.float32),
+                        pltpu.VMEM((nbuf, head_group, page), jnp.float32)]
             n_sem = 4
         scratch += [
-            pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # m
-            pltpu.VMEM((hkv, g * block_q, 128), jnp.float32),  # l
-            pltpu.VMEM((hkv, g * block_q, d), jnp.float32),    # acc
+            pltpu.VMEM((head_group, g * block_q, 128), jnp.float32),  # m
+            pltpu.VMEM((head_group, g * block_q, 128), jnp.float32),  # l
+            pltpu.VMEM((head_group, g * block_q, d), jnp.float32),    # acc
             pltpu.SemaphoreType.DMA((n_sem, nbuf)),
         ]
         return scratch
@@ -871,29 +982,58 @@ def _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len, layer,
                                    block_q=block_q, scale=scale,
                                    quantized=quantized, int4=int4)
         dims = ("parallel", "arbitrary", "arbitrary")
+        out_shape = jax.ShapeDtypeStruct(qp.shape, q.dtype)
     else:
-        wl_seq, wl_qb, wl_pages = build_mixed_work_list(
+        wl_seq, wl_hg, wl_qb, wl_plo, wl_pages = build_mixed_work_list(
             pos32, qlen32, page=page, block_q=block_q, num_qb=num_qb,
-            max_pages=max_pages)
+            max_pages=max_pages, head_groups=n_hg, page_lo=page_lo,
+            page_hi=page_hi)
 
-        def q_map(i, layer_p, tables_p, pos_p, seq_p, qb_p, pages_p):
-            del layer_p, tables_p, pos_p, pages_p
-            return (seq_p[i], 0, 0, qb_p[i], 0)
+        def q_map(i, layer_p, tables_p, pos_p, seq_p, hg_p, qb_p, plo_p,
+                  pages_p):
+            del layer_p, tables_p, pos_p, plo_p, pages_p
+            return (seq_p[i], hg_p[i], 0, qb_p[i], 0)
+
+        blk = dict(q=(1, head_group, g, block_q, d),
+                   ml=(1, head_group, g, block_q, 128))
+        carry_inputs, carry_specs = [], []
+        if carry:
+            # Carry arrays are qpad-sized along the q axis — exactly what
+            # a previous emit_state call produced, so spans chain without
+            # re-padding.
+            m0, l0, a0 = carry_state
+            carry_inputs = [m0, l0, a0]
+            carry_specs = [pl.BlockSpec(blk["ml"], q_map),
+                           pl.BlockSpec(blk["ml"], q_map),
+                           pl.BlockSpec(blk["q"], q_map)]
+        if emit_state:
+            out_specs = (pl.BlockSpec(blk["ml"], q_map),
+                         pl.BlockSpec(blk["ml"], q_map),
+                         pl.BlockSpec(blk["q"], q_map))
+            out_shape = (
+                jax.ShapeDtypeStruct((s, hkv, g, qpad, 128), jnp.float32),
+                jax.ShapeDtypeStruct((s, hkv, g, qpad, 128), jnp.float32),
+                jax.ShapeDtypeStruct((s, hkv, g, qpad, d), jnp.float32))
+        else:
+            out_specs = pl.BlockSpec(blk["q"], q_map)
+            out_shape = jax.ShapeDtypeStruct(qp.shape, q.dtype)
 
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=6,  # layer, tables, pos_start, work list x3
-            grid=(s * num_qb,),
-            in_specs=[pl.BlockSpec((1, hkv, g, block_q, d), q_map)]
-            + pool_specs + scale_specs,
-            out_specs=pl.BlockSpec((1, hkv, g, block_q, d), q_map),
+            num_scalar_prefetch=8,  # layer, tables, pos_start, work list x5
+            grid=(s * n_hg * num_qb,),
+            in_specs=[pl.BlockSpec(blk["q"], q_map)]
+            + pool_specs + scale_specs + carry_specs,
+            out_specs=out_specs,
             scratch_shapes=make_scratch(dma_depth),
         )
-        inputs = [layer_arr, tables32, pos32, wl_seq, wl_qb, wl_pages,
-                  qp, k_pool, v_pool] + scale_inputs
+        inputs = [layer_arr, tables32, pos32, wl_seq, wl_hg, wl_qb,
+                  wl_plo, wl_pages, qp, k_pool, v_pool] \
+            + scale_inputs + carry_inputs
         kernel = functools.partial(_paged_mixed_ragged_kernel, page=page,
                                    block_q=block_q, scale=scale,
                                    quantized=quantized, int4=int4,
-                                   depth=dma_depth)
+                                   depth=dma_depth, head_group=head_group,
+                                   carry=carry, emit_state=emit_state)
         # Consecutive items may alias one output block (padding re-flush),
         # so the item axis is "arbitrary", never "parallel".
         dims = ("arbitrary",)
@@ -901,15 +1041,22 @@ def _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len, layer,
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_shape=out_shape,
         compiler_params=_compiler_params(dimension_semantics=dims),
         interpret=interpret,
     )(*inputs)
-    if qpad != qmax:
-        out = out[..., :qmax, :]
     # Rows past q_len[s] are undefined (dense: skipped blocks; ragged:
     # never-visited items) — zero them so both grids return IDENTICAL
     # bytes everywhere, not just on the rows callers keep.
+    if emit_state:
+        m, l, a = out
+        validp = (jnp.arange(qpad, dtype=jnp.int32)[None, :]
+                  < qlen32[:, None])[:, None, None, :, None]
+        return (jnp.where(validp, m, jnp.zeros_like(m)),
+                jnp.where(validp, l, jnp.zeros_like(l)),
+                jnp.where(validp, a, jnp.zeros_like(a)))
+    if qpad != qmax:
+        out = out[..., :qmax, :]
     valid = jnp.arange(qmax, dtype=jnp.int32)[None, :] < qlen32[:, None]
     return jnp.where(valid[:, None, None, :, None], out,
                      jnp.zeros_like(out))
@@ -929,25 +1076,43 @@ def paged_mixed_attention(
     interpret: bool = False,
     grid: str | None = None,        # "ragged" | "dense" | None (env)
     dma_depth: int | None = None,
-) -> jnp.ndarray:
+    head_group: int | None = None,  # KV heads per work item (None = tuned)
+    page_lo: jnp.ndarray | None = None,   # [S] span start (pages)
+    page_hi: jnp.ndarray | None = None,   # [S] span end bound (pages)
+    carry_state: tuple | None = None,     # (m, l, acc) from emit_state
+    emit_state: bool = False,
+):
     """[S, Hkv, G, Q, D] ragged mixed attention: query i of sequence s
     attends its table pages over positions [0, pos_start[s]+i].  Rows past
     q_len[s] are zeroed — the ONE kernel serving decode lanes (q_len=1),
     prefill chunks, and spec verify rows (q_len=K) in a single dispatch.
     The plan (block_q via autotune, grid mode via ARKS_MIXED_GRID, DMA
-    depth) is resolved HERE, outside jit, then passed as statics."""
+    depth, GQA head grouping) is resolved HERE, outside jit, then passed
+    as statics.
+
+    Span-bounded calls (page_lo/page_hi + carry_state/emit_state) chain
+    the online-softmax state across page ranges — the windowed-residency
+    building block.  With emit_state the return is the raw f32
+    (m, l, acc) triple (q axis padded to the plan's qpad) instead of the
+    normalized output; feeding it back as carry_state on the next span
+    and finishing with emit_state=False reproduces the single-call
+    result bitwise."""
     s, hkv, g, qmax, d = q.shape
     quantized = k_scale is not None
     int4 = is_int4_pool(k_pool, k_scale)
     page = pool_page_tokens(k_pool, k_scale)
     kvd = "int4" if int4 else ("int8" if quantized else str(k_pool.dtype))
     plan = mixed_grid_plan(qmax, hkv=hkv, g=g, d=d, page=page, kv=kvd,
-                           block_q=block_q, grid=grid, dma_depth=dma_depth)
+                           block_q=block_q, grid=grid, dma_depth=dma_depth,
+                           head_group=head_group)
     return _paged_mixed_call(q, k_pool, v_pool, tables, pos_start, q_len,
-                             layer, k_scale, v_scale,
+                             layer, k_scale, v_scale, page_lo, page_hi,
+                             carry_state,
                              block_q=plan["block_q"],
                              dma_depth=plan["dma_depth"],
-                             grid=plan["grid"], interpret=interpret)
+                             grid=plan["grid"], interpret=interpret,
+                             head_group=plan["head_group"],
+                             emit_state=emit_state)
 
 
 # ---------------------------------------------------------------------------

@@ -73,6 +73,19 @@ _k("ARKS_PIPELINE_DEPTH", "int", "2",
 _k("ARKS_MIXED_STEP", "enum", "auto",
    "Single mixed prefill+decode dispatch per step: auto = on where "
    "supported.", "engine", ("auto", "0", "1"))
+_k("ARKS_SAMPLER_FUSE", "enum", "1",
+   "Fuse sampler prep into steady-state depth-0 decode dispatches (the "
+   "pipelined program with immediate resolve: zero host-side prep "
+   "arrays between attention and sampling).  Kill switch; gated off "
+   "automatically around prefill, transient overrides, speculative "
+   "drafts and oversized stop sets.", "engine", ("0", "1"))
+_k("ARKS_RESIDENCY_WINDOW_PAGES", "int", "0",
+   "Windowed-residency attention: device-page budget per slot for "
+   "contexts larger than the device pool — cold pages spill to the "
+   "host tier and stream back through a staging window while the "
+   "kernel attends span-by-span with carried softmax state.  0 "
+   "disables (out-of-pool contexts are rejected as before).  Requires "
+   "the Pallas ragged mixed path.", "engine")
 _k("ARKS_MIXED_CHUNK_TOKENS", "int", None,
    "Prefill-token budget of one mixed dispatch (defaults to the chunked-"
    "prefill chunk size; clamped to max_cache_len).", "engine")

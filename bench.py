@@ -191,6 +191,69 @@ def pallas_parity_check(kv_quant: bool) -> float:
     return max(diff, pad_diff)
 
 
+# GQA sweep shape: (hkv, d, page, max_pages, qmax).
+_GQA_SHAPE = (8, 16, 16, 16, 64)
+_GQA_VMEM_BUDGET = 18432  # f32 lanes; hg=1 affords block_q=qmax, hg=8 only 4
+
+
+def _gqa_vmem_block_q(hg: int, g: int) -> int:
+    """Largest q block the modeled VMEM budget affords one (hg-head,
+    g-share) work item: double-buffered KV blocks (2 in flight) + q tile
+    + f32 accumulator.  Grouping divides the whole footprint by
+    hkv/head_group, which is the headroom the tuned plan re-invests in
+    block_q."""
+    hkv, d, page, _, qmax = _GQA_SHAPE
+    comp = (_GQA_VMEM_BUDGET // hg - 4 * page * d) // (2 * g * d)
+    if comp >= qmax:
+        return qmax
+    bq = 1
+    while bq * 2 <= comp:
+        bq *= 2
+    return bq
+
+
+def measure_gqa_bytes_sweep() -> dict:
+    """GQA head-group sweep (g in {1, 4, 8}), plan-only — no kernel
+    launches, so tests can gate on it cheaply.  The head-grouped DMA
+    restructure wins KV bytes THROUGH block_q: grouping shrinks a work
+    item's VMEM footprint by hkv/head_group, the tuned plan re-invests
+    that headroom in a larger q block, and fewer q blocks re-stream each
+    causal page prefix fewer times.  Emits the bytes-moved counter pair
+    (mixed_kv_bytes actual vs fetch-each-block-once ideal) for the
+    ungrouped baseline vs the grouped tuned plan; the g=8 row is the
+    acceptance shape (ratio >= g)."""
+    from arks_tpu.engine.paged import mixed_kv_bytes
+    from arks_tpu.ops import paged_attention as pa
+
+    hkv, d, page, maxp, qmax = _GQA_SHAPE
+    # Decode-heavy lanes: a long causal prefix (the re-stream cost the
+    # grouping exists to cut) plus a short second lane.
+    pos = np.zeros(4, np.int32)
+    ql = np.zeros(4, np.int32)
+    pos[:2] = (maxp * page - qmax, page)
+    ql[:2] = (qmax, 8)
+    phb = page * d * 4 * 2  # f32 K + V bytes per (page, head) block
+    out: dict = {}
+    for g in (1, 4, 8):
+        byt = {}
+        for name, hg in (("base", hkv), ("grouped", 1)):
+            plan = pa.mixed_grid_plan(
+                qmax, hkv=hkv, g=g, d=d, page=page, kv="float32",
+                block_q=_gqa_vmem_block_q(hg, g), grid="ragged",
+                head_group=hg)
+            b_act, b_ideal = mixed_kv_bytes(
+                pos, ql, page=page, block_q=plan["block_q"],
+                num_qb=plan["num_qb"], max_pages=maxp, hkv=hkv,
+                page_head_bytes=phb)
+            byt[name] = b_act
+            out[f"gqa_g{g}_{name}_block_q"] = plan["block_q"]
+            out[f"gqa_g{g}_{name}_kv_bytes"] = b_act
+            out[f"gqa_g{g}_kv_bytes_ideal"] = b_ideal
+        out[f"gqa_g{g}_bytes_ratio"] = round(byt["base"] / byt["grouped"],
+                                             2)
+    return out
+
+
 def measure_kernel_microbench() -> dict:
     """Mixed-kernel microbench rung: dense vs ragged grid x int8 vs int4
     KV x default vs tuned block_q, on a SPARSE batch (3 active lanes of 8)
@@ -273,6 +336,40 @@ def measure_kernel_microbench() -> dict:
                                     num_qb=plan["num_qb"], max_pages=maxp)
     out["grid_steps_ideal"] = ideal
     out["grid_steps_dense"] = dense
+    out.update(measure_gqa_bytes_sweep())
+
+    # Kernel launches on the g=8 acceptance shape: all three schedules
+    # (dense grid, ungrouped ragged, grouped ragged) must agree BITWISE,
+    # and the grouped tuned plan times alongside.
+    hkv8, d8, page8, maxp8, qmax8 = _GQA_SHAPE
+    pos8 = np.zeros(4, np.int32)
+    ql8 = np.zeros(4, np.int32)
+    pos8[:2] = (maxp8 * page8 - qmax8, page8)
+    ql8[:2] = (qmax8, 8)
+    g8 = 8
+    kf8 = jnp.asarray(rng.normal(size=(1, 2 * maxp8, hkv8, page8, d8)),
+                      jnp.float32)
+    vf8 = jnp.asarray(rng.normal(size=kf8.shape), jnp.float32)
+    t8 = jnp.arange(2 * maxp8, dtype=jnp.int32).reshape(2, maxp8)
+    q8 = jnp.asarray(rng.normal(size=(2, hkv8, g8, qmax8, d8)), jnp.float32)
+    p8j, q8j = jnp.asarray(pos8[:2]), jnp.asarray(ql8[:2])
+
+    def launch8(block_q, head_group, grid):
+        r = pa.paged_mixed_attention(
+            q8, kf8, vf8, t8, p8j, q8j, 0, block_q=block_q,
+            interpret=interpret, grid=grid, head_group=head_group)
+        return np.asarray(r)
+
+    base_bq8, tuned_bq8 = _gqa_vmem_block_q(hkv8, g8), _gqa_vmem_block_q(1, g8)
+    o_dense = launch8(base_bq8, hkv8, "dense")
+    o_base = launch8(base_bq8, hkv8, "ragged")
+    o_grp = launch8(tuned_bq8, 1, "ragged")
+    out["gqa_g8_bitwise"] = bool(np.array_equal(o_dense, o_base)
+                                 and np.array_equal(o_base, o_grp))
+    out["gqa_g8_base_ms"] = timeit(
+        lambda: launch8(base_bq8, hkv8, "ragged"))
+    out["gqa_g8_grouped_ms"] = timeit(
+        lambda: launch8(tuned_bq8, 1, "ragged"))
     return out
 
 

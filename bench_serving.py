@@ -1256,6 +1256,176 @@ def run_slo_tiers_bench() -> dict:
     }
 
 
+def run_long_context_bench() -> dict:
+    """``--workload long-context``: the windowed-residency acceptance
+    bench (CPU mechanics; the Pallas mixed path runs in interpret mode).
+    One decode stream grows a context strictly larger than the device
+    page pool; the windowed engine (ARKS_RESIDENCY_WINDOW_PAGES) spills
+    cold pages to pinned host RAM and streams them back span-by-span
+    each forward, issuing the H2D prefetch for span i+1 before the
+    attend of span i is dispatched.  Asserts the rung's acceptance
+    criteria:
+
+    - the final context is strictly larger than the device page pool;
+    - the windowed stream (token ids AND top-logprob floats) is
+      byte-identical to a large-pool control engine at pipeline depth 2;
+    - prefetch overlap is visible in the trace decomposition: residency
+      prefetch spans land ahead of the attend that consumes them.
+
+    Env knobs: ARKS_BENCH_LC_MODEL (default tiny), ARKS_BENCH_LC_WINDOW
+    (resident pages per slot, default 6), ARKS_BENCH_LC_PROMPT (default
+    40), ARKS_BENCH_LC_GEN (default 70), ARKS_BENCH_LC_DEPTH (pipeline
+    depth, default 2)."""
+    import queue as _queue
+
+    from arks_tpu.engine import (EngineConfig, InferenceEngine, Request,
+                                 SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+
+    model = os.environ.get("ARKS_BENCH_LC_MODEL", "tiny")
+    window = int(os.environ.get("ARKS_BENCH_LC_WINDOW", "6"))
+    prompt_len = int(os.environ.get("ARKS_BENCH_LC_PROMPT", "40"))
+    gen = int(os.environ.get("ARKS_BENCH_LC_GEN", "70"))
+    depth = int(os.environ.get("ARKS_BENCH_LC_DEPTH", "2"))
+    cfg = get_config(model)
+    os.environ["ARKS_MIXED_STEP"] = "1"
+    os.environ["ARKS_ATTN_IMPL"] = "pallas"
+    os.environ["ARKS_PIPELINE_DEPTH"] = str(depth)
+    os.environ["ARKS_TRACE"] = "1"
+    os.environ["ARKS_TRACE_RING"] = "65536"
+    os.environ["ARKS_TRACE_SAMPLE"] = "1.0"
+
+    def _mk(win):
+        os.environ["ARKS_RESIDENCY_WINDOW_PAGES"] = str(win)
+        eng = InferenceEngine(cfg, EngineConfig(
+            model=model, num_slots=1, max_cache_len=256,
+            prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+            prefill_chunk=16, kv_layout="paged", prefix_cache_mb=0),
+            ByteTokenizer())
+        if depth:
+            assert eng._pipe_warm_wait(300) == "ready"
+        return eng
+
+    def _run(eng):
+        """Drive one long greedy+logprobs decode; stamp the wall time of
+        every emitted token so tok/s splits at the engagement point."""
+        r = Request("lc",
+                    [(3 + i) % cfg.vocab_size for i in range(prompt_len)],
+                    SamplingParams(max_tokens=gen, temperature=0.0,
+                                   ignore_eos=True, logprobs=2))
+        eng.add_request(r)
+        ids, lps, stamps, fin = [], [], [], None
+        for _ in range(50000):
+            eng.step(block_s=0.01)
+            while True:
+                try:
+                    out = r.outputs.get_nowait()
+                except _queue.Empty:
+                    break
+                now = time.perf_counter()
+                for t in out.token_ids:
+                    ids.append(t)
+                    stamps.append(now)
+                if out.logprobs:
+                    lps.extend(out.logprobs)
+                if out.finished:
+                    fin = out
+            if fin is not None and eng.idle:
+                break
+        assert fin is not None, "long-context stream did not finish"
+        return ids, lps, fin.finish_reason, stamps
+
+    # -- windowed run -----------------------------------------------------
+    eng = _mk(window)
+    page = eng._page_size()
+    pool_pages = eng._alloc.num_pages
+    pool_tokens = pool_pages * page
+    ids, lps, reason, stamps = _run(eng)
+    final_ctx = prompt_len + len(ids)
+    assert final_ctx > pool_tokens, (
+        f"context {final_ctx} never outgrew the pool {pool_tokens} — "
+        f"raise ARKS_BENCH_LC_GEN")
+    spans = int(eng.metrics.residency_spans_total.total())
+    prefetch_pages = int(
+        eng.metrics.residency_prefetch_pages_total.total())
+    assert spans > 0 and prefetch_pages > 0, (spans, prefetch_pages)
+
+    # tok/s before vs after window engagement.  Engagement is
+    # deterministic: the step whose context needs more pages than the
+    # window flips the slot to windowed residency.
+    max_pages = eng._max_pages
+    from arks_tpu.engine.paged import pages_needed
+    split = next((k for k in range(len(ids))
+                  if pages_needed(prompt_len + k + 1, 1, page,
+                                  max_pages) > window), len(ids))
+
+    def _rate(ts):
+        if len(ts) < 2 or ts[-1] <= ts[0]:
+            return None
+        return round((len(ts) - 1) / (ts[-1] - ts[0]), 2)
+
+    # -- trace decomposition ---------------------------------------------
+    # residency.prefetch / residency.attend B/E pairs carry the page span
+    # [lo, hi) as arg.  A prefetch is "issued ahead" when the very next
+    # attend dispatched after it targets a DIFFERENT span — i.e. the
+    # scatter for span i+1 was already on the device stream before the
+    # attend of span i ran, so it never serializes with its consumer.
+    evs = [e for e in eng.trace.tail(65536)
+           if e["name"] in ("residency.prefetch", "residency.attend")]
+    decomp = {"residency.prefetch": [0, 0.0], "residency.attend": [0, 0.0]}
+    open_b: dict = {}
+    ahead = 0
+    pending_prefetch = []  # (arg,) prefetches waiting for their next attend
+    for e in evs:
+        if e["ph"] == "B":
+            open_b[e["name"]] = e
+            if e["name"] == "residency.prefetch":
+                pending_prefetch.append(e["arg"])
+            else:
+                ahead += sum(1 for a in pending_prefetch if a != e["arg"])
+                pending_prefetch.clear()
+        elif e["ph"] == "E" and e["name"] in open_b:
+            b = open_b.pop(e["name"])
+            d = decomp[e["name"]]
+            d[0] += 1
+            d[1] += e["t"] - b["t"]
+    n_pre, t_pre = decomp["residency.prefetch"]
+    n_att, t_att = decomp["residency.attend"]
+    assert n_pre > 0 and n_att > 0, "residency trace events missing"
+    assert ahead > 0, (
+        "no prefetch landed ahead of its consuming attend — the overlap "
+        "schedule regressed")
+
+    # -- large-pool control (same traffic, full-width pool) ---------------
+    ctl = _mk(0)
+    ctl_pool = ctl._alloc.num_pages * ctl._page_size()
+    assert ctl_pool >= final_ctx, "control pool too small to be a control"
+    c_ids, c_lps, c_reason, _ = _run(ctl)
+    assert (ids, lps, reason) == (c_ids, c_lps, c_reason), \
+        "windowed stream diverged from the large-pool control"
+
+    return {
+        "workload": "long-context",
+        "lc_model": model, "lc_window_pages": window,
+        "lc_pipeline_depth": depth,
+        "lc_pool_pages": pool_pages, "lc_pool_tokens": pool_tokens,
+        "lc_final_context_tokens": final_ctx,
+        "lc_finish_reason": reason,
+        "lc_streams_identical": True,
+        "lc_residency_spans_total": spans,
+        "lc_residency_prefetch_pages_total": prefetch_pages,
+        "lc_decode_toks_resident": _rate(stamps[:split]),
+        "lc_decode_toks_windowed": _rate(stamps[split:]),
+        "lc_trace_attend_spans": n_att,
+        "lc_trace_attend_ms_total": round(t_att * 1e3, 2),
+        "lc_trace_prefetch_events": n_pre,
+        "lc_trace_prefetch_ms_total": round(t_pre * 1e3, 2),
+        "lc_trace_prefetch_issued_ahead": ahead,
+        "lc_trace_prefetch_ahead_frac": round(ahead / n_pre, 3),
+    }
+
+
 def run_multi_tenant_bench() -> dict:
     """``--workload multi-tenant``: the tenant-fair admission acceptance
     bench (CPU mechanics).  One aggressor tenant floods the engine with a
@@ -1927,7 +2097,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload",
                     choices=("default", "shared-prefix", "multi-model",
-                             "slo-tiers", "multi-tenant"),
+                             "slo-tiers", "multi-tenant", "long-context"),
                     default="default")
     ap.add_argument("--backends", type=int, default=1,
                     help="shared-prefix only: N>1 runs the multi-backend "
@@ -1970,6 +2140,10 @@ def main() -> None:
     if args.workload == "multi-tenant":
         print(json.dumps({"metric": "multi_tenant_serving",
                           **run_multi_tenant_bench()}))
+        return
+    if args.workload == "long-context":
+        print(json.dumps({"metric": "long_context_serving",
+                          **run_long_context_bench()}))
         return
     print(json.dumps({
         "metric": "serving_throughput",

@@ -686,6 +686,72 @@ def test_fetch_resolve_fault_quarantines_only_the_fetcher(monkeypatch,
     assert eng.state == "serving"
 
 
+def _residency_scenario(monkeypatch, depth, inject=None, retries=None):
+    """Windowed-residency traffic: a long decode stream outgrows the
+    6-page resident window (pool = num_slots * window) and engages the
+    span-streaming path — the injectable "residency" phase — while an
+    innocent seeded stream decodes alongside on the classic mixed path."""
+    monkeypatch.setenv("ARKS_RESIDENCY_WINDOW_PAGES", "6")
+    monkeypatch.setenv("ARKS_ATTN_IMPL", "pallas")
+    cfg, eng = _mk_engine(monkeypatch, depth, "1", inject=inject,
+                          retries=retries, prefill_chunk=16,
+                          kv_layout="paged", prefix_cache_mb=0,
+                          max_cache_len=256)
+    # 40-token prompt + 70 decode tokens = 110 > the 96-token resident
+    # budget: the stream engages mid-decode and finishes windowed.
+    long_r = Request("win", [int(x) % cfg.vocab_size
+                             for x in range(3, 43)],
+                     SamplingParams(max_tokens=70, temperature=0.0,
+                                    ignore_eos=True))
+    bystander = Request("by", [5, 6, 7], SamplingParams(
+        max_tokens=80, temperature=0.9, top_p=0.9, top_k=40, seed=11,
+        ignore_eos=True))
+    eng.add_request(long_r)
+    eng.add_request(bystander)
+    _drive(eng, n_steps=3000)
+    outs = [_collect(long_r), _collect(bystander)]
+    return outs, eng
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [0, 2])
+def test_residency_fault_recovers_all_streams_byte_identical(
+        monkeypatch, depth):
+    """A fault injected at the windowed span step ("residency" phase):
+    within the retry budget the engaged stream token-replays (re-growing
+    back through engagement), the co-resident classic-path stream
+    replays too, and BOTH finish byte-identical to the fault-free run at
+    pipeline depths 0 and 2."""
+    base, beng = _residency_scenario(monkeypatch, depth)
+    assert beng.metrics.residency_spans_total.total() > 0, \
+        "scenario never engaged the windowed path"
+    got, eng = _residency_scenario(monkeypatch, depth,
+                                   inject="residency:1:runtime")
+    assert [f.finish_reason for _, f in got] == ["length", "length"]
+    assert got == base, "streams diverged after the residency fault"
+    assert eng.metrics.engine_faults_total.get(
+        phase="residency", kind="injected") == 1
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+@pytest.mark.slow
+def test_residency_fault_quarantines_only_the_engaged_culprit(monkeypatch):
+    """With a zero retry budget the residency fault fails the ENGAGED
+    stream alone (finish_reason="error"/engine_fault) — the culprit set
+    is the window-engaged slots, never the co-resident classic-path
+    stream, which finishes byte-identical to the fault-free run."""
+    base, _ = _residency_scenario(monkeypatch, 0)
+    got, eng = _residency_scenario(monkeypatch, 0,
+                                   inject="residency:1:runtime", retries=0)
+    (_, w_fin), (by_ids, by_fin) = got
+    assert w_fin.finish_reason == "error"
+    assert w_fin.error.startswith("engine_fault")
+    assert (by_ids, by_fin.finish_reason) == (base[1][0], "length")
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 1
+    assert eng.state == "serving"
+
+
 def test_decode_fault_while_another_request_prefills(monkeypatch):
     """A decode fault with a long prompt mid-chunked-prefill: the decoding
     stream token-replays, the prefilling one re-runs from the top, both

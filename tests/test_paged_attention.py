@@ -361,6 +361,84 @@ def test_ragged_and_dense_grids_byte_identical(kv):
     np.testing.assert_array_equal(np.asarray(ragged), np.asarray(deep))
 
 
+@pytest.mark.parametrize("kv", ["f32", "int8", "int4"])
+@pytest.mark.parametrize("dma_depth", [2, 4])
+def test_gqa_head_grouped_kernel_byte_identical(kv, dma_depth):
+    """GQA head grouping is a pure DMA-schedule change: every head_group
+    divisor of hkv returns BITWISE the ungrouped ragged kernel's output
+    (which is itself pinned bitwise to the dense reference above), for
+    every pool dtype and DMA depth, and stays oracle-close."""
+    if kv == "int4":
+        q, (kp, vp), _, kps, vps, tables = _setup_int4()
+    else:
+        q, kp, vp, kps, vps, tables, _ = _setup(
+            quantized=(kv == "int8"), page=128 if kv == "int8" else 16)
+    b, hkv, g, d = q.shape
+    qmax = 8
+    qm = jax.random.normal(jax.random.PRNGKey(9), (b, hkv, g, qmax, d),
+                           jnp.float32)
+    page = kps.shape[3] if kps is not None else kp.shape[3]
+    pos_start = jnp.asarray([5, page - 2, 0, 3], jnp.int32)
+    q_len = jnp.asarray([1, qmax, 3, 0], jnp.int32)
+    kwargs = dict(k_scale=kps, v_scale=vps, block_q=4, interpret=True,
+                  grid="ragged", dma_depth=dma_depth)
+    base = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                 head_group=hkv, **kwargs)
+    for head_group in (1, 2):
+        if hkv % head_group:
+            continue
+        grouped = paged_mixed_attention(qm, kp, vp, tables, pos_start,
+                                        q_len, 0, head_group=head_group,
+                                        **kwargs)
+        np.testing.assert_array_equal(np.asarray(base),
+                                      np.asarray(grouped))
+    dense = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                  k_scale=kps, v_scale=vps, block_q=4,
+                                  interpret=True, grid="dense")
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(dense))
+    if kv == "f32":
+        ref = _mixed_ref(qm, kp, vp, kps, vps, tables, pos_start, q_len, 0)
+        for s in range(b):
+            for i in range(int(q_len[s])):
+                np.testing.assert_allclose(
+                    np.asarray(base[s, :, :, i], np.float32),
+                    ref[s, :, :, i], atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kv", ["f32", "int8", "int4"])
+def test_span_chained_state_matches_single_call(kv):
+    """Windowed-residency building block: splitting the page loop into
+    [0, split) + [split, end) spans with the f32 (m, l, acc) state carried
+    between calls reproduces the single-call output BITWISE — the online
+    softmax's per-page update sequence is unchanged and the final
+    normalization happens exactly once, on the last span."""
+    if kv == "int4":
+        q, (kp, vp), _, kps, vps, tables = _setup_int4()
+    else:
+        q, kp, vp, kps, vps, tables, _ = _setup(
+            quantized=(kv == "int8"), page=128 if kv == "int8" else 16)
+    b, hkv, g, d = q.shape
+    page = kps.shape[3] if kps is not None else kp.shape[3]
+    # Decode-shaped lanes deep enough to span several pages each.
+    qm = jax.random.normal(jax.random.PRNGKey(12), (b, hkv, g, 1, d),
+                           jnp.float32)
+    pos_start = jnp.asarray([3 * page + 5, 2 * page, page + 1, 3],
+                            jnp.int32)
+    q_len = jnp.ones((b,), jnp.int32)
+    kwargs = dict(k_scale=kps, v_scale=vps, block_q=1, interpret=True,
+                  grid="ragged")
+    whole = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                  **kwargs)
+    split = jnp.full((b,), 2, jnp.int32)
+    state = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len, 0,
+                                  page_hi=split, emit_state=True, **kwargs)
+    assert all(s.dtype == jnp.float32 for s in state)
+    chained = paged_mixed_attention(qm, kp, vp, tables, pos_start, q_len,
+                                    0, page_lo=split, carry_state=state,
+                                    **kwargs)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(chained))
+
+
 def test_mixed_all_lanes_inactive_returns_zeros():
     """q_len = 0 everywhere: the ragged work list is ALL padding (zero real
     page steps) and the output is defined — all zeros."""
@@ -397,12 +475,14 @@ def test_mixed_single_item_work_list():
 def test_build_mixed_work_list_compaction():
     """Real items are compacted to the grid front in (seq, qb) order with
     per-item causal page counts; padding items alias the LAST real item's
-    output block (revisit semantics: no extra flush) with pages=0."""
+    output block (revisit semantics: no extra flush) with pages=0.  The
+    (seq, qb, pages) columns are the PR 11 fixture values — the
+    head-group / page-span refactor must not move them."""
     pos = jnp.asarray([5, 128, 0, 3], jnp.int32)
     qlen = jnp.asarray([1, 5, 3, 0], jnp.int32)
-    seq, qb, pages = build_mixed_work_list(
+    seq, hg, qb, plo, pages = build_mixed_work_list(
         pos, qlen, page=128, block_q=2, num_qb=3, max_pages=3)
-    seq, qb, pages = map(np.asarray, (seq, qb, pages))
+    seq, hg, qb, plo, pages = map(np.asarray, (seq, hg, qb, plo, pages))
     assert seq.shape == (12,)
     # Real: (0,0) 1 page; (1,0/1/2) 2 pages each; (2,0/1) 1 page each.
     np.testing.assert_array_equal(seq[:6], [0, 1, 1, 1, 2, 2])
@@ -412,10 +492,43 @@ def test_build_mixed_work_list_compaction():
     np.testing.assert_array_equal(seq[6:], [2] * 6)
     np.testing.assert_array_equal(qb[6:], [1] * 6)
     np.testing.assert_array_equal(pages[6:], [0] * 6)
+    # Ungrouped, unbounded defaults: hg and plo are identically zero.
+    np.testing.assert_array_equal(hg, np.zeros(12, np.int32))
+    np.testing.assert_array_equal(plo, np.zeros(12, np.int32))
+
+
+def test_build_mixed_work_list_head_groups_and_spans():
+    """head_groups replicates each real (seq, qb) item per KV head group
+    (seq-major, hg, qb order) and page_lo/page_hi clamp each sequence's
+    span — the windowed-residency hook.  Same PR 11 fixture inputs."""
+    pos = jnp.asarray([5, 128, 0, 3], jnp.int32)
+    qlen = jnp.asarray([1, 5, 3, 0], jnp.int32)
+    seq, hg, qb, plo, pages = build_mixed_work_list(
+        pos, qlen, page=128, block_q=2, num_qb=3, max_pages=3,
+        head_groups=2,
+        page_lo=jnp.asarray([0, 1, 0, 0], jnp.int32),
+        page_hi=jnp.asarray([3, 2, 1, 3], jnp.int32))
+    seq, hg, qb, plo, pages = map(np.asarray, (seq, hg, qb, plo, pages))
+    assert seq.shape == (24,)
+    # Each real item appears once per head group, hg-major inside a seq.
+    np.testing.assert_array_equal(seq[:12],
+                                  [0, 0, 1, 1, 1, 1, 1, 1, 2, 2, 2, 2])
+    np.testing.assert_array_equal(hg[:12],
+                                  [0, 1, 0, 0, 0, 1, 1, 1, 0, 0, 1, 1])
+    np.testing.assert_array_equal(qb[:12],
+                                  [0, 0, 0, 1, 2, 0, 1, 2, 0, 1, 0, 1])
+    # seq 1's pages clamp to page_hi=2 (unchanged here) with plo=1; seq
+    # 2's clamp to 1.  plo never exceeds the clamped page count.
+    np.testing.assert_array_equal(pages[:12],
+                                  [1, 1, 2, 2, 2, 2, 2, 2, 1, 1, 1, 1])
+    np.testing.assert_array_equal(plo[:12],
+                                  [0, 0, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0])
+    np.testing.assert_array_equal(pages[12:], np.zeros(12, np.int32))
+    np.testing.assert_array_equal(plo[12:], np.zeros(12, np.int32))
 
 
 def test_build_mixed_work_list_all_inactive():
-    seq, qb, pages = build_mixed_work_list(
+    seq, hg, qb, plo, pages = build_mixed_work_list(
         jnp.zeros((3,), jnp.int32), jnp.zeros((3,), jnp.int32),
         page=16, block_q=4, num_qb=2, max_pages=4)
     np.testing.assert_array_equal(np.asarray(pages), np.zeros(6, np.int32))

@@ -124,6 +124,49 @@ def test_sparse_batch_grid_steps_drop_to_ideal(monkeypatch):
     assert steps < dense / 10, (steps, dense)
 
 
+def test_gqa_bytes_sweep_hits_group_factor(monkeypatch):
+    """The bench GQA sweep's acceptance shape: at g=8 the grouped tuned
+    plan moves >= g fewer KV bytes than the ungrouped baseline (the win
+    arrives through the larger tuned block_q that head grouping's VMEM
+    headroom affords), and the grouped plan reaches the
+    fetch-each-block-once ideal.  Plan-only — no kernel launches; the
+    bitwise identity of the grouped kernel lives in
+    test_paged_attention.py."""
+    monkeypatch.delenv("ARKS_MIXED_GRID", raising=False)
+    import bench
+    r = bench.measure_gqa_bytes_sweep()
+    assert r["gqa_g8_bytes_ratio"] >= 8
+    assert r["gqa_g8_grouped_kv_bytes"] == r["gqa_g8_kv_bytes_ideal"]
+    # The win scales with the GQA share factor.
+    assert (r["gqa_g1_bytes_ratio"] < r["gqa_g4_bytes_ratio"]
+            < r["gqa_g8_bytes_ratio"])
+
+
+def test_kv_bytes_moved_counter_pair(monkeypatch):
+    """Every mixed dispatch accounts the KV bytes its grid plan moves
+    (mixed_kv_bytes_total) against the fetch-each-block-once ideal
+    (mixed_kv_bytes_ideal_total) — the waste ratio the head-grouped DMA
+    restructure is gated on.  Counters describe the PLAN, so the fast
+    XLA oracle drives them; actual >= ideal always, and with the
+    head-group factor covering every kv head in one pass the pair
+    converges for single-page decode dispatches."""
+    cfg, eng = _mk_engine(monkeypatch, grid="ragged", impl="xla",
+                          num_slots=4)
+    for i in range(2):
+        eng.add_request(Request(f"r{i}", [5 + i, 6, 7], SamplingParams(
+            max_tokens=4, temperature=0.0, ignore_eos=True)))
+    _drive(eng)
+    actual = eng.metrics.mixed_kv_bytes_total.total()
+    ideal = eng.metrics.mixed_kv_bytes_ideal_total.total()
+    assert ideal > 0
+    assert actual >= ideal
+    # The tiny model's decode batches fit one q-block, so the ragged
+    # plan fetches each (seq, page) block exactly once: no waste.
+    plan = next(iter(eng._grid_plans.values()))
+    if plan["num_qb"] == 1:
+        assert actual == ideal
+
+
 def test_dense_grid_counts_padding_waste(monkeypatch):
     """Under ARKS_MIXED_GRID=dense the counter pair splits: steps_total
     records the dense grid's full S*num_qb*max_pages while ideal_total

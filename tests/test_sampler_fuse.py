@@ -1,0 +1,121 @@
+"""Depth-0 sampler fusion (ARKS_SAMPLER_FUSE): steady-state pure decode
+issues ONE fused attention+sampler device program per step instead of
+the classic mixed batch (~20 host-prepped arrays) + separate sampler
+dispatch — and the token streams are byte-identical either way.
+
+The fused path reuses the pipelined decode programs in fresh mode with
+the threaded state dropped after every resolve, so the host mirrors
+stay authoritative; anything non-steady (prefill chunks, admissions,
+first-token override columns, aborts) falls back to the classic pair
+mid-run, which is exactly what the mixed-traffic workload below
+exercises.
+"""
+
+import pytest
+
+from arks_tpu.engine import EngineConfig, InferenceEngine, Request, SamplingParams
+from arks_tpu.engine.tokenizer import ByteTokenizer
+from arks_tpu.models import get_config
+
+
+def _mk_engine(monkeypatch, *, fuse, depth=0, **kw):
+    monkeypatch.setenv("ARKS_MIXED_STEP", "1")
+    monkeypatch.setenv("ARKS_SAMPLER_FUSE", "1" if fuse else "0")
+    monkeypatch.setenv("ARKS_PIPELINE_DEPTH", str(depth))
+    cfg = get_config("tiny")
+    defaults = dict(model="tiny", num_slots=2, max_cache_len=64,
+                    prefill_buckets=(8, 16, 32), steps_per_dispatch=4,
+                    prefill_chunk=16, kv_layout="paged", prefix_cache_mb=0)
+    defaults.update(kw)
+    eng = InferenceEngine(cfg, EngineConfig(**defaults), ByteTokenizer())
+    if fuse and not depth and "draft_model" not in kw:
+        # The fused path dispatches the pipe programs; wait for the
+        # background compile so the run actually exercises fusion
+        # instead of racing past it on the classic fallback.
+        assert eng._pipe_warm_wait(300) == "ready"
+    return cfg, eng
+
+
+def _drive(eng, n_steps=2000):
+    for _ in range(n_steps):
+        eng.step(block_s=0.01)
+        if (eng.num_running == 0 and eng._queue.empty()
+                and not eng._prefilling):
+            break
+
+
+def _collect(req):
+    ids, lps, fin = [], [], None
+    while True:
+        out = req.outputs.get(timeout=120)
+        ids.extend(out.token_ids)
+        if out.logprobs:
+            lps.extend(out.logprobs)
+        if out.finished:
+            fin = out
+            break
+    return ids, lps, fin.finish_reason
+
+
+def _run_workload(eng, cfg, guided=False):
+    """Plain greedy (+logprobs) + fixed-seed sampled (+ optionally
+    guided) traffic — chunked and one-shot prompts, more requests than
+    slots, so the run crosses steady state and fallback repeatedly."""
+    reqs = [
+        Request("g0", [5, 6, 7], SamplingParams(
+            max_tokens=12, temperature=0.0, ignore_eos=True, logprobs=2)),
+        Request("s0", [int(x) % cfg.vocab_size for x in range(3, 40)],
+                SamplingParams(max_tokens=12, temperature=0.8, top_p=0.9,
+                               top_k=40, seed=7, ignore_eos=True)),
+        Request("g1", [9] * 20, SamplingParams(
+            max_tokens=12, temperature=0.0, ignore_eos=True)),
+    ]
+    if guided:
+        reqs.append(Request("j0", [4, 8, 2], SamplingParams(
+            max_tokens=8, temperature=0.0, guide=("json", ""))))
+    for r in reqs:
+        eng.add_request(r)
+    _drive(eng)
+    return [_collect(r) for r in reqs]
+
+
+def test_stream_identity_fused_vs_classic(monkeypatch):
+    """Plain + guided + logprob traffic at depth 0: fusion ON emits
+    byte-identical streams (ids, logprob floats, finish reasons) to
+    fusion OFF, and the fused program actually carried decode steps."""
+    outs = {}
+    for fuse in (True, False):
+        cfg, eng = _mk_engine(monkeypatch, fuse=fuse)
+        outs[fuse] = _run_workload(eng, cfg, guided=True)
+        n_fused = eng.metrics.sampler_fused_dispatch_total.total()
+        if fuse:
+            assert n_fused > 0, "fused program never dispatched"
+        else:
+            assert n_fused == 0
+    assert outs[True] == outs[False]
+
+
+def test_fusion_defers_to_the_pipeline_at_depth(monkeypatch):
+    """At ARKS_PIPELINE_DEPTH>0 the pipelined scheduler owns steady
+    state — the fused counter stays at zero and the streams still match
+    the depth-0 fused run (depth invariance)."""
+    cfg, eng = _mk_engine(monkeypatch, fuse=True, depth=2)
+    assert eng._pipe_warm_wait(300) == "ready"
+    piped = _run_workload(eng, cfg)
+    assert eng.metrics.sampler_fused_dispatch_total.total() == 0
+    cfg, eng0 = _mk_engine(monkeypatch, fuse=True, depth=0)
+    fused = _run_workload(eng0, cfg)
+    assert eng0.metrics.sampler_fused_dispatch_total.total() > 0
+    assert [(ids, fr) for ids, _, fr in piped] \
+        == [(ids, fr) for ids, _, fr in fused]
+
+
+def test_fusion_disabled_for_spec_engines(monkeypatch):
+    """Speculative engines keep the classic spec-mixed dispatch (their
+    verify blocks don't ride the fused columns): the fused counter stays
+    zero and the run completes."""
+    cfg, eng = _mk_engine(monkeypatch, fuse=True, draft_model="tiny",
+                          draft_len=3)
+    outs = _run_workload(eng, cfg)
+    assert eng.metrics.sampler_fused_dispatch_total.total() == 0
+    assert all(fr == "length" for _, _, fr in outs)
