@@ -112,6 +112,14 @@ ROOTS = (
     # of blocking fetches — the host sync belongs to its
     # _pipe_resolve_one tail alone.
     (ENGINE, ENGINE_CLASS, "_step_fused"),
+    # Elastic resize: the reshard plan builds per-leaf device_put calls
+    # from live params at the drained boundary — issue-side by design
+    # (survivors are parked on host; a blocking fetch here stretches the
+    # drain window every in-flight stream is waiting out).  The warm-up
+    # issue helper runs right after the rebuild on the scheduler thread,
+    # before traffic returns — same no-sleep / no-serialization budget.
+    ("arks_tpu/models/weights.py", None, "reshard_params_to_mesh"),
+    (ENGINE, ENGINE_CLASS, "_issue_warmup_request"),
 )
 
 BOUNDARY_RE = re.compile(

@@ -134,6 +134,14 @@ class Controller:
         for t in self._threads:
             t.join(timeout=5)
 
+    def kick(self, name: str, namespace: str = "default") -> None:
+        """Enqueue an immediate reconcile of one primary object, outside
+        any watch event.  The elastic control loop uses this to force an
+        autoscaler evaluation the moment fresh overload evidence lands
+        (an SLO-burn spike mid-tick) instead of waiting out the ticker
+        interval; the workqueue's dedup makes redundant kicks free."""
+        self.queue.add((namespace, name))
+
     # -- loop ----------------------------------------------------------
 
     def _work(self) -> None:

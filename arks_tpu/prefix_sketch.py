@@ -204,6 +204,7 @@ class SketchExporter:
             raise ValueError("ARKS_ROUTER_SKETCH_* knobs must be positive")
         self._boot = os.urandom(4).hex()
         self._resets = 0
+        self._reset_reason: str | None = None
         self._builds = 0
         self._lock = threading.Lock()
         # text digest -> aligned token digest, LRU order (oldest first).
@@ -215,13 +216,16 @@ class SketchExporter:
     def epoch(self) -> str:
         return f"{self._boot}.{self._resets}"
 
-    def bump_epoch(self) -> None:
+    def bump_epoch(self, reason: str | None = None) -> None:
         """Reset/restart marker: the next exported sketch carries a new
         epoch, and pollers drop their pre-reset copy immediately (a fresh
-        cache must not keep winning on stale membership)."""
+        cache must not keep winning on stale membership).  ``reason``
+        ("resize", "rearm", ...) rides the next payloads' meta so a
+        router operator can tell an elastic epoch roll from a crash."""
         with self._lock:
             self._resets += 1
             self._cache = None
+            self._reset_reason = reason
             # The ledger maps text to token digests, not to residency —
             # it survives the reset like the host tier does.
 
@@ -315,6 +319,7 @@ class SketchExporter:
                 payload = {
                     "enabled": True,
                     "epoch": self.epoch,
+                    "epoch_reason": self._reset_reason,
                     "version": self._builds,
                     "built_unix": time.time(),
                     "page_tokens": self.page,

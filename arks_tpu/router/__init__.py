@@ -75,7 +75,14 @@ HDR_PEER_HINT = "X-Arks-Peer-Hint"
 
 
 class Discovery:
-    """mtime-cached backend lists from a discovery file (+ env fallback)."""
+    """mtime-cached backend lists from a discovery file (+ env fallback).
+
+    A programmatic overlay (``add``/``remove``) sits ON TOP of the file/
+    env lists: planned membership changes (Router.plan_join / plan_leave,
+    the elastic scale-up handoff) take effect immediately and survive file
+    reloads — the controller's discovery file catching up later is a
+    no-op, not a flap.  ``remove`` also MASKS a file-listed backend, so a
+    planned leave can run ahead of the file update."""
 
     def __init__(self, path: str | None):
         self.path = path
@@ -83,6 +90,27 @@ class Discovery:
         self._lock = threading.Lock()
         self._prefill: list[str] = _env_addrs("ARKS_PREFILL_ADDRS")
         self._decode: list[str] = _env_addrs("ARKS_DECODE_ADDRS")
+        self._extra: dict[str, list[str]] = {"prefill": [], "decode": []}
+        self._masked: dict[str, set[str]] = {"prefill": set(),
+                                             "decode": set()}
+
+    def add(self, role: str, addr: str) -> None:
+        """Admit ``addr`` to ``role`` ahead of the discovery file."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown backend role {role!r}")
+        with self._lock:
+            self._masked[role].discard(addr)
+            if addr not in self._extra[role]:
+                self._extra[role].append(addr)
+
+    def remove(self, role: str, addr: str) -> None:
+        """Withdraw ``addr`` from ``role`` (and mask it if file-listed)."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown backend role {role!r}")
+        with self._lock:
+            if addr in self._extra[role]:
+                self._extra[role].remove(addr)
+            self._masked[role].add(addr)
 
     def backends(self) -> tuple[list[str], list[str]]:
         if self.path and os.path.exists(self.path):
@@ -98,7 +126,13 @@ class Discovery:
             except (OSError, ValueError, json.JSONDecodeError):
                 log.warning("bad discovery file %s", self.path, exc_info=True)
         with self._lock:
-            return list(self._prefill), list(self._decode)
+            out = []
+            for role, base in (("prefill", self._prefill),
+                               ("decode", self._decode)):
+                merged = [a for a in base if a not in self._masked[role]]
+                merged += [a for a in self._extra[role] if a not in merged]
+                out.append(merged)
+            return out[0], out[1]
 
 
 def _env_addrs(name: str) -> list[str]:
@@ -365,6 +399,23 @@ class _SketchPoller:
         with self._lock:
             self._state.pop(addr, None)
 
+    def prime(self, addr: str) -> bool:
+        """Seed a joining backend's sketch BEFORE it enters routing (the
+        planned-membership handoff).  A prime is the backend's first
+        observation, so it NEVER counts as an epoch drop — the drop
+        counter stays reserved for restarts/resizes of an already-known
+        backend.  Returns True when a sketch (enabled or not) was
+        fetched and stored."""
+        payload = self._fetch(addr)
+        if payload is None:
+            return False
+        bs = sketch_mod.BackendSketch.from_payload(payload)
+        with self._lock:
+            self._state[addr] = {
+                "sketch": bs if bs.enabled else None,
+                "at": time.monotonic()}
+        return True
+
 
 class Router:
     def __init__(self, discovery: Discovery, served_model_name: str,
@@ -466,6 +517,84 @@ class Router:
         self.sketches.stop()
         if self._httpd:
             self._httpd.shutdown()
+
+    # ---- planned membership (elastic scale-up/down handoff) ----------
+
+    def plan_join(self, addr: str, role: str = "decode",
+                  timeout_s: float | None = None) -> dict:
+        """Admit a (re-)armed backend through a PLANNED handoff: gate on
+        its /readiness (a scaled-to-zero replica 503s until re-armed and
+        warm-up has been issued), prime its sketch drop-free, and only
+        then add it to routing — the joining replica never sees traffic
+        before it can serve, so a mid-workload join produces zero 5xx.
+        Returns join stats; raises TimeoutError when the backend never
+        went ready within ARKS_ELASTIC_JOIN_TIMEOUT_S."""
+        if timeout_s is None:
+            timeout_s = knobs.get_float("ARKS_ELASTIC_JOIN_TIMEOUT_S")
+        add = getattr(self.discovery, "add", None)
+        if add is None:
+            raise TypeError(
+                f"discovery {type(self.discovery).__name__} does not "
+                "support programmatic membership (plan_join needs "
+                "Discovery.add)")
+        t0 = time.monotonic()
+        polls = 0
+        deadline = t0 + max(timeout_s, 0.0)
+        while True:
+            polls += 1
+            if self._backend_ready(addr):
+                break
+            if time.monotonic() >= deadline:
+                self.metrics.planned_membership_total.inc(
+                    op="join", outcome="timeout")
+                raise TimeoutError(
+                    f"backend {addr} not ready after {timeout_s:.1f}s "
+                    "(ARKS_ELASTIC_JOIN_TIMEOUT_S)")
+            time.sleep(min(0.05, max(deadline - time.monotonic(), 0.0)))
+        primed = False
+        if self.sketch_on and role == "decode":
+            primed = self.sketches.prime(addr)
+        add(role, addr)
+        dt = time.monotonic() - t0
+        self.metrics.planned_membership_total.inc(op="join", outcome="ok")
+        self.metrics.join_seconds.set(dt, backend=addr)
+        log.info("planned join: %s role=%s ready after %d poll(s) in "
+                 "%.3fs (sketch primed=%s)", addr, role, polls, dt, primed)
+        return {"addr": addr, "role": role, "seconds": dt,
+                "ready_polls": polls, "sketch_primed": primed}
+
+    def plan_leave(self, addr: str, role: str = "decode") -> dict:
+        """Withdraw a backend from routing (scale-down / maintenance):
+        remove it from membership and drop its sketch so placement stops
+        crediting a cache that is about to disappear.  In-flight streams
+        on the leaving backend finish naturally — the router only stops
+        sending NEW work."""
+        remove = getattr(self.discovery, "remove", None)
+        if remove is None:
+            raise TypeError(
+                f"discovery {type(self.discovery).__name__} does not "
+                "support programmatic membership (plan_leave needs "
+                "Discovery.remove)")
+        remove(role, addr)
+        self.sketches.invalidate(addr)
+        self.metrics.planned_membership_total.inc(op="leave", outcome="ok")
+        log.info("planned leave: %s role=%s", addr, role)
+        return {"addr": addr, "role": role}
+
+    def _backend_ready(self, addr: str) -> bool:
+        host, _, port = addr.partition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port or 80),
+                                              timeout=2.0)
+            try:
+                conn.request("GET", "/readiness")
+                resp = conn.getresponse()
+                resp.read()
+                return resp.status == 200
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException, ValueError):
+            return False
 
     # ------------------------------------------------------------------
 

@@ -324,6 +324,11 @@ class OpenAIServer:
                     # snapshots only — the export never touches device
                     # data, same non-blocking discipline as spills).
                     self._json(200, server._sketch_payload())
+                elif self.path == "/v1/elastic/status":
+                    # Elastic state snapshot (armed, current shape, last
+                    # resize/rearm stats) — reachable even while the
+                    # replica is disarmed/draining, unlike /readiness.
+                    self._json(200, server._elastic_meta())
                 elif self.path == "/readiness":
                     # Multi-host gangs: only process 0 (the leader) accepts
                     # traffic — workers participate in collectives but must
@@ -342,6 +347,12 @@ class OpenAIServer:
                         # ("wedged"): pull this backend from Service
                         # endpoints; in-flight streams keep draining.
                         self._error(503, server.engine.state)
+                    elif not getattr(server.engine, "armed", True):
+                        # Scaled to zero: no device state exists.  The
+                        # router's planned join polls this gate — the
+                        # replica re-enters routing only once re-armed
+                        # (and warm-up issued) flips it back to 200.
+                        self._error(503, "scaled to zero (disarmed)")
                     else:
                         # Worker-wedge gate: a follower that is alive but
                         # hung (SIGSTOP, OOM-thrash) stops heartbeating on
@@ -359,10 +370,19 @@ class OpenAIServer:
                             # admission block is the saturation signal:
                             # edges read queue depth/drain here to back
                             # off BEFORE the bounded queue starts 503ing.
+                            # The admission block + per-tier SLO burn +
+                            # elastic state together are the autoscaler's
+                            # scrape surface (control.autoscaler.
+                            # scrape_signals) — live saturation/burn
+                            # drive scaling instead of raw RPM.
                             self._json(200, {"status": "ready",
                                              "sketch": server._sketch_meta(),
                                              "admission":
-                                                 server.engine.saturation()})
+                                                 server.engine.saturation(),
+                                             "slo_burn":
+                                                 server._slo_burn(),
+                                             "elastic":
+                                                 server._elastic_meta()})
                 else:
                     self._error(404, f"no route {self.path}")
 
@@ -380,6 +400,12 @@ class OpenAIServer:
                             body.get("logdir") or None))
                 if self.path == "/v1/profiler/stop":
                     return self._json(200, server.engine.profiler.stop())
+                if self.path == "/v1/elastic/resize":
+                    # Live topology resize / scale-from-zero re-arm
+                    # (operator + autoscaler actuator — exempt from the
+                    # drain gate like the profiler: a resize request must
+                    # land even while completions are gated).
+                    return server._handle_resize(self, body)
                 # Admission check and active-count increment are ATOMIC:
                 # drain() waiting for _active == 0 is then guaranteed no
                 # handler slips in after its last look.
@@ -499,6 +525,46 @@ class OpenAIServer:
                 "version": p.get("version"),
                 "age_s": round(max(0.0, time.time()
                                    - float(p.get("built_unix", 0.0))), 3)}
+
+    def _elastic_meta(self) -> dict:
+        """Elastic snapshot for /readiness and /v1/elastic/status."""
+        fn = getattr(self.engine, "elastic_status", None)
+        return fn() if callable(fn) else {"armed": True}
+
+    def _slo_burn(self) -> dict:
+        fn = getattr(self.engine, "slo_burn", None)
+        return fn() if callable(fn) else {}
+
+    def _handle_resize(self, h, body: dict) -> None:
+        """POST /v1/elastic/resize: {"tensor_parallel": N,
+        "data_parallel": M, "timeout_s": T}.  Posts the resize to the
+        engine's elastic state machine and waits (bounded) for it to
+        drain/reshard/resume; a resize posted to a scaled-to-zero replica
+        re-arms it at the requested shape (streaming scale-from-zero).
+        200 = resumed at the new shape, 202 = still in flight past the
+        wait budget, 409 = another resize in flight, 422 = shape refused
+        (fallback matrix, docs/application-usage.md)."""
+        fn = getattr(self.engine, "request_resize", None)
+        if not callable(fn):
+            return h._error(501, "engine has no elastic resize support")
+        try:
+            tp = body.get("tensor_parallel")
+            dp = body.get("data_parallel")
+            req = fn(tensor_parallel=None if tp is None else int(tp),
+                     data_parallel=None if dp is None else int(dp))
+        except (ValueError, TypeError) as e:
+            return h._error(400, str(e))
+        except RuntimeError as e:
+            return h._error(409, str(e))
+        timeout_s = float(body.get("timeout_s", 120.0))
+        if not req.wait(timeout_s):
+            return h._json(202, {"status": "pending",
+                                 "elastic": self._elastic_meta()})
+        payload = {"status": req.outcome, "seconds": req.seconds,
+                   "error": str(req.error) if req.error else None,
+                   "elastic": self._elastic_meta()}
+        code = {"ok": 200, "rejected": 422}.get(req.outcome, 500)
+        h._json(code, payload)
 
     def _models_payload(self) -> dict:
         data = [{

@@ -303,6 +303,44 @@ _k("ARKS_ROUTER_SKETCH_LINKS", "int", "4096",
    "Max parent->child digest links kept in the sketch chain index.",
    "router")
 
+# -------------------------------------------------------------- elastic
+_k("ARKS_ELASTIC_COOLDOWN_S", "float", "30",
+   "Minimum seconds between autoscaler-driven elastic actions on one "
+   "application (scale-up-from-zero is exempt).", "elastic")
+_k("ARKS_ELASTIC_BURN_HI", "float", "1.0",
+   "SLO burn rate above which the signals-mode autoscaler scales up "
+   "even when RPM alone would not.", "elastic")
+_k("ARKS_ELASTIC_BURN_LO", "float", "0.25",
+   "SLO burn rate below which (together with ARKS_ELASTIC_SAT_LO) "
+   "signals-mode scale-down becomes eligible.", "elastic")
+_k("ARKS_ELASTIC_SAT_HI", "float", "0.9",
+   "Admission saturation above which the signals-mode autoscaler "
+   "scales up.", "elastic")
+_k("ARKS_ELASTIC_SAT_LO", "float", "0.3",
+   "Admission saturation below which (together with "
+   "ARKS_ELASTIC_BURN_LO) signals-mode scale-down becomes eligible.",
+   "elastic")
+_k("ARKS_ELASTIC_IDLE_ZERO_S", "float", "0",
+   "Idle seconds after which a fully drained engine scales itself to "
+   "zero (drops params + device KV, keeps host/disk prefix tiers); "
+   "0 = never.", "elastic")
+_k("ARKS_ELASTIC_WARMUP", "bool", "1",
+   "Issue a self-enqueued warm-up request after a live resize or a "
+   "scale-from-zero re-arm, before external traffic hits the new "
+   "shape.", "elastic")
+_k("ARKS_ELASTIC_JOIN_TIMEOUT_S", "float", "10",
+   "Seconds the router's planned membership handoff waits for a "
+   "joining backend's /readiness to go green before giving up.",
+   "elastic")
+_k("ARKS_SLO_BURN_WINDOW_S", "float", "60",
+   "Rolling window (seconds) over which the engine computes per-tier "
+   "SLO burn rates for /readiness and the signals-mode autoscaler.",
+   "elastic")
+_k("ARKS_SLO_ERROR_BUDGET", "float", "0.1",
+   "Allowed fraction of requests missing their tier's ttft_ms target; "
+   "burn rate = observed violation fraction / this budget (1.0 = "
+   "burning exactly at budget).", "elastic")
+
 # ------------------------------------------------------------------ obs
 _k("ARKS_TRACE", "bool", "1",
    "Request tracing (span timelines, flight recorder); 0 disables.",

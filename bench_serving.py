@@ -2092,12 +2092,373 @@ def run_multi_model_bench() -> dict:
     return out
 
 
+def run_elastic_bench() -> dict:
+    """``--workload elastic``: the elastic-parallelism acceptance bench
+    (CPU mechanics).  Three phases, each asserting an acceptance claim
+    from the PR in-bench:
+
+    1. **Live resize mid-workload** — greedy streams decode on a tp1
+       engine, a resize to tp2 posts mid-stream, and every surviving
+       stream must be byte-identical to a never-resized run (greedy
+       only: sampled streams are distribution-exact across a TP change,
+       not byte-exact — psum reduction order).  Reports
+       ``resize_to_first_token_s``: resize POST to the first token
+       emitted at the new shape.
+    2. **Streaming scale-from-zero + planned join** — replica B idles
+       to zero behind a real OpenAIServer; a workload runs against the
+       router (replica A only); B re-arms over POST /v1/elastic/resize
+       and joins through Router.plan_join.  Asserts ZERO client-visible
+       failures across the handoff and reports
+       ``scale_from_zero_to_first_token_s``.
+    3. **Autoscaler SLO-burn rescue** — a flood against A alone drives
+       its per-tier SLO burn over the high-water mark; the signals-mode
+       AutoscalerController scales the Application 1 -> 2 and its
+       actuator re-arms + joins B inline.  Asserts the burn rate DROPS
+       after the rescue (the loop closed).
+
+    Env knobs: ARKS_BENCH_ELASTIC_MODEL (default tiny),
+    ARKS_BENCH_ELASTIC_FLOOD (phase-3 client threads, default 8),
+    ARKS_BENCH_ELASTIC_TTFT_MS (phase-3 tier target, default 600)."""
+    import queue as queue_mod
+    import threading
+    import urllib.error
+
+    from arks_tpu.engine import (EngineConfig, InferenceEngine, Request,
+                                 SamplingParams)
+    from arks_tpu.engine.tokenizer import ByteTokenizer
+    from arks_tpu.models import get_config
+    from arks_tpu.router import Discovery, Router
+    from arks_tpu.server import OpenAIServer
+
+    model = os.environ.get("ARKS_BENCH_ELASTIC_MODEL", "tiny")
+    cfg = get_config(model)
+    os.environ["ARKS_MIXED_STEP"] = "auto"
+    os.environ.pop("ARKS_ELASTIC_IDLE_ZERO_S", None)
+
+    def _mk(**kw):
+        defaults = dict(model=model, num_slots=2, max_cache_len=128,
+                        prefill_buckets=(16, 32), steps_per_dispatch=4,
+                        prefill_chunk=16, kv_layout="paged")
+        defaults.update(kw)
+        return InferenceEngine(cfg, EngineConfig(**defaults),
+                               ByteTokenizer())
+
+    def _greedy(rid, prompt, max_tokens=16):
+        return Request(rid, [int(x) % cfg.vocab_size for x in prompt],
+                       SamplingParams(max_tokens=max_tokens,
+                                      temperature=0.0, ignore_eos=True))
+
+    def _collect(req):
+        toks, fin = [], None
+        while True:
+            out = req.outputs.get(timeout=300)
+            toks.extend(out.token_ids)
+            if out.finished:
+                fin = out
+                break
+        return toks, fin.finish_reason
+
+    # ---- phase 1: live resize mid-workload ---------------------------
+
+    def _phase_resize() -> dict:
+        def _run(resize: bool):
+            eng = _mk()
+            reqs = [_greedy(f"r{i}", p) for i, p in
+                    enumerate([[5, 6, 7], [9] * 5])]
+            for r in reqs:
+                eng.add_request(r)
+            for _ in range(60):
+                try:
+                    eng.step(block_s=0.01)
+                except Exception as e:  # noqa: BLE001
+                    eng._recover_from_fault(e)
+                if eng._slots:
+                    break
+            hold = t_post = None
+            snap = t_first = None
+            if resize:
+                t_post = time.perf_counter()
+                hold = eng.request_resize(tensor_parallel=2)
+            for _ in range(4000):
+                try:
+                    eng.step(block_s=0.01)
+                except Exception as e:  # noqa: BLE001
+                    eng._recover_from_fault(e)
+                if hold is not None and hold.outcome is not None:
+                    if snap is None:
+                        snap = [r.outputs.qsize() for r in reqs]
+                    elif t_first is None and any(
+                            r.outputs.qsize() > s
+                            for r, s in zip(reqs, snap)):
+                        t_first = time.perf_counter()
+                if (eng._resize_req is None and not eng._swapped
+                        and not eng._swap_pending and not eng._spills
+                        and eng.num_running == 0 and eng._queue.empty()
+                        and not eng._prefilling
+                        and not eng._awaiting_restore
+                        and eng.state == "serving"):
+                    break
+            outs = [_collect(r) for r in reqs]
+            ttf = (t_first - t_post) if (t_first and t_post) else None
+            return outs, eng, hold, ttf
+
+        base, _, _, _ = _run(resize=False)
+        got, eng, hold, ttf = _run(resize=True)
+        assert hold.outcome == "ok", hold.error
+        assert got == base, \
+            "greedy streams diverged across the live resize"
+        stats = eng.last_resize_stats
+        assert stats["to"] == "tp2xdp1"
+        return {
+            "resize_streams_identical": True,
+            "resize_from": stats["from"], "resize_to": stats["to"],
+            "resize_seconds": round(stats["seconds"], 4),
+            "resize_drain_seconds": round(stats["drain_seconds"], 4),
+            "resize_swapped_streams": stats["swapped"],
+            "resize_to_first_token_s": round(ttf, 4) if ttf else None,
+        }
+
+    # ---- shared HTTP plumbing for phases 2 and 3 ---------------------
+
+    def _post_json(port, path, body, timeout=300):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+
+    def _wait_disarmed(eng, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while eng.armed and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not eng.armed, "replica never scaled to zero"
+
+    def _mk_replica(idle_zero=None, slots=2):
+        if idle_zero is None:
+            os.environ.pop("ARKS_ELASTIC_IDLE_ZERO_S", None)
+        else:
+            os.environ["ARKS_ELASTIC_IDLE_ZERO_S"] = str(idle_zero)
+        eng = _mk(num_slots=slots)
+        eng.start()
+        srv = OpenAIServer(eng, served_model_name=model,
+                           host="127.0.0.1", port=0)
+        srv.start(background=True)
+        os.environ.pop("ARKS_ELASTIC_IDLE_ZERO_S", None)
+        return eng, srv
+
+    def _mk_router(decode):
+        os.environ["ARKS_PREFILL_ADDRS"] = ""
+        os.environ["ARKS_DECODE_ADDRS"] = decode
+        os.environ["ARKS_ROUTER_RETRY_BACKOFF_S"] = "0.01"
+        os.environ["ARKS_ROUTER_SKETCH_POLL_S"] = "60"
+        r = Router(Discovery(None), model, host="127.0.0.1", port=0,
+                   policy="cache_aware", unified=True)
+        r.start(background=True)
+        return r
+
+    class _Flood:
+        """Closed-loop client threads against the router; every failure
+        (non-2xx or raise) is recorded — the zero-5xx assertion."""
+
+        def __init__(self, port, clients, max_tokens=8):
+            self.port, self.clients = port, clients
+            self.max_tokens = max_tokens
+            self.failures: list = []
+            self.completions = 0
+            self._done = threading.Event()
+            self._threads: list[threading.Thread] = []
+            self._lock = threading.Lock()
+
+        def _one(self, tid, n):
+            body = json.dumps({
+                "model": model, "prompt": [1 + tid, 2, 3, n % 97],
+                "max_tokens": self.max_tokens, "temperature": 0,
+                "ignore_eos": True}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    if resp.status != 200:
+                        self.failures.append(resp.status)
+                    else:
+                        resp.read()
+                        with self._lock:
+                            self.completions += 1
+            except Exception as e:  # noqa: BLE001
+                self.failures.append(repr(e))
+
+        def start(self):
+            def loop(tid):
+                n = 0
+                while not self._done.is_set():
+                    n += 1
+                    self._one(tid, n)
+            for tid in range(self.clients):
+                t = threading.Thread(target=loop, args=(tid,), daemon=True)
+                t.start()
+                self._threads.append(t)
+
+        def stop(self):
+            self._done.set()
+            for t in self._threads:
+                t.join(timeout=60)
+
+    # ---- phase 2: scale-from-zero + planned membership handoff -------
+
+    def _phase_scale_from_zero() -> dict:
+        a_eng, a_srv = _mk_replica()
+        b_eng, b_srv = _mk_replica(idle_zero=0.05)
+        r = _mk_router(f"127.0.0.1:{a_srv.port}")
+        flood = _Flood(r.port, clients=2)
+        try:
+            _wait_disarmed(b_eng)
+            flood.start()
+            time.sleep(0.2)
+            t0 = time.perf_counter()
+            code, out = _post_json(b_srv.port, "/v1/elastic/resize",
+                                   {"tensor_parallel": 1})
+            assert code == 200 and out["status"] == "ok", out
+            join = r.plan_join(f"127.0.0.1:{b_srv.port}")
+            # First token at the re-armed replica, through the planned
+            # membership (warm-up already compiled the programs).
+            code, comp = _post_json(b_srv.port, "/v1/completions", {
+                "model": model, "prompt": [4, 5, 6], "max_tokens": 1,
+                "temperature": 0, "ignore_eos": True})
+            t_first = time.perf_counter()
+            assert code == 200
+            time.sleep(0.3)   # post-join traffic crosses the handoff
+        finally:
+            flood.stop()
+            r.stop()
+            for srv, eng in ((a_srv, a_eng), (b_srv, b_eng)):
+                srv.stop()
+                eng.stop()
+        assert not flood.failures, \
+            f"client-visible failures across the handoff: {flood.failures[:5]}"
+        assert flood.completions > 0
+        return {
+            "zero_handoff_failures": 0,
+            "zero_handoff_completions": flood.completions,
+            "scale_from_zero_to_first_token_s": round(t_first - t0, 4),
+            "rearm_seconds": round(
+                out["elastic"]["last_rearm"]["seconds"], 4),
+            "join_seconds": round(join["seconds"], 4),
+            "rearm_streamed": out["elastic"]["last_rearm"]["streamed"],
+        }
+
+    # ---- phase 3: autoscaler-closed SLO-burn rescue ------------------
+
+    def _phase_autoscaler_rescue() -> dict:
+        from arks_tpu.control import resources as res
+        from arks_tpu.control.autoscaler import (AutoscalerController,
+                                                 fleet_signals,
+                                                 scrape_signals)
+        from arks_tpu.control.store import Store
+
+        # 600ms: the 8-client flood on one 2-slot replica queues TTFT
+        # well past it (measured ~900ms mean on the CPU tiny engine);
+        # split across two replicas it sits well under (~350ms).
+        ttft_ms = os.environ.get("ARKS_BENCH_ELASTIC_TTFT_MS", "600")
+        clients = int(os.environ.get("ARKS_BENCH_ELASTIC_FLOOD", "8"))
+        os.environ["ARKS_SLO_TIERS"] = f"rt:ttft_ms={ttft_ms}"
+        os.environ["ARKS_SLO_BURN_WINDOW_S"] = "3"
+        try:
+            a_eng, a_srv = _mk_replica()
+            b_eng, b_srv = _mk_replica(idle_zero=0.05)
+        finally:
+            os.environ.pop("ARKS_SLO_TIERS", None)
+            os.environ.pop("ARKS_SLO_BURN_WINDOW_S", None)
+        a_addr = f"127.0.0.1:{a_srv.port}"
+        b_addr = f"127.0.0.1:{b_srv.port}"
+        r = _mk_router(a_addr)
+        rescue_t: list[float] = []
+
+        def actuator(app, desired, sig):
+            t0 = time.perf_counter()
+            code, out = _post_json(b_srv.port, "/v1/elastic/resize",
+                                   {"tensor_parallel": 1})
+            assert code == 200 and out["status"] == "ok", out
+            r.plan_join(b_addr)
+            rescue_t.append(time.perf_counter() - t0)
+
+        store = Store()
+        app = store.create(res.Application(name="fleet", spec={
+            "replicas": 1, "servedModelName": model,
+            "autoscale": {"minReplicas": 1, "maxReplicas": 2,
+                          "scaleDownStabilizationSeconds": 3600},
+        }))
+        ctl = AutoscalerController(
+            store, rate_source=lambda ns, m: 0.0,
+            signals_source=lambda ns, m: fleet_signals([a_addr, b_addr]),
+            actuator=actuator)
+        flood = _Flood(r.port, clients=clients, max_tokens=24)
+        try:
+            _wait_disarmed(b_eng)
+            flood.start()
+            # The flood against A alone drives its burn over the mark.
+            burn_before = 0.0
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                sig = scrape_signals(a_addr) or {}
+                burn_before = max(burn_before, sig.get("burn", 0.0))
+                if burn_before >= 1.0:
+                    break
+                time.sleep(0.2)
+            assert burn_before >= 1.0, \
+                f"flood never induced an SLO burn (peak {burn_before})"
+            pre = fleet_signals([a_addr, b_addr])
+            # One reconcile closes the loop: signal_high -> replicas 2,
+            # actuator re-arms + joins B.
+            ctl.reconcile(store.get(res.Application, "fleet"))
+            app = store.get(res.Application, "fleet")
+            assert app.spec["replicas"] == 2, app.status
+            assert app.status["autoscale"]["reason"] == "signal_high"
+            assert rescue_t, "the actuator never ran"
+            assert b_eng.armed, "the rescue did not re-arm replica B"
+            # The burn window (3s) rolls past the pre-rescue violations
+            # while the flood now splits across two replicas.
+            time.sleep(4.0)
+            after = fleet_signals([a_addr, b_addr])
+            burn_after = after["burn"]
+        finally:
+            flood.stop()
+            r.stop()
+            for srv, eng in ((a_srv, a_eng), (b_srv, b_eng)):
+                srv.stop()
+                eng.stop()
+        assert not flood.failures, \
+            f"client-visible failures during the rescue: {flood.failures[:5]}"
+        assert burn_after < burn_before, (
+            f"the scale-up did not drop the burn rate: "
+            f"{burn_before} -> {burn_after}")
+        return {
+            "rescue_burn_before": round(burn_before, 3),
+            "rescue_burn_after": round(burn_after, 3),
+            "rescue_burn_dropped": True,
+            "rescue_replicas": app.spec["replicas"],
+            "rescue_actuation_s": round(rescue_t[0], 4),
+            "rescue_disarmed_before": int(pre.get("disarmed", 0)),
+            "rescue_ttft_target_ms": float(ttft_ms),
+            "rescue_flood_clients": clients,
+            "rescue_completions": flood.completions,
+        }
+
+    out = {"workload": "elastic", "elastic_model": model}
+    out.update(_phase_resize())
+    out.update(_phase_scale_from_zero())
+    out.update(_phase_autoscaler_rescue())
+    return out
+
+
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload",
                     choices=("default", "shared-prefix", "multi-model",
-                             "slo-tiers", "multi-tenant", "long-context"),
+                             "slo-tiers", "multi-tenant", "long-context",
+                             "elastic"),
                     default="default")
     ap.add_argument("--backends", type=int, default=1,
                     help="shared-prefix only: N>1 runs the multi-backend "
@@ -2144,6 +2505,10 @@ def main() -> None:
     if args.workload == "long-context":
         print(json.dumps({"metric": "long_context_serving",
                           **run_long_context_bench()}))
+        return
+    if args.workload == "elastic":
+        print(json.dumps({"metric": "elastic_serving",
+                          **run_elastic_bench()}))
         return
     print(json.dumps({
         "metric": "serving_throughput",

@@ -783,3 +783,148 @@ def test_decode_fault_while_another_request_prefills(monkeypatch):
     assert got == base
     assert sum(eng.metrics.requests_recovered_total._values.values()) == 2
     assert eng.state == "serving"
+
+
+# ---- elastic resize (live topology change) ---------------------------
+
+
+def _drive_elastic(eng, n_steps=3000):
+    """_drive, but quiet also requires the resize machinery to be done:
+    no in-flight resize request and no swapped victims awaiting restore
+    (the plain quiet check reads num_running == 0 at the drained
+    boundary and would bail mid-resize)."""
+    for _ in range(n_steps):
+        try:
+            eng.step(block_s=0.01)
+        except Exception as e:  # noqa: BLE001 — routed like _run_loop
+            eng._recover_from_fault(e)
+        if (eng._resize_req is None and not eng._swapped
+                and not eng._swap_pending and not eng._spills
+                and eng.num_running == 0 and eng._queue.empty()
+                and not eng._prefilling and not eng._awaiting_fetch
+                and not eng._awaiting_restore and eng.state == "serving"):
+            break
+
+
+def _resize_scenario(monkeypatch, depth, inject=None, retries=None,
+                     resize=True, tp=2):
+    """Mid-stream live resize: two ALL-GREEDY streams decode on the
+    paged-mixed engine, a tp1 -> tp{tp} resize posts once both hold
+    slots, and the drive runs the drain/reshard/resume machinery to
+    completion.  Greedy only: byte-identity across a TP change holds
+    for argmax streams (sampled streams are distribution-exact, not
+    byte-exact — the psum reduction order shifts with the mesh)."""
+    cfg, eng = _mk_engine(monkeypatch, depth, "auto", inject=inject,
+                          retries=retries, prefill_chunk=16,
+                          kv_layout="paged")
+    reqs = [Request(f"r{i}", [int(x) % cfg.vocab_size for x in p],
+                    SamplingParams(max_tokens=14, temperature=0.0,
+                                   ignore_eos=True))
+            for i, p in enumerate([[5, 6, 7], [9] * 5])]
+    for r in reqs:
+        eng.add_request(r)
+    for _ in range(60):
+        try:
+            eng.step(block_s=0.01)
+        except Exception as e:  # noqa: BLE001 — routed like _run_loop
+            eng._recover_from_fault(e)
+        if eng._slots:
+            break
+    assert eng._slots, "streams never reached slots before the resize"
+    hold = eng.request_resize(tensor_parallel=tp) if resize else None
+    _drive_elastic(eng, n_steps=3000)
+    outs = [_collect(r) for r in reqs]
+    return outs, eng, hold
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_live_resize_preserves_streams_byte_identical(monkeypatch, depth):
+    """A tp1 -> tp2 live resize posted MID-STREAM: both greedy streams
+    finish byte-identical to a run that never resized, the request
+    completes "ok", and the engine reports the new shape — at pipeline
+    depths 0 and 2."""
+    base, _, _ = _resize_scenario(monkeypatch, depth, resize=False)
+    got, eng, hold = _resize_scenario(monkeypatch, depth)
+    assert hold.outcome == "ok", hold.error
+    assert [f.finish_reason for _, f in got] == ["length", "length"]
+    assert got == base, "streams diverged across the live resize"
+    assert eng._mesh_shape_str() == "tp2xdp1"
+    stats = eng.last_resize_stats
+    assert stats and stats["from"] == "tp1xdp1" and stats["to"] == "tp2xdp1"
+    assert stats["seconds"] > 0
+    assert eng.metrics.engine_resizes_total.get(
+        mode="resize", outcome="ok") == 1
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+@pytest.mark.parametrize("seam,expect_shape", [
+    (1, "tp1xdp1"),   # drain seam: fault before the reshard -> old shape
+    (2, "tp1xdp1"),   # reshard seam: plan ran, commit didn't -> old shape
+    (3, "tp2xdp1"),   # resume seam: commit landed -> recover at NEW shape
+], ids=["drain", "reshard", "resume"])
+def test_resize_seam_fault_recovers_streams_byte_identical(
+        monkeypatch, depth, seam, expect_shape):
+    """A fault injected at each resize seam (drain / reshard / resume):
+    the resize request reports "error", recovery lands at the expected
+    shape (old for the first two seams, new for the last), and EVERY
+    stream still finishes byte-identical to the never-resized run —
+    nobody is quarantined (the resize serves no specific request)."""
+    base, _, _ = _resize_scenario(monkeypatch, depth, resize=False)
+    got, eng, hold = _resize_scenario(
+        monkeypatch, depth, inject=f"resize:{seam}:runtime")
+    assert hold.outcome == "error"
+    assert [f.finish_reason for _, f in got] == ["length", "length"]
+    assert got == base, "streams diverged after the resize-seam fault"
+    assert eng._mesh_shape_str() == expect_shape
+    assert eng.metrics.engine_faults_total.get(
+        phase="resize", kind="injected") == 1
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+def test_resize_seam_fault_zero_retries_quarantines_nobody(monkeypatch):
+    """Even with a ZERO retry budget a resize-seam fault quarantines
+    NOBODY: the drained streams were preserved (swapped or re-queued)
+    before the seam fired, so the culprit set is empty and every stream
+    replays to a byte-identical finish."""
+    base, _, _ = _resize_scenario(monkeypatch, 0, resize=False)
+    got, eng, hold = _resize_scenario(monkeypatch, 0,
+                                      inject="resize:2:runtime", retries=0)
+    assert hold.outcome == "error"
+    assert [f.finish_reason for _, f in got] == ["length", "length"]
+    assert got == base
+    assert sum(eng.metrics.requests_quarantined_total._values.values()) == 0
+    assert eng.state == "serving"
+
+
+@pytest.mark.slow
+def test_randomized_resize_sweep(monkeypatch):
+    """Randomized resize chaos: each round posts a mid-stream resize
+    with a fault at a random seam, optionally stacked with a decode
+    fault.  Per-stream integrity must hold every round — each stream
+    either matches the never-resized run exactly or fails alone with an
+    engine_fault error — and the engine always returns to "serving" at
+    a coherent shape."""
+    base, _, _ = _resize_scenario(monkeypatch, 0, resize=False)
+    base_by_rid = {fin.request_id: (ids, fin.finish_reason)
+                   for ids, fin in base}
+    rng = random.Random(4321)
+    for round_i in range(5):
+        specs = [f"resize:{rng.randint(1, 3)}:runtime"]
+        if rng.random() < 0.5:
+            specs.append(f"decode:{rng.randint(1, 4)}:runtime")
+        spec = ",".join(specs)
+        got, eng, hold = _resize_scenario(monkeypatch, 0, inject=spec)
+        for ids, fin in got:
+            if fin.finish_reason == "error":
+                assert fin.error.startswith("engine_fault"), \
+                    f"round {round_i} ({spec}): unexpected error {fin.error}"
+                continue
+            assert (ids, fin.finish_reason) == base_by_rid[fin.request_id], \
+                f"round {round_i} ({spec}): stream integrity violated"
+        assert hold.outcome in ("ok", "error"), f"round {round_i} ({spec})"
+        assert eng.state == "serving", f"round {round_i} ({spec})"
+        assert eng._mesh_shape_str() in ("tp1xdp1", "tp2xdp1"), \
+            f"round {round_i} ({spec}): incoherent shape"
